@@ -1,0 +1,142 @@
+"""deepspeed_tpu — a TPU-native training/inference framework with the
+capabilities of DeepSpeed (reference v0.4.5), re-designed for JAX/XLA:
+SPMD named-axis meshes instead of process groups, sharding rules instead
+of optimizer-wrapper hooks (ZeRO 1-3), Pallas kernels instead of CUDA,
+XLA collectives over ICI instead of NCCL.
+
+Public API mirrors the reference's ``deepspeed/__init__.py``:
+``initialize`` (:58), ``init_inference`` (:227), ``init_distributed``,
+``add_config_arguments`` (:211).
+"""
+from __future__ import annotations
+
+import argparse
+from typing import Any, Callable, Optional, Tuple
+
+from deepspeed_tpu.version import __version__
+from deepspeed_tpu.comm.distributed import init_distributed
+from deepspeed_tpu.config.config import DeepSpeedConfig, DeepSpeedConfigError
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+__git_hash__ = None
+__git_branch__ = None
+
+
+def initialize(
+    args=None,
+    model: Optional[Callable] = None,
+    model_parameters: Any = None,
+    optimizer: Any = None,
+    training_data: Any = None,
+    lr_scheduler: Any = None,
+    mesh=None,
+    tp_spec_fn=None,
+    loss_fn: Optional[Callable] = None,
+    dist_init_required: Optional[bool] = None,
+    collate_fn: Optional[Callable] = None,
+    config: Any = None,
+    config_params: Any = None,
+):
+    """Build a ready-to-train engine.
+
+    Reference signature preserved (``deepspeed/__init__.py:58-157``) with
+    TPU-native meanings:
+
+    * ``model`` — callable ``(params, batch, rng) -> loss`` (or outputs if
+      ``loss_fn`` is given).  Flax modules: pass
+      ``lambda p, b, rng: module.apply({'params': p}, b, rngs={'dropout': rng})``.
+    * ``model_parameters`` — the initial parameter pytree (the reference
+      passes ``model.parameters()`` here).
+    * ``config`` — dict or path to a DeepSpeed-style JSON config.
+    * ``mesh`` — optional prebuilt ``jax.sharding.Mesh``; default built
+      from the config's ``mesh`` block over all devices.
+
+    Returns ``(engine, optimizer, dataloader, lr_scheduler)``.
+    """
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+    from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+    from deepspeed_tpu.comm.mesh import MeshInfo, make_mesh
+
+    if config is None and config_params is not None:
+        config = config_params
+    if config is None and args is not None and hasattr(args, "deepspeed_config") and args.deepspeed_config:
+        config = args.deepspeed_config
+    if config is None:
+        raise DeepSpeedConfigError("initialize() needs `config` (dict or json path)")
+    if model is None:
+        raise ValueError("initialize() needs `model` (callable (params, batch, rng) -> loss/outputs)")
+    if model_parameters is None:
+        raise ValueError("initialize() needs `model_parameters` (initial parameter pytree)")
+
+    if dist_init_required is None or dist_init_required:
+        init_distributed(verbose=False)
+
+    # Parse config twice-cheaply: once to get the mesh block, then with the
+    # resolved dp world size for the batch triad.
+    pre = DeepSpeedConfig(config, world_size=1)
+    if mesh is None:
+        mesh = make_mesh(pre.mesh)
+    info = MeshInfo.from_mesh(mesh)
+    ds_config = DeepSpeedConfig(config, world_size=info.dp_world_size)
+
+    engine = DeepSpeedEngine(
+        model=model,
+        params=model_parameters,
+        config=ds_config,
+        optimizer=optimizer,
+        lr_scheduler=lr_scheduler,
+        mesh=mesh,
+        tp_spec_fn=tp_spec_fn,
+        loss_fn=loss_fn,
+        dist_init_required=dist_init_required,
+    )
+
+    dataloader = None
+    if training_data is not None:
+        import jax
+
+        local_dp = max(1, info.dp_world_size // jax.process_count())
+        dataloader = DeepSpeedDataLoader(
+            training_data,
+            batch_size=ds_config.train_micro_batch_size_per_gpu * local_dp,
+            shuffle=True,
+            seed=ds_config.seed,
+            drop_last=ds_config.dataloader_drop_last,
+            collate_fn=collate_fn,
+        )
+
+    return engine, engine.optimizer, dataloader, engine.lr_schedule
+
+
+def init_inference(model=None, **kwargs):
+    """Reference ``init_inference`` (:227) — builds an InferenceEngine."""
+    from deepspeed_tpu.inference.engine import InferenceEngine
+
+    return InferenceEngine(model=model, **kwargs)
+
+
+def add_config_arguments(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    """Reference ``add_config_arguments`` (:211): the standard argparse
+    group so recipes keep their ``--deepspeed --deepspeed_config x.json``
+    flags."""
+    group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
+    group.add_argument(
+        "--deepspeed",
+        default=False,
+        action="store_true",
+        help="Enable DeepSpeed (helper flag for user code, no impact on engine)",
+    )
+    group.add_argument("--deepspeed_config", default=None, type=str, help="DeepSpeed json configuration file")
+    group.add_argument(
+        "--deepscale",
+        default=False,
+        action="store_true",
+        help="Deprecated enable DeepSpeed (helper flag for user code, no impact on engine)",
+    )
+    group.add_argument("--deepscale_config", default=None, type=str, help="Deprecated DeepSpeed json configuration file")
+    group.add_argument("--local_rank", default=-1, type=int, help="Reserved for compatibility; unused on TPU")
+    return parser
+
+
+# `zero` namespace for reference-style `with deepspeed.zero.Init()` usage.
+from deepspeed_tpu.runtime.zero import api as zero  # noqa: E402

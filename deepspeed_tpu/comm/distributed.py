@@ -1,0 +1,97 @@
+"""Multi-host bootstrap.
+
+Analog of the reference's ``deepspeed/utils/distributed.py``
+(``init_distributed`` :12, ``mpi_discovery`` :54): maps environment/MPI
+rank discovery onto ``jax.distributed.initialize``.  On a TPU pod the
+runtime usually auto-discovers peers; env-var and MPI fallbacks cover
+CPU/GPU clusters and manual launches.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+_initialized = False
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def init_distributed(
+    dist_backend: str = "xla",
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    auto_mpi_discovery: bool = True,
+    verbose: bool = True,
+) -> None:
+    """Initialize the JAX distributed runtime (idempotent).
+
+    Single-process runs (num_processes==1, or no cluster env present) skip
+    initialization entirely — SPMD over local devices needs none.
+    """
+    global _initialized
+    if _initialized:
+        return
+
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("MASTER_ADDR")
+        port = os.environ.get("MASTER_PORT", "29500")
+        if coordinator_address is not None:
+            coordinator_address = f"{coordinator_address}:{port}"
+    if num_processes is None and "WORLD_SIZE" in os.environ:
+        num_processes = int(os.environ["WORLD_SIZE"])
+    if process_id is None and "RANK" in os.environ:
+        process_id = int(os.environ["RANK"])
+
+    if (num_processes is None or process_id is None) and auto_mpi_discovery:
+        mpi = mpi_discovery()
+        if mpi is not None:
+            num_processes = num_processes or mpi["world_size"]
+            process_id = process_id if process_id is not None else mpi["rank"]
+            coordinator_address = coordinator_address or f"{mpi['master_addr']}:29500"
+
+    import jax
+
+    if num_processes is None or num_processes <= 1:
+        # Single process: nothing to do; jax.devices() already works.
+        _initialized = True
+        if verbose:
+            logger.info("init_distributed: single-process run, skipping jax.distributed")
+        return
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    if verbose:
+        logger.info(
+            f"init_distributed: process {process_id}/{num_processes} via {coordinator_address} "
+            f"({jax.device_count()} global devices)"
+        )
+
+
+def mpi_discovery() -> Optional[dict]:
+    """Map OpenMPI/MVAPICH env vars to rank info (reference
+    ``utils/distributed.py:54-96``), without importing mpi4py."""
+    env = os.environ
+    if "OMPI_COMM_WORLD_RANK" in env:
+        return {
+            "rank": int(env["OMPI_COMM_WORLD_RANK"]),
+            "world_size": int(env["OMPI_COMM_WORLD_SIZE"]),
+            "local_rank": int(env.get("OMPI_COMM_WORLD_LOCAL_RANK", 0)),
+            "master_addr": env.get("MASTER_ADDR", "127.0.0.1"),
+        }
+    if "MV2_COMM_WORLD_RANK" in env:
+        return {
+            "rank": int(env["MV2_COMM_WORLD_RANK"]),
+            "world_size": int(env["MV2_COMM_WORLD_SIZE"]),
+            "local_rank": int(env.get("MV2_COMM_WORLD_LOCAL_RANK", 0)),
+            "master_addr": env.get("MASTER_ADDR", "127.0.0.1"),
+        }
+    return None

@@ -1,0 +1,141 @@
+"""Named-axis SPMD device mesh.
+
+TPU-native replacement for the reference's process-group plumbing
+(``runtime/pipe/topology.py`` grids + ``torch.distributed`` groups,
+SURVEY.md §2.6): one ``jax.sharding.Mesh`` with named axes replaces every
+process group.  Axis names:
+
+* ``pipe``   — pipeline stages (reference PP axis)
+* ``data``   — pure data parallel (gradients all-reduced)
+* ``fsdp``   — ZeRO/FSDP axis: params/grads/opt-state sharded here
+               (reference's ZeRO partitioning over the DP group)
+* ``seq``    — sequence/context parallel (ring attention)
+* ``model``  — tensor parallel (reference's mpu "model"/"slice" axis)
+* ``expert`` — expert parallel (MoE)
+
+The reference's ZeRO partitions over the *entire* DP group; here the DP
+group is factored into ``data × fsdp`` so ZeRO stage selection is a
+sharding-rule choice, not a different optimizer class.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.config.config import MeshConfig
+from deepspeed_tpu.utils.logging import logger
+
+# Canonical axis order: outermost (slowest-varying, most DCN-tolerant) first.
+# pipe and data tolerate slower links; model/seq need the fastest ICI, so they
+# are innermost (adjacent device ids share a physical link on TPU slices).
+MESH_AXES: Tuple[str, ...] = ("pipe", "data", "fsdp", "seq", "model", "expert")
+
+
+def resolve_mesh_shape(cfg: MeshConfig, n_devices: int) -> Dict[str, int]:
+    """Fill in the -1 ("remaining") axis and validate the product."""
+    sizes = {ax: int(getattr(cfg, ax)) for ax in MESH_AXES}
+    free = [ax for ax, s in sizes.items() if s == -1]
+    if len(free) > 1:
+        raise ValueError(f"At most one mesh axis may be -1, got {free}")
+    fixed = 1
+    for ax, s in sizes.items():
+        if s != -1:
+            if s < 1:
+                raise ValueError(f"mesh axis {ax} must be >=1 or -1, got {s}")
+            fixed *= s
+    if free:
+        rem, mod = divmod(n_devices, fixed)
+        if mod:
+            raise ValueError(f"{n_devices} devices not divisible by fixed axes product {fixed}")
+        sizes[free[0]] = rem
+    total = int(np.prod(list(sizes.values())))
+    if total != n_devices:
+        raise ValueError(f"Mesh {sizes} covers {total} devices but {n_devices} are available")
+    return sizes
+
+
+def make_mesh(cfg: Optional[MeshConfig] = None, devices: Optional[Sequence] = None):
+    """Build the framework mesh over the given (default: all) devices."""
+    import jax
+    from jax.sharding import Mesh
+
+    if cfg is None:
+        cfg = MeshConfig()
+    if devices is None:
+        devices = jax.devices()
+    sizes = resolve_mesh_shape(cfg, len(devices))
+    shape = tuple(sizes[ax] for ax in MESH_AXES)
+    dev_array = np.asarray(devices).reshape(shape)
+    mesh = Mesh(dev_array, MESH_AXES)
+    logger.info(
+        "mesh: " + " × ".join(f"{ax}={sizes[ax]}" for ax in MESH_AXES if sizes[ax] > 1 or ax == "data")
+    )
+    return mesh
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshInfo:
+    """Cheap axis-size accessors mirroring the reference's grid API
+    (``PipelineParallelGrid.get_*_parallel_world_size``, topology.py:252+)."""
+
+    sizes: Dict[str, int]
+
+    @classmethod
+    def from_mesh(cls, mesh) -> "MeshInfo":
+        return cls(sizes=dict(zip(mesh.axis_names, mesh.devices.shape)))
+
+    @property
+    def dp_world_size(self) -> int:
+        # The reference's "data parallel world size" = everything ZeRO
+        # partitions over = data × fsdp here.
+        return self.sizes.get("data", 1) * self.sizes.get("fsdp", 1)
+
+    @property
+    def fsdp_world_size(self) -> int:
+        return self.sizes.get("fsdp", 1)
+
+    @property
+    def model_parallel_world_size(self) -> int:
+        return self.sizes.get("model", 1)
+
+    @property
+    def pipe_parallel_world_size(self) -> int:
+        return self.sizes.get("pipe", 1)
+
+    @property
+    def seq_parallel_world_size(self) -> int:
+        return self.sizes.get("seq", 1)
+
+    @property
+    def expert_parallel_world_size(self) -> int:
+        return self.sizes.get("expert", 1)
+
+    @property
+    def world_size(self) -> int:
+        return int(np.prod(list(self.sizes.values())))
+
+
+# ---------------------------------------------------------------------------
+# Standard sharding specs
+# ---------------------------------------------------------------------------
+
+def batch_pspec(ndim: int = 2, seq_dim: Optional[int] = 1, seq_sharded: bool = False):
+    """PartitionSpec for a batch input: dim 0 sharded over (data, fsdp)
+    — fsdp ranks see distinct micro-slices (the fsdp axis is part of the
+    DP group, matching ZeRO's partitioning over the whole DP world) — and
+    optionally the sequence dim over ``seq`` for context parallelism."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = [None] * ndim
+    spec[0] = ("data", "fsdp")
+    if seq_sharded and seq_dim is not None and ndim > seq_dim:
+        spec[seq_dim] = "seq"
+    return P(*spec)
+
+
+def replicated_pspec():
+    from jax.sharding import PartitionSpec as P
+
+    return P()

@@ -1,0 +1,660 @@
+"""Typed config system.
+
+Parses the reference's JSON config surface (``runtime/config.py:655``
+``DeepSpeedConfig`` and its ~80 ``get_*`` accessors, defaults in
+``runtime/constants.py``) into typed dataclasses.  Differences from the
+reference, per the TPU design stance (SURVEY.md §5.6):
+
+* unknown keys raise instead of being silently ignored;
+* the batch-size triad invariant (``train_batch_size = micro_batch ×
+  grad_accum × dp_world_size``, reference ``config.py:736-898``) is
+  auto-completed and validated identically;
+* a ``mesh`` block (TPU-native extension) declares named SPMD axis sizes,
+  replacing the reference's mpu/process-group plumbing.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from deepspeed_tpu.config import constants as C
+
+
+class DeepSpeedConfigError(Exception):
+    pass
+
+
+def _pop(d: Dict[str, Any], key: str, default: Any = None) -> Any:
+    return d.pop(key, default)
+
+
+def _check_empty(d: Dict[str, Any], block: str) -> None:
+    if d:
+        raise DeepSpeedConfigError(
+            f"Unknown key(s) in '{block}' config block: {sorted(d.keys())}"
+        )
+
+
+@dataclass
+class OffloadDeviceConfig:
+    """``zero_optimization.offload_param`` / ``offload_optimizer``
+    (reference ``runtime/zero/offload_config.py``).  On TPU, ``device:
+    'cpu'`` means host-resident shards (SIMD host optimizer path) and
+    ``device: 'nvme'`` means the aio swapper."""
+
+    device: str = "none"  # none | cpu | nvme
+    nvme_path: Optional[str] = None
+    buffer_count: int = 5
+    buffer_size: int = 100_000_000
+    pin_memory: bool = False
+    pipeline_read: bool = False
+    pipeline_write: bool = False
+    fast_init: bool = False
+    max_in_cpu: int = 1_000_000_000
+    ratio: float = 1.0
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]], block: str) -> "OffloadDeviceConfig":
+        if d is None:
+            return cls()
+        d = dict(d)
+        out = cls(
+            device=_pop(d, "device", "none"),
+            nvme_path=_pop(d, "nvme_path", None),
+            buffer_count=int(_pop(d, "buffer_count", 5)),
+            buffer_size=int(_pop(d, "buffer_size", 100_000_000)),
+            pin_memory=bool(_pop(d, "pin_memory", False)),
+            pipeline_read=bool(_pop(d, "pipeline_read", False)),
+            pipeline_write=bool(_pop(d, "pipeline_write", False)),
+            fast_init=bool(_pop(d, "fast_init", False)),
+            max_in_cpu=int(_pop(d, "max_in_cpu", 1_000_000_000)),
+            ratio=float(_pop(d, "ratio", 1.0)),
+        )
+        _check_empty(d, block)
+        if out.device not in ("none", "cpu", "nvme"):
+            raise DeepSpeedConfigError(f"{block}.device must be none|cpu|nvme, got {out.device}")
+        return out
+
+    @property
+    def enabled(self) -> bool:
+        return self.device != "none"
+
+
+@dataclass
+class ZeroConfig:
+    """``zero_optimization`` block (reference ``runtime/zero/config.py:14``)."""
+
+    stage: int = C.ZERO_STAGE_DEFAULT
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: int = 500_000_000
+    allgather_partitions: bool = True
+    allgather_bucket_size: int = 500_000_000
+    overlap_comm: bool = True
+    load_from_fp32_weights: bool = True
+    elastic_checkpoint: bool = True
+    offload_param: OffloadDeviceConfig = field(default_factory=OffloadDeviceConfig)
+    offload_optimizer: OffloadDeviceConfig = field(default_factory=OffloadDeviceConfig)
+    sub_group_size: int = 1_000_000_000
+    prefetch_bucket_size: int = 50_000_000
+    param_persistence_threshold: int = 100_000
+    max_live_parameters: int = 1_000_000_000
+    max_reuse_distance: int = 1_000_000_000
+    gather_fp16_weights_on_model_save: bool = False
+    round_robin_gradients: bool = False
+    ignore_unused_parameters: bool = True
+    legacy_stage1: bool = False
+    cpu_offload: bool = False  # legacy alias for offload_optimizer.device=cpu
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ZeroConfig":
+        if d is None:
+            return cls()
+        d = dict(d)
+        cpu_offload = bool(_pop(d, "cpu_offload", False))
+        offload_param = OffloadDeviceConfig.from_dict(_pop(d, "offload_param", None), "zero_optimization.offload_param")
+        offload_optimizer = OffloadDeviceConfig.from_dict(
+            _pop(d, "offload_optimizer", None), "zero_optimization.offload_optimizer"
+        )
+        if cpu_offload and not offload_optimizer.enabled:
+            offload_optimizer = dataclasses.replace(offload_optimizer, device="cpu")
+        out = cls(
+            stage=int(_pop(d, "stage", C.ZERO_STAGE_DEFAULT)),
+            contiguous_gradients=bool(_pop(d, "contiguous_gradients", True)),
+            reduce_scatter=bool(_pop(d, "reduce_scatter", True)),
+            reduce_bucket_size=int(_pop(d, "reduce_bucket_size", 500_000_000)),
+            allgather_partitions=bool(_pop(d, "allgather_partitions", True)),
+            allgather_bucket_size=int(_pop(d, "allgather_bucket_size", 500_000_000)),
+            overlap_comm=bool(_pop(d, "overlap_comm", True)),
+            load_from_fp32_weights=bool(_pop(d, "load_from_fp32_weights", True)),
+            elastic_checkpoint=bool(_pop(d, "elastic_checkpoint", True)),
+            offload_param=offload_param,
+            offload_optimizer=offload_optimizer,
+            sub_group_size=int(_pop(d, "sub_group_size", 1_000_000_000)),
+            prefetch_bucket_size=int(_pop(d, "stage3_prefetch_bucket_size", _pop(d, "prefetch_bucket_size", 50_000_000))),
+            param_persistence_threshold=int(
+                _pop(d, "stage3_param_persistence_threshold", _pop(d, "param_persistence_threshold", 100_000))
+            ),
+            max_live_parameters=int(_pop(d, "stage3_max_live_parameters", _pop(d, "max_live_parameters", 1_000_000_000))),
+            max_reuse_distance=int(_pop(d, "stage3_max_reuse_distance", _pop(d, "max_reuse_distance", 1_000_000_000))),
+            gather_fp16_weights_on_model_save=bool(
+                _pop(d, "stage3_gather_fp16_weights_on_model_save", _pop(d, "gather_fp16_weights_on_model_save", False))
+            ),
+            round_robin_gradients=bool(_pop(d, "round_robin_gradients", False)),
+            ignore_unused_parameters=bool(_pop(d, "ignore_unused_parameters", True)),
+            legacy_stage1=bool(_pop(d, "legacy_stage1", False)),
+            cpu_offload=cpu_offload,
+        )
+        _check_empty(d, C.ZERO_OPTIMIZATION)
+        if not (0 <= out.stage <= C.MAX_STAGE_ZERO_OPTIMIZATION):
+            raise DeepSpeedConfigError(f"zero_optimization.stage must be in [0,3], got {out.stage}")
+        return out
+
+
+@dataclass
+class Fp16Config:
+    enabled: bool = C.FP16_ENABLED_DEFAULT
+    loss_scale: float = C.FP16_LOSS_SCALE_DEFAULT  # 0 => dynamic
+    initial_scale_power: int = C.FP16_INITIAL_SCALE_POWER_DEFAULT
+    loss_scale_window: int = C.FP16_LOSS_SCALE_WINDOW_DEFAULT
+    hysteresis: int = C.FP16_HYSTERESIS_DEFAULT
+    min_loss_scale: float = C.FP16_MIN_LOSS_SCALE_DEFAULT
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "Fp16Config":
+        if d is None:
+            return cls()
+        d = dict(d)
+        out = cls(
+            enabled=bool(_pop(d, C.FP16_ENABLED, C.FP16_ENABLED_DEFAULT)),
+            loss_scale=float(_pop(d, C.FP16_LOSS_SCALE, C.FP16_LOSS_SCALE_DEFAULT)),
+            initial_scale_power=int(_pop(d, C.FP16_INITIAL_SCALE_POWER, C.FP16_INITIAL_SCALE_POWER_DEFAULT)),
+            loss_scale_window=int(_pop(d, C.FP16_LOSS_SCALE_WINDOW, C.FP16_LOSS_SCALE_WINDOW_DEFAULT)),
+            hysteresis=int(_pop(d, C.FP16_HYSTERESIS, C.FP16_HYSTERESIS_DEFAULT)),
+            min_loss_scale=float(_pop(d, C.FP16_MIN_LOSS_SCALE, C.FP16_MIN_LOSS_SCALE_DEFAULT)),
+        )
+        _check_empty(d, C.FP16)
+        return out
+
+    @property
+    def dynamic_loss_scale(self) -> bool:
+        return self.loss_scale == 0
+
+
+@dataclass
+class Bf16Config:
+    enabled: bool = C.BF16_ENABLED_DEFAULT
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "Bf16Config":
+        if d is None:
+            return cls()
+        d = dict(d)
+        out = cls(enabled=bool(_pop(d, C.BF16_ENABLED, C.BF16_ENABLED_DEFAULT)))
+        _check_empty(d, C.BF16)
+        return out
+
+
+@dataclass
+class OptimizerConfig:
+    type: Optional[str] = None
+    params: Dict[str, Any] = field(default_factory=dict)
+    legacy_fusion: bool = False
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "OptimizerConfig":
+        if d is None:
+            return cls()
+        d = dict(d)
+        out = cls(
+            type=_pop(d, C.TYPE, None),
+            params=dict(_pop(d, C.OPTIMIZER_PARAMS, {}) or {}),
+            legacy_fusion=bool(_pop(d, C.LEGACY_FUSION, C.LEGACY_FUSION_DEFAULT)),
+        )
+        _check_empty(d, C.OPTIMIZER)
+        if out.type is not None and not isinstance(out.type, str):
+            raise DeepSpeedConfigError("optimizer.type must be a string")
+        return out
+
+    @property
+    def name(self) -> Optional[str]:
+        return self.type.lower() if self.type else None
+
+
+@dataclass
+class SchedulerConfig:
+    type: Optional[str] = None
+    params: Dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "SchedulerConfig":
+        if d is None:
+            return cls()
+        d = dict(d)
+        out = cls(type=_pop(d, C.TYPE, None), params=dict(_pop(d, C.SCHEDULER_PARAMS, {}) or {}))
+        _check_empty(d, C.SCHEDULER)
+        return out
+
+
+@dataclass
+class MeshConfig:
+    """TPU-native named SPMD mesh axes (SURVEY.md §2.6 TPU equivalent).
+
+    Axis sizes; ``data`` defaults to "whatever is left" (-1).  The full
+    mesh device count must equal ``jax.device_count()`` at engine init.
+    """
+
+    data: int = -1
+    fsdp: int = 1
+    model: int = 1  # tensor parallel (the reference's "slice parallel")
+    pipe: int = 1
+    seq: int = 1  # sequence/context parallel (ring attention axis)
+    expert: int = 1
+
+    AXES = ("pipe", "data", "fsdp", "seq", "model", "expert")
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "MeshConfig":
+        if d is None:
+            return cls()
+        d = dict(d)
+        out = cls(
+            data=int(_pop(d, "data", -1)),
+            fsdp=int(_pop(d, "fsdp", 1)),
+            model=int(_pop(d, "model", 1)),
+            pipe=int(_pop(d, "pipe", 1)),
+            seq=int(_pop(d, "seq", 1)),
+            expert=int(_pop(d, "expert", 1)),
+        )
+        _check_empty(d, C.MESH)
+        return out
+
+
+@dataclass
+class ActivationCheckpointingConfig:
+    """Reference ``runtime/activation_checkpointing/config.py``.  On TPU,
+    ``partition_activations`` maps to sharding saved residuals over the
+    model axis; ``cpu_checkpointing`` maps to a host-offload remat policy."""
+
+    partition_activations: bool = False
+    contiguous_memory_optimization: bool = False
+    cpu_checkpointing: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ActivationCheckpointingConfig":
+        if d is None:
+            return cls()
+        d = dict(d)
+        out = cls(
+            partition_activations=bool(_pop(d, "partition_activations", False)),
+            contiguous_memory_optimization=bool(_pop(d, "contiguous_memory_optimization", False)),
+            cpu_checkpointing=bool(_pop(d, "cpu_checkpointing", False)),
+            number_checkpoints=_pop(d, "number_checkpoints", None),
+            synchronize_checkpoint_boundary=bool(_pop(d, "synchronize_checkpoint_boundary", False)),
+            profile=bool(_pop(d, "profile", False)),
+        )
+        _check_empty(d, "activation_checkpointing")
+        return out
+
+
+@dataclass
+class FlopsProfilerConfig:
+    enabled: bool = False
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "FlopsProfilerConfig":
+        if d is None:
+            return cls()
+        d = dict(d)
+        out = cls(
+            enabled=bool(_pop(d, "enabled", False)),
+            profile_step=int(_pop(d, "profile_step", 1)),
+            module_depth=int(_pop(d, "module_depth", -1)),
+            top_modules=int(_pop(d, "top_modules", 1)),
+            detailed=bool(_pop(d, "detailed", True)),
+            output_file=_pop(d, "output_file", None),
+        )
+        _check_empty(d, "flops_profiler")
+        return out
+
+
+@dataclass
+class TensorboardConfig:
+    enabled: bool = C.TENSORBOARD_ENABLED_DEFAULT
+    output_path: str = C.TENSORBOARD_OUTPUT_PATH_DEFAULT
+    job_name: str = C.TENSORBOARD_JOB_NAME_DEFAULT
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "TensorboardConfig":
+        if d is None:
+            return cls()
+        d = dict(d)
+        out = cls(
+            enabled=bool(_pop(d, C.TENSORBOARD_ENABLED, C.TENSORBOARD_ENABLED_DEFAULT)),
+            output_path=_pop(d, C.TENSORBOARD_OUTPUT_PATH, C.TENSORBOARD_OUTPUT_PATH_DEFAULT),
+            job_name=_pop(d, C.TENSORBOARD_JOB_NAME, C.TENSORBOARD_JOB_NAME_DEFAULT),
+        )
+        _check_empty(d, C.TENSORBOARD)
+        return out
+
+
+@dataclass
+class PipelineConfig:
+    """``pipeline`` block (reference ``runtime/config.py:409`` area)."""
+
+    stages: Any = "auto"
+    partition: str = "best"
+    seed_layers: bool = False
+    activation_checkpoint_interval: int = 0
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "PipelineConfig":
+        if d is None:
+            return cls()
+        d = dict(d)
+        out = cls(
+            stages=_pop(d, "stages", "auto"),
+            partition=_pop(d, "partition", "best"),
+            seed_layers=bool(_pop(d, "seed_layers", False)),
+            activation_checkpoint_interval=int(_pop(d, "activation_checkpoint_interval", 0)),
+        )
+        _check_empty(d, C.PIPELINE)
+        return out
+
+
+@dataclass
+class AioConfig:
+    """``aio`` block (reference ``runtime/swap_tensor/aio_config.py``)."""
+
+    block_size: int = 1048576
+    queue_depth: int = 8
+    thread_count: int = 1
+    single_submit: bool = False
+    overlap_events: bool = True
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "AioConfig":
+        if d is None:
+            return cls()
+        d = dict(d)
+        out = cls(
+            block_size=int(_pop(d, "block_size", 1048576)),
+            queue_depth=int(_pop(d, "queue_depth", 8)),
+            thread_count=int(_pop(d, "thread_count", 1)),
+            single_submit=bool(_pop(d, "single_submit", False)),
+            overlap_events=bool(_pop(d, "overlap_events", True)),
+        )
+        _check_empty(d, "aio")
+        return out
+
+
+@dataclass
+class QuantizeTrainingConfig:
+    """MoQ progressive quantize-training (reference ``runtime/config.py:186-221``)."""
+
+    enabled: bool = False
+    quantize_verbose: bool = False
+    quantizer_kernel: bool = False
+    quantize_type: str = "symmetric"
+    quantize_bits_start: int = 16
+    quantize_bits_target: int = 8
+    quantize_schedule_offset: int = 1000
+    quantize_groups: int = 1
+    fp16_mixed_quantize: bool = False
+    quantize_change_ratio: float = 0.001
+    quantize_rounding: str = "nearest"  # nearest | stochastic
+    eigenvalue_enabled: bool = False
+    eigenvalue_verbose: bool = False
+    eigenvalue_max_iter: int = 100
+    eigenvalue_tol: float = 1e-2
+    eigenvalue_stability: float = 1e-6
+    eigenvalue_gas_boundary_resolution: int = 1
+    eigenvalue_layer_name: str = "bert.encoder.layer"
+    eigenvalue_layer_num: int = 0
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "QuantizeTrainingConfig":
+        if d is None:
+            return cls()
+        d = dict(d)
+        out = cls(
+            enabled=bool(_pop(d, "enabled", False)),
+            quantize_verbose=bool(_pop(d, "quantize_verbose", False)),
+            quantizer_kernel=bool(_pop(d, "quantizer_kernel", False)),
+            quantize_type=_pop(d, "quantize_type", "symmetric"),
+            quantize_bits_start=int(_pop(d, "quantize_bits_start", _pop(d, "start_bits", 16))),
+            quantize_bits_target=int(_pop(d, "quantize_bits_target", _pop(d, "target_bits", 8))),
+            quantize_schedule_offset=int(_pop(d, "quantize_schedule_offset", 1000)),
+            quantize_groups=int(_pop(d, "quantize_groups", 1)),
+            fp16_mixed_quantize=bool(_pop(d, "fp16_mixed_quantize", False)),
+            quantize_change_ratio=float(_pop(d, "quantize_change_ratio", 0.001)),
+            quantize_rounding=_pop(d, "quantize_rounding", "nearest"),
+            eigenvalue_enabled=bool(_pop(d, "eigenvalue_enabled", False)),
+            eigenvalue_verbose=bool(_pop(d, "eigenvalue_verbose", False)),
+            eigenvalue_max_iter=int(_pop(d, "eigenvalue_max_iter", 100)),
+            eigenvalue_tol=float(_pop(d, "eigenvalue_tol", 1e-2)),
+            eigenvalue_stability=float(_pop(d, "eigenvalue_stability", 1e-6)),
+            eigenvalue_gas_boundary_resolution=int(_pop(d, "eigenvalue_gas_boundary_resolution", 1)),
+            eigenvalue_layer_name=_pop(d, "eigenvalue_layer_name", "bert.encoder.layer"),
+            eigenvalue_layer_num=int(_pop(d, "eigenvalue_layer_num", 0)),
+        )
+        _check_empty(d, "quantize_training")
+        return out
+
+
+@dataclass
+class ProgressiveLayerDropConfig:
+    enabled: bool = False
+    theta: float = 0.5
+    gamma: float = 0.001
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ProgressiveLayerDropConfig":
+        if d is None:
+            return cls()
+        d = dict(d)
+        out = cls(
+            enabled=bool(_pop(d, "enabled", False)),
+            theta=float(_pop(d, "theta", 0.5)),
+            gamma=float(_pop(d, "gamma", 0.001)),
+        )
+        _check_empty(d, "progressive_layer_drop")
+        return out
+
+
+@dataclass
+class SparseAttentionConfig:
+    mode: Optional[str] = None  # dense|fixed|variable|bigbird|bslongformer
+    params: Dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "SparseAttentionConfig":
+        if d is None:
+            return cls()
+        d = dict(d)
+        mode = _pop(d, "mode", None)
+        # remaining keys are mode params (block, different_layout_per_head, ...)
+        return cls(mode=mode, params=d)
+
+
+_KNOWN_TOP_LEVEL = {
+    C.TRAIN_BATCH_SIZE,
+    C.TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+    C.GRADIENT_ACCUMULATION_STEPS,
+    C.OPTIMIZER,
+    C.SCHEDULER,
+    C.FP16,
+    C.BF16,
+    C.AMP,
+    C.GRADIENT_CLIPPING,
+    C.PRESCALE_GRADIENTS,
+    C.GRADIENT_PREDIVIDE_FACTOR,
+    C.SPARSE_GRADIENTS,
+    C.ALLREDUCE_ALWAYS_FP32,
+    C.ZERO_OPTIMIZATION,
+    C.STEPS_PER_PRINT,
+    C.WALL_CLOCK_BREAKDOWN,
+    C.MEMORY_BREAKDOWN,
+    C.DUMP_STATE,
+    C.DISABLE_ALLGATHER,
+    C.TENSORBOARD,
+    C.PIPELINE,
+    C.CHECKPOINT_TAG_VALIDATION,
+    C.MESH,
+    "activation_checkpointing",
+    "flops_profiler",
+    "aio",
+    "elasticity",
+    "quantize_training",
+    "progressive_layer_drop",
+    "sparse_attention",
+    "zero_allow_untested_optimizer",
+    "dataloader_drop_last",
+    "seed",
+}
+
+
+class DeepSpeedConfig:
+    """Parse a config dict / JSON path and resolve the batch-size triad.
+
+    ``world_size`` here is the *data-parallel* world size (``data × fsdp``
+    mesh axes), matching the reference's use of dp_world_size in
+    ``runtime/config.py:736-898``.
+    """
+
+    def __init__(self, config: Any, world_size: Optional[int] = None, mesh_shape: Optional[Dict[str, int]] = None):
+        if isinstance(config, str):
+            with open(config, "r") as f:
+                d = json.load(f)
+        elif isinstance(config, dict):
+            d = json.loads(json.dumps(config))  # deep copy + json-type check
+        else:
+            raise DeepSpeedConfigError(f"config must be a dict or a path to a JSON file, got {type(config)}")
+
+        unknown = set(d.keys()) - _KNOWN_TOP_LEVEL
+        if unknown:
+            raise DeepSpeedConfigError(f"Unknown top-level config key(s): {sorted(unknown)}")
+
+        self._raw = d
+        self.train_batch_size = d.get(C.TRAIN_BATCH_SIZE)
+        self.train_micro_batch_size_per_gpu = d.get(C.TRAIN_MICRO_BATCH_SIZE_PER_GPU)
+        self.gradient_accumulation_steps = d.get(C.GRADIENT_ACCUMULATION_STEPS)
+
+        self.optimizer = OptimizerConfig.from_dict(d.get(C.OPTIMIZER))
+        self.scheduler = SchedulerConfig.from_dict(d.get(C.SCHEDULER))
+        self.fp16 = Fp16Config.from_dict(d.get(C.FP16))
+        self.bf16 = Bf16Config.from_dict(d.get(C.BF16))
+        self.zero_config = ZeroConfig.from_dict(d.get(C.ZERO_OPTIMIZATION))
+        self.mesh = MeshConfig.from_dict(d.get(C.MESH))
+        if mesh_shape:
+            for axis, size in mesh_shape.items():
+                setattr(self.mesh, axis, size)
+        self.activation_checkpointing = ActivationCheckpointingConfig.from_dict(d.get("activation_checkpointing"))
+        self.flops_profiler = FlopsProfilerConfig.from_dict(d.get("flops_profiler"))
+        self.tensorboard = TensorboardConfig.from_dict(d.get(C.TENSORBOARD))
+        self.pipeline = PipelineConfig.from_dict(d.get(C.PIPELINE))
+        self.aio = AioConfig.from_dict(d.get("aio"))
+        self.quantize_training = QuantizeTrainingConfig.from_dict(d.get("quantize_training"))
+        self.progressive_layer_drop = ProgressiveLayerDropConfig.from_dict(d.get("progressive_layer_drop"))
+        self.sparse_attention = SparseAttentionConfig.from_dict(d.get("sparse_attention"))
+        self.elasticity_dict = d.get("elasticity")
+
+        self.gradient_clipping = float(d.get(C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT))
+        self.prescale_gradients = bool(d.get(C.PRESCALE_GRADIENTS, C.PRESCALE_GRADIENTS_DEFAULT))
+        self.gradient_predivide_factor = float(d.get(C.GRADIENT_PREDIVIDE_FACTOR, C.GRADIENT_PREDIVIDE_FACTOR_DEFAULT))
+        self.sparse_gradients_enabled = bool(d.get(C.SPARSE_GRADIENTS, C.SPARSE_GRADIENTS_DEFAULT))
+        self.allreduce_always_fp32 = bool(d.get(C.ALLREDUCE_ALWAYS_FP32, C.ALLREDUCE_ALWAYS_FP32_DEFAULT))
+        self.steps_per_print = int(d.get(C.STEPS_PER_PRINT, C.STEPS_PER_PRINT_DEFAULT))
+        self.wall_clock_breakdown = bool(d.get(C.WALL_CLOCK_BREAKDOWN, C.WALL_CLOCK_BREAKDOWN_DEFAULT))
+        self.memory_breakdown = bool(d.get(C.MEMORY_BREAKDOWN, C.MEMORY_BREAKDOWN_DEFAULT))
+        self.dump_state = bool(d.get(C.DUMP_STATE, C.DUMP_STATE_DEFAULT))
+        self.disable_allgather = bool(d.get(C.DISABLE_ALLGATHER, C.DISABLE_ALLGATHER_DEFAULT))
+        self.checkpoint_tag_validation_mode = d.get(C.CHECKPOINT_TAG_VALIDATION, C.CHECKPOINT_TAG_VALIDATION_DEFAULT)
+        self.zero_allow_untested_optimizer = bool(d.get("zero_allow_untested_optimizer", False))
+        self.dataloader_drop_last = bool(d.get("dataloader_drop_last", False))
+        self.seed = int(d.get("seed", 42))
+
+        if self.checkpoint_tag_validation_mode not in C.CHECKPOINT_TAG_VALIDATION_MODES:
+            raise DeepSpeedConfigError(
+                f"checkpoint_tag_validation must be one of {C.CHECKPOINT_TAG_VALIDATION_MODES}"
+            )
+        if self.fp16.enabled and self.bf16.enabled:
+            raise DeepSpeedConfigError("fp16 and bf16 cannot both be enabled")
+
+        self.world_size = world_size if world_size is not None else 1
+        self._resolve_batch_triad()
+
+    # --- batch triad (reference runtime/config.py:736-898) ---
+    def _resolve_batch_triad(self) -> None:
+        train = self.train_batch_size
+        micro = self.train_micro_batch_size_per_gpu
+        gas = self.gradient_accumulation_steps
+        ws = self.world_size
+
+        if train is not None and micro is not None and gas is not None:
+            pass
+        elif train is not None and micro is not None:
+            gas, rem = divmod(train, micro * ws)
+            if rem:
+                raise DeepSpeedConfigError(
+                    f"train_batch_size ({train}) not divisible by micro_batch*world_size ({micro}*{ws})"
+                )
+        elif train is not None and gas is not None:
+            micro, rem = divmod(train, gas * ws)
+            if rem:
+                raise DeepSpeedConfigError(
+                    f"train_batch_size ({train}) not divisible by grad_accum*world_size ({gas}*{ws})"
+                )
+        elif micro is not None and gas is not None:
+            train = micro * gas * ws
+        elif train is not None:
+            gas = 1
+            micro, rem = divmod(train, ws)
+            if rem:
+                raise DeepSpeedConfigError(f"train_batch_size ({train}) not divisible by world_size ({ws})")
+        elif micro is not None:
+            gas = 1
+            train = micro * ws
+        else:
+            raise DeepSpeedConfigError(
+                "At least one of train_batch_size / train_micro_batch_size_per_gpu must be set"
+            )
+
+        self.train_batch_size = int(train)
+        self.train_micro_batch_size_per_gpu = int(micro)
+        self.gradient_accumulation_steps = int(gas)
+        if self.train_batch_size != self.train_micro_batch_size_per_gpu * self.gradient_accumulation_steps * ws:
+            raise DeepSpeedConfigError(
+                f"Batch triad check failed: {self.train_batch_size} != "
+                f"{self.train_micro_batch_size_per_gpu} * {self.gradient_accumulation_steps} * {ws}"
+            )
+
+    # --- convenience ---
+    @property
+    def zero_enabled(self) -> bool:
+        return self.zero_config.stage > 0
+
+    @property
+    def zero_optimization_stage(self) -> int:
+        return self.zero_config.stage
+
+    @property
+    def compute_dtype(self) -> str:
+        if self.fp16.enabled:
+            return "float16"
+        if self.bf16.enabled:
+            return "bfloat16"
+        return "float32"
+
+    def print_config(self) -> str:
+        return json.dumps(self._raw, indent=2, sort_keys=True)

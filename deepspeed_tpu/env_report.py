@@ -1,0 +1,86 @@
+"""Environment / ops report — the ``ds_report`` analog (reference
+``env_report.py``).  Prints which ops lower to Pallas vs plain XLA vs
+native C++, the device inventory, and asserts **zero CUDA ops** (the
+north-star requirement): any op whose lowering would require CUDA is a
+FAIL row.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+
+GREEN = "\033[92m"
+RED = "\033[91m"
+YELLOW = "\033[93m"
+END = "\033[0m"
+OKAY = f"{GREEN}[OKAY]{END}"
+FAIL = f"{RED}[FAIL]{END}"
+WARNING = f"{YELLOW}[WARNING]{END}"
+
+
+def op_report(verbose: bool = True) -> bool:
+    from deepspeed_tpu.ops.registry import all_ops
+
+    max_dots = 50
+    print("-" * 64)
+    print("deepspeed_tpu op lowering report")
+    print("-" * 64)
+    print("op name" + "." * (max_dots - len("op name")) + "lowering / status")
+    print("-" * 64)
+    ok = True
+    cuda_ops = 0
+    for name, spec in sorted(all_ops().items()):
+        compatible = spec.is_compatible()
+        ok = ok and compatible
+        if spec.lowering == "cuda":
+            cuda_ops += 1
+        status = OKAY if compatible else FAIL
+        print(f"{name}{'.' * (max_dots - len(name))}[{spec.lowering}] {status}")
+    print("-" * 64)
+    if cuda_ops:
+        print(f"CUDA ops detected: {cuda_ops} {FAIL}")
+        ok = False
+    else:
+        print(f"CUDA ops detected: 0 {OKAY}")
+    return ok
+
+
+def debug_report() -> None:
+    import jax
+
+    print()
+    print("DeepSpeed-TPU general environment info:")
+    from deepspeed_tpu.version import __version__
+
+    rows = [
+        ("deepspeed_tpu version", __version__),
+        ("jax version", jax.__version__),
+        ("default backend", jax.default_backend()),
+        ("device count", jax.device_count()),
+        ("local device count", jax.local_device_count()),
+        ("process count", jax.process_count()),
+        ("devices", ", ".join(str(d) for d in jax.devices()[:8]) + (" ..." if jax.device_count() > 8 else "")),
+    ]
+    try:
+        import jaxlib
+
+        rows.insert(2, ("jaxlib version", jaxlib.__version__))
+    except Exception:
+        pass
+    for name, value in rows:
+        print(f"{name} " + "." * (30 - len(name)) + f" {value}")
+
+
+def cli_main() -> int:
+    ok = op_report()
+    debug_report()
+    return 0 if ok else 1
+
+
+def main():
+    sys.exit(cli_main())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,144 @@
+"""Fused Adam / AdamW.
+
+TPU-native equivalent of the reference's multi-tensor fused Adam CUDA
+kernel (``csrc/adam/multi_tensor_adam.cu``, Python wrapper
+``ops/adam/fused_adam.py:15``).  On TPU the "fusion" is XLA's: the whole
+pytree update lowers to fused elementwise programs executed on the shard
+each rank owns (ZeRO: the fsdp-sharded slice), so the reference's
+multi-tensor-apply chunking machinery is unnecessary.
+
+The optimizer protocol is optax-compatible — ``init(params)`` /
+``update(grads, state, params, lr=...)`` — but ``lr`` is an explicit traced
+argument so schedules evaluate inside the jitted train step.
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.registry import register_op
+
+
+class AdamState(NamedTuple):
+    step: jnp.ndarray  # scalar int32
+    exp_avg: Any  # m, same tree as params (fp32)
+    exp_avg_sq: Any  # v, same tree as params (fp32)
+
+
+def _map_multi(fn, n_out, *trees):
+    """tree-map a function returning an n-tuple into n trees."""
+    leaves_list = [jax.tree.leaves(t) for t in trees]
+    treedef = jax.tree.structure(trees[0])
+    results = [fn(*leaves) for leaves in zip(*leaves_list)]
+    return tuple(treedef.unflatten([r[i] for r in results]) for i in range(n_out))
+
+
+class FusedAdam:
+    """Adam with decoupled (AdamW) or L2 (classic) weight decay.
+
+    ``adam_w_mode=True`` matches the reference default
+    (``ops/adam/fused_adam.py:40``): decay applied to params, not grads.
+    """
+
+    name = "adam"
+
+    def __init__(
+        self,
+        lr: float = 1e-3,
+        betas=(0.9, 0.999),
+        eps: float = 1e-8,
+        weight_decay: float = 0.0,
+        adam_w_mode: bool = True,
+        bias_correction: bool = True,
+        amsgrad: bool = False,
+    ):
+        if amsgrad:
+            raise ValueError("FusedAdam does not support amsgrad (matches reference)")
+        self.lr = lr
+        self.b1, self.b2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adam_w_mode = adam_w_mode
+        self.bias_correction = bias_correction
+
+    def init(self, params: Any) -> AdamState:
+        zeros = lambda: jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        return AdamState(step=jnp.zeros((), jnp.int32), exp_avg=zeros(), exp_avg_sq=zeros())
+
+    def update(self, grads: Any, state: AdamState, params: Any, lr: Optional[jnp.ndarray] = None):
+        """Returns (updates, new_state); apply with ``p + u``."""
+        lr = self.lr if lr is None else lr
+        step = state.step + 1
+        b1, b2 = self.b1, self.b2
+        if self.bias_correction:
+            c1 = 1.0 - b1 ** step.astype(jnp.float32)
+            c2 = 1.0 - b2 ** step.astype(jnp.float32)
+        else:
+            c1 = c2 = jnp.float32(1.0)
+
+        def one(g, m, v, p):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            if not self.adam_w_mode and self.weight_decay > 0.0:
+                g = g + self.weight_decay * p32
+            m_new = b1 * m + (1.0 - b1) * g
+            v_new = b2 * v + (1.0 - b2) * g * g
+            denom = jnp.sqrt(v_new / c2) + self.eps
+            upd = -(lr * (m_new / c1) / denom)
+            if self.adam_w_mode and self.weight_decay > 0.0:
+                upd = upd - lr * self.weight_decay * p32
+            return upd, m_new, v_new
+
+        updates, m, v = _map_multi(one, 3, grads, state.exp_avg, state.exp_avg_sq, params)
+        return updates, AdamState(step=step, exp_avg=m, exp_avg_sq=v)
+
+
+class FusedAdamW(FusedAdam):
+    name = "adamw"
+
+    def __init__(self, lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8, weight_decay: float = 0.01, **kw):
+        super().__init__(lr=lr, betas=betas, eps=eps, weight_decay=weight_decay, adam_w_mode=True, **kw)
+
+
+class SGD:
+    name = "sgd"
+
+    def __init__(self, lr: float = 1e-3, momentum: float = 0.0, weight_decay: float = 0.0, nesterov: bool = False):
+        self.lr = lr
+        self.momentum = momentum
+        self.weight_decay = weight_decay
+        self.nesterov = nesterov
+
+    def init(self, params: Any):
+        state = {"step": jnp.zeros((), jnp.int32)}
+        if self.momentum != 0.0:
+            state["momentum_buffer"] = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        return state
+
+    def update(self, grads: Any, state, params: Any, lr: Optional[jnp.ndarray] = None):
+        lr = self.lr if lr is None else lr
+
+        def one(g, p, buf=None):
+            g = g.astype(jnp.float32)
+            if self.weight_decay > 0.0:
+                g = g + self.weight_decay * p.astype(jnp.float32)
+            if buf is None:
+                return (-lr * g,)
+            buf_new = self.momentum * buf + g
+            d = g + self.momentum * buf_new if self.nesterov else buf_new
+            return -lr * d, buf_new
+
+        new_state = {"step": state["step"] + 1}
+        if self.momentum == 0.0:
+            (updates,) = _map_multi(one, 1, grads, params)
+        else:
+            updates, bufs = _map_multi(one, 2, grads, params, state["momentum_buffer"])
+            new_state["momentum_buffer"] = bufs
+        return updates, new_state
+
+
+@register_op("fused_adam", "xla", "Fused Adam/AdamW as one XLA-fused update over the owned shard")
+def _load_fused_adam():
+    return FusedAdam

@@ -1,0 +1,234 @@
+"""Flash attention — the TPU-native replacement for the reference's fused
+attention CUDA kernels (``csrc/transformer/softmax_kernels.cu`` +
+strided-batch GEMMs in ``csrc/transformer/ds_transformer_cuda.cpp``; and
+the inference decode path in ``csrc/transformer/inference/csrc/softmax.cu``).
+
+Design:
+* **Forward**: Pallas TPU kernel, online-softmax over KV blocks held in
+  VMEM, fp32 accumulation, grid over (batch×heads, q-blocks) so the MXU
+  sees (block_q × d) @ (d × block_k) matmuls back-to-back.
+* **Backward**: blockwise-rematerialized XLA computation (lax.scan over KV
+  blocks under jax.checkpoint) — O(seq) memory like flash-attention-2's
+  backward, fused by XLA.  (A full Pallas backward is a later-round
+  optimization; the contract and tests don't change.)
+* On non-TPU backends the same kernel runs under ``interpret=True`` so
+  unit tests execute on the CPU mesh.
+
+Layout convention: ``(batch, heads, seq, head_dim)``.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from deepspeed_tpu.ops.registry import register_op
+
+DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Reference implementation (tests + tiny shapes)
+# ---------------------------------------------------------------------------
+
+def mha_reference(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    bias: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Plain XLA attention; numerics ground truth for the Pallas kernel."""
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * sm_scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if causal:
+        qlen, klen = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((qlen, klen), bool), k=klen - qlen)
+        s = jnp.where(mask, s, DEFAULT_MASK_VALUE)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward kernel
+# ---------------------------------------------------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float, causal: bool, block_k: int):
+    block_q, d = q_ref.shape[1], q_ref.shape[2]
+    seq_k = k_ref.shape[1]
+    q_idx = pl.program_id(1)
+
+    q = q_ref[0].astype(jnp.float32) * sm_scale  # (block_q, d)
+
+    num_kv = seq_k // block_k
+    if causal:
+        # Last KV block whose start can be <= this q block's end.
+        hi = jax.lax.div((q_idx + 1) * block_q + block_k - 1, block_k)
+        hi = jnp.minimum(hi, num_kv)
+    else:
+        hi = num_kv
+
+    def body(i, carry):
+        acc, m_prev, l_prev = carry
+        k = k_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # (block_q, block_k)
+        if causal:
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = i * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
+        m_cur = jnp.max(s, axis=1, keepdims=True)  # (block_q, 1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc * alpha + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    init = (
+        jnp.zeros((block_q, d), jnp.float32),
+        jnp.full((block_q, 1), -jnp.inf, jnp.float32),
+        jnp.zeros((block_q, 1), jnp.float32),
+    )
+    acc, m, l = jax.lax.fori_loop(0, hi, body, init)
+    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: int, interpret: bool):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bh = b * h
+    qr = q.reshape(bh, sq, d)
+    kr = k.reshape(bh, sk, d)
+    vr = v.reshape(bh, sk, d)
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    assert sq % block_q == 0 and sk % block_k == 0, (sq, sk, block_q, block_k)
+
+    grid = (bh, sq // block_q)
+    out = pl.pallas_call(
+        functools.partial(_flash_fwd_kernel, sm_scale=sm_scale, causal=causal, block_k=block_k),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh_, qi: (bh_, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh_, qi: (bh_, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, sq, d)
+
+
+# ---------------------------------------------------------------------------
+# Blockwise XLA path (backward + long-sequence fallback): flash-style
+# online softmax as a lax.scan over KV blocks, rematerialized.
+# ---------------------------------------------------------------------------
+
+def _blockwise_xla(q, k, v, causal: bool, sm_scale: float, block_k: int):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_k = min(block_k, sk)
+    assert sk % block_k == 0
+    num_kv = sk // block_k
+    qf = q.astype(jnp.float32) * sm_scale
+    kf = k.astype(jnp.float32).reshape(b, h, num_kv, block_k, d)
+    vf = v.astype(jnp.float32).reshape(b, h, num_kv, block_k, d)
+    q_pos = jnp.arange(sq)[:, None]
+
+    @functools.partial(jax.checkpoint, prevent_cse=False)
+    def block(carry, inputs):
+        acc, m_prev, l_prev = carry
+        kb, vb, kv_i = inputs
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kb)
+        if causal:
+            k_pos = kv_i * block_k + jnp.arange(block_k)[None, :]
+            s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum("bhqk,bhkd->bhqd", p, vb)
+        return (acc, m_new, l_new), None
+
+    init = (
+        jnp.zeros((b, h, sq, d), jnp.float32),
+        jnp.full((b, h, sq, 1), -jnp.inf, jnp.float32),
+        jnp.zeros((b, h, sq, 1), jnp.float32),
+    )
+    kb = jnp.moveaxis(kf, 2, 0)  # (num_kv, b, h, block_k, d)
+    vb = jnp.moveaxis(vf, 2, 0)
+    (acc, m, l), _ = jax.lax.scan(block, init, (kb, vb, jnp.arange(num_kv)))
+    l = jnp.where(l == 0.0, 1.0, l)
+    return (acc / l).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Public API with custom VJP
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    return _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+
+
+def _flash_fwd_rule(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out = _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q_, k_, v_: _blockwise_xla(q_, k_, v_, causal, sm_scale, block_k), q, k, v)
+    return vjp(g)
+
+
+_flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Flash attention over ``(batch, heads, seq, head_dim)`` inputs.
+
+    Differentiable; forward runs the Pallas kernel, backward the blockwise
+    rematerialized path.  ``interpret`` defaults to True off-TPU.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    if interpret is None:
+        interpret = not _on_tpu()
+    sq, sk = q.shape[2], k.shape[2]
+    if sq % min(block_q, sq) != 0 or sk % min(block_k, sk) != 0 or sq < 8 or sk < 8:
+        # Ragged tiny shapes: reference path (still differentiable).
+        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+    return _flash_attention(q, k, v, causal, float(sm_scale), block_q, block_k, interpret)
+
+
+@register_op("flash_attention", "pallas", "Online-softmax fused attention kernel (fwd) + blockwise remat bwd")
+def _load_flash_attention():
+    return flash_attention

@@ -1,0 +1,86 @@
+"""Kernel registry — the TPU-native replacement for ``op_builder/``.
+
+The reference resolves op names (``fused_adam``, ``transformer``,
+``sparse_attn``, ...) to CUDA extensions compiled by ninja at first use
+(``op_builder/builder.py:337-392``).  Here each op name resolves to a
+Python callable backed by a Pallas kernel or a jitted XLA computation —
+there is nothing to compile ahead of time (XLA JIT-compiles at trace
+time), so the registry's job is discovery + compatibility reporting
+(``ds_report`` analog in ``deepspeed_tpu/env_report.py``).
+
+``lowering`` records how the op hits the hardware:
+  * ``pallas`` — hand-written Pallas TPU kernel
+  * ``xla``    — jitted jax.numpy/lax, fused by XLA
+  * ``native`` — host-side C++ (aio, cpu optimizer)
+  * ``python`` — pure-Python host logic (not perf-critical)
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclasses.dataclass
+class OpSpec:
+    name: str
+    lowering: str  # pallas | xla | native | python
+    loader: Callable[[], Any]
+    description: str = ""
+    _cache: Any = None
+    _error: Optional[str] = None
+
+    def load(self) -> Any:
+        if self._cache is None and self._error is None:
+            try:
+                self._cache = self.loader()
+            except Exception as e:  # record, don't crash ds_report
+                self._error = f"{type(e).__name__}: {e}"
+                raise
+        if self._error is not None:
+            raise RuntimeError(f"op '{self.name}' failed to load: {self._error}")
+        return self._cache
+
+    def is_compatible(self) -> bool:
+        try:
+            self.load()
+            return True
+        except Exception:
+            return False
+
+
+_REGISTRY: Dict[str, OpSpec] = {}
+
+
+def register_op(name: str, lowering: str, description: str = "") -> Callable:
+    def deco(loader: Callable[[], Any]):
+        _REGISTRY[name] = OpSpec(name=name, lowering=lowering, loader=loader, description=description)
+        return loader
+
+    return deco
+
+
+def get_op(name: str) -> Any:
+    if name not in _REGISTRY:
+        raise KeyError(f"Unknown op '{name}'. Registered: {sorted(_REGISTRY)}")
+    return _REGISTRY[name].load()
+
+
+def all_ops() -> Dict[str, OpSpec]:
+    # Import op modules for registration side effects.
+    import deepspeed_tpu.ops.adam.fused_adam  # noqa: F401
+    import deepspeed_tpu.ops.lamb.fused_lamb  # noqa: F401
+    import deepspeed_tpu.ops.quantizer.quantizer  # noqa: F401
+    import deepspeed_tpu.ops.attention.flash_attention  # noqa: F401
+
+    for mod in (
+        "deepspeed_tpu.ops.adam.cpu_adam",
+        "deepspeed_tpu.ops.aio.aio",
+        "deepspeed_tpu.ops.transformer.transformer",
+        "deepspeed_tpu.ops.transformer.inference",
+        "deepspeed_tpu.ops.attention.sparse",
+    ):
+        try:
+            __import__(mod)
+        except ImportError:
+            pass
+    return dict(_REGISTRY)

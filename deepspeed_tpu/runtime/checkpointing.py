@@ -1,0 +1,154 @@
+"""Checkpoint save/load.
+
+Replaces the reference's per-rank checkpoint file zoo
+(``mp_rank_XX_model_states.pt`` + ``*_zero_pp_rank_N_..._optim_states.pt``,
+engine.py:1854-2106 and SURVEY.md §5.4) with **one sharded checkpoint per
+tag** written through orbax/tensorstore: every rank writes its shards of
+the same logical arrays, and on load orbax reshards to whatever mesh the
+restoring job uses — which subsumes the reference's elastic-DP checkpoint
+machinery (stage2.py:1828-2004) and ``MegatronSDLoader`` MP resize
+(state_dict_factory.py:199) in one mechanism.
+
+Kept semantics: ``latest`` tag file, client_state round-trip, tag
+validation mode.  The ``zero_to_fp32`` analog (full fp32 state_dict from a
+sharded checkpoint) is ``consolidate_fp32_state_dict`` below.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+LATEST_FILE = "latest"
+
+
+def _ckpt_path(save_dir: str, tag: str) -> str:
+    return os.path.join(os.path.abspath(save_dir), str(tag))
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def save_checkpoint(
+    engine,
+    save_dir: str,
+    tag: Optional[str] = None,
+    client_state: Optional[dict] = None,
+    save_latest: bool = True,
+) -> str:
+    if tag is None:
+        tag = f"global_step{int(engine.state['global_step'])}"
+    path = _ckpt_path(save_dir, tag)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+
+    ckptr = _checkpointer()
+    ckptr.save(os.path.join(path, "state"), engine.state, force=True)
+    ckptr.wait_until_finished()
+
+    meta = {
+        "tag": str(tag),
+        "global_step": int(engine.state["global_step"]),
+        "micro_step": int(engine.state["micro_step"]),
+        "global_samples": int(engine.state["global_samples"]),
+        "skipped_steps": int(engine.skipped_steps),
+        "world_size": engine.mesh_info.world_size,
+        "dp_world_size": engine.mesh_info.dp_world_size,
+        "mp_world_size": engine.mesh_info.model_parallel_world_size,
+        "zero_stage": engine.zero_stage,
+        "client_state": client_state or {},
+        "ds_tpu_version": _version(),
+    }
+    if jax.process_index() == 0:
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump(meta, f, indent=2)
+        if save_latest:
+            with open(os.path.join(os.path.abspath(save_dir), LATEST_FILE), "w") as f:
+                f.write(str(tag))
+    log_dist(f"saved checkpoint {path}")
+    return path
+
+
+def load_checkpoint(
+    engine,
+    load_dir: str,
+    tag: Optional[str] = None,
+    load_optimizer_states: bool = True,
+    load_lr_scheduler_states: bool = True,
+    load_module_only: bool = False,
+):
+    """Returns (path, client_state) like the reference (engine.py:1654),
+    or (None, {}) if nothing to load."""
+    load_dir = os.path.abspath(load_dir)
+    if tag is None:
+        latest = os.path.join(load_dir, LATEST_FILE)
+        if not os.path.exists(latest):
+            logger.warning(f"no '{LATEST_FILE}' file at {load_dir}; nothing loaded")
+            return None, {}
+        with open(latest) as f:
+            tag = f.read().strip()
+    path = _ckpt_path(load_dir, tag)
+    if not os.path.isdir(path):
+        logger.warning(f"checkpoint {path} not found")
+        return None, {}
+
+    ckptr = _checkpointer()
+    # Abstract target: current shapes + *current* shardings — orbax
+    # reshards on read, giving elastic DP/MP resize on load.
+    def abstract(x, sharding):
+        return jax.ShapeDtypeStruct(np.shape(x), x.dtype, sharding=sharding)
+
+    target = jax.tree.map(abstract, engine.state, engine._state_shardings)
+    restored = ckptr.restore(os.path.join(path, "state"), target)
+
+    if load_module_only or not load_optimizer_states:
+        engine.state["params"] = restored["params"]
+        if not load_module_only:
+            for key in ("micro_step", "global_step", "global_samples", "loss_scale", "rng"):
+                engine.state[key] = restored[key]
+    else:
+        engine.state = restored
+
+    meta_path = os.path.join(path, "meta.json")
+    client_state: Dict[str, Any] = {}
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+        client_state = meta.get("client_state", {})
+        engine.skipped_steps = meta.get("skipped_steps", 0)
+        if load_lr_scheduler_states and engine.client_lr_scheduler is not None and hasattr(engine.client_lr_scheduler, "load_state_dict"):
+            sd = client_state.get("__lr_scheduler__")
+            if sd:
+                engine.client_lr_scheduler.load_state_dict(sd)
+    log_dist(f"loaded checkpoint {path} (global_step={int(engine.state['global_step'])})")
+    return path, client_state
+
+
+def consolidate_fp32_state_dict(engine) -> Dict[str, np.ndarray]:
+    """Gather full (unsharded) fp32 params on host — the
+    ``zero_to_fp32.py`` / ``_zero3_consolidated_fp16_state_dict``
+    (engine.py:2039) analog.  Works for any ZeRO stage because params are
+    logical arrays; this is just a device->host gather."""
+    flat = {}
+
+    def visit(path, leaf):
+        arr = np.asarray(jax.device_get(leaf)).astype(np.float32)
+        from deepspeed_tpu.runtime.zero.stages import _path_str
+
+        flat[_path_str(path)] = arr
+
+    jax.tree_util.tree_map_with_path(visit, engine.state["params"])
+    return flat
+
+
+def _version() -> str:
+    from deepspeed_tpu.version import __version__
+
+    return __version__

@@ -1,0 +1,110 @@
+"""Data loading.
+
+Analog of the reference's ``runtime/dataloader.py``
+(``DeepSpeedDataLoader`` :33 with ``DistributedSampler``;
+``RepeatingLoader`` :10).  On TPU the "distributed sampler" story changes:
+within one process, SPMD sharding of the batch across the (data, fsdp)
+mesh axes replaces per-rank samplers; across hosts, each process loads its
+``jax.process_index()`` slice and the engine assembles a global array.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+class RepeatingLoader:
+    """Wrap an iterator to auto-restart at StopIteration (reference :10)."""
+
+    def __init__(self, loader: Iterable):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)
+
+    def __len__(self):
+        return len(self.loader)
+
+
+class DeepSpeedDataLoader:
+    """Batches an indexable dataset of pytrees/arrays.
+
+    ``dataset`` may be: a dict/tuple of equal-length numpy arrays, or a
+    sequence of per-example pytrees (collated by stacking).  Yields
+    host numpy batches of size ``batch_size`` (the per-process batch =
+    micro_batch × local share of the DP world); the engine device_puts
+    them with the right sharding.
+    """
+
+    def __init__(
+        self,
+        dataset: Any,
+        batch_size: int,
+        shuffle: bool = False,
+        seed: int = 0,
+        drop_last: bool = True,
+        collate_fn: Optional[Callable] = None,
+        process_index: Optional[int] = None,
+        process_count: Optional[int] = None,
+    ):
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn
+        self.process_index = process_index if process_index is not None else jax.process_index()
+        self.process_count = process_count if process_count is not None else jax.process_count()
+        self.epoch = 0
+
+        self._columnar = isinstance(dataset, dict) or (
+            isinstance(dataset, (tuple, list))
+            and len(dataset) > 0
+            and all(isinstance(x, np.ndarray) for x in jax.tree.leaves(dataset))
+            and not np.isscalar(dataset[0])
+            and hasattr(dataset[0], "shape")
+        )
+        self._n = len(jax.tree.leaves(dataset)[0]) if self._columnar else len(dataset)
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        per_proc = self._n // self.process_count
+        if self.drop_last:
+            return per_proc // self.batch_size
+        return math.ceil(per_proc / self.batch_size)
+
+    def __iter__(self) -> Iterator[Any]:
+        idx = np.arange(self._n)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            rng.shuffle(idx)
+        # contiguous per-process shard (DistributedSampler semantics)
+        per_proc = self._n // self.process_count
+        idx = idx[self.process_index * per_proc : (self.process_index + 1) * per_proc]
+        n_batches = len(self)
+        for b in range(n_batches):
+            sel = idx[b * self.batch_size : (b + 1) * self.batch_size]
+            if len(sel) == 0:
+                return
+            if self._columnar:
+                batch = jax.tree.map(lambda col: col[sel], self.dataset)
+            else:
+                examples = [self.dataset[int(i)] for i in sel]
+                if self.collate_fn is not None:
+                    batch = self.collate_fn(examples)
+                else:
+                    batch = jax.tree.map(lambda *xs: np.stack(xs), *examples)
+            yield batch

@@ -1,0 +1,454 @@
+"""The training engine.
+
+TPU-native re-design of the reference's ``DeepSpeedEngine``
+(``runtime/engine.py:85``).  The reference engine is a mutable
+``nn.Module`` wrapper that intercepts autograd; here the hot path is a
+**pure jitted train step** over an explicit ``TrainState`` pytree, and the
+engine object is a thin stateful host shell (step counters, timers,
+checkpoint I/O) — SURVEY.md §7 design stance.
+
+API mapping (reference → here):
+
+* ``engine(batch); engine.backward(loss); engine.step()`` →  the same
+  three calls work (micro-batch at a time, grad accumulation in state),
+  but ``forward`` runs the fused forward+backward (JAX cannot split
+  autodiff across Python calls); ``backward`` folds the cached grads into
+  the accumulator; ``step`` applies the update at the boundary.
+* ``engine.train_batch(batch)`` — one full global batch (all
+  micro-batches) in a single compiled step; preferred path.
+* ZeRO stage selection (``_configure_zero_optimizer``,
+  engine.py:888-982) → sharding-rule selection (zero/stages.py).
+* fp16 loss scaling (``_configure_fp16_optimizer``) → LossScaleState in
+  the TrainState; bf16 default needs none.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.comm.mesh import MeshInfo, batch_pspec, make_mesh
+from deepspeed_tpu.config.config import DeepSpeedConfig
+from deepspeed_tpu.config import constants as C
+from deepspeed_tpu.runtime.fp16.loss_scaler import LossScaler
+from deepspeed_tpu.runtime.lr_schedules import get_lr_schedule
+from deepspeed_tpu.runtime.zero.stages import ZeroShardingRules, opt_state_specs
+from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.timer import (
+    BACKWARD_TIMER,
+    FORWARD_TIMER,
+    STEP_TIMER,
+    TRAIN_BATCH_TIMER,
+    SynchronizedWallClockTimer,
+    ThroughputTimer,
+)
+
+MEMORY_OPT_ALLREDUCE_SIZE = 500_000_000
+
+
+def _global_norm(tree: Any) -> jnp.ndarray:
+    leaves = [jnp.sum(jnp.square(g.astype(jnp.float32))) for g in jax.tree.leaves(tree)]
+    return jnp.sqrt(sum(leaves)) if leaves else jnp.zeros((), jnp.float32)
+
+
+def _clip_by_global_norm(tree: Any, max_norm: float) -> Tuple[Any, jnp.ndarray]:
+    norm = _global_norm(tree)
+    factor = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree.map(lambda g: (g.astype(jnp.float32) * factor).astype(g.dtype), tree), norm
+
+
+class DeepSpeedEngine:
+    def __init__(
+        self,
+        model: Callable,
+        params: Any,
+        config: DeepSpeedConfig,
+        optimizer: Any = None,
+        lr_scheduler: Any = None,
+        mesh=None,
+        tp_spec_fn=None,
+        loss_fn: Optional[Callable] = None,
+        rng: Optional[jax.Array] = None,
+        dist_init_required: Optional[bool] = None,
+    ):
+        """``model``: callable ``(params, batch, rng) -> loss`` (or outputs
+        if ``loss_fn`` given, then ``loss_fn(outputs, batch) -> loss``).
+        ``params``: initial parameter pytree (host or device arrays).
+        """
+        self.config = config
+        self._model_fn = model
+        self._loss_fn = loss_fn
+        self.mesh = mesh if mesh is not None else make_mesh(config.mesh)
+        self.mesh_info = MeshInfo.from_mesh(self.mesh)
+        self.global_rank = jax.process_index()
+        self.world_size = self.mesh_info.world_size
+
+        # -- precision ----------------------------------------------------
+        if config.fp16.enabled:
+            self.compute_dtype = jnp.float16
+        elif config.bf16.enabled:
+            self.compute_dtype = jnp.bfloat16
+        else:
+            self.compute_dtype = jnp.float32
+        self.loss_scaler = LossScaler.from_config(config.fp16)
+
+        # -- sharding rules (ZeRO stage -> specs) --------------------------
+        self.zero_rules = ZeroShardingRules(
+            config.zero_config, fsdp_size=self.mesh_info.fsdp_world_size, tp_spec_fn=tp_spec_fn
+        )
+
+        # -- optimizer -----------------------------------------------------
+        self.optimizer = optimizer if optimizer is not None else self._configure_basic_optimizer()
+        self.lr_schedule = self._configure_lr_schedule(lr_scheduler)
+        self.client_lr_scheduler = lr_scheduler
+
+        # -- state ---------------------------------------------------------
+        self._param_specs = self.zero_rules.tree_param_specs(params)
+        self._grad_specs = self.zero_rules.tree_grad_specs(params)
+        params = self._shard_params(params)
+        opt_state = jax.eval_shape(self.optimizer.init, params)
+        self._opt_specs = opt_state_specs(opt_state, params, self.zero_rules)
+        opt_state = jax.jit(
+            self.optimizer.init,
+            out_shardings=jax.tree.map(self._sh, self._opt_specs, is_leaf=lambda x: isinstance(x, P)),
+        )(params)
+
+        if rng is None:
+            rng = jax.random.PRNGKey(config.seed)
+        self.state: Dict[str, Any] = {
+            "params": params,
+            "opt_state": opt_state,
+            "grad_acc": jax.jit(
+                lambda p: jax.tree.map(lambda x: jnp.zeros(x.shape, jnp.float32), p),
+                out_shardings=jax.tree.map(self._sh, self._grad_specs, is_leaf=lambda x: isinstance(x, P)),
+            )(params),
+            "micro_step": jnp.zeros((), jnp.int32),
+            "global_step": jnp.zeros((), jnp.int32),
+            "global_samples": jnp.zeros((), jnp.int32),
+            "loss_scale": self.loss_scaler.init(),
+            "rng": rng,
+        }
+        self._state_shardings = {
+            "params": jax.tree.map(self._sh, self._param_specs, is_leaf=lambda x: isinstance(x, P)),
+            "opt_state": jax.tree.map(self._sh, self._opt_specs, is_leaf=lambda x: isinstance(x, P)),
+            "grad_acc": jax.tree.map(self._sh, self._grad_specs, is_leaf=lambda x: isinstance(x, P)),
+            "micro_step": self._sh(P()),
+            "global_step": self._sh(P()),
+            "global_samples": self._sh(P()),
+            "loss_scale": jax.tree.map(lambda _: self._sh(P()), self.state["loss_scale"]),
+            "rng": self._sh(P()),
+        }
+
+        # -- host-side bookkeeping ----------------------------------------
+        self.timers = SynchronizedWallClockTimer()
+        self.tput_timer = ThroughputTimer(
+            batch_size=config.train_batch_size, steps_per_output=config.steps_per_print
+        )
+        self.wall_clock_breakdown = config.wall_clock_breakdown
+        self._cached_loss = None
+        self._compiled = {}
+        self.skipped_steps = 0
+
+        log_dist(
+            f"engine: zero_stage={self.zero_stage} dtype={self.compute_dtype.__name__} "
+            f"micro_bs={config.train_micro_batch_size_per_gpu} gas={config.gradient_accumulation_steps} "
+            f"dp={self.mesh_info.dp_world_size} (data={self.mesh_info.sizes.get('data',1)} × "
+            f"fsdp={self.mesh_info.fsdp_world_size}) tp={self.mesh_info.model_parallel_world_size} "
+            f"pp={self.mesh_info.pipe_parallel_world_size}"
+        )
+
+    # ------------------------------------------------------------------
+    # configuration helpers
+    # ------------------------------------------------------------------
+    def _sh(self, spec: P) -> NamedSharding:
+        return NamedSharding(self.mesh, spec if spec is not None else P())
+
+    def _configure_basic_optimizer(self):
+        """Reference ``_configure_basic_optimizer`` (engine.py:752-809)."""
+        from deepspeed_tpu.ops.adam.fused_adam import SGD, FusedAdam, FusedAdamW
+        from deepspeed_tpu.ops.lamb.fused_lamb import FusedLamb
+
+        name = self.config.optimizer.name or C.ADAM_OPTIMIZER
+        params = dict(self.config.optimizer.params)
+        params.pop("torch_adam", None)
+        lr = params.pop("lr", 1e-3)
+        if name == C.ADAM_OPTIMIZER:
+            adam_w_mode = params.pop("adam_w_mode", True)
+            return FusedAdam(lr=lr, adam_w_mode=adam_w_mode, **params)
+        if name == C.ADAMW_OPTIMIZER:
+            return FusedAdamW(lr=lr, **params)
+        if name == C.LAMB_OPTIMIZER:
+            return FusedLamb(lr=lr, **params)
+        if name == C.ONEBIT_ADAM_OPTIMIZER:
+            from deepspeed_tpu.runtime.fp16.onebit.adam import OnebitAdam
+
+            return OnebitAdam(lr=lr, fsdp_size=self.mesh_info.fsdp_world_size, **params)
+        if name == C.ONEBIT_LAMB_OPTIMIZER:
+            from deepspeed_tpu.runtime.fp16.onebit.lamb import OnebitLamb
+
+            return OnebitLamb(lr=lr, **params)
+        if name == C.SGD_OPTIMIZER:
+            return SGD(lr=lr, **params)
+        raise ValueError(f"Unknown optimizer '{name}'")
+
+    def _configure_lr_schedule(self, client_scheduler):
+        if callable(client_scheduler):
+            return client_scheduler
+        if self.config.scheduler.type:
+            return get_lr_schedule(self.config.scheduler.type, self.config.scheduler.params)
+        base_lr = getattr(self.optimizer, "lr", 1e-3)
+        return lambda step: jnp.asarray(base_lr, jnp.float32)
+
+    def _shard_params(self, params: Any) -> Any:
+        shardings = jax.tree.map(self._sh, self._param_specs, is_leaf=lambda x: isinstance(x, P))
+        return jax.device_put(jax.tree.map(lambda p: jnp.asarray(p, jnp.float32), params), shardings)
+
+    # ------------------------------------------------------------------
+    # properties (reference engine exposes config as methods, :227-506)
+    # ------------------------------------------------------------------
+    @property
+    def zero_stage(self) -> int:
+        return self.config.zero_config.stage
+    zero_optimization_stage = zero_stage
+
+    @property
+    def train_batch_size(self) -> int:
+        return self.config.train_batch_size
+
+    @property
+    def train_micro_batch_size_per_gpu(self) -> int:
+        return self.config.train_micro_batch_size_per_gpu
+
+    @property
+    def gradient_accumulation_steps(self) -> int:
+        return self.config.gradient_accumulation_steps
+
+    @property
+    def global_steps(self) -> int:
+        return int(self.state["global_step"])
+
+    @property
+    def micro_steps(self) -> int:
+        return int(self.state["micro_step"])
+
+    @property
+    def loss_scale(self) -> float:
+        return float(self.state["loss_scale"].scale)
+
+    @property
+    def module(self):
+        return self._model_fn
+
+    def get_lr(self):
+        return [float(self.lr_schedule(self.state["global_step"]))]
+
+    def is_gradient_accumulation_boundary(self) -> bool:
+        return int(self.state["micro_step"]) % self.gradient_accumulation_steps == 0
+
+    # ------------------------------------------------------------------
+    # core compiled steps
+    # ------------------------------------------------------------------
+    def _compute_loss(self, params, batch, rng, ls_state):
+        cparams = jax.tree.map(lambda p: p.astype(self.compute_dtype), params)
+        out = self._model_fn(cparams, batch, rng)
+        loss = self._loss_fn(out, batch) if self._loss_fn is not None else out
+        loss = jnp.asarray(loss)
+        if loss.ndim != 0:
+            loss = jnp.mean(loss)
+        return self.loss_scaler.scale_loss(loss.astype(jnp.float32), ls_state), loss
+
+    def _micro_step_impl(self, state, batch):
+        """One micro-batch: fused forward+backward, accumulate grads."""
+        rng = jax.random.fold_in(state["rng"], state["micro_step"])
+        (scaled_loss, loss), grads = jax.value_and_grad(
+            lambda p: self._compute_loss(p, batch, rng, state["loss_scale"]), has_aux=True
+        )(state["params"])
+        grads = jax.lax.with_sharding_constraint(
+            grads, jax.tree.map(self._sh, self._grad_specs, is_leaf=lambda x: isinstance(x, P))
+        )
+        new_acc = jax.tree.map(lambda a, g: a + g.astype(jnp.float32), state["grad_acc"], grads)
+        state = dict(state)
+        state["grad_acc"] = new_acc
+        state["micro_step"] = state["micro_step"] + 1
+        state["global_samples"] = state["global_samples"] + self.train_micro_batch_size_per_gpu * self.mesh_info.dp_world_size
+        return state, loss
+
+    def _apply_step_impl(self, state):
+        """Optimizer step at the grad-accumulation boundary (reference
+        ``_take_model_step``, engine.py:1269)."""
+        gas = self.gradient_accumulation_steps
+        grads = jax.tree.map(lambda g: g / gas, state["grad_acc"])
+        grads, overflow = self.loss_scaler.unscale_and_check(grads, state["loss_scale"])
+        grad_norm = jnp.zeros((), jnp.float32)
+        if self.config.gradient_clipping > 0.0:
+            grads, grad_norm = _clip_by_global_norm(grads, self.config.gradient_clipping)
+        lr = jnp.asarray(self.lr_schedule(state["global_step"]), jnp.float32)
+        updates, new_opt = self.optimizer.update(grads, state["opt_state"], state["params"], lr=lr)
+
+        def apply_or_skip(p, u):
+            return jnp.where(overflow, p, (p.astype(jnp.float32) + u).astype(p.dtype))
+
+        new_params = jax.tree.map(apply_or_skip, state["params"], updates)
+        # on overflow, keep the old optimizer state too
+        new_opt = jax.tree.map(
+            lambda old, new: jnp.where(overflow, old, new) if hasattr(old, "shape") else new,
+            state["opt_state"],
+            new_opt,
+        )
+        state = dict(state)
+        state["params"] = new_params
+        state["opt_state"] = new_opt
+        state["grad_acc"] = jax.tree.map(jnp.zeros_like, state["grad_acc"])
+        state["global_step"] = state["global_step"] + jnp.where(overflow, 0, 1)
+        state["loss_scale"] = self.loss_scaler.update(state["loss_scale"], overflow)
+        return state, {"lr": lr, "grad_norm": grad_norm, "overflow": overflow}
+
+    def _get_compiled(self, name: str, fn, donate: bool = True):
+        if name not in self._compiled:
+            self._compiled[name] = jax.jit(fn, donate_argnums=(0,) if donate else ())
+        return self._compiled[name]
+
+    # ------------------------------------------------------------------
+    # public training API
+    # ------------------------------------------------------------------
+    def _prepare_batch(self, batch: Any) -> Any:
+        def put(x):
+            x = np.asarray(x) if not isinstance(x, (jax.Array, np.ndarray)) else x
+            sh = self._sh(batch_pspec(np.ndim(x), seq_sharded=self.mesh_info.seq_parallel_world_size > 1))
+            return jax.device_put(x, sh)
+
+        return jax.tree.map(put, batch)
+
+    def forward(self, batch: Any) -> jnp.ndarray:
+        """Fused forward+backward on one micro-batch; returns the loss.
+
+        Deviation from the reference (engine.py:1089): JAX autodiff cannot
+        be split across Python calls, so gradients are produced here and
+        folded into the accumulator; ``backward()`` validates ordering.
+        """
+        if self.wall_clock_breakdown:
+            self.timers(FORWARD_TIMER).start()
+        batch = self._prepare_batch(batch)
+        fn = self._get_compiled("micro_step", self._micro_step_impl)
+        self.state, loss = fn(self.state, batch)
+        self._cached_loss = loss
+        if self.wall_clock_breakdown:
+            self.timers(FORWARD_TIMER).stop(sync_token=loss)
+        return loss
+
+    __call__ = forward
+
+    def backward(self, loss: Any = None, allreduce_gradients: bool = True) -> Any:
+        """Grad accumulation already happened in ``forward``; this is the
+        ordering checkpoint (and the place a future pipeline engine hooks)."""
+        if self._cached_loss is None:
+            raise RuntimeError("backward() called before forward()")
+        if self.wall_clock_breakdown:
+            self.timers(BACKWARD_TIMER).start()
+            self.timers(BACKWARD_TIMER).stop()
+        loss = self._cached_loss
+        self._cached_loss = None
+        return loss
+
+    def step(self) -> None:
+        """Apply the optimizer step at the gradient-accumulation boundary
+        (reference engine.step, :1318)."""
+        if self.wall_clock_breakdown:
+            self.timers(STEP_TIMER).start()
+        if self.is_gradient_accumulation_boundary():
+            fn = self._get_compiled("apply_step", self._apply_step_impl)
+            self.state, info = fn(self.state)
+            if bool(info["overflow"]):
+                self.skipped_steps += 1
+                log_dist(f"step skipped on overflow; loss scale -> {self.loss_scale}")
+            self._maybe_report_progress()
+        if self.wall_clock_breakdown:
+            self.timers(STEP_TIMER).stop(sync_token=self.state["global_step"])
+            self.timers.log([FORWARD_TIMER, BACKWARD_TIMER, STEP_TIMER])
+
+    def train_batch(self, batch: Any) -> jnp.ndarray:
+        """One full global batch — all GAS micro-batches + optimizer step in
+        a single compiled program (lax.scan over micro-batches).
+
+        ``batch`` leaves must have leading dim ``gas * micro_batch`` (one
+        full train_batch worth of per-replica samples) or ``micro_batch``
+        (gas==1).
+        """
+        self.tput_timer.start()
+        gas = self.gradient_accumulation_steps
+        batch = jax.tree.map(lambda x: np.asarray(x) if not isinstance(x, jax.Array) else x, batch)
+
+        if "train_batch" not in self._compiled:
+
+            def full_step(state, stacked):
+                def body(st, mb):
+                    return self._micro_step_impl(st, mb)
+
+                state, losses = jax.lax.scan(body, state, stacked)
+                state, info = self._apply_step_impl(state)
+                return state, jnp.mean(losses)
+
+            self._compiled["train_batch"] = jax.jit(full_step, donate_argnums=(0,))
+
+        def stack(x):
+            mb = x.shape[0] // gas
+            return x.reshape((gas, mb) + x.shape[1:])
+
+        stacked = jax.tree.map(stack, batch)
+        stacked = jax.tree.map(
+            lambda x: jax.device_put(
+                x, self._sh(P(*([None] + list(batch_pspec(np.ndim(x) - 1, seq_sharded=self.mesh_info.seq_parallel_world_size > 1)))))
+            ),
+            stacked,
+        )
+        self.state, loss = self._compiled["train_batch"](self.state, stacked)
+        self.tput_timer.stop(sync_token=loss)
+        self._maybe_report_progress()
+        return loss
+
+    def eval_batch(self, batch: Any) -> Any:
+        batch = self._prepare_batch(batch)
+        if "eval" not in self._compiled:
+
+            def eval_fn(state, b):
+                rng = jax.random.fold_in(state["rng"], 0x7FFFFFFF)
+                _, loss = self._compute_loss(state["params"], b, rng, state["loss_scale"])
+                return loss
+
+            self._compiled["eval"] = jax.jit(eval_fn)
+        return self._compiled["eval"](self.state, batch)
+
+    def predict(self, batch: Any) -> Any:
+        """Raw model outputs (inference forward)."""
+        batch = self._prepare_batch(batch)
+        if "predict" not in self._compiled:
+
+            def pred_fn(state, b):
+                cparams = jax.tree.map(lambda p: p.astype(self.compute_dtype), state["params"])
+                rng = jax.random.fold_in(state["rng"], 0x7FFFFFFE)
+                return self._model_fn(cparams, b, rng)
+
+            self._compiled["predict"] = jax.jit(pred_fn)
+        return self._compiled["predict"](self.state, batch)
+
+    def _maybe_report_progress(self):
+        step = int(self.state["global_step"])
+        if step > 0 and step % self.config.steps_per_print == 0:
+            log_dist(f"step={step} lr={self.get_lr()[0]:.3e} loss_scale={self.loss_scale:.1f}")
+
+    # ------------------------------------------------------------------
+    # checkpointing (engine.save_checkpoint, reference :1854)
+    # ------------------------------------------------------------------
+    def save_checkpoint(self, save_dir: str, tag: Optional[str] = None, client_state: Optional[dict] = None, save_latest: bool = True):
+        from deepspeed_tpu.runtime.checkpointing import save_checkpoint as _save
+
+        return _save(self, save_dir, tag=tag, client_state=client_state, save_latest=save_latest)
+
+    def load_checkpoint(self, load_dir: str, tag: Optional[str] = None, **kw):
+        from deepspeed_tpu.runtime.checkpointing import load_checkpoint as _load
+
+        return _load(self, load_dir, tag=tag, **kw)

@@ -1,0 +1,116 @@
+"""Loss scaling for fp16-compat mode.
+
+Functional re-design of the reference's ``runtime/fp16/loss_scaler.py``
+(``LossScaler`` :56, ``DynamicLossScaler`` :79): scaler state is a small
+pytree carried through the jitted train step, and the overflow-check /
+scale-update logic runs as traced ``jnp.where`` — no Python-side branch,
+so a skipped step costs nothing extra on device.
+
+bf16 (the TPU-native default) does not need loss scaling; the static
+scaler with scale=1 is used so the train-step graph is identical.
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.config.config import Fp16Config
+
+
+class LossScaleState(NamedTuple):
+    scale: jnp.ndarray  # f32 scalar
+    good_steps: jnp.ndarray  # i32 scalar — consecutive overflow-free steps
+    hysteresis_left: jnp.ndarray  # i32 scalar
+    overflow: jnp.ndarray  # bool scalar — last step overflowed
+
+
+class LossScaler:
+    """Static or dynamic; ``dynamic=False, init_scale=1`` = no-op scaler."""
+
+    def __init__(
+        self,
+        dynamic: bool = False,
+        init_scale: float = 2.0**32,
+        scale_factor: float = 2.0,
+        scale_window: int = 1000,
+        min_scale: float = 1.0,
+        hysteresis: int = 2,
+    ):
+        self.dynamic = dynamic
+        self.init_scale = float(init_scale)
+        self.scale_factor = float(scale_factor)
+        self.scale_window = int(scale_window)
+        self.min_scale = float(min_scale)
+        self.hysteresis = int(hysteresis)
+
+    @classmethod
+    def from_config(cls, cfg: Fp16Config) -> "LossScaler":
+        if not cfg.enabled:
+            return cls(dynamic=False, init_scale=1.0)
+        if cfg.dynamic_loss_scale:
+            return cls(
+                dynamic=True,
+                init_scale=2.0**cfg.initial_scale_power,
+                scale_window=cfg.loss_scale_window,
+                min_scale=cfg.min_loss_scale,
+                hysteresis=cfg.hysteresis,
+            )
+        return cls(dynamic=False, init_scale=cfg.loss_scale)
+
+    def init(self) -> LossScaleState:
+        return LossScaleState(
+            scale=jnp.asarray(self.init_scale, jnp.float32),
+            good_steps=jnp.zeros((), jnp.int32),
+            hysteresis_left=jnp.asarray(self.hysteresis, jnp.int32),
+            overflow=jnp.zeros((), jnp.bool_),
+        )
+
+    def scale_loss(self, loss: jnp.ndarray, state: LossScaleState) -> jnp.ndarray:
+        return loss * state.scale.astype(loss.dtype)
+
+    def unscale_and_check(self, grads: Any, state: LossScaleState) -> Tuple[Any, jnp.ndarray]:
+        """Unscale grads; return (grads, overflow) — overflow is the
+        reference's ``CheckOverflow`` (runtime/utils.py:84) as one fused
+        reduction."""
+        inv = 1.0 / state.scale
+
+        def unscale(g):
+            return (g.astype(jnp.float32) * inv).astype(g.dtype)
+
+        grads = jax.tree.map(unscale, grads)
+        if not self.dynamic:
+            return grads, jnp.zeros((), jnp.bool_)
+        finite = jnp.asarray(True)
+        for g in jax.tree.leaves(grads):
+            finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+        return grads, jnp.logical_not(finite)
+
+    def update(self, state: LossScaleState, overflow: jnp.ndarray) -> LossScaleState:
+        """Dynamic scale update (reference loss_scaler.py:132-172):
+        overflow → cut scale (respecting hysteresis) and reset window;
+        ``scale_window`` clean steps → double scale."""
+        if not self.dynamic:
+            return state._replace(overflow=overflow)
+        hysteresis_left = jnp.where(overflow, jnp.maximum(state.hysteresis_left - 1, 0), state.hysteresis_left)
+        should_cut = jnp.logical_and(overflow, hysteresis_left <= 0)
+        new_scale = jnp.where(
+            should_cut,
+            jnp.maximum(state.scale / self.scale_factor, self.min_scale),
+            state.scale,
+        )
+        hysteresis_left = jnp.where(should_cut, self.hysteresis, hysteresis_left)
+        good = jnp.where(overflow, 0, state.good_steps + 1)
+        grow = jnp.logical_and(jnp.logical_not(overflow), good >= self.scale_window)
+        new_scale = jnp.where(grow, new_scale * self.scale_factor, new_scale)
+        good = jnp.where(grow, 0, good)
+        return LossScaleState(scale=new_scale, good_steps=good, hysteresis_left=hysteresis_left, overflow=overflow)
+
+    @property
+    def loss_scale(self) -> float:
+        return self.init_scale
+
+
+# Reference-compat aliases
+DynamicLossScaler = LossScaler

@@ -1,0 +1,210 @@
+"""Learning-rate schedules.
+
+Re-implements the reference's ``runtime/lr_schedules.py`` schedule zoo —
+``LRRangeTest`` (:301), ``OneCycle`` (:408), ``WarmupLR`` (:677),
+``WarmupDecayLR`` (:761) — as *pure functions of the step count*
+(optax-style schedules), which is the XLA-friendly formulation: the lr
+becomes a traced scalar inside the jitted train step instead of mutable
+Python state.  A thin stateful wrapper preserves the reference's
+``step()/get_lr()/state_dict()`` object API.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Optional
+
+import jax.numpy as jnp
+
+LR_SCHEDULE_REGISTRY: Dict[str, Callable[..., Callable]] = {}
+
+LR_RANGE_TEST = "LRRangeTest"
+ONE_CYCLE = "OneCycle"
+WARMUP_LR = "WarmupLR"
+WARMUP_DECAY_LR = "WarmupDecayLR"
+VALID_LR_SCHEDULES = [LR_RANGE_TEST, ONE_CYCLE, WARMUP_LR, WARMUP_DECAY_LR]
+
+
+def _register(name: str):
+    def deco(fn):
+        LR_SCHEDULE_REGISTRY[name.lower()] = fn
+        return fn
+
+    return deco
+
+
+@_register(LR_RANGE_TEST)
+def lr_range_test(
+    lr_range_test_min_lr: float = 1e-3,
+    lr_range_test_step_size: int = 2000,
+    lr_range_test_step_rate: float = 1.0,
+    lr_range_test_staircase: bool = False,
+    **_ignored,
+) -> Callable:
+    """LR range ("LR finder") sweep: lr = min_lr * (1 + rate * interval)
+    (reference lr_schedules.py:301-406)."""
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        interval = step / lr_range_test_step_size
+        if lr_range_test_staircase:
+            interval = jnp.floor(interval)
+        return lr_range_test_min_lr * (1.0 + interval * lr_range_test_step_rate)
+
+    return schedule
+
+
+@_register(ONE_CYCLE)
+def one_cycle(
+    cycle_min_lr: float,
+    cycle_max_lr: float,
+    decay_lr_rate: float = 0.0,
+    cycle_first_step_size: int = 2000,
+    cycle_second_step_size: Optional[int] = None,
+    cycle_first_stair_count: int = 0,
+    cycle_second_stair_count: Optional[int] = None,
+    decay_step_size: int = 0,
+    cycle_momentum: bool = True,
+    cycle_min_mom: float = 0.8,
+    cycle_max_mom: float = 0.9,
+    decay_mom_rate: float = 0.0,
+    **_ignored,
+) -> Callable:
+    """1cycle policy (reference lr_schedules.py:408-675): linear ramp
+    min→max over the first leg, max→min over the second, then post-cycle
+    decay of the min lr.  Returns lr; momentum companion via
+    ``one_cycle_momentum`` below."""
+    second = cycle_second_step_size if cycle_second_step_size is not None else cycle_first_step_size
+    total_cycle = cycle_first_step_size + second
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        first = jnp.asarray(cycle_first_step_size, jnp.float32)
+        in_first = step < first
+        up = cycle_min_lr + (cycle_max_lr - cycle_min_lr) * (step / first)
+        down_frac = jnp.clip((step - first) / jnp.asarray(second, jnp.float32), 0.0, 1.0)
+        down = cycle_max_lr - (cycle_max_lr - cycle_min_lr) * down_frac
+        in_cycle = step < total_cycle
+        post = step - total_cycle
+        if decay_step_size > 0:
+            decay_intervals = jnp.floor(post / decay_step_size)
+        else:
+            decay_intervals = post
+        decayed = cycle_min_lr / (1.0 + decay_lr_rate * jnp.maximum(decay_intervals, 0.0))
+        return jnp.where(in_first, up, jnp.where(in_cycle, down, decayed))
+
+    return schedule
+
+
+def one_cycle_momentum(
+    cycle_min_mom: float = 0.8,
+    cycle_max_mom: float = 0.9,
+    decay_mom_rate: float = 0.0,
+    cycle_first_step_size: int = 2000,
+    cycle_second_step_size: Optional[int] = None,
+    decay_step_size: int = 0,
+    **_ignored,
+) -> Callable:
+    """Momentum leg of 1cycle: moves inversely to lr (max→min→max)."""
+    second = cycle_second_step_size if cycle_second_step_size is not None else cycle_first_step_size
+    total_cycle = cycle_first_step_size + second
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        first = jnp.asarray(cycle_first_step_size, jnp.float32)
+        in_first = step < first
+        down = cycle_max_mom - (cycle_max_mom - cycle_min_mom) * (step / first)
+        up_frac = jnp.clip((step - first) / jnp.asarray(second, jnp.float32), 0.0, 1.0)
+        up = cycle_min_mom + (cycle_max_mom - cycle_min_mom) * up_frac
+        in_cycle = step < total_cycle
+        post = jnp.maximum(step - total_cycle, 0.0)
+        if decay_step_size > 0:
+            decay_intervals = jnp.floor(post / decay_step_size)
+        else:
+            decay_intervals = post
+        decayed = cycle_max_mom * (1.0 + decay_mom_rate * decay_intervals)
+        return jnp.where(in_first, down, jnp.where(in_cycle, up, decayed))
+
+    return schedule
+
+
+@_register(WARMUP_LR)
+def warmup_lr(
+    warmup_min_lr: float = 0.0,
+    warmup_max_lr: float = 0.001,
+    warmup_num_steps: int = 1000,
+    warmup_type: str = "log",
+    **_ignored,
+) -> Callable:
+    """Warmup then hold (reference lr_schedules.py:677-759).  The
+    reference's default warmup is logarithmic (``log``); ``linear`` also
+    supported."""
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        n = jnp.asarray(max(warmup_num_steps, 1), jnp.float32)
+        if warmup_type == "log":
+            # log(1+step)/log(1+n) ramp, as in the reference (:736)
+            frac = jnp.log1p(jnp.minimum(step, n)) / jnp.log1p(n)
+        else:
+            frac = jnp.minimum(step, n) / n
+        lr = warmup_min_lr + (warmup_max_lr - warmup_min_lr) * frac
+        return jnp.where(step >= n, warmup_max_lr, lr)
+
+    return schedule
+
+
+@_register(WARMUP_DECAY_LR)
+def warmup_decay_lr(
+    total_num_steps: int,
+    warmup_min_lr: float = 0.0,
+    warmup_max_lr: float = 0.001,
+    warmup_num_steps: int = 1000,
+    warmup_type: str = "log",
+    **_ignored,
+) -> Callable:
+    """Warmup then linear decay to zero over ``total_num_steps``
+    (reference lr_schedules.py:761-809)."""
+    base = warmup_lr(warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type)
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        n = jnp.asarray(max(warmup_num_steps, 1), jnp.float32)
+        total = jnp.asarray(max(total_num_steps, 1), jnp.float32)
+        decay = jnp.clip((total - step) / jnp.maximum(total - n, 1.0), 0.0, 1.0)
+        return jnp.where(step < n, base(step), warmup_max_lr * decay)
+
+    return schedule
+
+
+def get_lr_schedule(name: str, params: Dict[str, Any]) -> Callable:
+    """Resolve a scheduler config block to a schedule function."""
+    key = name.lower()
+    if key not in LR_SCHEDULE_REGISTRY:
+        raise ValueError(f"Unknown lr schedule '{name}'; valid: {VALID_LR_SCHEDULES}")
+    return LR_SCHEDULE_REGISTRY[key](**params)
+
+
+class LRScheduler:
+    """Stateful wrapper preserving the reference object API
+    (``step()``, ``get_lr()``, ``state_dict()``/``load_state_dict()``)."""
+
+    def __init__(self, schedule_fn: Callable, last_batch_iteration: int = -1):
+        self.schedule_fn = schedule_fn
+        self.last_batch_iteration = last_batch_iteration
+
+    def step(self, last_batch_iteration: Optional[int] = None) -> None:
+        if last_batch_iteration is None:
+            last_batch_iteration = self.last_batch_iteration + 1
+        self.last_batch_iteration = last_batch_iteration
+
+    def get_lr(self) -> List[float]:
+        return [float(self.schedule_fn(max(self.last_batch_iteration, 0)))]
+
+    def get_last_lr(self) -> List[float]:
+        return self.get_lr()
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"last_batch_iteration": self.last_batch_iteration}
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.last_batch_iteration = sd["last_batch_iteration"]

@@ -1,0 +1,100 @@
+"""`deepspeed.zero`-compatible namespace.
+
+The reference's ``zero.Init`` (partition_parameters.py:339) monkey-patches
+``nn.Module.__init__`` so parameters are partitioned at construction time,
+and ``GatheredParameters`` (:1079) temporarily all-gathers them.  In JAX,
+parameters are explicit pytrees with shardings, so:
+
+* ``Init`` — context manager that shards a params pytree over the fsdp
+  axis as it is created (``Init.shard(params)``), or used as a no-op
+  compatibility shim around model construction.
+* ``GatheredParameters`` — yields a fully-replicated copy of the params
+  (device_put to replicated sharding); mutations inside the block can be
+  written back with ``.update()``.
+* ``estimate_zero2/3_model_states_mem_needs`` — the reference's memory
+  estimators (stage2.py:2019, stage3.py analog), same formulas.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.config.config import ZeroConfig
+from deepspeed_tpu.runtime.zero.stages import ZeroShardingRules
+
+
+class Init:
+    """Shard params over the fsdp axis at construction time.
+
+    Usage (TPU-native)::
+
+        zinit = zero.Init(mesh=mesh)
+        params = zinit.shard(model.init(rng, batch))
+
+    As a context manager it is a no-op shim so reference-style
+    ``with zero.Init():`` blocks still run.
+    """
+
+    def __init__(self, mesh=None, config: Optional[ZeroConfig] = None, module=None, data_parallel_group=None, **_compat):
+        self.mesh = mesh
+        self.config = config or ZeroConfig(stage=3)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def shard(self, params: Any, tp_spec_fn=None) -> Any:
+        mesh = self.mesh
+        if mesh is None:
+            from deepspeed_tpu.comm.mesh import make_mesh
+
+            mesh = make_mesh()
+        fsdp = dict(zip(mesh.axis_names, mesh.devices.shape)).get("fsdp", 1)
+        rules = ZeroShardingRules(self.config, fsdp_size=fsdp, tp_spec_fn=tp_spec_fn)
+        specs = rules.tree_param_specs(params)
+        return jax.device_put(params, jax.tree.map(lambda s: NamedSharding(mesh, s), specs, is_leaf=lambda x: isinstance(x, P)))
+
+
+@contextlib.contextmanager
+def GatheredParameters(params: Any, modifier_rank: Optional[int] = None, fwd_module=None, enabled: bool = True):
+    """Yield a fully-replicated host-visible copy of ``params``
+    (reference partition_parameters.py:1079)."""
+    if not enabled:
+        yield params
+        return
+    gathered = jax.tree.map(lambda p: np.asarray(jax.device_get(p)), params)
+    yield gathered
+
+
+def estimate_zero2_model_states_mem_needs(total_params: int, num_gpus_per_node: int = 1, num_nodes: int = 1, cpu_offload: bool = True, additional_buffer_factor: float = 1.5):
+    """Reference stage2.py:2019 formulas (bytes per device / host)."""
+    total_gpus = num_nodes * num_gpus_per_node
+    if cpu_offload:
+        gpu_mem = 2 * total_params  # bf16 params
+        cpu_mem = total_params * max(4 * total_gpus, 16) * additional_buffer_factor
+    else:
+        gpu_mem = 4 * total_params + 16 * total_params / total_gpus
+        cpu_mem = total_params * 4 * num_gpus_per_node * additional_buffer_factor
+    return int(cpu_mem), int(gpu_mem)
+
+
+def estimate_zero3_model_states_mem_needs(total_params: int, largest_layer_params: int = 0, num_gpus_per_node: int = 1, num_nodes: int = 1, cpu_offload: bool = True, cpu_offload_params: bool = False, zero_init: bool = True, additional_buffer_factor: float = 1.5):
+    total_gpus = num_nodes * num_gpus_per_node
+    gpu_mem_largest = 4 * largest_layer_params
+    if cpu_offload:
+        if cpu_offload_params:
+            gpu_mem = gpu_mem_largest
+            cpu_mem = total_params * max(4 * total_gpus, 18) * additional_buffer_factor
+        else:
+            gpu_mem = gpu_mem_largest + 2 * total_params / total_gpus
+            cpu_mem = total_params * max(4 * total_gpus, 16) * additional_buffer_factor
+    else:
+        gpu_mem = gpu_mem_largest + 18 * total_params / total_gpus
+        cpu_mem = total_params * 4 * num_gpus_per_node * additional_buffer_factor if zero_init else 0
+    return int(cpu_mem), int(gpu_mem)

@@ -1,0 +1,193 @@
+"""ZeRO stages 1–3 as SPMD sharding rules.
+
+The reference implements ZeRO with three optimizer-wrapper classes that
+intercept autograd (``runtime/zero/stage1.py``, ``stage2.py:70``,
+``stage3.py:595``) and hand-roll partitioning, bucketed reduce-scatter,
+gather-on-use hooks and prefetching.  On TPU, every one of those moving
+parts is a *sharding annotation* compiled by GSPMD (SURVEY.md §7 design
+stance):
+
+* **Stage 1** — optimizer state sharded over the ``fsdp`` axis.  XLA
+  partitions the weight-update computation across ranks and all-gathers
+  updated params ("automatic cross-replica sharding of weight update",
+  the ZeRO-1 insight, arXiv:2004.13336).
+* **Stage 2** — + gradients constrained to ``fsdp``-sharded: the grad
+  psum becomes a reduce-scatter (the reference's bucketed async
+  ``average_tensor`` path, stage2.py:780, for free — XLA buckets and
+  overlaps collectives itself).
+* **Stage 3** — + parameters sharded over ``fsdp``; GSPMD inserts
+  all-gathers *just in time* at each use site and frees gathered
+  buffers after last use, which is exactly the reference's
+  fetch/release/prefetch coordinator (stage3.py:169-533) as a compiler
+  schedule.  Small params stay replicated via the persistence threshold
+  (stage3.py:1416 semantics).
+
+The rules compose with tensor-parallel PartitionSpecs: fsdp is placed on
+the largest dimension not already consumed by ``model``/other axes.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.config.config import ZeroConfig
+
+
+def _spec_tuple(spec: Optional[P], ndim: int) -> Tuple[Any, ...]:
+    """Normalize a PartitionSpec to a full-length tuple."""
+    if spec is None:
+        return (None,) * ndim
+    t = tuple(spec)
+    return t + (None,) * (ndim - len(t))
+
+
+def _used_axes(entry) -> Sequence[str]:
+    if entry is None:
+        return ()
+    if isinstance(entry, str):
+        return (entry,)
+    return tuple(entry)
+
+
+def add_fsdp_axis(
+    shape: Sequence[int],
+    base_spec: Optional[P],
+    fsdp_size: int,
+    min_size: int = 0,
+) -> P:
+    """Add the ``fsdp`` axis to a param's PartitionSpec.
+
+    Picks the largest dim that (a) is not already sharded by another axis
+    and (b) is divisible by ``fsdp_size``.  Params smaller than
+    ``min_size`` elements (the ZeRO-3 persistence threshold,
+    stage3.py:1416) or with no divisible dim stay as-is (replicated over
+    fsdp) — matching the reference's ``persistent_parameters`` behavior.
+    """
+    ndim = len(shape)
+    base = _spec_tuple(base_spec, ndim)
+    if fsdp_size <= 1:
+        return P(*base)
+    if int(np.prod(shape)) < max(min_size, 1) and min_size > 0:
+        return P(*base)
+    candidates = [
+        (shape[i], i)
+        for i in range(ndim)
+        if base[i] is None and shape[i] % fsdp_size == 0 and shape[i] >= fsdp_size
+    ]
+    if not candidates:
+        return P(*base)
+    _, dim = max(candidates)
+    new = list(base)
+    new[dim] = "fsdp"
+    return P(*new)
+
+
+class ZeroShardingRules:
+    """Produces PartitionSpecs for params / grads / optimizer state for a
+    given ZeRO stage.  ``tp_spec_fn(path, shape)`` supplies the
+    tensor-parallel base spec (the ``model`` axis) if any."""
+
+    def __init__(self, zero_config: ZeroConfig, fsdp_size: int, tp_spec_fn=None):
+        self.config = zero_config
+        self.stage = zero_config.stage
+        self.fsdp_size = fsdp_size
+        self.tp_spec_fn = tp_spec_fn or (lambda path, shape: None)
+
+    # -- params ------------------------------------------------------------
+    def param_spec(self, path, shape) -> P:
+        base = self.tp_spec_fn(path, shape)
+        if self.stage >= 3 and self.fsdp_size > 1:
+            return add_fsdp_axis(shape, base, self.fsdp_size, min_size=self.config.param_persistence_threshold)
+        return base if base is not None else P()
+
+    # -- grads -------------------------------------------------------------
+    def grad_spec(self, path, shape) -> P:
+        base = self.tp_spec_fn(path, shape)
+        if self.stage >= 2 and self.fsdp_size > 1:
+            # stage 3 grads are sharded the same way as the param so the
+            # reduce-scatter lands at the owner (partition_parameters.py:934)
+            min_size = self.config.param_persistence_threshold if self.stage >= 3 else 0
+            return add_fsdp_axis(shape, base, self.fsdp_size, min_size=min_size)
+        return base if base is not None else P()
+
+    # -- optimizer state ---------------------------------------------------
+    def opt_spec(self, path, shape) -> P:
+        base = self.tp_spec_fn(path, shape)
+        if self.stage >= 1 and self.fsdp_size > 1:
+            min_size = self.config.param_persistence_threshold if self.stage >= 3 else 0
+            return add_fsdp_axis(shape, base, self.fsdp_size, min_size=min_size)
+        return base if base is not None else P()
+
+    # -- pytree helpers ----------------------------------------------------
+    def tree_param_specs(self, params: Any) -> Any:
+        return _tree_specs_with_paths(params, self.param_spec)
+
+    def tree_grad_specs(self, params: Any) -> Any:
+        return _tree_specs_with_paths(params, self.grad_spec)
+
+    def tree_opt_specs_like(self, params: Any) -> Any:
+        """Specs for one params-shaped slot of optimizer state (m or v)."""
+        return _tree_specs_with_paths(params, self.opt_spec)
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def _tree_specs_with_paths(tree: Any, spec_fn) -> Any:
+    return jax.tree_util.tree_map_with_path(lambda path, leaf: spec_fn(_path_str(path), leaf.shape), tree)
+
+
+def opt_state_specs(opt_state: Any, params: Any, rules: ZeroShardingRules) -> Any:
+    """Specs for an arbitrary optimizer-state pytree: leaves whose shape
+    matches a param get that param's opt spec; scalars are replicated.
+
+    Works by matching on shape within params-shaped subtrees (AdamState's
+    exp_avg/exp_avg_sq mirror the params treedef).
+    """
+    param_leaves = jax.tree.leaves(params)
+    param_struct = jax.tree.structure(params)
+    opt_spec_tree = rules.tree_opt_specs_like(params)
+    spec_leaves = jax.tree.leaves(opt_spec_tree, is_leaf=lambda x: isinstance(x, P))
+
+    def leaf_spec(leaf):
+        return None  # placeholder (handled below)
+
+    # Strategy: traverse the opt_state; any subtree whose structure equals
+    # the params structure gets mapped with the param opt specs; any other
+    # leaf (steps, scalars) is replicated.
+    def convert(node):
+        try:
+            if jax.tree.structure(node) == param_struct:
+                leaves = jax.tree.leaves(node)
+                if all(
+                    hasattr(l, "shape") and l.shape == p.shape
+                    for l, p in zip(leaves, param_leaves)
+                ):
+                    return jax.tree.unflatten(param_struct, spec_leaves)
+        except Exception:
+            pass
+        if hasattr(node, "shape"):  # array leaf not matching params
+            return P()
+        # container: recurse over children
+        if isinstance(node, (list, tuple)):
+            converted = [convert(c) for c in node]
+            return type(node)(converted) if not hasattr(node, "_fields") else type(node)(*converted)
+        if isinstance(node, dict):
+            return {k: convert(v) for k, v in node.items()}
+        return P()
+
+    return convert(opt_state)

@@ -1,0 +1,69 @@
+"""Rank-aware logging.
+
+TPU-native analog of the reference's ``deepspeed/utils/logging.py``
+(``LoggerFactory`` at :15, ``log_dist`` at :48).  In a JAX multi-host
+program "rank" means ``jax.process_index()``; inside a single-process
+SPMD program every device is driven by one Python thread, so rank
+filtering only matters across hosts.
+"""
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import sys
+
+_LOG_FORMAT = "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s"
+
+
+class LoggerFactory:
+    @staticmethod
+    def create_logger(name: str = "deepspeed_tpu", level: int = logging.INFO) -> logging.Logger:
+        logger_ = logging.getLogger(name)
+        logger_.setLevel(level)
+        logger_.propagate = False
+        if not logger_.handlers:
+            handler = logging.StreamHandler(stream=sys.stdout)
+            handler.setFormatter(logging.Formatter(_LOG_FORMAT))
+            handler.setLevel(level)
+            logger_.addHandler(handler)
+        return logger_
+
+
+logger = LoggerFactory.create_logger()
+
+
+@functools.lru_cache(maxsize=1)
+def _process_index() -> int:
+    # Avoid importing jax at module import time (keeps CLI tools fast) and
+    # tolerate running before distributed init.
+    if "JAX_PROCESS_INDEX" in os.environ:
+        return int(os.environ["JAX_PROCESS_INDEX"])
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message: str, ranks=None, level: int = logging.INFO) -> None:
+    """Log ``message`` only on the given host ranks (default: rank 0 only).
+
+    ``ranks=[-1]`` logs on every host — same contract as the reference
+    (``utils/logging.py:48``).
+    """
+    my_rank = _process_index()
+    if ranks is None:
+        ranks = [0]
+    if -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def warning_once(message: str) -> None:
+    _warn_once_impl(message)
+
+
+@functools.lru_cache(maxsize=None)
+def _warn_once_impl(message: str) -> None:
+    logger.warning(message)

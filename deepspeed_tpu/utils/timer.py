@@ -1,0 +1,159 @@
+"""Async-dispatch-aware wall clock timers and throughput accounting.
+
+TPU-native analog of the reference's ``deepspeed/utils/timer.py``:
+``SynchronizedWallClockTimer`` (:19) synchronizes CUDA streams around each
+named timer; on TPU the equivalent barrier is blocking on the most recent
+output array (``jax.block_until_ready``) — XLA dispatch is asynchronous, so
+without a sync point wall-clock numbers only measure Python overhead.
+
+``ThroughputTimer`` mirrors the reference's samples/sec logger (:100).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import log_dist
+
+FORWARD_TIMER = "forward"
+BACKWARD_TIMER = "backward"
+STEP_TIMER = "step"
+TRAIN_BATCH_TIMER = "train_batch"
+
+
+def _sync(token: Any = None) -> None:
+    """Block until device work feeding ``token`` (or all work) is done."""
+    if token is not None:
+        try:
+            import jax
+
+            jax.block_until_ready(token)
+            return
+        except Exception:
+            pass
+    # No token: rely on caller having something to block on; a plain
+    # time.time() read still bounds host-side time.
+
+
+class _Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self.started = False
+        self._start = 0.0
+        self._elapsed = 0.0
+
+    def start(self, sync_token: Any = None) -> None:
+        assert not self.started, f"timer {self.name} already started"
+        _sync(sync_token)
+        self._start = time.time()
+        self.started = True
+
+    def stop(self, sync_token: Any = None, record: bool = True) -> None:
+        assert self.started, f"timer {self.name} not started"
+        _sync(sync_token)
+        if record:
+            self._elapsed += time.time() - self._start
+        self.started = False
+
+    def reset(self) -> None:
+        self.started = False
+        self._elapsed = 0.0
+
+    def elapsed(self, reset: bool = True) -> float:
+        if self.started:
+            # report including current in-flight interval
+            now = time.time()
+            value = self._elapsed + (now - self._start)
+        else:
+            value = self._elapsed
+        if reset:
+            self._elapsed = 0.0
+            if self.started:
+                self._start = time.time()
+        return value
+
+
+class SynchronizedWallClockTimer:
+    """Named timers; ``sync_token`` lets callers pass the array whose
+    readiness defines "device done" (cheaper than a full device sync)."""
+
+    def __init__(self):
+        self.timers: Dict[str, _Timer] = {}
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def has_timer(self, name: str) -> bool:
+        return name in self.timers
+
+    def log(self, names: List[str], normalizer: float = 1.0, reset: bool = True, ranks=None) -> None:
+        assert normalizer > 0.0
+        parts = []
+        for name in names:
+            if name in self.timers:
+                ms = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                parts.append(f"{name}: {ms:.2f}ms")
+        if parts:
+            log_dist("time (ms) | " + " | ".join(parts), ranks=ranks)
+
+    def get_mean(self, names: List[str], normalizer: float = 1.0, reset: bool = True) -> Dict[str, float]:
+        out = {}
+        for name in names:
+            if name in self.timers:
+                out[name] = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+        return out
+
+
+class ThroughputTimer:
+    def __init__(self, batch_size: int, start_step: int = 2, steps_per_output: int = 50, monitor_memory: bool = False, logging_fn=None):
+        self.start_time = 0.0
+        self.end_time = 0.0
+        self.started = False
+        self.batch_size = max(1, batch_size)
+        self.start_step = start_step
+        self.epoch_count = 0
+        self.micro_step_count = 0
+        self.global_step_count = 0
+        self.total_elapsed_time = 0.0
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.logging = logging_fn or (lambda msg: log_dist(msg))
+
+    def update_epoch_count(self) -> None:
+        self.epoch_count += 1
+        self.micro_step_count = 0
+
+    def _init_timer(self) -> None:
+        self.initialized = True
+
+    def start(self) -> None:
+        self.started = True
+        if self.global_step_count >= self.start_step:
+            self.start_time = time.time()
+
+    def stop(self, sync_token: Any = None, report_speed: bool = True) -> None:
+        if not self.started:
+            return
+        self.started = False
+        self.micro_step_count += 1
+        self.global_step_count += 1
+        if self.start_time > 0:
+            _sync(sync_token)
+            self.end_time = time.time()
+            duration = self.end_time - self.start_time
+            self.total_elapsed_time += duration
+            if report_speed and self.global_step_count % self.steps_per_output == 0:
+                self.logging(
+                    f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
+                    f"global_step={self.global_step_count}, "
+                    f"RunningAvgSamplesPerSec={self.avg_samples_per_sec():.3f}, "
+                    f"CurrSamplesPerSec={self.batch_size / duration:.3f}"
+                )
+
+    def avg_samples_per_sec(self) -> float:
+        if self.global_step_count > self.start_step and self.total_elapsed_time > 0:
+            samples = self.batch_size * (self.global_step_count - self.start_step)
+            return samples / self.total_elapsed_time
+        return float("nan")

@@ -1,0 +1,34 @@
+"""Test harness: single-process SPMD over 8 virtual CPU devices.
+
+This replaces the reference's ``@distributed_test`` fork-per-rank
+machinery (``tests/unit/common.py:16-104``): instead of N OS processes
+over NCCL, tests run one process whose XLA "host platform" exposes 8
+devices, and every collective/sharding path exercises the same GSPMD
+code that runs on a real TPU slice (SURVEY.md §4 "what to replicate").
+"""
+import os
+
+# Must be set before the CPU backend initializes (first jax array op).
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_TRACEBACK_FILTERING", "off")
+
+import jax  # noqa: E402
+
+# The image's sitecustomize may register a TPU-tunnel backend and force
+# jax_platforms to it; pin back to CPU for hermetic, fast tests.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running test")

@@ -1,0 +1,67 @@
+"""Toy fixtures (reference: tests/unit/simple_model.py — SimpleModel,
+LinearStack, random_dataloader).  Pure-JAX equivalents: a model here is
+(init_fn, apply_fn) over explicit param pytrees."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def simple_model_init(hidden_dim: int, nlayers: int = 2, seed: int = 0):
+    """LinearStack analog: nlayers of [linear+relu], final linear to
+    hidden_dim, loss = MSE to target."""
+    rng = np.random.default_rng(seed)
+    params = {}
+    for i in range(nlayers):
+        params[f"layer_{i}"] = {
+            "w": rng.standard_normal((hidden_dim, hidden_dim)).astype(np.float32) * (1.0 / np.sqrt(hidden_dim)),
+            "b": np.zeros((hidden_dim,), np.float32),
+        }
+    return params
+
+
+def simple_model_loss(params, batch, rng=None):
+    x, y = batch["x"], batch["y"]
+    h = x.astype(jnp.float32)
+    n = len(params)
+    for i in range(n):
+        p = params[f"layer_{i}"]
+        h = h @ p["w"].astype(h.dtype) + p["b"].astype(h.dtype)
+        if i < n - 1:
+            h = jax.nn.relu(h)
+    return jnp.mean((h.astype(jnp.float32) - y.astype(jnp.float32)) ** 2)
+
+
+def random_dataset(batches: int, batch_size: int, hidden_dim: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    n = batches * batch_size
+    x = rng.standard_normal((n, hidden_dim)).astype(np.float32)
+    y = (x @ rng.standard_normal((hidden_dim, hidden_dim)).astype(np.float32) * 0.1).astype(np.float32)
+    return {"x": x, "y": y}
+
+
+def random_batches(batches: int, batch_size: int, hidden_dim: int, seed: int = 0):
+    data = random_dataset(batches, batch_size, hidden_dim, seed)
+    return [
+        {k: v[i * batch_size : (i + 1) * batch_size] for k, v in data.items()}
+        for i in range(batches)
+    ]
+
+
+def base_config(stage: int = 0, micro_bs: int = 8, gas: int = 1, dtype: str = "bf16", mesh=None, **extra):
+    cfg = {
+        "train_micro_batch_size_per_gpu": micro_bs,
+        "gradient_accumulation_steps": gas,
+        "steps_per_print": 1000,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": stage},
+    }
+    if dtype == "bf16":
+        cfg["bf16"] = {"enabled": True}
+    elif dtype == "fp16":
+        cfg["fp16"] = {"enabled": True}
+    if mesh:
+        cfg["mesh"] = mesh
+    cfg.update(extra)
+    return cfg

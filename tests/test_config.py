@@ -1,0 +1,127 @@
+"""Config-system tests (reference: tests/unit/test_config.py,
+test_ds_config.py)."""
+import pytest
+
+from deepspeed_tpu.config.config import DeepSpeedConfig, DeepSpeedConfigError
+
+
+def base_config(**overrides):
+    d = {
+        "train_batch_size": 16,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+    }
+    d.update(overrides)
+    return d
+
+
+class TestBatchTriad:
+    def test_full_triad(self):
+        c = DeepSpeedConfig(
+            {"train_batch_size": 32, "train_micro_batch_size_per_gpu": 4, "gradient_accumulation_steps": 2},
+            world_size=4,
+        )
+        assert c.train_batch_size == 32
+        assert c.train_micro_batch_size_per_gpu == 4
+        assert c.gradient_accumulation_steps == 2
+
+    def test_infer_gas(self):
+        c = DeepSpeedConfig({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 4}, world_size=2)
+        assert c.gradient_accumulation_steps == 4
+
+    def test_infer_micro(self):
+        c = DeepSpeedConfig({"train_batch_size": 32, "gradient_accumulation_steps": 2}, world_size=2)
+        assert c.train_micro_batch_size_per_gpu == 8
+
+    def test_infer_train(self):
+        c = DeepSpeedConfig(
+            {"train_micro_batch_size_per_gpu": 4, "gradient_accumulation_steps": 2}, world_size=4
+        )
+        assert c.train_batch_size == 32
+
+    def test_only_train(self):
+        c = DeepSpeedConfig({"train_batch_size": 32}, world_size=4)
+        assert c.train_micro_batch_size_per_gpu == 8
+        assert c.gradient_accumulation_steps == 1
+
+    def test_invalid_triad(self):
+        with pytest.raises(DeepSpeedConfigError):
+            DeepSpeedConfig(
+                {"train_batch_size": 30, "train_micro_batch_size_per_gpu": 4, "gradient_accumulation_steps": 2},
+                world_size=4,
+            )
+
+    def test_nothing_set(self):
+        with pytest.raises(DeepSpeedConfigError):
+            DeepSpeedConfig({"optimizer": {"type": "Adam"}}, world_size=1)
+
+
+class TestUnknownKeys:
+    def test_unknown_top_level(self):
+        with pytest.raises(DeepSpeedConfigError, match="Unknown top-level"):
+            DeepSpeedConfig(base_config(definitely_not_a_key=1))
+
+    def test_unknown_zero_key(self):
+        with pytest.raises(DeepSpeedConfigError, match="zero_optimization"):
+            DeepSpeedConfig(base_config(zero_optimization={"stage": 2, "typo_key": True}))
+
+
+class TestZeroConfig:
+    def test_defaults(self):
+        c = DeepSpeedConfig(base_config())
+        assert c.zero_config.stage == 0
+        assert not c.zero_enabled
+
+    def test_stage3_with_offload(self):
+        c = DeepSpeedConfig(
+            base_config(
+                zero_optimization={
+                    "stage": 3,
+                    "offload_optimizer": {"device": "cpu", "pin_memory": True},
+                    "offload_param": {"device": "nvme", "nvme_path": "/tmp/nvme"},
+                    "stage3_param_persistence_threshold": 1000,
+                }
+            )
+        )
+        assert c.zero_config.stage == 3
+        assert c.zero_config.offload_optimizer.device == "cpu"
+        assert c.zero_config.offload_param.device == "nvme"
+        assert c.zero_config.param_persistence_threshold == 1000
+
+    def test_legacy_cpu_offload(self):
+        c = DeepSpeedConfig(base_config(zero_optimization={"stage": 2, "cpu_offload": True}))
+        assert c.zero_config.offload_optimizer.device == "cpu"
+
+    def test_bad_stage(self):
+        with pytest.raises(DeepSpeedConfigError):
+            DeepSpeedConfig(base_config(zero_optimization={"stage": 5}))
+
+
+class TestPrecision:
+    def test_bf16(self):
+        c = DeepSpeedConfig(base_config(bf16={"enabled": True}))
+        assert c.compute_dtype == "bfloat16"
+
+    def test_fp16_dynamic(self):
+        c = DeepSpeedConfig(base_config(fp16={"enabled": True}))
+        assert c.fp16.dynamic_loss_scale
+
+    def test_fp16_static(self):
+        c = DeepSpeedConfig(base_config(fp16={"enabled": True, "loss_scale": 128}))
+        assert not c.fp16.dynamic_loss_scale
+        assert c.fp16.loss_scale == 128
+
+    def test_both_fails(self):
+        with pytest.raises(DeepSpeedConfigError):
+            DeepSpeedConfig(base_config(fp16={"enabled": True}, bf16={"enabled": True}))
+
+
+class TestMeshConfig:
+    def test_default(self):
+        c = DeepSpeedConfig(base_config())
+        assert c.mesh.data == -1
+        assert c.mesh.fsdp == 1
+
+    def test_explicit(self):
+        c = DeepSpeedConfig(base_config(mesh={"fsdp": 4, "model": 2}))
+        assert c.mesh.fsdp == 4
+        assert c.mesh.model == 2

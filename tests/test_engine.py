@@ -1,0 +1,139 @@
+"""Engine end-to-end tests on the 8-device CPU mesh (reference:
+tests/unit/test_fp16.py + test_zero.py core paths)."""
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from tests.simple_model import base_config, random_batches, simple_model_init, simple_model_loss
+
+HIDDEN = 64
+
+
+def make_engine(stage=0, mesh=None, dtype="bf16", micro_bs=8, gas=1, **extra):
+    params = simple_model_init(HIDDEN)
+    cfg = base_config(stage=stage, micro_bs=micro_bs, gas=gas, dtype=dtype, mesh=mesh, **extra)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_model_loss, model_parameters=params, config=cfg
+    )
+    return engine
+
+
+def train_losses(engine, steps=10, gas=1, seed=0):
+    batches = random_batches(steps * gas, engine.train_micro_batch_size_per_gpu * engine.mesh_info.dp_world_size, HIDDEN, seed)
+    losses = []
+    i = 0
+    for _ in range(steps):
+        for _ in range(gas):
+            loss = engine(batches[i])
+            engine.backward(loss)
+            engine.step()
+            i += 1
+        losses.append(float(loss))
+    return losses
+
+
+@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+def test_zero_stage_trains(stage):
+    mesh = {"data": 2, "fsdp": 4} if stage else None
+    engine = make_engine(stage=stage, mesh=mesh)
+    losses = train_losses(engine, steps=10)
+    assert losses[-1] < losses[0] * 0.9, f"loss did not decrease: {losses}"
+    assert engine.global_steps == 10
+
+
+def test_zero_stages_agree():
+    """All ZeRO stages are the same math — losses must match closely."""
+    results = {}
+    for stage, mesh in [(0, {"data": 8}), (1, {"fsdp": 8}), (2, {"fsdp": 8}), (3, {"data": 2, "fsdp": 4})]:
+        engine = make_engine(stage=stage, mesh=mesh, dtype="fp32")
+        results[stage] = train_losses(engine, steps=5)
+    for stage in (1, 2, 3):
+        np.testing.assert_allclose(results[0], results[stage], rtol=1e-4), stage
+
+
+def test_gradient_accumulation():
+    engine = make_engine(stage=2, mesh={"fsdp": 8}, gas=4)
+    losses = train_losses(engine, steps=4, gas=4)
+    assert engine.global_steps == 4
+    assert engine.micro_steps == 16
+    assert losses[-1] < losses[0]
+
+
+def test_train_batch_matches_micro_steps():
+    """train_batch (fused scan) must equal the forward/backward/step loop."""
+    cfg = dict(stage=2, mesh={"fsdp": 8}, gas=2, dtype="fp32", micro_bs=4)
+    e1 = make_engine(**cfg)
+    e2 = make_engine(**cfg)
+    batches = random_batches(6, 4 * e1.mesh_info.dp_world_size, HIDDEN)
+    # engine1: micro-step loop
+    l1 = []
+    for s in range(3):
+        for g in range(2):
+            loss = e1(batches[s * 2 + g])
+            e1.backward(loss)
+            e1.step()
+        l1.append(float(loss))
+    # engine2: fused train_batch over concatenated micro-batches
+    l2 = []
+    for s in range(3):
+        full = jax.tree.map(lambda *xs: np.concatenate(xs), batches[s * 2], batches[s * 2 + 1])
+        l2.append(float(e2.train_batch(full)))
+    assert e1.global_steps == e2.global_steps == 3
+    np.testing.assert_allclose(
+        jax.tree.leaves(e1.state["params"])[0][:4],
+        jax.tree.leaves(e2.state["params"])[0][:4],
+        rtol=2e-4,
+    )
+
+
+def test_fp16_dynamic_loss_scale_overflow():
+    """Force an overflow; engine must skip the step and back the scale off
+    (reference test_dynamic_loss_scale.py semantics)."""
+    engine = make_engine(stage=0, dtype="fp16", fp16={"enabled": True, "initial_scale_power": 16, "hysteresis": 1})
+    init_scale = engine.loss_scale
+    assert init_scale == 2.0**16
+    bs = engine.train_micro_batch_size_per_gpu * engine.mesh_info.dp_world_size
+    bad = {
+        "x": np.full((bs, HIDDEN), 1e30, np.float32),
+        "y": np.zeros((bs, HIDDEN), np.float32),
+    }
+    loss = engine(bad)
+    engine.backward(loss)
+    engine.step()
+    assert engine.skipped_steps == 1
+    assert engine.global_steps == 0
+    assert engine.loss_scale == init_scale / 2  # hysteresis=1 → immediate cut
+
+    good = random_batches(1, bs, HIDDEN)[0]
+    loss = engine(good)
+    engine.backward(loss)
+    engine.step()
+    assert engine.global_steps == 1
+    assert engine.skipped_steps == 1
+
+
+def test_eval_batch():
+    engine = make_engine(stage=1, mesh={"fsdp": 8})
+    batch = random_batches(1, engine.train_micro_batch_size_per_gpu * engine.mesh_info.dp_world_size, HIDDEN)[0]
+    loss = engine.eval_batch(batch)
+    assert np.isfinite(float(loss))
+
+
+def test_lamb_optimizer():
+    params = simple_model_init(HIDDEN)
+    cfg = base_config(stage=1, mesh={"fsdp": 8})
+    cfg["optimizer"] = {"type": "Lamb", "params": {"lr": 1e-2}}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=simple_model_loss, model_parameters=params, config=cfg)
+    losses = train_losses(engine, steps=8)
+    assert losses[-1] < losses[0]
+
+
+def test_scheduler_in_engine():
+    cfg_extra = {"scheduler": {"type": "WarmupLR", "params": {"warmup_min_lr": 0.0, "warmup_max_lr": 1e-2, "warmup_num_steps": 5}}}
+    engine = make_engine(stage=0, **cfg_extra)
+    lr0 = engine.get_lr()[0]
+    train_losses(engine, steps=6)
+    lr6 = engine.get_lr()[0]
+    assert lr6 > lr0
+    assert abs(lr6 - 1e-2) < 1e-6
