@@ -1,0 +1,106 @@
+"""Benchmark: GPT-2 training throughput on the available chip(s).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+``vs_baseline`` = achieved MFU / 0.35 (the BASELINE.json north-star MFU
+for ZeRO-3 GPT-2 pretraining).  Extra detail goes to stderr.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def peak_flops_per_chip(backend: str) -> float:
+    """bf16 peak. v5e: 197 TFLOP/s. CPU fallback: nominal 1e12 so the
+    script still reports a number in dev environments."""
+    if backend in ("tpu", "axon"):
+        return 197e12
+    return 1e12
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt2
+
+    backend = jax.default_backend()
+    n_dev = jax.device_count()
+    on_tpu = backend in ("tpu", "axon")
+    log(f"backend={backend} devices={n_dev}")
+
+    cfg = gpt2.GPT2_SMALL if on_tpu else gpt2.GPT2_TINY
+    seq = 1024 if on_tpu else 128
+    micro_bs = 8 if on_tpu else 2
+    steps = 10 if on_tpu else 3
+
+    model_fn, init_fn, tp_fn = gpt2.make_model(cfg)
+    config = {
+        "train_micro_batch_size_per_gpu": micro_bs,
+        "gradient_accumulation_steps": 1,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1 if n_dev > 1 else 0},
+        "mesh": {"fsdp": n_dev, "data": 1} if n_dev > 1 else None,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "steps_per_print": 10_000,
+    }
+    config = {k: v for k, v in config.items() if v is not None}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model_fn, model_parameters=init_fn(), config=config, tp_spec_fn=tp_fn
+    )
+
+    dp = engine.mesh_info.dp_world_size
+    global_bs = micro_bs * dp
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (global_bs, seq), dtype=np.int32)}
+
+    # warmup / compile
+    t0 = time.time()
+    loss = engine.train_batch(batch)
+    jax.block_until_ready(loss)
+    log(f"compile+first step: {time.time()-t0:.1f}s loss={float(loss):.3f}")
+
+    t0 = time.time()
+    for _ in range(steps):
+        loss = engine.train_batch(batch)
+    jax.block_until_ready(loss)
+    dt = (time.time() - t0) / steps
+
+    tokens_per_step = global_bs * seq
+    tokens_per_sec = tokens_per_step / dt
+    tokens_per_sec_chip = tokens_per_sec / n_dev
+
+    # Training FLOPs/token ≈ 6*N + 12*L*D*seq (attention term)
+    n_params = cfg.num_params()
+    flops_per_token = 6 * n_params + 12 * cfg.n_layer * cfg.n_embd * seq
+    achieved = tokens_per_sec_chip * flops_per_token
+    mfu = achieved / peak_flops_per_chip(backend)
+    log(
+        f"step={dt*1000:.1f}ms tokens/s/chip={tokens_per_sec_chip:,.0f} "
+        f"model={n_params/1e6:.0f}M seq={seq} MFU={mfu*100:.1f}%"
+    )
+
+    print(
+        json.dumps(
+            {
+                "metric": f"gpt2_{n_params//1_000_000}M_train_tokens_per_sec_per_chip",
+                "value": round(tokens_per_sec_chip, 1),
+                "unit": "tokens/s/chip",
+                "vs_baseline": round(mfu / 0.35, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -71,11 +71,17 @@ def initialize(
     if dist_init_required is None or dist_init_required:
         init_distributed(verbose=False)
 
-    # Parse config twice-cheaply: once to get the mesh block, then with the
-    # resolved dp world size for the batch triad.
-    pre = DeepSpeedConfig(config, world_size=1)
+    # Resolve the mesh first (the batch triad needs the dp world size).
     if mesh is None:
-        mesh = make_mesh(pre.mesh)
+        import json as _json
+
+        from deepspeed_tpu.config.config import MeshConfig
+
+        raw = config
+        if isinstance(raw, str):
+            with open(raw) as f:
+                raw = _json.load(f)
+        mesh = make_mesh(MeshConfig.from_dict(raw.get("mesh")))
     info = MeshInfo.from_mesh(mesh)
     ds_config = DeepSpeedConfig(config, world_size=info.dp_world_size)
 

@@ -1,0 +1,101 @@
+"""Error-feedback 1-bit compressed collectives.
+
+TPU-native port of the reference's compressed allreduce algorithm
+(``runtime/comm/nccl.py:47-186``; same algorithm over MPI in
+``comm/mpi.py``): each rank adds its error-feedback residual, compresses
+to sign bits + an L1 scale, exchanges chunks (all_to_all), every rank
+averages the signs it "serves", re-compresses with a server-side
+residual, and all-gathers the result.  cupy bit-packing + NCCL
+primitives become pure XLA ops inside ``shard_map`` over a named mesh
+axis — on TPU the sign tensors ride ICI as int8 (XLA has no bit-packed
+dtype; volume saving is 4× vs fp32 rather than the reference's ~32×,
+but the error-feedback math and convergence behavior are identical,
+and int8 is the densest ICI-native exchange format).
+
+State (worker_error, server_error) lives in the optimizer state.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _shard_map():
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:  # older jax fallback
+        from jax.experimental.shard_map import shard_map as sm
+    return sm
+
+
+def _sign_compress(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Compress to {-1,+1} int8 signs + scalar L1 scale (reference
+    nccl.py:76-86: scale = |x|.mean(); sign with 0→+1)."""
+    scale = jnp.mean(jnp.abs(x))
+    signs = jnp.where(x >= 0, jnp.int8(1), jnp.int8(-1))
+    return signs, scale
+
+
+def _decompress(signs: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    return signs.astype(jnp.float32) * scale
+
+
+def _body(x, worker_error, server_error, *, axis_name: str):
+    """Per-rank body under shard_map.  Shapes (leading mapped dim of 1):
+    x, worker_error: (1, M); server_error: (1, M//n).  Returns the
+    averaged tensor (1, M) (identical on every rank) + new errors."""
+    n = jax.lax.psum(1, axis_name)
+    x = x[0]
+    werr = worker_error[0]
+    serr = server_error[0]
+    m = x.shape[0]
+    chunk = m // n
+
+    corrected = x + werr
+    signs, scale = _sign_compress(corrected)
+    new_werr = corrected - _decompress(signs, scale)
+
+    # Phase 1 — scatter: rank j receives chunk j from every rank
+    # (reference's all_to_all_single, nccl.py:99) + scales via all_gather.
+    served = jax.lax.all_to_all(signs.reshape(n, chunk), axis_name, split_axis=0, concat_axis=0, tiled=False)
+    scales = jax.lax.all_gather(scale, axis_name)  # (n,)
+    avg = jnp.mean(served.astype(jnp.float32) * scales[:, None], axis=0)  # (chunk,)
+
+    # Phase 2 — server-side re-compress with server error feedback
+    # (nccl.py:120-150).
+    corrected_srv = avg + serr
+    srv_signs, srv_scale = _sign_compress(corrected_srv)
+    new_serr = corrected_srv - _decompress(srv_signs, srv_scale)
+
+    # Phase 3 — allgather the served slices back (nccl.py:152-170).
+    all_signs = jax.lax.all_gather(srv_signs, axis_name)  # (n, chunk)
+    all_scales = jax.lax.all_gather(srv_scale, axis_name)  # (n,)
+    out = (all_signs.astype(jnp.float32) * all_scales[:, None]).reshape(-1)
+    return out[None], new_werr[None], new_serr[None]
+
+
+def compressed_allreduce(x_per_rank, worker_error, server_error, mesh, axis_name: str = "data"):
+    """1-bit error-feedback averaged allreduce.
+
+    ``x_per_rank``: (n, M) — row i is rank i's local tensor (M divisible
+    by n).  ``worker_error``: (n, M).  ``server_error``: (n, M // n).
+    Returns (avg (n, M) — every row identical, new_worker_error,
+    new_server_error), all sharded over ``axis_name``.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    n = x_per_rank.shape[0]
+    m = x_per_rank.shape[1]
+    if m % n:
+        raise ValueError(f"tensor length {m} not divisible by axis size {n}")
+    fn = functools.partial(_body, axis_name=axis_name)
+    mapped = _shard_map()(
+        fn,
+        mesh=mesh,
+        in_specs=(P(axis_name), P(axis_name), P(axis_name)),
+        out_specs=(P(axis_name), P(axis_name), P(axis_name)),
+        check_vma=False,
+    )
+    return mapped(x_per_rank, worker_error, server_error)

@@ -1,0 +1,245 @@
+"""GPT-2 family — the flagship training model.
+
+The reference trains GPT-2 through client Megatron-LM code (SURVEY.md §6
+workload ladder: GPT-2 345M/1.5B ZeRO-3); this framework ships the model
+natively, TPU-idiomatic:
+
+* all transformer blocks **stacked on a leading layer dim** and executed
+  with ``lax.scan`` — one trace/compile regardless of depth, and the
+  layer dim doubles as the pipeline-partition dim;
+* attention through the Pallas flash-attention op (ops/attention);
+* Megatron-style tensor parallelism expressed as PartitionSpecs on the
+  weights (``tp_spec_fn``): qkv/fc column-parallel, proj row-parallel,
+  vocab-sharded embedding — GSPMD inserts the psums the reference gets
+  from explicit mpu collectives;
+* activation checkpointing via ``jax.checkpoint`` policy on the scanned
+  block (reference ``runtime/activation_checkpointing``).
+
+Params are a plain pytree of jnp arrays (fp32 masters; engine casts).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.ops.attention.flash_attention import flash_attention, mha_reference
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    dropout: float = 0.0
+    layer_norm_epsilon: float = 1e-5
+    use_flash_attention: bool = True
+    remat: bool = True  # activation checkpointing per block
+    remat_policy: str = "nothing_saveable"  # or "dots_with_no_batch_dims_saveable"
+    dtype: Any = jnp.float32  # activation dtype is set by the engine cast
+
+    @property
+    def head_dim(self) -> int:
+        assert self.n_embd % self.n_head == 0
+        return self.n_embd // self.n_head
+
+    def num_params(self) -> int:
+        d, l, v, s = self.n_embd, self.n_layer, self.vocab_size, self.n_positions
+        per_layer = 12 * d * d + 13 * d
+        return v * d + s * d + l * per_layer + 2 * d
+
+
+# Model zoo (sizes as in the GPT-2 paper; 1.5B == "xl" is the BASELINE
+# north-star model).
+GPT2_TINY = GPT2Config(vocab_size=512, n_positions=128, n_embd=64, n_layer=2, n_head=4)
+GPT2_SMALL = GPT2Config()  # 124M
+GPT2_MEDIUM = GPT2Config(n_embd=1024, n_layer=24, n_head=16)  # 350M
+GPT2_LARGE = GPT2Config(n_embd=1280, n_layer=36, n_head=20)  # 774M
+GPT2_XL = GPT2Config(n_embd=1600, n_layer=48, n_head=25)  # 1.5B
+
+PRESETS = {
+    "tiny": GPT2_TINY,
+    "gpt2": GPT2_SMALL,
+    "gpt2-small": GPT2_SMALL,
+    "gpt2-medium": GPT2_MEDIUM,
+    "gpt2-large": GPT2_LARGE,
+    "gpt2-xl": GPT2_XL,
+    "gpt2-1.5b": GPT2_XL,
+}
+
+
+def init_params(cfg: GPT2Config, seed: int = 0) -> Dict[str, Any]:
+    """GPT-2 init: normal(0.02), residual projections scaled by
+    1/sqrt(2*n_layer)."""
+    rng = np.random.default_rng(seed)
+    d, l = cfg.n_embd, cfg.n_layer
+    std = 0.02
+    proj_std = std / np.sqrt(2 * l)
+
+    def n(*shape, s=std):
+        return (rng.standard_normal(shape) * s).astype(np.float32)
+
+    def z(*shape):
+        return np.zeros(shape, np.float32)
+
+    def o(*shape):
+        return np.ones(shape, np.float32)
+
+    return {
+        "wte": n(cfg.vocab_size, d),
+        "wpe": n(cfg.n_positions, d, s=0.01),
+        "blocks": {
+            "ln1_g": o(l, d),
+            "ln1_b": z(l, d),
+            "qkv_w": n(l, d, 3 * d),
+            "qkv_b": z(l, 3 * d),
+            "proj_w": n(l, d, d, s=proj_std),
+            "proj_b": z(l, d),
+            "ln2_g": o(l, d),
+            "ln2_b": z(l, d),
+            "fc_w": n(l, d, 4 * d),
+            "fc_b": z(l, 4 * d),
+            "fc_proj_w": n(l, 4 * d, d, s=proj_std),
+            "fc_proj_b": z(l, d),
+        },
+        "lnf_g": o(d),
+        "lnf_b": z(d),
+    }
+
+
+def tp_spec_fn(path: str, shape) -> Optional[P]:
+    """Megatron-style tensor-parallel specs over the ``model`` axis
+    (reference delegates TP to Megatron mpu; inference-side slicing in
+    module_inject/replace_module.py:11-88 follows the same column/row
+    split)."""
+    name = path.split("/")[-1]
+    col = {"qkv_w": P(None, None, "model"), "qkv_b": P(None, "model"),
+           "fc_w": P(None, None, "model"), "fc_b": P(None, "model")}
+    row = {"proj_w": P(None, "model", None), "fc_proj_w": P(None, "model", None)}
+    if name in col:
+        return col[name]
+    if name in row:
+        return row[name]
+    if name == "wte":
+        return P("model", None)  # vocab-parallel embedding
+    return None
+
+
+def _layer_norm(x, g, b, eps):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * g.astype(jnp.float32) + b.astype(jnp.float32)).astype(x.dtype)
+
+
+def _dropout(x, rate, rng, deterministic):
+    if deterministic or rate == 0.0 or rng is None:
+        return x
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
+
+
+def _block(cfg: GPT2Config, x, lp, rng, deterministic: bool):
+    """One transformer block; ``lp`` holds this layer's slice of the
+    stacked params."""
+    B, T, D = x.shape
+    H, hd = cfg.n_head, cfg.head_dim
+    r1 = r2 = r3 = None
+    if rng is not None:
+        r1, r2, r3 = jax.random.split(rng, 3)
+
+    h = _layer_norm(x, lp["ln1_g"], lp["ln1_b"], cfg.layer_norm_epsilon)
+    qkv = h @ lp["qkv_w"].astype(h.dtype) + lp["qkv_b"].astype(h.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        return t.reshape(B, T, H, hd).transpose(0, 2, 1, 3)
+
+    q, k, v = heads(q), heads(k), heads(v)
+    if cfg.use_flash_attention and T >= 128:
+        attn = flash_attention(q, k, v, causal=True)
+    else:
+        attn = mha_reference(q, k, v, causal=True)
+    attn = attn.transpose(0, 2, 1, 3).reshape(B, T, D)
+    attn = attn @ lp["proj_w"].astype(attn.dtype) + lp["proj_b"].astype(attn.dtype)
+    x = x + _dropout(attn, cfg.dropout, r1, deterministic)
+
+    h = _layer_norm(x, lp["ln2_g"], lp["ln2_b"], cfg.layer_norm_epsilon)
+    h = h @ lp["fc_w"].astype(h.dtype) + lp["fc_b"].astype(h.dtype)
+    h = jax.nn.gelu(h, approximate=True)
+    h = _dropout(h, cfg.dropout, r2, deterministic)
+    h = h @ lp["fc_proj_w"].astype(h.dtype) + lp["fc_proj_b"].astype(h.dtype)
+    x = x + _dropout(h, cfg.dropout, r3, deterministic)
+    return x
+
+
+def apply(params: Dict[str, Any], tokens: jnp.ndarray, cfg: GPT2Config, rng=None, deterministic: bool = True) -> jnp.ndarray:
+    """Forward pass: ``tokens (B, T) int32`` → logits ``(B, T, V)``."""
+    B, T = tokens.shape
+    x = jnp.take(params["wte"], tokens, axis=0) + params["wpe"][:T][None]
+    x = x.astype(params["blocks"]["qkv_w"].dtype)
+
+    n_layer = cfg.n_layer
+    if rng is not None:
+        layer_rngs = jax.random.split(rng, n_layer)
+    else:
+        layer_rngs = jnp.zeros((n_layer, 2), jnp.uint32)
+
+    block_fn = functools.partial(_block, cfg)
+
+    def scan_body(carry, xs):
+        lp, lr = xs
+        r = lr if rng is not None else None
+        y = block_fn(carry, lp, r, deterministic)
+        return y, None
+
+    if cfg.remat:
+        policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
+        scan_body = jax.checkpoint(scan_body, policy=policy, prevent_cse=False)
+
+    x, _ = jax.lax.scan(scan_body, x, (params["blocks"], layer_rngs))
+    x = _layer_norm(x, params["lnf_g"], params["lnf_b"], cfg.layer_norm_epsilon)
+    logits = x @ params["wte"].T.astype(x.dtype)  # tied embedding head
+    return logits
+
+
+def loss_fn(params: Dict[str, Any], batch: Dict[str, Any], rng=None, cfg: GPT2Config = None, deterministic: bool = False) -> jnp.ndarray:
+    """Next-token cross entropy.  ``batch``: {"input_ids": (B, T)} with
+    optional "labels" (default: shifted input_ids) and "attention_mask"."""
+    tokens = batch["input_ids"]
+    logits = apply(params, tokens, cfg, rng=rng, deterministic=deterministic)
+    if "labels" in batch:
+        labels = batch["labels"]
+        logits_shift = logits
+    else:
+        labels = tokens[:, 1:]
+        logits_shift = logits[:, :-1]
+    logits32 = logits_shift.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits32, axis=-1)
+    gold = jnp.take_along_axis(logits32, labels[..., None], axis=-1)[..., 0]
+    nll = logz - gold
+    if "attention_mask" in batch:
+        # mask indexed at the *label* position (tokens[:, 1:]), not the query
+        mask = batch["attention_mask"][:, 1 : 1 + nll.shape[1]].astype(jnp.float32) if "labels" not in batch else batch["attention_mask"][:, : nll.shape[1]].astype(jnp.float32)
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.mean(nll)
+
+
+def make_model(cfg: GPT2Config):
+    """Returns (model_fn, init_fn, tp_spec_fn) — ``model_fn`` plugs
+    straight into ``deepspeed_tpu.initialize(model=...)``."""
+
+    def model_fn(params, batch, rng):
+        # rng=None ⇒ eval mode (engine passes None from eval_batch/predict)
+        deterministic = rng is None or cfg.dropout == 0.0
+        return loss_fn(params, batch, rng=rng, cfg=cfg, deterministic=deterministic)
+
+    return model_fn, functools.partial(init_params, cfg), tp_spec_fn

@@ -70,15 +70,21 @@ def mha_reference(
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float, causal: bool, block_k: int):
     block_q, d = q_ref.shape[1], q_ref.shape[2]
     seq_k = k_ref.shape[1]
+    seq_q_total = pl.num_programs(1) * block_q
     q_idx = pl.program_id(1)
+    # End-aligned causal offset (queries are the LAST seq_q positions of
+    # the kv sequence — decode convention, matches mha_reference's
+    # tril(k=klen-qlen)).
+    causal_offset = seq_k - seq_q_total
 
     q = q_ref[0].astype(jnp.float32) * sm_scale  # (block_q, d)
 
     num_kv = seq_k // block_k
     if causal:
-        # Last KV block whose start can be <= this q block's end.
-        hi = jax.lax.div((q_idx + 1) * block_q + block_k - 1, block_k)
-        hi = jnp.minimum(hi, num_kv)
+        # Last KV block whose start can be <= this q block's end position.
+        q_end = causal_offset + (q_idx + 1) * block_q
+        hi = jax.lax.div(q_end + block_k - 1, block_k)
+        hi = jnp.clip(hi, 0, num_kv)
     else:
         hi = num_kv
 
@@ -88,7 +94,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float, causal: bo
         v = v_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # (block_q, block_k)
         if causal:
-            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            q_pos = causal_offset + q_idx * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             k_pos = i * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
         m_cur = jnp.max(s, axis=1, keepdims=True)  # (block_q, 1)
@@ -150,7 +156,8 @@ def _blockwise_xla(q, k, v, causal: bool, sm_scale: float, block_k: int):
     qf = q.astype(jnp.float32) * sm_scale
     kf = k.astype(jnp.float32).reshape(b, h, num_kv, block_k, d)
     vf = v.astype(jnp.float32).reshape(b, h, num_kv, block_k, d)
-    q_pos = jnp.arange(sq)[:, None]
+    # end-aligned causal positions (match mha_reference tril(k=klen-qlen))
+    q_pos = (sk - sq) + jnp.arange(sq)[:, None]
 
     @functools.partial(jax.checkpoint, prevent_cse=False)
     def block(carry, inputs):

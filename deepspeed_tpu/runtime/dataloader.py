@@ -68,14 +68,19 @@ class DeepSpeedDataLoader:
         self.process_count = process_count if process_count is not None else jax.process_count()
         self.epoch = 0
 
-        self._columnar = isinstance(dataset, dict) or (
-            isinstance(dataset, (tuple, list))
-            and len(dataset) > 0
-            and all(isinstance(x, np.ndarray) for x in jax.tree.leaves(dataset))
-            and not np.isscalar(dataset[0])
-            and hasattr(dataset[0], "shape")
+        # Columnar = dict (or tuple) of equal-length arrays, one row per
+        # example.  A *list* is always treated as a sequence of per-example
+        # pytrees — a list of equal-shape arrays is ambiguous, and rows win.
+        self._columnar = isinstance(dataset, (dict, tuple)) and all(
+            isinstance(x, np.ndarray) for x in jax.tree.leaves(dataset)
         )
-        self._n = len(jax.tree.leaves(dataset)[0]) if self._columnar else len(dataset)
+        if self._columnar:
+            lengths = {len(x) for x in jax.tree.leaves(dataset)}
+            if len(lengths) != 1:
+                raise ValueError(f"columnar dataset has unequal column lengths: {sorted(lengths)}")
+            self._n = lengths.pop()
+        else:
+            self._n = len(dataset)
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch

@@ -362,7 +362,7 @@ class DeepSpeedEngine:
         if self.is_gradient_accumulation_boundary():
             fn = self._get_compiled("apply_step", self._apply_step_impl)
             self.state, info = fn(self.state)
-            if bool(info["overflow"]):
+            if self.loss_scaler.dynamic and bool(info["overflow"]):
                 self.skipped_steps += 1
                 log_dist(f"step skipped on overflow; loss scale -> {self.loss_scale}")
             self._maybe_report_progress()
@@ -390,7 +390,7 @@ class DeepSpeedEngine:
 
                 state, losses = jax.lax.scan(body, state, stacked)
                 state, info = self._apply_step_impl(state)
-                return state, jnp.mean(losses)
+                return state, jnp.mean(losses), info
 
             self._compiled["train_batch"] = jax.jit(full_step, donate_argnums=(0,))
 
@@ -405,7 +405,11 @@ class DeepSpeedEngine:
             ),
             stacked,
         )
-        self.state, loss = self._compiled["train_batch"](self.state, stacked)
+        self.state, loss, info = self._compiled["train_batch"](self.state, stacked)
+        # host sync on the overflow flag only when dynamic scaling is live
+        if self.loss_scaler.dynamic and bool(info["overflow"]):
+            self.skipped_steps += 1
+            log_dist(f"step skipped on overflow; loss scale -> {self.loss_scale}")
         self.tput_timer.stop(sync_token=loss)
         self._maybe_report_progress()
         return loss
@@ -415,8 +419,8 @@ class DeepSpeedEngine:
         if "eval" not in self._compiled:
 
             def eval_fn(state, b):
-                rng = jax.random.fold_in(state["rng"], 0x7FFFFFFF)
-                _, loss = self._compute_loss(state["params"], b, rng, state["loss_scale"])
+                # rng=None ⇒ deterministic eval (model convention)
+                _, loss = self._compute_loss(state["params"], b, None, state["loss_scale"])
                 return loss
 
             self._compiled["eval"] = jax.jit(eval_fn)
@@ -429,8 +433,7 @@ class DeepSpeedEngine:
 
             def pred_fn(state, b):
                 cparams = jax.tree.map(lambda p: p.astype(self.compute_dtype), state["params"])
-                rng = jax.random.fold_in(state["rng"], 0x7FFFFFFE)
-                return self._model_fn(cparams, b, rng)
+                return self._model_fn(cparams, b, None)
 
             self._compiled["predict"] = jax.jit(pred_fn)
         return self._compiled["predict"](self.state, batch)

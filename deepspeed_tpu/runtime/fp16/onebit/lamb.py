@@ -1,0 +1,105 @@
+"""1-bit LAMB.
+
+Re-implements the reference's ``runtime/fp16/onebit/lamb.py``
+(``OnebitLamb`` :11; algorithm in arXiv:2104.06069): LAMB with a warmup
+phase, then frozen variance + compressed momentum exchange, with the
+trust ratio computed from *frozen-phase* statistics — the reference
+tracks per-layer ``scaling_coeff`` from the warmup so the compressed
+phase keeps LAMB's layerwise adaptivity without communicating norms.
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.adam.fused_adam import _map_multi
+
+
+class OnebitLambState(NamedTuple):
+    step: jnp.ndarray
+    exp_avg: Any
+    exp_avg_sq: Any
+    worker_error: Any
+    scaling_coeff: Any  # per-param frozen trust ratio (lamb_coeff)
+
+
+class OnebitLamb:
+    name = "onebitlamb"
+
+    def __init__(
+        self,
+        lr: float = 1e-3,
+        betas=(0.9, 0.999),
+        eps: float = 1e-8,
+        weight_decay: float = 0.0,
+        freeze_step: int = 100000,
+        max_coeff: float = 10.0,
+        min_coeff: float = 0.01,
+        coeff_beta: float = 0.9,
+        **_compat,
+    ):
+        self.lr = lr
+        self.b1, self.b2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.freeze_step = int(freeze_step)
+        self.max_coeff = max_coeff
+        self.min_coeff = min_coeff
+        self.coeff_beta = coeff_beta
+
+    def init(self, params: Any) -> OnebitLambState:
+        zeros = lambda: jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        ones_scalar = jax.tree.map(lambda p: jnp.ones((), jnp.float32), params)
+        return OnebitLambState(
+            step=jnp.zeros((), jnp.int32),
+            exp_avg=zeros(),
+            exp_avg_sq=zeros(),
+            worker_error=zeros(),
+            scaling_coeff=ones_scalar,
+        )
+
+    def update(self, grads: Any, state: OnebitLambState, params: Any, lr: Optional[jnp.ndarray] = None):
+        lr = self.lr if lr is None else lr
+        step = state.step + 1
+        b1, b2 = self.b1, self.b2
+        frozen = step > self.freeze_step
+        # v bias correction clamped at freeze (see onebit/adam.py)
+        t_eff = jnp.minimum(step, self.freeze_step).astype(jnp.float32)
+        c2 = 1.0 - b2**t_eff
+
+        def one(g, m, v, werr, coeff, p):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            m_new = b1 * m + (1.0 - b1) * g
+            v_new = jnp.where(frozen, v, b2 * v + (1.0 - b2) * g * g)
+
+            # compressed momentum (error feedback), frozen phase only
+            corrected = m_new + werr
+            scale = jnp.mean(jnp.abs(corrected))
+            m_comp = jnp.where(corrected >= 0, scale, -scale)
+            m_eff = jnp.where(frozen, m_comp, m_new)
+            werr_out = jnp.where(frozen, corrected - m_comp, werr)
+
+            update_dir = m_eff / (jnp.sqrt(v_new / c2) + self.eps)
+            if self.weight_decay > 0.0:
+                update_dir = update_dir + self.weight_decay * p32
+
+            w_norm = jnp.linalg.norm(p32.reshape(-1))
+            u_norm = jnp.linalg.norm(update_dir.reshape(-1))
+            fresh = jnp.where(
+                (w_norm > 0) & (u_norm > 0),
+                jnp.clip(w_norm / u_norm, self.min_coeff, self.max_coeff),
+                jnp.float32(1.0),
+            )
+            # warmup: EMA the coeff (reference's lamb_coeff_freeze);
+            # frozen: reuse the frozen coefficient
+            coeff_new = jnp.where(frozen, coeff, self.coeff_beta * coeff + (1 - self.coeff_beta) * fresh)
+            trust = jnp.where(frozen, coeff, fresh)
+            return -lr * trust * update_dir, m_new, v_new, werr_out, coeff_new
+
+        updates, m, v, werr, coeff = _map_multi(
+            one, 5, grads, state.exp_avg, state.exp_avg_sq, state.worker_error, state.scaling_coeff, params
+        )
+        return updates, OnebitLambState(step=step, exp_avg=m, exp_avg_sq=v, worker_error=werr, scaling_coeff=coeff)

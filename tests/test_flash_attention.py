@@ -1,0 +1,77 @@
+"""Flash-attention kernel numerics vs reference (the reference's
+test_cuda_forward.py / test_cuda_backward.py role: kernel vs framework
+numerics)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.attention.flash_attention import (
+    _blockwise_xla,
+    flash_attention,
+    mha_reference,
+)
+
+
+def qkv(b=2, h=4, sq=256, sk=256, d=64, dtype=jnp.float32, seed=0):
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return (
+        jax.random.normal(k1, (b, h, sq, d), dtype),
+        jax.random.normal(k2, (b, h, sk, d), dtype),
+        jax.random.normal(k3, (b, h, sk, d), dtype),
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_forward_matches_reference(causal):
+    q, k, v = qkv()
+    out = flash_attention(q, k, v, causal=causal, interpret=True)
+    ref = mha_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_forward_rectangular_blocks():
+    q, k, v = qkv(sq=128, sk=384)
+    out = flash_attention(q, k, v, causal=False, block_q=64, block_k=128, interpret=True)
+    ref = mha_reference(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_blockwise_xla_matches_reference():
+    q, k, v = qkv()
+    out = _blockwise_xla(q, k, v, causal=True, sm_scale=q.shape[-1] ** -0.5, block_k=64)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_backward_matches_reference(causal):
+    q, k, v = qkv(b=1, h=2, sq=128, sk=128, d=32)
+
+    def f_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal, interpret=True) ** 2)
+
+    def f_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=causal) ** 2)
+
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr), atol=1e-4, rtol=1e-4)
+
+
+def test_bf16_forward_close():
+    q, k, v = qkv(dtype=jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    ref = mha_reference(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32), causal=True
+    )
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref), atol=3e-2, rtol=3e-2)
+
+
+def test_tiny_shapes_fallback():
+    q, k, v = qkv(sq=7, sk=7, d=16)
+    out = flash_attention(q, k, v, causal=True)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)

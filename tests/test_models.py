@@ -1,0 +1,117 @@
+"""Model-family tests: GPT-2 and BERT train end-to-end through the
+engine on the CPU mesh, including TP (model axis) and ZeRO-3 (fsdp)."""
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import bert, gpt2
+
+
+def token_batch(bs, seq, vocab, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, vocab, (bs, seq), dtype=np.int32)}
+
+
+def make_gpt2_engine(mesh=None, stage=0, gas=1, micro_bs=2, cfg=gpt2.GPT2_TINY, **extra):
+    model_fn, init_fn, tp_fn = gpt2.make_model(cfg)
+    config = {
+        "train_micro_batch_size_per_gpu": micro_bs,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": stage},
+        "steps_per_print": 1000,
+    }
+    if mesh:
+        config["mesh"] = mesh
+    config.update(extra)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model_fn, model_parameters=init_fn(), config=config, tp_spec_fn=tp_fn
+    )
+    return engine
+
+
+def run_steps(engine, vocab, seq, steps=5, fixed_batch=True):
+    """fixed_batch=True memorizes one batch — a reliable loss-decrease
+    signal in few steps (random fresh tokens only teach unigram stats)."""
+    bs = engine.train_micro_batch_size_per_gpu * engine.mesh_info.dp_world_size
+    losses = []
+    for s in range(steps):
+        batch = token_batch(bs, seq, vocab, seed=0 if fixed_batch else s)
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    return losses
+
+
+def test_gpt2_tiny_trains():
+    engine = make_gpt2_engine(mesh={"data": 8})
+    losses = run_steps(engine, gpt2.GPT2_TINY.vocab_size, 64, steps=6)
+    assert losses[-1] < losses[0]
+    assert np.isfinite(losses).all()
+
+
+def test_gpt2_zero3_tp():
+    """ZeRO-3 + tensor parallel composed: fsdp=2 × model=2 × data=2."""
+    engine = make_gpt2_engine(mesh={"data": 2, "fsdp": 2, "model": 2}, stage=3)
+    losses = run_steps(engine, gpt2.GPT2_TINY.vocab_size, 64, steps=4)
+    assert losses[-1] < losses[0]
+
+    # TP actually sharded the qkv weight over the model axis
+    qkv = engine.state["params"]["blocks"]["qkv_w"]
+    spec = engine._param_specs["blocks"]["qkv_w"]
+    assert "model" in jax.tree.leaves(tuple(spec), is_leaf=lambda x: isinstance(x, str))
+
+
+def test_gpt2_tp_matches_dp_numerics():
+    e_dp = make_gpt2_engine(mesh={"data": 8}, stage=0)
+    e_tp = make_gpt2_engine(mesh={"data": 2, "model": 4}, stage=0)
+    l_dp = run_steps(e_dp, gpt2.GPT2_TINY.vocab_size, 64, steps=3, fixed_batch=False)
+    # tp engine has dp_world=2 so use same *global* batch by hand
+    bs = 2 * 8
+    l_tp = []
+    for s in range(3):
+        batch = token_batch(bs, 64, gpt2.GPT2_TINY.vocab_size, seed=s)
+        loss = e_tp(batch)
+        e_tp.backward(loss)
+        e_tp.step()
+        l_tp.append(float(loss))
+    np.testing.assert_allclose(l_dp, l_tp, rtol=2e-3)
+
+
+def test_bert_tiny_trains():
+    cfg = bert.BERT_TINY
+    model_fn, init_fn, tp_fn = bert.make_model(cfg)
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "Lamb", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2},
+        "mesh": {"fsdp": 8},
+        "steps_per_print": 1000,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model_fn, model_parameters=init_fn(), config=config, tp_spec_fn=tp_fn
+    )
+    rng = np.random.default_rng(0)
+    bs = 2 * 8
+    ids = rng.integers(0, cfg.vocab_size, (bs, 64), dtype=np.int32)
+    labels = np.where(rng.random((bs, 64)) < 0.15, ids, -100).astype(np.int32)
+    batch = {
+        "input_ids": ids,
+        "masked_lm_labels": labels,
+        "attention_mask": np.ones((bs, 64), np.int32),
+        "next_sentence_label": rng.integers(0, 2, (bs,), dtype=np.int32),
+    }
+    losses = []
+    for s in range(5):
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_gpt2_param_count():
+    assert abs(gpt2.GPT2_SMALL.num_params() - 124_000_000) / 124e6 < 0.05
+    assert abs(gpt2.GPT2_XL.num_params() - 1_558_000_000) / 1.558e9 < 0.05
