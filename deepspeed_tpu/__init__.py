@@ -55,6 +55,7 @@ def initialize(
     """
     from deepspeed_tpu.runtime.engine import DeepSpeedEngine
     from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+    from deepspeed_tpu.runtime.pipe.module import PipelineModule
     from deepspeed_tpu.comm.mesh import MeshInfo, make_mesh
 
     if config is None and config_params is not None:
@@ -65,7 +66,8 @@ def initialize(
         raise DeepSpeedConfigError("initialize() needs `config` (dict or json path)")
     if model is None:
         raise ValueError("initialize() needs `model` (callable (params, batch, rng) -> loss/outputs)")
-    if model_parameters is None:
+    is_pipe = isinstance(model, PipelineModule)
+    if model_parameters is None and not is_pipe:
         raise ValueError("initialize() needs `model_parameters` (initial parameter pytree)")
 
     if dist_init_required is None or dist_init_required:
@@ -85,17 +87,36 @@ def initialize(
     info = MeshInfo.from_mesh(mesh)
     ds_config = DeepSpeedConfig(config, world_size=info.dp_world_size)
 
-    engine = DeepSpeedEngine(
-        model=model,
-        params=model_parameters,
-        config=ds_config,
-        optimizer=optimizer,
-        lr_scheduler=lr_scheduler,
-        mesh=mesh,
-        tp_spec_fn=tp_spec_fn,
-        loss_fn=loss_fn,
-        dist_init_required=dist_init_required,
-    )
+    if is_pipe:
+        # reference: PipelineEngine iff model is a PipelineModule
+        # (deepspeed/__init__.py:125-149)
+        from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
+
+        if loss_fn is not None:
+            if model.loss_fn is not None and model.loss_fn is not loss_fn:
+                raise ValueError("loss_fn given both to PipelineModule and initialize()")
+            model.loss_fn = loss_fn
+        engine = PipelineEngine(
+            module=model,
+            config=ds_config,
+            mesh=mesh,
+            params=model_parameters,
+            optimizer=optimizer,
+            lr_scheduler=lr_scheduler,
+            tp_spec_fn=tp_spec_fn,
+        )
+    else:
+        engine = DeepSpeedEngine(
+            model=model,
+            params=model_parameters,
+            config=ds_config,
+            optimizer=optimizer,
+            lr_scheduler=lr_scheduler,
+            mesh=mesh,
+            tp_spec_fn=tp_spec_fn,
+            loss_fn=loss_fn,
+            dist_init_required=dist_init_required,
+        )
 
     dataloader = None
     if training_data is not None:

@@ -1,0 +1,201 @@
+"""Activation checkpointing (rematerialization).
+
+TPU-native analog of the reference's
+``runtime/activation_checkpointing/checkpointing.py`` (``checkpoint()``
+:677, ``CheckpointFunction`` :351, ``configure()`` :759, RNG tracking
+``CudaRNGStatesTracker`` :122).
+
+The reference hand-rolls recompute-in-backward with torch autograd
+Functions, explicit RNG state save/restore, activation *partitioning*
+across model-parallel ranks, and optional CPU placement of the saved
+inputs.  Under XLA each of those is a policy handed to ``jax.checkpoint``:
+
+* recompute-with-same-randomness is automatic — JAX threads the PRNG key
+  functionally, so the recomputed forward sees identical randomness with
+  no state juggling;
+* ``partition_activations`` → saved residuals kept sharded over the
+  ``model``/``seq`` axes (they already are under GSPMD; the knob adds a
+  sharding constraint on the carried inputs);
+* ``cpu_checkpointing`` → ``jax.checkpoint`` offload policy
+  (``save_and_offload_only_these_names`` / host offload of residuals);
+* ``contiguous_memory_optimization`` → no-op (XLA's allocator already
+  packs buffers; kept for config compatibility).
+
+``checkpoint(fn, *args)`` keeps the reference's call signature so ported
+Megatron-style models run unchanged.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+
+from deepspeed_tpu.config.config import ActivationCheckpointingConfig
+from deepspeed_tpu.utils.logging import log_dist
+
+_CONFIG = ActivationCheckpointingConfig()
+_NUM_LAYERS: Optional[int] = None
+
+
+def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, num_checkpoints=None,
+              checkpoint_in_cpu=None, synchronize=None, profile=None) -> None:
+    """Reference ``configure()`` (checkpointing.py:759): set module-level
+    checkpointing behavior, either from a DeepSpeedConfig or explicit args."""
+    global _CONFIG, _NUM_LAYERS
+    if deepspeed_config is not None:
+        cfg = getattr(deepspeed_config, "activation_checkpointing", None)
+        if cfg is not None:
+            _CONFIG = cfg
+    import dataclasses
+
+    updates = {}
+    if partition_activations is not None:
+        updates["partition_activations"] = partition_activations
+    if contiguous_checkpointing is not None:
+        updates["contiguous_memory_optimization"] = contiguous_checkpointing
+    if checkpoint_in_cpu is not None:
+        updates["cpu_checkpointing"] = checkpoint_in_cpu
+    if synchronize is not None:
+        updates["synchronize_checkpoint_boundary"] = synchronize
+    if profile is not None:
+        updates["profile"] = profile
+    if num_checkpoints is not None:
+        _NUM_LAYERS = num_checkpoints
+        updates["number_checkpoints"] = num_checkpoints
+    if updates:
+        _CONFIG = dataclasses.replace(_CONFIG, **updates)
+    log_dist(
+        f"activation checkpointing configured: partition={_CONFIG.partition_activations} "
+        f"cpu={_CONFIG.cpu_checkpointing}"
+    )
+
+
+def is_configured() -> bool:
+    return _CONFIG is not None
+
+
+def get_config() -> ActivationCheckpointingConfig:
+    return _CONFIG
+
+
+# ---------------------------------------------------------------------------
+# policies
+# ---------------------------------------------------------------------------
+
+def _policy_for(config: ActivationCheckpointingConfig):
+    """Map config knobs to a jax.checkpoint policy.
+
+    * default — save nothing, recompute everything (the reference's
+      behavior: only layer inputs survive; everything inside recomputes).
+    * cpu_checkpointing — additionally offload what *is* saved to host
+      memory (the reference's PA_TO_CPU path, checkpointing.py:689).
+    """
+    cp = jax.checkpoint_policies
+    if config.cpu_checkpointing and hasattr(cp, "offload_dot_with_no_batch_dims"):
+        return cp.offload_dot_with_no_batch_dims("device", "pinned_host")
+    return None  # = save nothing
+
+
+def checkpoint(function: Callable, *args, **kwargs):
+    """Checkpoint a forward: ``checkpoint(fn, *args)`` runs ``fn(*args)``
+    now and recomputes it during backward (reference ``checkpoint()``,
+    checkpointing.py:677).  Randomness inside ``fn`` must flow through an
+    explicit PRNG key argument — then recompute reuses it exactly."""
+    fn = jax.checkpoint(function, policy=_policy_for(_CONFIG))
+    return fn(*args, **kwargs)
+
+
+def checkpoint_wrapper(function: Callable, config: Optional[ActivationCheckpointingConfig] = None) -> Callable:
+    """Decorator form: returns a rematerialized version of ``function``."""
+    cfg = config if config is not None else _CONFIG
+    return jax.checkpoint(function, policy=_policy_for(cfg))
+
+
+def checkpoint_sequential(apply_block: Callable, params_stacked: Any, x: Any,
+                          rng=None, every: int = 1) -> Any:
+    """Scan ``apply_block`` over stacked per-layer params with remat every
+    ``every`` layers (the reference's Megatron usage: chunked
+    ``checkpoint(custom(l, l+chunk), hidden)``)."""
+    blk = jax.checkpoint(apply_block, policy=_policy_for(_CONFIG))
+
+    if every <= 1:
+        def body(carry, p):
+            h, r = carry
+            r2 = None if r is None else jax.random.fold_in(r, 1)
+            return (blk(p, h, r), r2), None
+
+        (x, _), _ = jax.lax.scan(body, (x, rng), params_stacked)
+        return x
+
+    # group layers into chunks of `every`, remat at chunk granularity
+    leaves = jax.tree.leaves(params_stacked)
+    L = leaves[0].shape[0]
+    assert L % every == 0, f"{L} layers not divisible by checkpoint interval {every}"
+    grouped = jax.tree.map(lambda l: l.reshape((L // every, every) + l.shape[1:]), params_stacked)
+
+    def chunk_fn(pchunk, h, r):
+        def body(carry, p):
+            hh, rr = carry
+            r2 = None if rr is None else jax.random.fold_in(rr, 1)
+            return (apply_block(p, hh, rr), r2), None
+
+        (h, _), _ = jax.lax.scan(body, (h, r), pchunk)
+        return h
+
+    chunk_fn = jax.checkpoint(chunk_fn, policy=_policy_for(_CONFIG))
+
+    def outer(carry, pchunk):
+        h, r = carry
+        r2 = None if r is None else jax.random.fold_in(r, 1)
+        return (chunk_fn(pchunk, h, r), r2), None
+
+    (x, _), _ = jax.lax.scan(outer, (x, rng), grouped)
+    return x
+
+
+# Reference-parity RNG helpers (checkpointing.py:122-238).  In JAX the
+# "tracker" is just named fold_in streams on an explicit key.
+class CudaRNGStatesTracker:
+    """API-compatible shim: named RNG streams over functional keys."""
+
+    def __init__(self):
+        self.states_ = {}
+
+    def reset(self):
+        self.states_ = {}
+
+    def get_states(self):
+        return dict(self.states_)
+
+    def set_states(self, states):
+        self.states_ = dict(states)
+
+    def add(self, name: str, seed: int):
+        if name in self.states_:
+            raise Exception(f"rng state {name} already exists")
+        self.states_[name] = jax.random.PRNGKey(seed)
+
+    def fork(self, name: str = "model-parallel-rng"):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _fork():
+            key = self.states_[name]
+            self.states_[name], _ = jax.random.split(key)
+            yield
+        return _fork()
+
+
+_CUDA_RNG_TRACKER = CudaRNGStatesTracker()
+
+
+def get_cuda_rng_tracker() -> CudaRNGStatesTracker:
+    return _CUDA_RNG_TRACKER
+
+
+def model_parallel_cuda_manual_seed(seed: int) -> None:
+    """Reference checkpointing.py:272: seed the model-parallel stream."""
+    _CUDA_RNG_TRACKER.reset()
+    _CUDA_RNG_TRACKER.add("model-parallel-rng", seed + 2718)

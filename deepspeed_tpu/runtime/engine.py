@@ -119,13 +119,17 @@ class DeepSpeedEngine:
 
         if rng is None:
             rng = jax.random.PRNGKey(config.seed)
+        # subclasses that never accumulate (pipeline) skip the fp32 buffer
+        self._use_grad_acc = getattr(self, "_use_grad_acc", True)
         self.state: Dict[str, Any] = {
             "params": params,
             "opt_state": opt_state,
             "grad_acc": jax.jit(
                 lambda p: jax.tree.map(lambda x: jnp.zeros(x.shape, jnp.float32), p),
                 out_shardings=jax.tree.map(self._sh, self._grad_specs, is_leaf=lambda x: isinstance(x, P)),
-            )(params),
+            )(params)
+            if self._use_grad_acc
+            else {},
             "micro_step": jnp.zeros((), jnp.int32),
             "global_step": jnp.zeros((), jnp.int32),
             "global_samples": jnp.zeros((), jnp.int32),
@@ -135,13 +139,22 @@ class DeepSpeedEngine:
         self._state_shardings = {
             "params": jax.tree.map(self._sh, self._param_specs, is_leaf=lambda x: isinstance(x, P)),
             "opt_state": jax.tree.map(self._sh, self._opt_specs, is_leaf=lambda x: isinstance(x, P)),
-            "grad_acc": jax.tree.map(self._sh, self._grad_specs, is_leaf=lambda x: isinstance(x, P)),
+            "grad_acc": jax.tree.map(self._sh, self._grad_specs, is_leaf=lambda x: isinstance(x, P))
+            if self._use_grad_acc
+            else {},
             "micro_step": self._sh(P()),
             "global_step": self._sh(P()),
             "global_samples": self._sh(P()),
             "loss_scale": jax.tree.map(lambda _: self._sh(P()), self.state["loss_scale"]),
             "rng": self._sh(P()),
         }
+
+        # -- activation checkpointing (reference _configure_checkpointing,
+        # engine.py:523) — publish the config block to the module-level
+        # checkpoint() API so user models pick it up
+        from deepspeed_tpu.runtime.activation_checkpointing import checkpointing as act_ckpt
+
+        act_ckpt.configure(deepspeed_config=config)
 
         # -- host-side bookkeeping ----------------------------------------
         self.timers = SynchronizedWallClockTimer()
@@ -282,6 +295,13 @@ class DeepSpeedEngine:
         ``_take_model_step``, engine.py:1269)."""
         gas = self.gradient_accumulation_steps
         grads = jax.tree.map(lambda g: g / gas, state["grad_acc"])
+        state, info = self._apply_update(state, grads)
+        state["grad_acc"] = jax.tree.map(jnp.zeros_like, state["grad_acc"])
+        return state, info
+
+    def _apply_update(self, state, grads):
+        """Unscale/clip/update given already-averaged grads (shared by the
+        grad-accumulation path and the pipeline engine's fused batch)."""
         grads, overflow = self.loss_scaler.unscale_and_check(grads, state["loss_scale"])
         grad_norm = jnp.zeros((), jnp.float32)
         if self.config.gradient_clipping > 0.0:
@@ -302,7 +322,6 @@ class DeepSpeedEngine:
         state = dict(state)
         state["params"] = new_params
         state["opt_state"] = new_opt
-        state["grad_acc"] = jax.tree.map(jnp.zeros_like, state["grad_acc"])
         state["global_step"] = state["global_step"] + jnp.where(overflow, 0, 1)
         state["loss_scale"] = self.loss_scaler.update(state["loss_scale"], overflow)
         return state, {"lr": lr, "grad_norm": grad_norm, "overflow": overflow}

@@ -1,0 +1,5 @@
+"""Pipeline parallelism (reference ``deepspeed/runtime/pipe/``)."""
+from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule, TiedLayerSpec
+from deepspeed_tpu.runtime.pipe import schedule
+
+__all__ = ["PipelineModule", "LayerSpec", "TiedLayerSpec", "schedule"]

@@ -1,0 +1,353 @@
+"""Pipeline module: the model expressed as a list of layers.
+
+Behavioral analog of the reference's ``runtime/pipe/module.py``
+(``PipelineModule`` :87, ``LayerSpec`` :25, ``TiedLayerSpec`` :73,
+``_partition_layers`` :355).  Differences forced (and enabled) by the
+TPU/XLA execution model:
+
+* A "layer" is functional: an object with ``init(rng) -> params`` and
+  ``apply(params, x, rng=None) -> x`` (or ``__call__``), or a plain
+  stateless callable ``f(x)``.  No module mutation, no hooks.
+* The repeated transformer blocks (the *body*) must be homogeneous —
+  identical param structure — so they can be **stacked** into leaves of
+  shape ``[L, ...]`` sharded ``P('pipe')`` over the mesh and executed as
+  a compiled ``scan``/``ppermute`` pipeline (engine.py here).  This is
+  what lets XLA overlap stage compute with inter-stage transfers instead
+  of interpreting send/recv instructions rank-by-rank.
+* Leading layers before the body (embedding, reshapes) and trailing
+  layers after it (final norm, LM head) are executed replicated over the
+  ``pipe`` axis, sharded over ``data``/``model`` axes as usual.  Weight
+  tying (``TiedLayerSpec``, e.g. embedding ⇄ LM head) therefore needs
+  **no** tied-weight grad all-reduce (reference pipe/module.py:412-425):
+  tied layers simply share one params entry.
+* Every process builds the full (sharded) model — under GSPMD there is
+  no per-rank construction; ``zero.Init``-style scoped construction is
+  unnecessary because params are sharded from birth by ``jax.jit``
+  output shardings.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.utils import partition_balanced, partition_uniform
+from deepspeed_tpu.utils.logging import logger
+
+
+class LayerSpec:
+    """Lazy layer description: ``typename(*args, **kwargs)`` built at
+    engine-init time (reference pipe/module.py:25-70)."""
+
+    def __init__(self, typename: Callable, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+        if not callable(typename):
+            raise RuntimeError("LayerSpec requires a callable typename")
+
+    def __repr__(self):
+        return f"LayerSpec({getattr(self.typename, '__name__', self.typename)})"
+
+    def build(self):
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+
+class TiedLayerSpec(LayerSpec):
+    """A layer whose parameters are shared with every other tied layer of
+    the same ``key`` (reference pipe/module.py:73-85).  ``forward_fn``
+    optionally overrides how the shared params are applied at this site
+    (e.g. embedding weights reused as the LM head)."""
+
+    def __init__(self, key: str, typename: Callable, *module_args, forward_fn=None, **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+
+
+class _BuiltLayer:
+    """Normalized (init, apply) pair for one layer position."""
+
+    def __init__(self, obj: Any, tied_key: Optional[str] = None, forward_fn=None, name: str = ""):
+        self.obj = obj
+        self.tied_key = tied_key
+        self.forward_fn = forward_fn
+        self.name = name or type(obj).__name__
+        self.has_params = hasattr(obj, "init")
+        if forward_fn is not None:
+            self._fn = forward_fn
+        elif self.has_params:
+            self._fn = getattr(obj, "apply", None) or obj
+        else:
+            self._fn = obj
+        self._accepts_rng = _accepts_rng(self._fn)
+
+    def init(self, rng) -> Any:
+        return self.obj.init(rng) if self.has_params else None
+
+    def apply(self, params: Any, x: Any, rng=None) -> Any:
+        if not self.has_params and self.forward_fn is None:
+            return self._fn(x)
+        if self._accepts_rng:
+            return self._fn(params, x, rng=rng)
+        return self._fn(params, x)
+
+
+def _accepts_rng(fn) -> bool:
+    """Determined once at build time (never by catching TypeError from
+    inside the layer body, which would mask real layer bugs)."""
+    import inspect
+
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return True
+    params = sig.parameters
+    if "rng" in params:
+        return True
+    return any(p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values())
+
+
+class PipelineModule:
+    """The model-as-layer-list for pipeline-parallel execution.
+
+    Args:
+        layers: sequence of :class:`LayerSpec` / layer objects / callables.
+        loss_fn: ``loss_fn(outputs, labels) -> scalar``.
+        num_stages: pipeline stages; defaults to the mesh's ``pipe`` axis
+            size when the engine adopts the module.
+        partition_method: 'uniform' | 'parameters' | 'type:<regex>' —
+            stage-boundary balancing (reference ``_partition_layers``,
+            pipe/module.py:355).  On TPU stage boundaries additionally
+            require the homogeneous body to split evenly, so the
+            partition is advisory: it is computed, logged, and used for
+            checkpoint layer naming.
+        activation_checkpoint_interval: remat every N layers (0 = off).
+        seed_layers: give each layer a distinct init RNG stream.
+    """
+
+    def __init__(
+        self,
+        layers: Sequence[Any],
+        num_stages: Optional[int] = None,
+        topology=None,
+        loss_fn: Optional[Callable] = None,
+        seed_layers: bool = False,
+        partition_method: str = "parameters",
+        activation_checkpoint_interval: int = 0,
+        base_seed: int = 1234,
+    ):
+        self.specs = list(layers)
+        self.loss_fn = loss_fn
+        self.partition_method = partition_method
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        self.seed_layers = seed_layers
+        self.base_seed = base_seed
+        self._topology = topology
+        if topology is not None and num_stages is None:
+            num_stages = topology.get_dim("pipe")
+        self.num_stages = num_stages
+        self.parts: Optional[List[int]] = None
+
+        self._layers: List[_BuiltLayer] = [self._build_one(i, s) for i, s in enumerate(self.specs)]
+        self._classify_body()
+
+    # ------------------------------------------------------------------
+    # construction
+    # ------------------------------------------------------------------
+    def _build_one(self, idx: int, spec: Any) -> _BuiltLayer:
+        if isinstance(spec, TiedLayerSpec):
+            layer = _BuiltLayer(spec.build(), tied_key=spec.key, forward_fn=spec.forward_fn,
+                                name=f"{idx}:{spec.typename.__name__}")
+        elif isinstance(spec, LayerSpec):
+            layer = _BuiltLayer(spec.build(), name=f"{idx}:{spec.typename.__name__}")
+        else:
+            layer = _BuiltLayer(spec, name=f"{idx}:{type(spec).__name__}")
+        # Homogeneity key: stacked body layers must share BEHAVIOR, not
+        # just param structure — same class built with the same args.
+        if isinstance(spec, LayerSpec):
+            layer.homo_key = (spec.typename, repr(spec.module_args), repr(sorted(spec.module_kwargs.items())))
+        else:
+            layer.homo_key = (type(spec), repr(sorted(getattr(spec, "__dict__", {}).items())))
+        return layer
+
+    def _classify_body(self) -> None:
+        """Find the maximal run of homogeneous parametered layers — the
+        pipelined body.  Everything before runs replicated pre-pipeline,
+        everything after post-pipeline."""
+        runs: List[Tuple[int, int]] = []  # (start, length)
+        i = 0
+        n = len(self._layers)
+        while i < n:
+            l = self._layers[i]
+            if not l.has_params or l.tied_key is not None:
+                i += 1
+                continue
+            j = i
+            key = l.homo_key
+            while (
+                j < n
+                and self._layers[j].has_params
+                and self._layers[j].tied_key is None
+                and self._layers[j].homo_key == key
+            ):
+                j += 1
+            runs.append((i, j - i))
+            i = j
+        if runs:
+            start, length = max(runs, key=lambda r: r[1])
+        else:
+            start, length = len(self._layers), 0
+        self.body_start = start
+        self.body_len = length
+        self.pre_ids = list(range(0, start))
+        self.body_ids = list(range(start, start + length))
+        self.post_ids = list(range(start + length, n))
+
+    def build_params(self, rng) -> Dict[str, Any]:
+        """Initialize the full param tree::
+
+            {"pre": {idx: p}, "blocks": stacked [L, ...] leaves,
+             "post": {idx: p}, "tied": {key: p}}
+        """
+        params: Dict[str, Any] = {"pre": {}, "blocks": None, "post": {}, "tied": {}}
+
+        def layer_rng(i):
+            return jax.random.fold_in(rng, i if self.seed_layers else 0)
+
+        for section, ids in (("pre", self.pre_ids), ("post", self.post_ids)):
+            for i in ids:
+                layer = self._layers[i]
+                if layer.tied_key is not None:
+                    if layer.tied_key not in params["tied"]:
+                        params["tied"][layer.tied_key] = layer.init(layer_rng(i))
+                elif layer.has_params:
+                    params[section][str(i)] = layer.init(layer_rng(i))
+
+        if self.body_ids:
+            per_layer = [self._layers[i].init(layer_rng(i)) for i in self.body_ids]
+            treedef = jax.tree.structure(per_layer[0])
+            for p in per_layer[1:]:
+                if jax.tree.structure(p) != treedef:
+                    raise ValueError("pipeline body layers must have identical param structure")
+            params["blocks"] = jax.tree.map(lambda *ls: jnp.stack(ls), *per_layer)
+        return params
+
+    # ------------------------------------------------------------------
+    # application
+    # ------------------------------------------------------------------
+    def _apply_section(self, params: Dict[str, Any], ids: List[int], section: str, x, rng):
+        for i in ids:
+            layer = self._layers[i]
+            if layer.tied_key is not None:
+                p = params["tied"][layer.tied_key]
+            elif layer.has_params:
+                p = params[section][str(i)]
+            else:
+                p = None
+            x = layer.apply(p, x, rng=None if rng is None else jax.random.fold_in(rng, i))
+        return x
+
+    def apply_pre(self, params, x, rng=None):
+        return self._apply_section(params, self.pre_ids, "pre", x, rng)
+
+    def apply_post(self, params, x, rng=None):
+        return self._apply_section(params, self.post_ids, "post", x, rng)
+
+    def apply_block(self, block_params, x, rng=None):
+        """Apply ONE body block given its (unstacked) params."""
+        return self._layers[self.body_ids[0]].apply(block_params, x, rng=rng)
+
+    def apply_body(self, params, x, rng=None, remat: bool = False):
+        """All body blocks sequentially via scan over the stacked leaves,
+        with remat at ``activation_checkpoint_interval`` granularity."""
+        if not self.body_ids:
+            return x
+        interval = self.activation_checkpoint_interval
+        if remat and interval <= 0:
+            interval = 1
+        if interval > 0:
+            from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import (
+                checkpoint_sequential,
+            )
+
+            return checkpoint_sequential(self.apply_block, params["blocks"], x, rng=rng, every=interval)
+
+        def body(carry, bp):
+            h, r = carry
+            r2 = None if r is None else jax.random.fold_in(r, 1)
+            h = self.apply_block(bp, h, r)
+            return (h, r2), None
+
+        (x, _), _ = jax.lax.scan(body, (x, rng), params["blocks"])
+        return x
+
+    def sequential(self, params, x, rng=None, remat: bool = False):
+        """Full forward without pipelining (pipe axis = 1, eval, tests)."""
+        x = self.apply_pre(params, x, rng)
+        x = self.apply_body(params, x, rng, remat=remat)
+        return self.apply_post(params, x, rng)
+
+    # ------------------------------------------------------------------
+    # partitioning bookkeeping (advisory on TPU; reference :355-410)
+    # ------------------------------------------------------------------
+    def configure_stages(self, num_stages: int) -> None:
+        self.num_stages = num_stages
+        if num_stages > 1:
+            if not self.body_ids:
+                raise ValueError(
+                    "pipe parallelism needs a homogeneous run of layers to pipeline; "
+                    "none found in this layer list"
+                )
+            if self.body_len % num_stages != 0:
+                raise ValueError(
+                    f"pipeline body of {self.body_len} layers does not divide "
+                    f"evenly over {num_stages} stages"
+                )
+        self.parts = self._partition_layers(num_stages)
+        for s in range(num_stages):
+            logger.info(f"pipe stage {s}: layers [{self.parts[s]}, {self.parts[s + 1]})")
+
+    def _partition_layers(self, num_stages: int) -> List[int]:
+        method = (self.partition_method or "uniform").lower()
+        n = len(self._layers)
+        if method == "uniform":
+            return partition_uniform(n, num_stages)
+        if method == "parameters":
+            weights = [self._layer_param_count(i) for i in range(n)]
+            return partition_balanced(weights, num_stages)
+        if method.startswith("type:"):
+            pat = method.split(":", 1)[1]
+            weights = [1 if re.search(pat, self._layers[i].name, re.IGNORECASE) else 0 for i in range(n)]
+            return partition_balanced(weights, num_stages)
+        raise NotImplementedError(f"partition_method '{method}'")
+
+    def _layer_param_count(self, i: int) -> int:
+        layer = self._layers[i]
+        if not layer.has_params:
+            return 0
+        shapes = jax.eval_shape(layer.init, jax.random.PRNGKey(0))
+        import numpy as np
+
+        return int(sum(np.prod(l.shape) for l in jax.tree.leaves(shapes)))
+
+    def stage_of_layer(self, layer_idx: int) -> int:
+        assert self.parts is not None, "configure_stages() first"
+        for s in range(len(self.parts) - 1):
+            if self.parts[s] <= layer_idx < self.parts[s + 1]:
+                return s
+        raise ValueError(layer_idx)
+
+    @property
+    def num_layers(self) -> int:
+        return len(self._layers)
+
+    def topology(self):
+        return self._topology
+
+    def ckpt_layer_path(self, ckpt_dir: str, local_layer_idx: int) -> str:
+        import os
+
+        return os.path.join(ckpt_dir, f"layer_{local_layer_idx:02d}-model_states.msgpack")

@@ -1,0 +1,169 @@
+"""Runtime helper utilities.
+
+TPU-native analog of the reference's ``runtime/utils.py`` (SURVEY.md
+§2.1): the partitioning helpers (`partition_uniform` reference
+runtime/utils.py:352, `partition_balanced` :418) are pure logic and keep
+the same contract — they drive pipeline layer placement.  The tensor
+helpers (`clip_grad_norm_`, `CheckOverflow`, runtime/utils.py:84-269)
+become jnp reductions; memory reporting maps to
+``jax.local_devices()[...].memory_stats()`` instead of the torch
+allocator.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+try:  # jax optional so pure-logic helpers stay importable anywhere
+    import jax
+    import jax.numpy as jnp
+except Exception:  # pragma: no cover
+    jax = None
+    jnp = None
+
+
+# ---------------------------------------------------------------------------
+# partitioning (pure logic; drives pipeline layer placement)
+# ---------------------------------------------------------------------------
+
+def partition_uniform(num_items: int, num_parts: int) -> List[int]:
+    """Boundaries that split ``num_items`` into ``num_parts`` near-equal
+    contiguous chunks.  Returns ``num_parts + 1`` boundaries; chunk ``p``
+    is ``[parts[p], parts[p+1])``.  (Reference runtime/utils.py:352.)"""
+    parts = [0] * (num_parts + 1)
+    if num_items <= num_parts:
+        for p in range(num_parts + 1):
+            parts[p] = min(p, num_items)
+        return parts
+    chunksize = num_items // num_parts
+    residual = num_items % num_parts
+    for p in range(1, num_parts + 1):
+        parts[p] = min(chunksize * p, num_items)
+    # distribute the remainder one item at a time to the earliest chunks
+    for p in range(1, residual + 1):
+        for q in range(p, num_parts + 1):
+            parts[q] += 1
+    return parts
+
+
+def prefix_sum_inc(weights: Sequence[float]) -> List[float]:
+    """Inclusive prefix sum (reference runtime/utils.py:406)."""
+    out = []
+    total = 0.0
+    for w in weights:
+        total += w
+        out.append(total)
+    return out
+
+
+def partition_balanced(weights: Sequence[float], num_parts: int, eps: float = 1e-3) -> List[int]:
+    """Boundaries that split weighted items into ``num_parts`` contiguous
+    chunks minimizing the max chunk weight (binary search over the
+    bottleneck, reference runtime/utils.py:418).  Same return convention
+    as :func:`partition_uniform`."""
+    num_items = len(weights)
+    if num_items <= num_parts:
+        return partition_uniform(num_items, num_parts)
+
+    prefix = prefix_sum_inc(weights)
+
+    def can_pack(limit: float) -> Optional[List[int]]:
+        """Greedy: pack as many items per chunk as fit under ``limit``."""
+        parts = [0]
+        for _ in range(num_parts):
+            start = parts[-1]
+            if start == num_items:  # all items placed; trailing chunks empty
+                parts.append(start)
+                continue
+            base = prefix[start - 1] if start > 0 else 0.0
+            # furthest end with sum(start..end) <= limit
+            end = start
+            while end < num_items and prefix[end] - base <= limit:
+                end += 1
+            if end == start:  # single item exceeds limit
+                return None
+            parts.append(end)
+        return parts if parts[-1] == num_items else None
+
+    lo = max(weights)
+    hi = prefix[-1]
+    while hi - lo > eps * max(1.0, hi):
+        mid = (lo + hi) / 2
+        if can_pack(mid) is not None:
+            hi = mid
+        else:
+            lo = mid
+    parts = can_pack(hi)
+    assert parts is not None
+    return parts
+
+
+# ---------------------------------------------------------------------------
+# numeric helpers (jnp)
+# ---------------------------------------------------------------------------
+
+def global_norm(tree: Any):
+    """L2 norm over a pytree (reference get_grad_norm, runtime/utils.py:211)."""
+    leaves = [jnp.sum(jnp.square(g.astype(jnp.float32))) for g in jax.tree.leaves(tree)]
+    return jnp.sqrt(sum(leaves)) if leaves else jnp.zeros((), jnp.float32)
+
+
+def clip_grad_norm(tree: Any, max_norm: float):
+    """Global-norm gradient clipping; returns (clipped_tree, pre_clip_norm)."""
+    norm = global_norm(tree)
+    factor = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree.map(lambda g: (g.astype(jnp.float32) * factor).astype(g.dtype), tree), norm
+
+
+def has_inf_or_nan(x) -> Any:
+    """Reference ``CheckOverflow._has_inf_or_nan`` (runtime/utils.py:150)."""
+    s = jnp.sum(x.astype(jnp.float32))
+    return jnp.logical_not(jnp.isfinite(s))
+
+
+def count_parameters(tree: Any) -> int:
+    return int(sum(np.prod(l.shape) for l in jax.tree.leaves(tree) if hasattr(l, "shape")))
+
+
+# ---------------------------------------------------------------------------
+# memory reporting (reference see_memory_usage, runtime/utils.py:588)
+# ---------------------------------------------------------------------------
+
+def device_memory_stats(device=None) -> Dict[str, int]:
+    if jax is None:
+        return {}
+    device = device or jax.local_devices()[0]
+    stats = getattr(device, "memory_stats", lambda: None)()
+    return dict(stats) if stats else {}
+
+
+def see_memory_usage(message: str, force: bool = False) -> None:
+    from deepspeed_tpu.utils.logging import logger
+
+    stats = device_memory_stats()
+    if stats:
+        used = stats.get("bytes_in_use", 0) / 2**30
+        peak = stats.get("peak_bytes_in_use", 0) / 2**30
+        logger.info(f"{message} | device mem: {used:.2f}GB (peak {peak:.2f}GB)")
+    else:
+        try:
+            import psutil
+
+            vm = psutil.virtual_memory()
+            logger.info(f"{message} | host mem used: {vm.percent}%")
+        except Exception:
+            logger.info(message)
+
+
+def call_to_str(base: str, *args, **kwargs) -> str:
+    """``name(arg, kw=val)`` pretty printer (reference runtime/utils.py:633)."""
+    name = f"{base}("
+    if args:
+        name += ", ".join(repr(a) for a in args)
+        if kwargs:
+            name += ", "
+    if kwargs:
+        name += ", ".join(f"{k}={v!r}" for k, v in kwargs.items())
+    name += ")"
+    return name

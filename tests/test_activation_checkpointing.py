@@ -1,0 +1,119 @@
+"""Activation checkpointing: remat numerics identical, memory policies
+apply, RNG reproducibility under recompute (reference
+tests/unit/test_activation_checkpointing.py)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.activation_checkpointing import checkpointing as ck
+
+
+def _block(p, x, rng=None):
+    h = x @ p["w"]
+    if rng is not None:
+        keep = jax.random.bernoulli(rng, 0.9, h.shape)
+        h = jnp.where(keep, h / 0.9, 0.0)
+    return jax.nn.gelu(h)
+
+
+def _stacked_params(L, d, seed=0):
+    k = jax.random.PRNGKey(seed)
+    return {"w": jax.random.normal(k, (L, d, d), jnp.float32) / np.sqrt(d)}
+
+
+def test_checkpoint_same_value_and_grad():
+    d = 16
+    p = {"w": jax.random.normal(jax.random.PRNGKey(0), (d, d), jnp.float32)}
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, d), jnp.float32)
+
+    def loss_plain(p):
+        return jnp.sum(_block(p, x) ** 2)
+
+    def loss_ck(p):
+        return jnp.sum(ck.checkpoint(_block, p, x) ** 2)
+
+    v1, g1 = jax.value_and_grad(loss_plain)(p)
+    v2, g2 = jax.value_and_grad(loss_ck)(p)
+    np.testing.assert_allclose(float(v1), float(v2), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(g1["w"]), np.asarray(g2["w"]), rtol=1e-6)
+
+
+def test_checkpoint_rng_reproducible():
+    """Recompute must see identical randomness (the reference's RNG
+    fork/restore machinery, checkpointing.py:122-238 — free in JAX)."""
+    d = 16
+    p = {"w": jax.random.normal(jax.random.PRNGKey(0), (d, d), jnp.float32)}
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, d), jnp.float32)
+    rng = jax.random.PRNGKey(42)
+
+    def loss_plain(p):
+        return jnp.sum(_block(p, x, rng) ** 2)
+
+    def loss_ck(p):
+        return jnp.sum(ck.checkpoint(_block, p, x, rng) ** 2)
+
+    v1, g1 = jax.value_and_grad(loss_plain)(p)
+    v2, g2 = jax.value_and_grad(loss_ck)(p)
+    np.testing.assert_allclose(np.asarray(g1["w"]), np.asarray(g2["w"]), rtol=1e-6)
+
+
+@pytest.mark.parametrize("every", [1, 2, 4])
+def test_checkpoint_sequential_matches_plain_scan(every):
+    L, d = 4, 8
+    params = _stacked_params(L, d)
+    x = jax.random.normal(jax.random.PRNGKey(2), (4, d), jnp.float32)
+
+    def plain(params):
+        def body(h, p):
+            return _block(p, h), None
+
+        h, _ = jax.lax.scan(body, x, params)
+        return jnp.sum(h ** 2)
+
+    def remat(params):
+        return jnp.sum(ck.checkpoint_sequential(_block, params, x, every=every) ** 2)
+
+    v1, g1 = jax.value_and_grad(plain)(params)
+    v2, g2 = jax.value_and_grad(remat)(params)
+    np.testing.assert_allclose(float(v1), float(v2), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(g1["w"]), np.asarray(g2["w"]), rtol=1e-5)
+
+
+def test_checkpoint_sequential_bad_interval():
+    params = _stacked_params(4, 8)
+    x = jnp.zeros((2, 8))
+    with pytest.raises(AssertionError):
+        ck.checkpoint_sequential(_block, params, x, every=3)
+
+
+def test_configure_from_dict_and_args():
+    from deepspeed_tpu.config.config import DeepSpeedConfig
+
+    cfg = DeepSpeedConfig(
+        {
+            "train_micro_batch_size_per_gpu": 1,
+            "activation_checkpointing": {"partition_activations": True, "cpu_checkpointing": True},
+        },
+        world_size=1,
+    )
+    ck.configure(deepspeed_config=cfg)
+    assert ck.get_config().partition_activations
+    assert ck.get_config().cpu_checkpointing
+    ck.configure(partition_activations=False, checkpoint_in_cpu=False)
+    assert not ck.get_config().partition_activations
+    assert not ck.get_config().cpu_checkpointing
+
+
+def test_rng_tracker_api():
+    tr = ck.CudaRNGStatesTracker()
+    tr.add("model-parallel-rng", 123)
+    with pytest.raises(Exception):
+        tr.add("model-parallel-rng", 5)
+    before = tr.get_states()["model-parallel-rng"]
+    with tr.fork():
+        pass
+    after = tr.get_states()["model-parallel-rng"]
+    assert not np.array_equal(np.asarray(before), np.asarray(after))
+    ck.model_parallel_cuda_manual_seed(7)
+    assert "model-parallel-rng" in ck.get_cuda_rng_tracker().get_states()

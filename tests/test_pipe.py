@@ -1,0 +1,227 @@
+"""Pipeline module + engine: partitioning logic, pipelined-vs-sequential
+numerics, e2e convergence on the 8-device CPU mesh (reference
+tests/unit/test_pipe.py, test_pipe_module.py)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.runtime.pipe import LayerSpec, PipelineModule, TiedLayerSpec
+from deepspeed_tpu.runtime.utils import partition_balanced, partition_uniform
+
+from tests.simple_model import base_config
+
+
+# ---------------------------------------------------------------------------
+# layer fixtures
+# ---------------------------------------------------------------------------
+class Linear:
+    def __init__(self, dim, act=True, seed_scale=1.0):
+        self.dim = dim
+        self.act = act
+        self.seed_scale = seed_scale
+
+    def init(self, rng):
+        w = jax.random.normal(rng, (self.dim, self.dim), jnp.float32) * (self.seed_scale / np.sqrt(self.dim))
+        return {"w": w, "b": jnp.zeros((self.dim,), jnp.float32)}
+
+    def apply(self, params, x, rng=None):
+        h = x @ params["w"].astype(x.dtype) + params["b"].astype(x.dtype)
+        return jax.nn.gelu(h) if self.act else h
+
+
+class Embed:
+    def __init__(self, vocab, dim):
+        self.vocab, self.dim = vocab, dim
+
+    def init(self, rng):
+        return {"e": jax.random.normal(rng, (self.vocab, self.dim), jnp.float32) * 0.02}
+
+    def apply(self, params, x, rng=None):
+        return params["e"].astype(jnp.float32)[x]
+
+
+def mse_loss(outputs, labels):
+    return jnp.mean((outputs.astype(jnp.float32) - labels.astype(jnp.float32)) ** 2)
+
+
+def make_pipe_module(dim=16, nblocks=4, loss_fn=mse_loss, **kw):
+    layers = [LayerSpec(Linear, dim, act=True) for _ in range(nblocks)]
+    layers.append(LayerSpec(Linear, dim, act=False))
+    return PipelineModule(layers=layers, loss_fn=loss_fn, **kw)
+
+
+def pipe_batch(bs, dim, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((bs, dim)).astype(np.float32)
+    y = np.tanh(x @ rng.standard_normal((dim, dim)).astype(np.float32) * 0.3)
+    return (x, y)
+
+
+# ---------------------------------------------------------------------------
+# partition helpers (pure logic)
+# ---------------------------------------------------------------------------
+def test_partition_uniform():
+    assert partition_uniform(8, 4) == [0, 2, 4, 6, 8]
+    assert partition_uniform(9, 4) == [0, 3, 5, 7, 9]
+    assert partition_uniform(3, 4) == [0, 1, 2, 3, 3]
+
+
+def test_partition_balanced():
+    parts = partition_balanced([1, 1, 1, 1], 2)
+    assert parts == [0, 2, 4]
+    # heavy head: first chunk should be smaller
+    parts = partition_balanced([10, 1, 1, 1, 1], 2)
+    assert parts[1] == 1
+    parts = partition_balanced([1, 1, 1, 1, 10], 2)
+    assert parts == [0, 4, 5]
+
+
+# ---------------------------------------------------------------------------
+# module structure
+# ---------------------------------------------------------------------------
+def test_pipeline_module_body_detection():
+    m = make_pipe_module(dim=8, nblocks=4)
+    # 4 act=True Linears form the body; the act=False head differs in
+    # constructor kwargs, so it is NOT part of the homogeneous body.
+    assert m.body_len == 4
+    assert m.post_ids == [4]
+    m2 = PipelineModule(
+        layers=[LayerSpec(Embed, 32, 8)] + [LayerSpec(Linear, 8) for _ in range(4)],
+        loss_fn=mse_loss,
+    )
+    assert m2.body_start == 1 and m2.body_len == 4
+    assert m2.pre_ids == [0]
+
+
+def test_pipeline_module_params_stacked():
+    m = PipelineModule(layers=[LayerSpec(Linear, 8) for _ in range(4)], loss_fn=mse_loss)
+    params = m.build_params(jax.random.PRNGKey(0))
+    assert params["blocks"]["w"].shape == (4, 8, 8)
+    assert params["pre"] == {} and params["post"] == {}
+
+
+def test_pipeline_module_configure_stages_divisibility():
+    m = PipelineModule(layers=[LayerSpec(Linear, 8) for _ in range(4)], loss_fn=mse_loss)
+    m.configure_stages(2)
+    assert m.parts is not None
+    with pytest.raises(ValueError):
+        m.configure_stages(3)
+
+
+def test_tied_layer_shared_params():
+    vocab, dim = 32, 8
+
+    def head_fn(params, x):
+        return x @ params["e"].T.astype(x.dtype)
+
+    m = PipelineModule(
+        layers=[
+            TiedLayerSpec("embed", Embed, vocab, dim),
+            LayerSpec(Linear, dim),
+            LayerSpec(Linear, dim),
+            TiedLayerSpec("embed", Embed, vocab, dim, forward_fn=head_fn),
+        ],
+        loss_fn=lambda out, labels: jnp.mean(out),
+    )
+    params = m.build_params(jax.random.PRNGKey(0))
+    assert list(params["tied"].keys()) == ["embed"]
+    tokens = jnp.array([[1, 2], [3, 4]], jnp.int32)
+    out = m.sequential(params, tokens)
+    assert out.shape == (2, 2, vocab)
+
+
+def test_sequential_matches_manual():
+    m = PipelineModule(layers=[LayerSpec(Linear, 8) for _ in range(3)], loss_fn=mse_loss)
+    params = m.build_params(jax.random.PRNGKey(0))
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((4, 8)), jnp.float32)
+    got = m.sequential(params, x)
+    h = x
+    for i in range(3):
+        p = jax.tree.map(lambda l: l[i], params["blocks"])
+        h = jax.nn.gelu(h @ p["w"] + p["b"])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(h), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# engine: pipelined == sequential numerics, convergence
+# ---------------------------------------------------------------------------
+def _make_engine(nblocks, pipe, gas, micro_bs, dim=16, stage=0, dtype="fp32"):
+    module = make_pipe_module(dim=dim, nblocks=nblocks)
+    cfg = base_config(
+        stage=stage,
+        micro_bs=micro_bs,
+        gas=gas,
+        dtype=dtype,
+        mesh={"pipe": pipe, "data": -1},
+    )
+    engine, _, _, _ = ds.initialize(model=module, config=cfg)
+    return engine, module
+
+
+@pytest.mark.parametrize("pipe", [2, 4])
+def test_pipeline_matches_sequential_loss(pipe):
+    """Pipelined loss must equal the sequential (pipe=1) loss exactly."""
+    gas, micro_bs, dim = 4, 2, 16
+    bs = gas * micro_bs
+    batch = pipe_batch(bs, dim)
+
+    e1, m1 = _make_engine(nblocks=4, pipe=1, gas=gas, micro_bs=micro_bs, dim=dim)
+    ep, mp = _make_engine(nblocks=4, pipe=pipe, gas=gas, micro_bs=micro_bs, dim=dim)
+    # align initial params (same seed → same init)
+    l_seq = float(e1.eval_batch(batch=batch))
+    l_pipe = float(ep.eval_batch(batch=batch))
+    assert l_seq == pytest.approx(l_pipe, rel=1e-5)
+
+
+def test_pipeline_train_matches_sequential_train():
+    """One optimizer step through the pipelined program matches the
+    sequential engine's step (same grads, same update)."""
+    gas, micro_bs, dim = 4, 2, 16
+    bs = gas * micro_bs
+    batch = pipe_batch(bs, dim)
+
+    e1, _ = _make_engine(nblocks=4, pipe=1, gas=gas, micro_bs=micro_bs, dim=dim)
+    ep, _ = _make_engine(nblocks=4, pipe=4, gas=gas, micro_bs=micro_bs, dim=dim)
+
+    l1 = float(e1.train_batch(batch=batch))
+    lp = float(ep.train_batch(batch=batch))
+    assert l1 == pytest.approx(lp, rel=1e-4)
+
+    # params after the step agree
+    w1 = np.asarray(e1.state["params"]["blocks"]["w"])
+    wp = np.asarray(ep.state["params"]["blocks"]["w"])
+    np.testing.assert_allclose(w1, wp, rtol=2e-4, atol=2e-5)
+
+
+def test_pipeline_convergence():
+    gas, micro_bs, dim = 4, 4, 16
+    bs = gas * micro_bs
+    engine, _ = _make_engine(nblocks=4, pipe=2, gas=gas, micro_bs=micro_bs, dim=dim, stage=1)
+    batch = pipe_batch(bs, dim, seed=0)
+    losses = [float(engine.train_batch(batch=batch)) for _ in range(15)]
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_pipeline_engine_rejects_zero2():
+    module = make_pipe_module(dim=8, nblocks=4)
+    cfg = base_config(stage=2, micro_bs=2, gas=2, mesh={"pipe": 2, "data": -1})
+    with pytest.raises(AssertionError):
+        ds.initialize(model=module, config=cfg)
+
+
+def test_pipeline_engine_rejects_micro_api():
+    engine, _ = _make_engine(nblocks=4, pipe=2, gas=2, micro_bs=2)
+    with pytest.raises(RuntimeError):
+        engine.forward({"x": np.zeros((2, 16))})
+    with pytest.raises(RuntimeError):
+        engine.step()
+
+
+def test_pipeline_data_iterator_api():
+    gas, micro_bs, dim = 2, 2, 16
+    engine, _ = _make_engine(nblocks=4, pipe=2, gas=gas, micro_bs=micro_bs, dim=dim)
+    micro = [pipe_batch(micro_bs, dim, seed=s) for s in range(gas)]
+    loss = engine.train_batch(iter(micro))
+    assert np.isfinite(float(loss))

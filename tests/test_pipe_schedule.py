@@ -1,0 +1,114 @@
+"""Pipeline schedule semantics (reference tests/unit/test_pipe_schedule.py):
+pure-logic instruction-stream checks, no devices needed."""
+import pytest
+
+from deepspeed_tpu.runtime.pipe import schedule as S
+
+
+def _all_instructions(sched):
+    out = []
+    for step in sched:
+        out.extend(step)
+    return out
+
+
+def test_train_schedule_singlestage():
+    sched = S.TrainSchedule(micro_batches=4, stages=1, stage_id=0)
+    full = _all_instructions(sched)
+    # no sends/recvs with one stage
+    assert not any(isinstance(c, (S.SendActivation, S.RecvActivation, S.SendGrad, S.RecvGrad)) for c in full)
+    assert sum(isinstance(c, S.ForwardPass) for c in full) == 4
+    assert sum(isinstance(c, S.BackwardPass) for c in full) == 4
+    assert isinstance(full[-1], S.OptimizerStep)
+
+
+@pytest.mark.parametrize("micro_batches", [1, 3, 8])
+@pytest.mark.parametrize("stages", [2, 4])
+def test_train_schedule_counts(micro_batches, stages):
+    for stage_id in range(stages):
+        sched = S.TrainSchedule(micro_batches, stages, stage_id)
+        full = _all_instructions(sched)
+        assert sum(isinstance(c, S.ForwardPass) for c in full) == micro_batches
+        assert sum(isinstance(c, S.BackwardPass) for c in full) == micro_batches
+        # interior edges: every non-first stage receives every activation
+        n_recv_act = sum(isinstance(c, S.RecvActivation) for c in full)
+        n_send_act = sum(isinstance(c, S.SendActivation) for c in full)
+        assert n_recv_act == (micro_batches if stage_id > 0 else 0)
+        assert n_send_act == (micro_batches if stage_id < stages - 1 else 0)
+        n_send_grad = sum(isinstance(c, S.SendGrad) for c in full)
+        n_recv_grad = sum(isinstance(c, S.RecvGrad) for c in full)
+        assert n_send_grad == (micro_batches if stage_id > 0 else 0)
+        assert n_recv_grad == (micro_batches if stage_id < stages - 1 else 0)
+        # loads only on first/last stage
+        n_load = sum(isinstance(c, S.LoadMicroBatch) for c in full)
+        if stage_id in (0, stages - 1):
+            assert n_load == micro_batches
+        else:
+            assert n_load == 0
+        # model update exactly once, at the very end
+        assert sum(isinstance(c, S.OptimizerStep) for c in full) == 1
+        assert isinstance(full[-1], S.OptimizerStep)
+        assert isinstance(full[-2], S.ReduceGrads)
+        assert isinstance(full[-3], S.ReduceTiedGrads)
+
+
+def test_train_schedule_fwd_before_bwd():
+    """Each micro-batch's forward precedes its backward on every stage."""
+    M, stages = 4, 4
+    for stage_id in range(stages):
+        sched = S.TrainSchedule(M, stages, stage_id)
+        fwd_step = {}
+        bwd_step = {}
+        fwd_seen = 0
+        bwd_seen = 0
+        for step_id, step in enumerate(sched.steps()):
+            for cmd in step:
+                if isinstance(cmd, S.ForwardPass):
+                    fwd_step[fwd_seen] = step_id
+                    fwd_seen += 1
+                elif isinstance(cmd, S.BackwardPass):
+                    bwd_step[bwd_seen] = step_id
+                    bwd_seen += 1
+        for mb in range(M):
+            assert fwd_step[mb] < bwd_step[mb]
+
+
+def test_train_schedule_buffers():
+    # last stage needs only 2 buffers; earlier stages more (1F1B depth)
+    assert S.TrainSchedule(8, 4, 3).num_pipe_buffers() == 2
+    assert S.TrainSchedule(8, 4, 0).num_pipe_buffers() == 5
+    assert S.TrainSchedule(1, 4, 0).num_pipe_buffers() == 2
+
+
+def test_inference_schedule():
+    M, stages = 4, 2
+    for stage_id in range(stages):
+        sched = S.InferenceSchedule(M, stages, stage_id)
+        full = _all_instructions(sched)
+        assert sum(isinstance(c, S.ForwardPass) for c in full) == M
+        assert not any(isinstance(c, S.BackwardPass) for c in full)
+        assert sched.num_pipe_buffers() == 2
+        # buffer ids alternate between 0 and 1
+        for c in full:
+            if isinstance(c, S.BufferOpInstruction):
+                assert c.buffer_id in (0, 1)
+
+
+def test_data_parallel_schedule():
+    sched = S.DataParallelSchedule(micro_batches=3, stages=1, stage_id=0)
+    steps = list(sched.steps())
+    assert len(steps) == 3
+    assert isinstance(steps[-1][-1], S.OptimizerStep)
+    assert sched.num_pipe_buffers() == 1
+
+
+def test_bubble_fraction():
+    assert S.TrainSchedule(8, 4, 0).bubble_fraction() == pytest.approx(3 / 11)
+    assert S.TrainSchedule(8, 1, 0).bubble_fraction() == 0.0
+
+
+def test_instruction_repr_and_eq():
+    a = S.ForwardPass(buffer_id=1)
+    assert a == S.ForwardPass(buffer_id=1)
+    assert a != S.ForwardPass(buffer_id=2)
+    assert "ForwardPass" in repr(a)
