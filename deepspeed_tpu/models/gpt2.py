@@ -41,6 +41,9 @@ class GPT2Config:
     dropout: float = 0.0
     layer_norm_epsilon: float = 1e-5
     use_flash_attention: bool = True
+    # "flash" | "ring" | "ulysses" — ring/ulysses run sequence-parallel
+    # over the mesh's `seq` axis (parallel/sequence.py)
+    attention_mode: str = "flash"
     remat: bool = True  # activation checkpointing per block
     remat_policy: str = "nothing_saveable"  # or "dots_with_no_batch_dims_saveable"
     dtype: Any = jnp.float32  # activation dtype is set by the engine cast
@@ -164,7 +167,17 @@ def _block(cfg: GPT2Config, x, lp, rng, deterministic: bool):
         return t.reshape(B, T, H, hd).transpose(0, 2, 1, 3)
 
     q, k, v = heads(q), heads(k), heads(v)
-    if cfg.use_flash_attention and T >= 128:
+    if cfg.attention_mode == "ring":
+        from deepspeed_tpu.parallel.sequence import ring_attention
+
+        attn = ring_attention(q, k, v, causal=True)
+    elif cfg.attention_mode == "ulysses":
+        from deepspeed_tpu.parallel.sequence import ulysses_attention
+
+        attn = ulysses_attention(q, k, v, causal=True, use_flash=cfg.use_flash_attention)
+    elif cfg.attention_mode != "flash":
+        raise ValueError(f"unknown attention_mode {cfg.attention_mode!r} (flash|ring|ulysses)")
+    elif cfg.use_flash_attention and T >= 128:
         attn = flash_attention(q, k, v, causal=True)
     else:
         attn = mha_reference(q, k, v, causal=True)

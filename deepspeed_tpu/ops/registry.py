@@ -73,6 +73,7 @@ def all_ops() -> Dict[str, OpSpec]:
     import deepspeed_tpu.ops.attention.flash_attention  # noqa: F401
 
     for mod in (
+        "deepspeed_tpu.parallel.sequence",
         "deepspeed_tpu.ops.adam.cpu_adam",
         "deepspeed_tpu.ops.aio.aio",
         "deepspeed_tpu.ops.transformer.transformer",

@@ -1,0 +1,13 @@
+"""Parallelism strategies beyond the core mesh (comm/mesh.py).
+
+* ``sequence`` — ring attention + Ulysses all-to-all sequence/context
+  parallelism over the ``seq`` axis (SURVEY.md §5.7's modern successor).
+"""
+from deepspeed_tpu.parallel.sequence import (
+    ring_attention,
+    ulysses_attention,
+    set_global_mesh,
+    get_global_mesh,
+)
+
+__all__ = ["ring_attention", "ulysses_attention", "set_global_mesh", "get_global_mesh"]

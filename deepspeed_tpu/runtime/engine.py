@@ -84,6 +84,11 @@ class DeepSpeedEngine:
         self._loss_fn = loss_fn
         self.mesh = mesh if mesh is not None else make_mesh(config.mesh)
         self.mesh_info = MeshInfo.from_mesh(self.mesh)
+        # publish the mesh so lazily-resolved parallel ops (ring/ulysses
+        # attention, MoE dispatch) can find it at trace time
+        from deepspeed_tpu.parallel.sequence import set_global_mesh
+
+        set_global_mesh(self.mesh)
         self.global_rank = jax.process_index()
         self.world_size = self.mesh_info.world_size
 
