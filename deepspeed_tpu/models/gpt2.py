@@ -44,6 +44,12 @@ class GPT2Config:
     # "flash" | "ring" | "ulysses" — ring/ulysses run sequence-parallel
     # over the mesh's `seq` axis (parallel/sequence.py)
     attention_mode: str = "flash"
+    # MoE: >0 replaces every block's FFN with an n_experts MoE layer
+    # (experts sharded over the `expert` mesh axis, moe/layer.py)
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
     remat: bool = True  # activation checkpointing per block
     remat_policy: str = "nothing_saveable"  # or "dots_with_no_batch_dims_saveable"
     dtype: Any = jnp.float32  # activation dtype is set by the engine cast
@@ -55,7 +61,12 @@ class GPT2Config:
 
     def num_params(self) -> int:
         d, l, v, s = self.n_embd, self.n_layer, self.vocab_size, self.n_positions
-        per_layer = 12 * d * d + 13 * d
+        if self.n_experts > 0:
+            E = self.n_experts
+            # attention (qkv+proj) + LNs + router + E expert FFNs
+            per_layer = 4 * d * d + 8 * d + d * E + E * (8 * d * d + 5 * d)
+        else:
+            per_layer = 12 * d * d + 13 * d
         return v * d + s * d + l * per_layer + 2 * d
 
 
@@ -95,6 +106,19 @@ def init_params(cfg: GPT2Config, seed: int = 0) -> Dict[str, Any]:
     def o(*shape):
         return np.ones(shape, np.float32)
 
+    if cfg.n_experts > 0:
+        from deepspeed_tpu.moe.layer import MoEConfig, init_moe_params
+
+        mcfg = MoEConfig(num_experts=cfg.n_experts, d_model=d, d_ff=4 * d)
+        per_layer = [init_moe_params(mcfg, rng, std=std, proj_std=proj_std) for _ in range(l)]
+        ffn = {k: np.stack([p[k] for p in per_layer]) for k in per_layer[0]}
+    else:
+        ffn = {
+            "fc_w": n(l, d, 4 * d),
+            "fc_b": z(l, 4 * d),
+            "fc_proj_w": n(l, 4 * d, d, s=proj_std),
+            "fc_proj_b": z(l, d),
+        }
     return {
         "wte": n(cfg.vocab_size, d),
         "wpe": n(cfg.n_positions, d, s=0.01),
@@ -107,10 +131,7 @@ def init_params(cfg: GPT2Config, seed: int = 0) -> Dict[str, Any]:
             "proj_b": z(l, d),
             "ln2_g": o(l, d),
             "ln2_b": z(l, d),
-            "fc_w": n(l, d, 4 * d),
-            "fc_b": z(l, 4 * d),
-            "fc_proj_w": n(l, 4 * d, d, s=proj_std),
-            "fc_proj_b": z(l, d),
+            **ffn,
         },
         "lnf_g": o(d),
         "lnf_b": z(d),
@@ -121,15 +142,23 @@ def tp_spec_fn(path: str, shape) -> Optional[P]:
     """Megatron-style tensor-parallel specs over the ``model`` axis
     (reference delegates TP to Megatron mpu; inference-side slicing in
     module_inject/replace_module.py:11-88 follows the same column/row
-    split)."""
+    split), plus expert-parallel specs over ``expert`` for MoE weights."""
     name = path.split("/")[-1]
     col = {"qkv_w": P(None, None, "model"), "qkv_b": P(None, "model"),
            "fc_w": P(None, None, "model"), "fc_b": P(None, "model")}
     row = {"proj_w": P(None, "model", None), "fc_proj_w": P(None, "model", None)}
+    # MoE expert weights: experts over `expert`, FFN hidden dim over
+    # `model` (EP × TP); layer dim leads (moe_param_specs is the single
+    # source of truth for this layout).
+    from deepspeed_tpu.moe.layer import moe_param_specs
+
+    moe = {k: v for k, v in moe_param_specs(layer_dim=True, tp_axis="model").items() if k != "gate_w"}
     if name in col:
         return col[name]
     if name in row:
         return row[name]
+    if name in moe:
+        return moe[name]
     if name == "wte":
         return P("model", None)  # vocab-parallel embedding
     return None
@@ -150,7 +179,7 @@ def _dropout(x, rate, rng, deterministic):
     return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
 
 
-def _block(cfg: GPT2Config, x, lp, rng, deterministic: bool):
+def _block(cfg: GPT2Config, x, lp, rng, deterministic: bool, token_mask=None):
     """One transformer block; ``lp`` holds this layer's slice of the
     stacked params."""
     B, T, D = x.shape
@@ -186,16 +215,35 @@ def _block(cfg: GPT2Config, x, lp, rng, deterministic: bool):
     x = x + _dropout(attn, cfg.dropout, r1, deterministic)
 
     h = _layer_norm(x, lp["ln2_g"], lp["ln2_b"], cfg.layer_norm_epsilon)
-    h = h @ lp["fc_w"].astype(h.dtype) + lp["fc_b"].astype(h.dtype)
-    h = jax.nn.gelu(h, approximate=True)
-    h = _dropout(h, cfg.dropout, r2, deterministic)
-    h = h @ lp["fc_proj_w"].astype(h.dtype) + lp["fc_proj_b"].astype(h.dtype)
+    if cfg.n_experts > 0:
+        from deepspeed_tpu.moe.layer import MoEConfig, moe_ffn
+
+        mcfg = MoEConfig(
+            num_experts=cfg.n_experts,
+            d_model=D,
+            d_ff=4 * D,
+            top_k=cfg.moe_top_k,
+            capacity_factor=cfg.moe_capacity_factor,
+        )
+        moe_params = {k: lp[k] for k in ("gate_w", "w1", "b1", "w2", "b2")}
+        # training ⇔ a dropout/jitter rng was threaded in (eval passes None)
+        h, aux = moe_ffn(moe_params, h, mcfg, rng=r2, training=rng is not None, token_mask=token_mask)
+    else:
+        h = h @ lp["fc_w"].astype(h.dtype) + lp["fc_b"].astype(h.dtype)
+        h = jax.nn.gelu(h, approximate=True)
+        h = _dropout(h, cfg.dropout, r2, deterministic)
+        h = h @ lp["fc_proj_w"].astype(h.dtype) + lp["fc_proj_b"].astype(h.dtype)
+        aux = jnp.zeros((), jnp.float32)
     x = x + _dropout(h, cfg.dropout, r3, deterministic)
-    return x
+    return x, aux
 
 
-def apply(params: Dict[str, Any], tokens: jnp.ndarray, cfg: GPT2Config, rng=None, deterministic: bool = True) -> jnp.ndarray:
-    """Forward pass: ``tokens (B, T) int32`` → logits ``(B, T, V)``."""
+def apply(params: Dict[str, Any], tokens: jnp.ndarray, cfg: GPT2Config, rng=None, deterministic: bool = True, return_aux: bool = False, token_mask=None):
+    """Forward pass: ``tokens (B, T) int32`` → logits ``(B, T, V)``.
+
+    ``return_aux=True`` additionally returns the summed MoE
+    load-balancing loss (zero for dense models).  ``token_mask (B, T)``
+    excludes padding from MoE routing/aux."""
     B, T = tokens.shape
     x = jnp.take(params["wte"], tokens, axis=0) + params["wpe"][:T][None]
     x = x.astype(params["blocks"]["qkv_w"].dtype)
@@ -209,18 +257,21 @@ def apply(params: Dict[str, Any], tokens: jnp.ndarray, cfg: GPT2Config, rng=None
     block_fn = functools.partial(_block, cfg)
 
     def scan_body(carry, xs):
+        x, aux_acc = carry
         lp, lr = xs
         r = lr if rng is not None else None
-        y = block_fn(carry, lp, r, deterministic)
-        return y, None
+        y, aux = block_fn(x, lp, r, deterministic, token_mask)
+        return (y, aux_acc + aux), None
 
     if cfg.remat:
         policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
         scan_body = jax.checkpoint(scan_body, policy=policy, prevent_cse=False)
 
-    x, _ = jax.lax.scan(scan_body, x, (params["blocks"], layer_rngs))
+    (x, aux_total), _ = jax.lax.scan(scan_body, (x, jnp.zeros((), jnp.float32)), (params["blocks"], layer_rngs))
     x = _layer_norm(x, params["lnf_g"], params["lnf_b"], cfg.layer_norm_epsilon)
     logits = x @ params["wte"].T.astype(x.dtype)  # tied embedding head
+    if return_aux:
+        return logits, aux_total
     return logits
 
 
@@ -228,7 +279,10 @@ def loss_fn(params: Dict[str, Any], batch: Dict[str, Any], rng=None, cfg: GPT2Co
     """Next-token cross entropy.  ``batch``: {"input_ids": (B, T)} with
     optional "labels" (default: shifted input_ids) and "attention_mask"."""
     tokens = batch["input_ids"]
-    logits = apply(params, tokens, cfg, rng=rng, deterministic=deterministic)
+    logits, moe_aux = apply(
+        params, tokens, cfg, rng=rng, deterministic=deterministic, return_aux=True,
+        token_mask=batch.get("attention_mask") if cfg.n_experts > 0 else None,
+    )
     if "labels" in batch:
         labels = batch["labels"]
         logits_shift = logits
@@ -239,11 +293,12 @@ def loss_fn(params: Dict[str, Any], batch: Dict[str, Any], rng=None, cfg: GPT2Co
     logz = jax.nn.logsumexp(logits32, axis=-1)
     gold = jnp.take_along_axis(logits32, labels[..., None], axis=-1)[..., 0]
     nll = logz - gold
+    aux = cfg.moe_aux_weight * moe_aux if cfg.n_experts > 0 else 0.0
     if "attention_mask" in batch:
         # mask indexed at the *label* position (tokens[:, 1:]), not the query
         mask = batch["attention_mask"][:, 1 : 1 + nll.shape[1]].astype(jnp.float32) if "labels" not in batch else batch["attention_mask"][:, : nll.shape[1]].astype(jnp.float32)
-        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
-    return jnp.mean(nll)
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0) + aux
+    return jnp.mean(nll) + aux
 
 
 def make_model(cfg: GPT2Config):

@@ -1,0 +1,15 @@
+"""Mixture-of-experts (expert parallelism over the ``expert`` mesh axis).
+
+Upstream DeepSpeed grew ``deepspeed.moe`` in v0.5 (after the reference
+snapshot); here it is first-class from round 1 because expert
+parallelism shapes the mesh design (SURVEY.md §2.5 notes EP as absent
+in the reference)."""
+from deepspeed_tpu.moe.layer import (
+    MoEConfig,
+    init_moe_params,
+    moe_ffn,
+    moe_param_specs,
+    top_k_gating,
+)
+
+__all__ = ["MoEConfig", "init_moe_params", "moe_ffn", "moe_param_specs", "top_k_gating"]

@@ -1,0 +1,211 @@
+"""Mixture-of-experts with expert parallelism over the ``expert`` axis.
+
+The reference snapshot (v0.4.5) predates DeepSpeed-MoE (landed v0.5,
+``deepspeed/moe/layer.py`` upstream); this framework ships MoE
+TPU-first from the start:
+
+* **Static-shape capacity dispatch** (GShard-style): top-k gating
+  produces dense ``(tokens, experts, capacity)`` dispatch/combine
+  tensors; dispatch and combine are einsums that XLA lowers onto the
+  MXU, and token→expert movement over the ``expert`` mesh axis becomes
+  an XLA all-to-all inserted by GSPMD from the sharding constraints —
+  no Python-side routing, no dynamic shapes.
+* **Experts stacked on a leading dim** ``(E, ...)`` sharded
+  ``P("expert", ...)`` so each expert-parallel rank owns ``E/ep``
+  experts; compute is a single batched matmul over the local experts.
+* **Load-balancing aux loss** (Switch/GShard): ``E * Σ_e mean_prob_e *
+  frac_tokens_e``, returned to the caller to add to the task loss.
+
+Functional API (params are plain pytrees, like the rest of the
+framework): ``init_moe_params`` → ``moe_ffn(params, x)``.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.ops.registry import register_op
+
+EXPERT_AXIS = "expert"
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int
+    d_model: int
+    d_ff: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    eval_capacity_factor: float = 2.0
+    min_capacity: int = 4
+    # router jitter noise (training only), as in Switch Transformer.
+    # NB: the aux-loss *weight* is applied by the caller (moe_ffn returns
+    # the unweighted load-balancing loss).
+    router_jitter: float = 0.0
+
+
+def init_moe_params(cfg: MoEConfig, rng: np.random.Generator, std: float = 0.02, proj_std: Optional[float] = None) -> Dict[str, Any]:
+    """Expert FFN + router weights, experts stacked on dim 0."""
+    if proj_std is None:
+        proj_std = std
+    E, D, F = cfg.num_experts, cfg.d_model, cfg.d_ff
+    return {
+        "gate_w": (rng.standard_normal((D, E)) * std).astype(np.float32),
+        "w1": (rng.standard_normal((E, D, F)) * std).astype(np.float32),
+        "b1": np.zeros((E, F), np.float32),
+        "w2": (rng.standard_normal((E, F, D)) * proj_std).astype(np.float32),
+        "b2": np.zeros((E, D), np.float32),
+    }
+
+
+def moe_param_specs(layer_dim: bool = False, tp_axis: Optional[str] = None) -> Dict[str, P]:
+    """PartitionSpecs for MoE weights: experts over ``expert``, and
+    (optionally) the expert-FFN hidden dim over ``tp_axis`` (EP × TP).
+
+    ``layer_dim=True`` prepends a replicated leading dim for models that
+    stack per-layer weights for ``lax.scan`` (e.g. models/gpt2.py).
+    This is the single source of truth — model ``tp_spec_fn``s should
+    consume it rather than re-declare the layout.
+    """
+    specs = {
+        "gate_w": P(),
+        "w1": P(EXPERT_AXIS, None, tp_axis),
+        "b1": P(EXPERT_AXIS, tp_axis),
+        "w2": P(EXPERT_AXIS, tp_axis, None),
+        "b2": P(EXPERT_AXIS, None),
+    }
+    if layer_dim:
+        specs = {k: P(None, *v) for k, v in specs.items()}
+    return specs
+
+
+def _capacity(tokens: int, num_experts: int, factor: float, min_capacity: int) -> int:
+    cap = int(np.ceil(tokens / num_experts * factor))
+    return max(cap, min_capacity)
+
+
+def top_k_gating(
+    logits: jnp.ndarray,
+    top_k: int,
+    capacity: int,
+    rng: Optional[jax.Array] = None,
+    jitter: float = 0.0,
+    token_mask: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """GShard-style top-k gating with static capacity.
+
+    ``logits``: (T, E) router scores for T tokens.  ``token_mask`` (T,)
+    in {0,1} excludes padding tokens from dispatch, capacity, and the
+    aux loss.
+    Returns ``(dispatch (T,E,C) bool-ish, combine (T,E,C) float, aux_loss)``.
+    """
+    T, E = logits.shape
+    if rng is not None and jitter > 0.0:
+        logits = logits * jax.random.uniform(rng, logits.shape, minval=1.0 - jitter, maxval=1.0 + jitter)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # (T, E)
+    if token_mask is None:
+        tmask = jnp.ones((T,), jnp.float32)
+        n_real = float(T)
+    else:
+        tmask = token_mask.astype(jnp.float32)
+        n_real = jnp.maximum(jnp.sum(tmask), 1.0)
+
+    # Iteratively pick top-k choices per token, masking previous picks.
+    masked = probs
+    dispatch = jnp.zeros((T, E, capacity), jnp.float32)
+    combine = jnp.zeros((T, E, capacity), jnp.float32)
+    # Track per-expert fill across the k rounds so capacity is shared.
+    fill = jnp.zeros((E,), jnp.int32)
+    frac_tokens = jnp.zeros((E,), jnp.float32)  # for aux loss (top-1 only per Switch)
+
+    for r in range(top_k):
+        idx = jnp.argmax(masked, axis=-1)  # (T,)
+        onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32) * tmask[:, None]  # (T, E); pads route nowhere
+        gate = jnp.sum(probs * onehot, axis=-1)  # (T,)
+        # position of each token within its chosen expert's buffer
+        pos_in_expert = (jnp.cumsum(onehot, axis=0) - onehot) * onehot  # (T, E)
+        pos = jnp.sum(pos_in_expert, axis=-1).astype(jnp.int32) + jnp.sum(onehot * fill[None, :], axis=-1).astype(jnp.int32)
+        keep = pos < capacity
+        gate = gate * keep
+        pos_oh = jax.nn.one_hot(jnp.clip(pos, 0, capacity - 1), capacity, dtype=jnp.float32)  # (T, C)
+        sel = onehot * keep[:, None]  # (T, E)
+        dispatch = dispatch + sel[:, :, None] * pos_oh[:, None, :]
+        combine = combine + (gate[:, None] * sel)[:, :, None] * pos_oh[:, None, :]
+        fill = fill + jnp.sum(sel, axis=0).astype(jnp.int32)
+        if r == 0:
+            frac_tokens = jnp.sum(onehot, axis=0) / n_real
+        masked = masked * (1.0 - onehot)  # mask picked expert for next round
+
+    mean_prob = jnp.sum(probs * tmask[:, None], axis=0) / n_real  # (E,)
+    aux_loss = E * jnp.sum(mean_prob * frac_tokens)
+    return dispatch, combine, aux_loss
+
+
+def _expert_sharding(spec: P):
+    """Best-effort NamedSharding from the engine's global mesh (None if
+    no engine/mesh yet — then GSPMD is unconstrained, still correct)."""
+    from deepspeed_tpu.parallel.sequence import get_global_mesh
+
+    mesh = get_global_mesh()
+    if mesh is None or EXPERT_AXIS not in mesh.axis_names:
+        return None
+    return NamedSharding(mesh, spec)
+
+
+def moe_ffn(
+    params: Dict[str, Any],
+    x: jnp.ndarray,
+    cfg: MoEConfig,
+    rng: Optional[jax.Array] = None,
+    training: bool = False,
+    token_mask: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """MoE feed-forward over ``x (B, T, D)`` → ``(out (B, T, D), aux_loss)``.
+
+    Expert weights ``params['w1'] (E, D, F)`` etc. may be sharded over
+    the ``expert`` axis; dispatch/combine einsums trigger GSPMD
+    all-to-alls between the token sharding (batch axes) and the expert
+    sharding.  ``training`` selects capacity_factor (vs the laxer
+    eval_capacity_factor) and enables router jitter; ``token_mask``
+    (B, T) excludes padding from routing/capacity/aux.
+    """
+    B, T, D = x.shape
+    tokens = B * T
+    E = cfg.num_experts
+    factor = cfg.capacity_factor if training else cfg.eval_capacity_factor
+    C = _capacity(tokens, E, factor, cfg.min_capacity)
+
+    xt = x.reshape(tokens, D)
+    logits = xt.astype(jnp.float32) @ params["gate_w"].astype(jnp.float32)
+    dispatch, combine, aux = top_k_gating(
+        logits,
+        cfg.top_k,
+        C,
+        rng=rng,
+        jitter=cfg.router_jitter if training else 0.0,
+        token_mask=token_mask.reshape(tokens) if token_mask is not None else None,
+    )
+    dispatch = dispatch.astype(x.dtype)
+    combine = combine.astype(jnp.float32)
+
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, xt)  # (E, C, D)
+    sh = _expert_sharding(P(EXPERT_AXIS, None, None))
+    if sh is not None:
+        expert_in = jax.lax.with_sharding_constraint(expert_in, sh)
+    h = jnp.einsum("ecd,edf->ecf", expert_in, params["w1"].astype(x.dtype)) + params["b1"][:, None, :].astype(x.dtype)
+    h = jax.nn.gelu(h, approximate=True)
+    out = jnp.einsum("ecf,efd->ecd", h, params["w2"].astype(x.dtype)) + params["b2"][:, None, :].astype(x.dtype)
+    if sh is not None:
+        out = jax.lax.with_sharding_constraint(out, sh)
+    y = jnp.einsum("tec,ecd->td", combine, out.astype(jnp.float32))
+    return y.reshape(B, T, D).astype(x.dtype), aux.astype(jnp.float32)
+
+
+@register_op("moe", "xla", "GShard-style top-k MoE dispatch/combine (GSPMD all-to-all over expert axis)")
+def _load_moe():
+    return moe_ffn

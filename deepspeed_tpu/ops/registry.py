@@ -74,6 +74,7 @@ def all_ops() -> Dict[str, OpSpec]:
 
     for mod in (
         "deepspeed_tpu.parallel.sequence",
+        "deepspeed_tpu.moe.layer",
         "deepspeed_tpu.ops.adam.cpu_adam",
         "deepspeed_tpu.ops.aio.aio",
         "deepspeed_tpu.ops.transformer.transformer",
