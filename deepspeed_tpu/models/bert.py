@@ -149,7 +149,11 @@ def encode(params, input_ids, cfg: BertConfig, token_type_ids=None, attention_ma
     B, T = input_ids.shape
     dtype = params["blocks"]["qkv_w"].dtype
     x = jnp.take(params["tok_emb"], input_ids, axis=0) + params["pos_emb"][:T][None]
-    if token_type_ids is not None:
+    if token_type_ids is None:
+        # BERT semantics: absent segment ids mean "all segment A" — the
+        # type-0 embedding is still added (HF does the same).
+        x = x + params["type_emb"][0][None, None]
+    else:
         x = x + jnp.take(params["type_emb"], token_type_ids, axis=0)
     x = _layer_norm(x.astype(dtype), params["emb_ln_g"], params["emb_ln_b"], cfg.layer_norm_eps)
 

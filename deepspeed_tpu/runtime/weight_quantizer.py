@@ -1,0 +1,100 @@
+"""Post-training weight quantization for inference (MoQ serving path).
+
+Reference: ``runtime/weight_quantizer.py`` (``WeightQuantization`` :5) and
+``module_inject/module_quantize.py`` (``quantize_transformer_layer``) —
+grouped symmetric int8 quantization of transformer weights applied while
+building the inference engine.
+
+TPU-native form: quantize-dequantize is a jittable elementwise transform;
+serving true-int8 matmuls is a Pallas-kernel optimization on top of the
+same grouped scales (``ops/quantizer`` holds the kernels).  Here we store
+either (a) dequantized bf16 weights (simulated quantization — numerics
+identical to the reference's dequantized path) or (b) the packed
+int8+scales pair for kernels that consume them.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class WeightQuantization:
+    def __init__(self, bits: int = 8, groups: int = 1, mlp_extra_grouping: bool = False):
+        if bits not in (4, 8):
+            raise ValueError(f"bits must be 4 or 8, got {bits}")
+        self.bits = bits
+        self.groups = max(1, int(groups))
+        self.mlp_extra_grouping = mlp_extra_grouping
+
+    # -- core grouped symmetric quantizer ---------------------------------
+    def quantize(self, w: np.ndarray, groups: int = None) -> Tuple[np.ndarray, np.ndarray]:
+        """Returns (q int8, scales fp32).  Granularity: each *row* of the
+        matrix (all leading dims flattened — so a stacked (L, in, out)
+        weight quantizes per (layer, input-row), never across layers)
+        split into ``groups`` column groups when divisible, else one scale
+        per row.  Mirrors the reference's grouped sym path
+        (``csrc/quantization/quantizer.cu``)."""
+        groups = groups or self.groups
+        w = np.asarray(w, np.float32)
+        C = w.shape[-1]
+        if C % groups != 0:
+            groups = 1
+        flat = w.reshape(-1, groups, C // groups)
+        qmax = (1 << (self.bits - 1)) - 1
+        scale = np.abs(flat).max(axis=2, keepdims=True) / qmax
+        scale = np.where(scale == 0.0, 1.0, scale)
+        q = np.clip(np.round(flat / scale), -qmax - 1, qmax).astype(np.int8)
+        return q.reshape(w.shape), scale.astype(np.float32)
+
+    def dequantize(self, q: np.ndarray, scale: np.ndarray) -> np.ndarray:
+        rows, groups = scale.shape[0], scale.shape[1]
+        return (q.astype(np.float32).reshape(rows, groups, -1) * scale).reshape(q.shape)
+
+    def quantize_dequantize(self, w) -> np.ndarray:
+        q, s = self.quantize(np.asarray(w))
+        return self.dequantize(q, s)
+
+    # -- tree-level application -------------------------------------------
+    def _is_matmul_weight(self, name: str, shape) -> bool:
+        return len(shape) >= 2 and name.endswith("_w")
+
+    def quantize_dequantize_tree(self, params: Any) -> Any:
+        """Simulated quantization over a parameter pytree: quantize every
+        matmul weight, leave norms/biases/embedding tables' small tensors
+        alone (reference quantizes qkvw/dense/mlp weights,
+        ``module_quantize.py``)."""
+
+        def visit(path, leaf):
+            name = str(getattr(path[-1], "key", path[-1])) if path else ""
+            arr = np.asarray(leaf)
+            if self._is_matmul_weight(name, arr.shape) and "emb" not in name and name != "wte":
+                groups = self.groups * (2 if self.mlp_extra_grouping and "fc" in name else 1)
+                q, s = self.quantize(arr, groups=groups)
+                return self.dequantize(q, s).astype(arr.dtype)
+            return leaf
+
+        return jax.tree_util.tree_map_with_path(visit, params)
+
+    def quantize_tree_packed(self, params: Any) -> Dict[str, Any]:
+        """True-int8 representation: {name: (q, scales)} for matmul
+        weights (consumed by quantized-matmul kernels)."""
+        packed = {}
+
+        def visit(path, leaf):
+            name = "/".join(str(getattr(k, "key", k)) for k in path)
+            arr = np.asarray(leaf)
+            short = name.split("/")[-1]
+            if self._is_matmul_weight(short, arr.shape) and "emb" not in short and short != "wte":
+                packed[name] = self.quantize(arr)
+            return leaf
+
+        jax.tree_util.tree_map_with_path(visit, params)
+        return packed
+
+
+def quantize_transformer_layer(params: Any, bits: int = 8, groups: int = 1) -> Any:
+    """Name-compat shim for ``module_inject/module_quantize.py``."""
+    return WeightQuantization(bits=bits, groups=groups).quantize_dequantize_tree(params)
