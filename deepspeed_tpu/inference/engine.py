@@ -192,7 +192,13 @@ class InferenceEngine:
     # ----------------------------------------------------------------------
     def forward(self, input_ids, **kw):
         """Full-sequence forward: GPT → logits (B,T,V); BERT → encoder
-        hidden states (pass token_type_ids/attention_mask as kwargs)."""
+        hidden states (BERT accepts token_type_ids/attention_mask
+        kwargs)."""
+        if self._is_gpt and kw:
+            raise TypeError(
+                f"forward() got unexpected kwargs {sorted(kw)} for a GPT-family "
+                "model (token_type_ids/attention_mask are BERT-only)"
+            )
         input_ids = jnp.asarray(np.asarray(input_ids), jnp.int32)
         key = ("fwd", input_ids.shape, tuple(sorted(kw)))
         if key not in self._compiled:
@@ -283,6 +289,11 @@ class InferenceEngine:
         B, T = input_ids.shape
         if T + max_new_tokens > self.model_config.n_positions:
             raise ValueError(f"T+max_new_tokens={T + max_new_tokens} exceeds n_positions={self.model_config.n_positions}")
+        if T + max_new_tokens > self.max_out_tokens:
+            raise ValueError(
+                f"T+max_new_tokens={T + max_new_tokens} exceeds the engine's "
+                f"max_out_tokens={self.max_out_tokens} (raise it in init_inference)"
+            )
         key = ("gen", B, T, max_new_tokens, do_sample, float(temperature), int(top_k), eos_token_id)
         if key not in self._compiled:
             self._compiled[key] = self._build_generate(B, T, max_new_tokens, do_sample, temperature, top_k, eos_token_id)

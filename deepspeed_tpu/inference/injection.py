@@ -75,7 +75,9 @@ class HFGPT2LayerPolicy(DSPolicy):
     fused c_attn is the same q|k|v concat our blocks use.
     """
 
-    architectures = ("GPT2LMHeadModel", "GPT2Model", "GPT2ForSequenceClassification")
+    # (GPT2ForSequenceClassification is deliberately absent: its score
+    # head has no analog in the fused LM layout)
+    architectures = ("GPT2LMHeadModel", "GPT2Model")
 
     @classmethod
     def convert(cls, model, hf_config=None):
@@ -286,6 +288,17 @@ class MegatronLayerPolicy(DSPolicy):
     """
 
     architectures = ("GPT2Model_megatron", "MegatronGPT")
+
+    @classmethod
+    def matches(cls, model) -> bool:
+        # Megatron checkpoints usually arrive as plain state dicts —
+        # probe for the transformer key prefix.
+        if isinstance(model, dict):
+            return "language_model.transformer.layers.0.input_layernorm.weight" in model
+        sd = model.state_dict() if hasattr(model, "state_dict") else {}
+        return super().matches(model) or (
+            "language_model.transformer.layers.0.input_layernorm.weight" in sd
+        )
 
     @classmethod
     def convert(cls, model, hf_config=None):

@@ -29,6 +29,9 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.ops.attention.flash_attention import flash_attention, mha_reference
+# single shared implementation (ops/normalize.py); aliased because
+# models/bert.py imports these names from here
+from deepspeed_tpu.ops.normalize import dropout as _dropout, layer_norm as _layer_norm
 
 
 @dataclasses.dataclass(frozen=True)
@@ -162,21 +165,6 @@ def tp_spec_fn(path: str, shape) -> Optional[P]:
     if name == "wte":
         return P("model", None)  # vocab-parallel embedding
     return None
-
-
-def _layer_norm(x, g, b, eps):
-    x32 = x.astype(jnp.float32)
-    mu = jnp.mean(x32, axis=-1, keepdims=True)
-    var = jnp.var(x32, axis=-1, keepdims=True)
-    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
-    return (y * g.astype(jnp.float32) + b.astype(jnp.float32)).astype(x.dtype)
-
-
-def _dropout(x, rate, rng, deterministic):
-    if deterministic or rate == 0.0 or rng is None:
-        return x
-    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
-    return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
 
 
 def _block(cfg: GPT2Config, x, lp, rng, deterministic: bool, token_mask=None):

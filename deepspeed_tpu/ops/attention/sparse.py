@@ -1,0 +1,518 @@
+"""Block-sparse attention.
+
+Reference: ``ops/sparse_attention/`` — Triton SDD/DSD/DDS block matmuls
+(``matmul.py:16-615``), block softmax (``softmax.py:107-230``), the
+``SparsityConfig`` layout family (``sparsity_config.py:9-662``:
+Dense/Fixed/Variable/BigBird/BSLongformer) and ``SparseSelfAttention``
+(``sparse_self_attention.py:14``).  The reference's long-sequence story
+is exactly this stack (10-16× longer sequences, SURVEY.md §5.7).
+
+TPU-native re-design (NOT a Triton port):
+
+* Layouts stay: the ``SparsityConfig`` classes reproduce the reference's
+  constructor surface and emit the same (heads, nb, nb) 0/1 block masks,
+  so existing recipes keep working.
+* The kernel is **gather-based blockwise attention**: for each (head,
+  q-block) the static layout gives the list of active kv-blocks, padded
+  to the layout's max row degree; K/V blocks are gathered with one
+  ``take_along_axis`` and attention runs as dense (block × deg·block)
+  MXU matmuls.  Compute and memory are O(nnz_blocks), not O(nb²) — the
+  same asymptotics the Triton SDD/DSD kernels buy, expressed in a form
+  XLA tiles onto the MXU.  A hand-fused Pallas splash-attention variant
+  can swap in underneath later without changing this contract.
+* Numerics are validated against dense attention under the equivalent
+  element mask (tests/test_sparse_attention.py), mirroring the
+  reference's ``test_sparse_attention.py``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import random as _random
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.ops.registry import register_op
+
+# ---------------------------------------------------------------------------
+# Layout configs (reference sparsity_config.py; same constructor surface)
+# ---------------------------------------------------------------------------
+
+
+class SparsityConfig:
+    """Abstract layout generator (reference ``SparsityConfig`` :9).
+
+    ``block`` is the square block size in tokens; layouts are
+    (num_heads, seq_blocks, seq_blocks) uint8 arrays."""
+
+    def __init__(self, num_heads: int, block: int = 16, different_layout_per_head: bool = False):
+        self.num_heads = num_heads
+        self.block = block
+        self.different_layout_per_head = different_layout_per_head
+        self.num_layout_heads = num_heads if different_layout_per_head else 1
+
+    def setup_layout(self, seq_len: int) -> np.ndarray:
+        if seq_len % self.block != 0:
+            raise ValueError(f"seq_len {seq_len} must be divisible by block {self.block}")
+        nb = seq_len // self.block
+        return np.zeros((self.num_heads, nb, nb), dtype=np.uint8)
+
+    def check_and_propagate_first_head_layout(self, layout: np.ndarray) -> np.ndarray:
+        if not self.different_layout_per_head:
+            layout[1:] = layout[0]
+        return layout
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        raise NotImplementedError
+
+
+class DenseSparsityConfig(SparsityConfig):
+    """All blocks active (reference :63) — for correctness comparisons."""
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        layout[:] = 1
+        return layout
+
+
+class FixedSparsityConfig(SparsityConfig):
+    """Fixed pattern à la Sparse Transformers (reference :94): local
+    windows of ``num_local_blocks`` plus global attention to the last
+    ``num_global_blocks`` of each window (vertical stripes; horizontal
+    too when bidirectional)."""
+
+    def __init__(
+        self,
+        num_heads: int,
+        block: int = 16,
+        different_layout_per_head: bool = False,
+        num_local_blocks: int = 4,
+        num_global_blocks: int = 1,
+        attention: str = "bidirectional",
+        horizontal_global_attention: bool = False,
+        num_different_global_patterns: int = 1,
+    ):
+        super().__init__(num_heads, block, different_layout_per_head)
+        if num_local_blocks % num_global_blocks != 0:
+            raise ValueError("num_local_blocks must be divisible by num_global_blocks")
+        if attention not in ("unidirectional", "bidirectional"):
+            raise ValueError("attention must be uni/bidirectional")
+        if horizontal_global_attention and attention != "bidirectional":
+            raise ValueError("horizontal global attention requires bidirectional attention")
+        if num_different_global_patterns > 1 and not different_layout_per_head:
+            raise ValueError("num_different_global_patterns > 1 requires different_layout_per_head")
+        if num_different_global_patterns > num_local_blocks // num_global_blocks:
+            raise ValueError("num_different_global_patterns too large")
+        self.num_local_blocks = num_local_blocks
+        self.num_global_blocks = num_global_blocks
+        self.attention = attention
+        self.horizontal_global_attention = horizontal_global_attention
+        self.num_different_global_patterns = num_different_global_patterns
+
+    def _set_local(self, layout: np.ndarray, h: int) -> None:
+        nb = layout.shape[1]
+        for start in range(0, nb, self.num_local_blocks):
+            end = min(start + self.num_local_blocks, nb)
+            for r in range(start, end):
+                hi = (r + 1) if self.attention == "unidirectional" else end
+                layout[h, r, start:hi] = 1
+
+    def _set_global(self, layout: np.ndarray, h: int) -> None:
+        nb = layout.shape[1]
+        # which block inside each window carries the global stripes —
+        # rotates across heads when multiple patterns are requested
+        pattern = h % self.num_different_global_patterns
+        first = self.num_local_blocks - (1 + pattern) * self.num_global_blocks
+        for wstart in range(0, nb, self.num_local_blocks):
+            gstart = wstart + first
+            gend = gstart + self.num_global_blocks
+            if gstart >= nb:
+                continue
+            gend = min(gend, nb)
+            # vertical stripes: rows at/after the global blocks attend to
+            # them (all rows when bidirectional)
+            if self.attention == "bidirectional":
+                layout[h, :, gstart:gend] = 1
+            else:
+                layout[h, gstart:, gstart:gend] = 1
+            if self.horizontal_global_attention:
+                layout[h, gstart:gend, :] = 1
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        for h in range(self.num_layout_heads):
+            self._set_local(layout, h)
+            self._set_global(layout, h)
+        layout = self.check_and_propagate_first_head_layout(layout)
+        if self.attention == "unidirectional":
+            layout = np.tril(layout)
+        return layout
+
+
+class VariableSparsityConfig(SparsityConfig):
+    """Variable local window sizes + explicit global blocks + random
+    blocks (reference :421)."""
+
+    def __init__(
+        self,
+        num_heads: int,
+        block: int = 16,
+        different_layout_per_head: bool = False,
+        num_random_blocks: int = 0,
+        local_window_blocks: Optional[List[int]] = None,
+        global_block_indices: Optional[List[int]] = None,
+        global_block_end_indices: Optional[List[int]] = None,
+        attention: str = "bidirectional",
+        horizontal_global_attention: bool = False,
+    ):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.num_random_blocks = num_random_blocks
+        self.local_window_blocks = local_window_blocks or [4]
+        self.global_block_indices = global_block_indices if global_block_indices is not None else [0]
+        self.global_block_end_indices = global_block_end_indices
+        if global_block_end_indices is not None and len(global_block_end_indices) != len(self.global_block_indices):
+            raise ValueError("global_block_end_indices must pair with global_block_indices")
+        self.attention = attention
+        self.horizontal_global_attention = horizontal_global_attention
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        nb = layout.shape[1]
+        rng = _random.Random(0)
+        for h in range(self.num_layout_heads):
+            # local variable-width windows, cycling the last width
+            start = 0
+            i = 0
+            while start < nb:
+                w = self.local_window_blocks[min(i, len(self.local_window_blocks) - 1)]
+                end = min(start + w, nb)
+                for r in range(start, end):
+                    hi = (r + 1) if self.attention == "unidirectional" else end
+                    layout[h, r, start:hi] = 1
+                start, i = end, i + 1
+            # global
+            for gi, g in enumerate(self.global_block_indices):
+                gend = (
+                    self.global_block_end_indices[gi]
+                    if self.global_block_end_indices is not None
+                    else g + 1
+                )
+                g0, g1 = min(g, nb), min(gend, nb)
+                layout[h, :, g0:g1] = 1
+                if self.horizontal_global_attention:
+                    layout[h, g0:g1, :] = 1
+            # random
+            for r in range(nb):
+                for _ in range(self.num_random_blocks):
+                    layout[h, r, rng.randrange(nb)] = 1
+        layout = self.check_and_propagate_first_head_layout(layout)
+        if self.attention == "unidirectional":
+            layout = np.tril(layout)
+        return layout
+
+
+class BigBirdSparsityConfig(SparsityConfig):
+    """BigBird ITC: random + sliding window + global first/last blocks
+    (reference :243)."""
+
+    def __init__(
+        self,
+        num_heads: int,
+        block: int = 16,
+        different_layout_per_head: bool = False,
+        num_random_blocks: int = 1,
+        num_sliding_window_blocks: int = 3,
+        num_global_blocks: int = 1,
+        attention: str = "bidirectional",
+    ):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.num_random_blocks = num_random_blocks
+        self.num_sliding_window_blocks = num_sliding_window_blocks
+        self.num_global_blocks = num_global_blocks
+        self.attention = attention
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        nb = layout.shape[1]
+        if nb < self.num_sliding_window_blocks:
+            raise ValueError(f"seq has {nb} blocks < sliding window {self.num_sliding_window_blocks}")
+        rng = _random.Random(0)
+        w = self.num_sliding_window_blocks // 2
+        g = self.num_global_blocks
+        for h in range(self.num_layout_heads):
+            for r in range(nb):
+                layout[h, r, max(0, r - w) : min(nb, r + w + 1)] = 1  # window
+                for _ in range(self.num_random_blocks):  # random
+                    layout[h, r, rng.randrange(nb)] = 1
+            layout[h, :, :g] = 1  # global columns (first blocks)
+            layout[h, :g, :] = 1  # global rows
+            if self.attention == "bidirectional":
+                layout[h, :, nb - g :] = 1
+                layout[h, nb - g :, :] = 1
+        layout = self.check_and_propagate_first_head_layout(layout)
+        if self.attention == "unidirectional":
+            layout = np.tril(layout)
+        return layout
+
+
+class BSLongformerSparsityConfig(SparsityConfig):
+    """Block-sparse Longformer: sliding window + selected global blocks
+    (reference :544)."""
+
+    def __init__(
+        self,
+        num_heads: int,
+        block: int = 16,
+        different_layout_per_head: bool = False,
+        num_sliding_window_blocks: int = 3,
+        global_block_indices: Optional[List[int]] = None,
+        global_block_end_indices: Optional[List[int]] = None,
+        attention: str = "bidirectional",
+    ):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.num_sliding_window_blocks = num_sliding_window_blocks
+        self.global_block_indices = global_block_indices if global_block_indices is not None else [0]
+        self.global_block_end_indices = global_block_end_indices
+        self.attention = attention
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        nb = layout.shape[1]
+        w = self.num_sliding_window_blocks // 2
+        for h in range(self.num_layout_heads):
+            for r in range(nb):
+                layout[h, r, max(0, r - w) : min(nb, r + w + 1)] = 1
+            for gi, g in enumerate(self.global_block_indices):
+                gend = (
+                    self.global_block_end_indices[gi]
+                    if self.global_block_end_indices is not None
+                    else g + 1
+                )
+                g0, g1 = min(g, nb), min(gend, nb)
+                layout[h, :, g0:g1] = 1
+                layout[h, g0:g1, :] = 1
+        layout = self.check_and_propagate_first_head_layout(layout)
+        if self.attention == "unidirectional":
+            layout = np.tril(layout)
+        return layout
+
+
+# ---------------------------------------------------------------------------
+# Kernel: gather-based blockwise sparse attention
+# ---------------------------------------------------------------------------
+
+NEG_INF = -1e30
+
+
+def _layout_gather_indices(layout: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Row-bucketed layout prep — the analog of the reference's C++ LUT
+    helper (``csrc/sparse_attention/utils.cpp``), plain numpy.
+
+    Rows are split into two buckets so a few *fully dense* rows (the
+    horizontal-global rows BigBird/Longformer emit) don't pad every
+    sparse row up to full degree:
+
+    * sparse rows → (idx (H, nb, deg), valid (H, nb, deg)): active
+      kv-block ids padded to the max degree **among sparse rows only**;
+      dense rows have valid=False everywhere (their gather output is 0
+      and gets overwritten by the dense bucket).
+    * dense rows → (dense_rows (H, M), dense_valid (H, M)): the q-block
+      ids of full-degree rows, padded to the max count across heads.
+    """
+    H, nb, _ = layout.shape
+    row_deg = layout.sum(-1)  # (H, nb)
+    dense_mask = row_deg >= nb
+    sparse_deg = int(np.where(dense_mask, 0, row_deg).max())
+    deg = max(1, sparse_deg)
+    idx = np.zeros((H, nb, deg), np.int32)
+    valid = np.zeros((H, nb, deg), bool)
+    for h in range(H):
+        for r in range(nb):
+            if dense_mask[h, r]:
+                continue
+            cols = np.nonzero(layout[h, r])[0]
+            idx[h, r, : len(cols)] = cols
+            valid[h, r, : len(cols)] = True
+    M = int(dense_mask.sum(-1).max())
+    dense_rows = np.zeros((H, max(M, 1)), np.int32)
+    dense_valid = np.zeros((H, max(M, 1)), bool)
+    for h in range(H):
+        rows = np.nonzero(dense_mask[h])[0]
+        dense_rows[h, : len(rows)] = rows
+        dense_valid[h, : len(rows)] = True
+    if M == 0:
+        dense_rows = dense_rows[:, :0]
+        dense_valid = dense_valid[:, :0]
+    return idx, valid, dense_rows, dense_valid
+
+
+def block_sparse_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    layout: np.ndarray,
+    block: int,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    key_padding_mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Attention restricted to the active blocks of ``layout``.
+
+    ``q,k,v``: (B, H, T, hd); ``layout``: (H, T//block, T//block) 0/1
+    numpy (static).  Compute is O(nb · max_row_degree): rows are padded
+    to the layout's max row degree, so layouts with *horizontal* global
+    rows (a few rows attending everywhere) pull the padding up to nb —
+    fine for the handful of global rows the configs emit, but a
+    row-bucketed variant is the follow-up optimization if profiles show
+    it.  ``causal=True`` additionally applies the elementwise causal mask
+    inside diagonal blocks (the layout itself should already be
+    lower-triangular for unidirectional configs)."""
+    B, H, T, hd = q.shape
+    nb = T // block
+    assert layout.shape == (H, nb, nb), f"layout {layout.shape} != {(H, nb, nb)}"
+    if sm_scale is None:
+        sm_scale = 1.0 / (hd ** 0.5)
+    idx_np, valid_np, drows_np, dvalid_np = _layout_gather_indices(layout)
+    deg = idx_np.shape[-1]
+    idx = jnp.asarray(idx_np)  # (H, nb, deg)
+    valid = jnp.asarray(valid_np)
+
+    qb = q.reshape(B, H, nb, block, hd)
+    kb = k.reshape(B, H, nb, block, hd)
+    vb = v.reshape(B, H, nb, block, hd)
+
+    def _masked_softmax(s):
+        # rows with no valid key at all (fully masked) → zeros, not NaNs
+        row_max = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - row_max)
+        denom = jnp.sum(p, axis=-1, keepdims=True)
+        return jnp.where(denom > 0, p / jnp.maximum(denom, 1e-30), 0.0)
+
+    # ---- sparse bucket: gather active kv blocks per (h, q-block) --------
+    gather = jax.vmap(  # over batch
+        jax.vmap(  # over heads
+            lambda blocks, ids: jnp.take(blocks, ids, axis=0), in_axes=(0, 0)
+        ),
+        in_axes=(0, None),
+    )
+    kg = gather(kb, idx)  # (B, H, nb, deg, block, hd)
+    vg = gather(vb, idx)
+
+    s = jnp.einsum("bhnqd,bhnekd->bhnqek", qb.astype(jnp.float32), kg.astype(jnp.float32)) * sm_scale
+    mask = valid[None, :, :, None, :, None]  # (1,H,nb,1,deg,1)
+    if causal:
+        q_pos = jnp.arange(nb)[:, None] * block + jnp.arange(block)[None, :]  # (nb, block)
+        k_pos = idx[..., None] * block + jnp.arange(block)[None, None, None, :]  # (H, nb, deg, block)
+        causal_ok = q_pos[None, :, :, None, None] >= k_pos[:, :, None, :, :]  # (H,nb,block,deg,block)
+        mask = mask & causal_ok[None]
+    if key_padding_mask is not None:
+        kp_blocks = key_padding_mask.reshape(B, nb, block)
+        kpg = jnp.take(kp_blocks, idx, axis=1)  # (B, H, nb, deg, block)
+        mask = mask & kpg[:, :, :, None, :, :]
+    s = jnp.where(mask, s, NEG_INF)
+    s = s.reshape(B, H, nb, block, deg * block)
+    p = _masked_softmax(s).reshape(B, H, nb, block, deg, block)
+    out = jnp.einsum("bhnqek,bhnekd->bhnqd", p, vg.astype(jnp.float32))
+
+    # ---- dense bucket: the few full-degree (horizontal-global) rows -----
+    if drows_np.shape[1] > 0:
+        drows = jnp.asarray(drows_np)  # (H, M)
+        dvalid = jnp.asarray(dvalid_np)
+        M = drows_np.shape[1]
+        qd = jnp.take_along_axis(qb, drows[None, :, :, None, None], axis=2)  # (B,H,M,block,hd)
+        sd = jnp.einsum("bhmqd,bhtd->bhmqt", qd.astype(jnp.float32), k.astype(jnp.float32)) * sm_scale
+        dmask = jnp.ones((1, 1, 1, 1, T), bool)
+        if causal:
+            q_pos_d = drows[:, :, None] * block + jnp.arange(block)[None, None, :]  # (H,M,block)
+            dmask = dmask & (q_pos_d[None, :, :, :, None] >= jnp.arange(T)[None, None, None, None, :])
+        if key_padding_mask is not None:
+            dmask = dmask & key_padding_mask[:, None, None, None, :]
+        sd = jnp.where(dmask, sd, NEG_INF)
+        pd = _masked_softmax(sd)
+        od = jnp.einsum("bhmqt,bhtd->bhmqd", pd, v.astype(jnp.float32))  # (B,H,M,block,hd)
+        # scatter dense-row outputs back over the gather outputs
+        onehot = jax.nn.one_hot(drows, nb, dtype=jnp.float32) * dvalid[..., None]  # (H,M,nb)
+        od_full = jnp.einsum("hmn,bhmqd->bhnqd", onehot, od)
+        is_dense_row = (jnp.sum(onehot, axis=1) > 0)[None, :, :, None, None]  # (1,H,nb,1,1)
+        out = jnp.where(is_dense_row, od_full, out)
+
+    return out.reshape(B, H, T, hd).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Module-level wrappers (reference sparse_self_attention.py /
+# bert_sparse_self_attention.py / sparse_attention_utils.py)
+# ---------------------------------------------------------------------------
+
+
+class SparseSelfAttention:
+    """Reference ``SparseSelfAttention`` (:14): holds a sparsity config,
+    caches per-seq-len layouts, applies block-sparse attention to
+    already-projected q/k/v in (B, H, T, hd) layout."""
+
+    def __init__(self, sparsity_config: Optional[SparsityConfig] = None, key_padding_mask_mode: str = "add", attn_mask_mode: str = "mul"):
+        self.sparsity_config = sparsity_config or FixedSparsityConfig(num_heads=4)
+        self.key_padding_mask_mode = key_padding_mask_mode
+        self.attn_mask_mode = attn_mask_mode
+        self._layouts = {}
+
+    def get_layout(self, seq_len: int) -> np.ndarray:
+        if seq_len not in self._layouts:
+            self._layouts[seq_len] = self.sparsity_config.make_layout(seq_len)
+        return self._layouts[seq_len]
+
+    def __call__(self, query, key, value, key_padding_mask=None, causal: Optional[bool] = None):
+        T = query.shape[2]
+        layout = self.get_layout(T)
+        if causal is None:
+            causal = getattr(self.sparsity_config, "attention", "bidirectional") == "unidirectional"
+        return block_sparse_attention(
+            query, key, value, layout, self.sparsity_config.block,
+            causal=causal, key_padding_mask=key_padding_mask,
+        )
+
+
+class SparseAttentionUtils:
+    """Helpers mirroring the reference's HF-patching utilities
+    (``sparse_attention_utils.py``) at the functional level."""
+
+    @staticmethod
+    def extend_position_embedding(pos_emb: np.ndarray, new_len: int) -> np.ndarray:
+        """Tile an existing position table to a longer sequence
+        (reference extends HF models' embeddings the same way)."""
+        cur = pos_emb.shape[0]
+        reps = -(-new_len // cur)
+        return np.concatenate([pos_emb] * reps, axis=0)[:new_len]
+
+    @staticmethod
+    def pad_to_block_size(block: int, tokens: np.ndarray, pad_token_id: int = 0):
+        """Right-pad (B, T) token ids to a multiple of ``block``; returns
+        (padded_tokens, attention_mask, pad_len)."""
+        B, T = tokens.shape
+        pad = (-T) % block
+        if pad == 0:
+            return tokens, np.ones((B, T), np.int32), 0
+        padded = np.concatenate([tokens, np.full((B, pad), pad_token_id, tokens.dtype)], axis=1)
+        mask = np.concatenate([np.ones((B, T), np.int32), np.zeros((B, pad), np.int32)], axis=1)
+        return padded, mask, pad
+
+    @staticmethod
+    def unpad_sequence_output(pad_len: int, out):
+        return out[:, : out.shape[1] - pad_len] if pad_len else out
+
+
+@register_op("sparse_attn", "xla", "gather-based block-sparse attention + layout configs (Triton blocksparse analog)")
+def _load_sparse_attn():
+    return {
+        "block_sparse_attention": block_sparse_attention,
+        "SparseSelfAttention": SparseSelfAttention,
+        "configs": {
+            "dense": DenseSparsityConfig,
+            "fixed": FixedSparsityConfig,
+            "variable": VariableSparsityConfig,
+            "bigbird": BigBirdSparsityConfig,
+            "bslongformer": BSLongformerSparsityConfig,
+        },
+    }

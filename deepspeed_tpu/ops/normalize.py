@@ -1,0 +1,30 @@
+"""Shared normalization / elementwise helpers.
+
+One implementation of the fp32-accumulated LayerNorm used by every model
+and transformer op (the reference fuses this in
+``csrc/transformer/normalize_kernels.cu``; XLA fuses the jnp form into
+the surrounding matmuls, so a single well-shaped helper is the whole
+kernel)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def layer_norm(x: jnp.ndarray, g: jnp.ndarray, b: jnp.ndarray, eps: float) -> jnp.ndarray:
+    """LayerNorm over the last dim with fp32 statistics, output in the
+    input dtype."""
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * g.astype(jnp.float32) + b.astype(jnp.float32)).astype(x.dtype)
+
+
+def dropout(x: jnp.ndarray, rate: float, rng, deterministic: bool) -> jnp.ndarray:
+    """Inverted dropout; no-op when deterministic / rate 0 / rng None
+    (the reference's dropout_kernels.cu analog — XLA fuses it)."""
+    if deterministic or rate == 0.0 or rng is None:
+        return x
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)

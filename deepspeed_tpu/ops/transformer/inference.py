@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.ops.attention.flash_attention import flash_attention, mha_reference
+from deepspeed_tpu.ops.normalize import layer_norm as _ln
 from deepspeed_tpu.ops.registry import register_op
 
 NEG_INF = -1e30
@@ -57,14 +58,6 @@ class DeepSpeedInferenceConfig:
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.heads
-
-
-def _ln(x, g, b, eps):
-    x32 = x.astype(jnp.float32)
-    mu = jnp.mean(x32, axis=-1, keepdims=True)
-    var = jnp.var(x32, axis=-1, keepdims=True)
-    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
-    return (y * g.astype(jnp.float32) + b.astype(jnp.float32)).astype(x.dtype)
 
 
 def init_kv_cache(n_layer: int, batch: int, heads: int, max_len: int, head_dim: int, dtype=jnp.bfloat16):
@@ -106,10 +99,14 @@ def inference_block(
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One transformer layer with cache update.
 
-    ``x``: (B, T, D) — T>1 ⇒ prefill (pos must be 0 for the flash path),
-    T==1 ⇒ decode.  Returns (y, new_k_cache, new_v_cache).
-    Mirrors the reference's fused attention+MLP inference module
-    (``transformer_inference.py`` DeepSpeedTransformerInference.forward).
+    ``x``: (B, T, D).  Initial prefill = pass a *static* ``pos=0`` (a
+    Python int) to get the flash/causal fast path over the prompt block;
+    any traced or non-zero ``pos`` (single-token decode, chunked
+    continuation, speculative multi-token steps) attends against the
+    whole cache with the position mask.  Returns
+    (y, new_k_cache, new_v_cache).  Mirrors the reference's fused
+    attention+MLP inference module (``transformer_inference.py``
+    DeepSpeedTransformerInference.forward).
     """
     B, T, D = x.shape
     H, hd = cfg.heads, cfg.head_dim
@@ -126,12 +123,15 @@ def inference_block(
     k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, 0, pos, 0))
     v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, 0, pos, 0))
 
-    if T > 1 and cfg.use_flash_attention and T >= 128:
+    is_initial_prefill = isinstance(pos, int) and pos == 0
+    if is_initial_prefill and T > 1 and cfg.use_flash_attention and T >= 128:
         # prefill fast path: pure causal attention over the prompt block
         attn = flash_attention(q, k, v, causal=True)
-    elif T > 1:
+    elif is_initial_prefill and T > 1:
         attn = mha_reference(q, k, v, causal=True)
     else:
+        # decode or mid-stream continuation: attend against the whole
+        # cache (correct for any pos, incl. T>1 chunked appends)
         attn = cache_attention(q, k_cache, v_cache, pos)
     attn = attn.transpose(0, 2, 1, 3).reshape(B, T, D)
     attn = attn @ lp["proj_w"].astype(attn.dtype) + lp["proj_b"].astype(attn.dtype)

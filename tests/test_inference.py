@@ -48,6 +48,24 @@ def test_cached_forward_matches_full_forward():
         )
 
 
+def test_chunked_continuation_uses_cache():
+    """T>1 append at pos>0 (chunked prefill) must attend to the cached
+    prefix, not just the new chunk."""
+    cfg = TINY
+    params = jax.tree.map(jnp.asarray, gpt2.init_params(cfg, seed=4))
+    B, T = 2, 12
+    toks = np.random.default_rng(4).integers(0, cfg.vocab_size, (B, T), dtype=np.int32)
+    ref_logits = gpt2.apply(params, jnp.asarray(toks), cfg, deterministic=True)
+    icfg = _icfg(cfg, T)
+    k, v = init_kv_cache(cfg.n_layer, B, cfg.n_head, T, cfg.head_dim, jnp.float32)
+    _, k, v = forward_with_cache(params, jnp.asarray(toks[:, :4]), k, v, 0, icfg)
+    # append a 4-token chunk at pos=4, then another at pos=8
+    log2, k, v = forward_with_cache(params, jnp.asarray(toks[:, 4:8]), k, v, jnp.int32(4), icfg)
+    log3, k, v = forward_with_cache(params, jnp.asarray(toks[:, 8:12]), k, v, jnp.int32(8), icfg)
+    np.testing.assert_allclose(np.asarray(log2), np.asarray(ref_logits[:, 4:8]), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(log3), np.asarray(ref_logits[:, 8:12]), rtol=2e-4, atol=2e-4)
+
+
 def test_generate_greedy_matches_naive_loop():
     eng = deepspeed_tpu.init_inference(
         model_config=TINY, mp_size=1, dtype=jnp.float32, max_out_tokens=64

@@ -1,0 +1,127 @@
+"""Sparse attention: layout generators + block-sparse kernel numerics vs
+dense attention under the equivalent element mask (reference
+tests/unit/test_sparse_attention.py)."""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.attention.flash_attention import mha_reference
+from deepspeed_tpu.ops.attention.sparse import (
+    BigBirdSparsityConfig,
+    BSLongformerSparsityConfig,
+    DenseSparsityConfig,
+    FixedSparsityConfig,
+    SparseSelfAttention,
+    VariableSparsityConfig,
+    block_sparse_attention,
+)
+
+
+def _qkv(B=2, H=4, T=64, hd=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return [jnp.asarray(rng.standard_normal((B, H, T, hd)).astype(np.float32)) for _ in range(3)]
+
+
+def _dense_with_layout(q, k, v, layout, block, causal):
+    """Ground truth: dense attention with the layout expanded to an
+    elementwise additive mask."""
+    H, nb, _ = layout.shape
+    T = nb * block
+    m = np.kron(layout.astype(np.float32), np.ones((block, block), np.float32))  # (H,T,T)
+    if causal:
+        m = m * np.tril(np.ones((T, T), np.float32))
+    bias = jnp.asarray(np.where(m > 0, 0.0, -1e30)[None])  # (1,H,T,T)
+    return mha_reference(q, k, v, causal=False, bias=bias)
+
+
+LAYOUT_CASES = [
+    ("dense", DenseSparsityConfig(num_heads=4, block=16), False),
+    ("fixed-bi", FixedSparsityConfig(num_heads=4, block=16, num_local_blocks=2, num_global_blocks=1), False),
+    ("fixed-uni", FixedSparsityConfig(num_heads=4, block=16, num_local_blocks=2, attention="unidirectional"), True),
+    ("bigbird", BigBirdSparsityConfig(num_heads=4, block=16, num_random_blocks=1, num_sliding_window_blocks=3, num_global_blocks=1), False),
+    ("longformer", BSLongformerSparsityConfig(num_heads=4, block=16, num_sliding_window_blocks=3, global_block_indices=[0, 2]), False),
+    ("variable", VariableSparsityConfig(num_heads=4, block=16, num_random_blocks=1, local_window_blocks=[1, 2], global_block_indices=[0]), False),
+]
+
+
+@pytest.mark.parametrize("name,cfg,causal", LAYOUT_CASES, ids=[c[0] for c in LAYOUT_CASES])
+def test_block_sparse_matches_masked_dense(name, cfg, causal):
+    q, k, v = _qkv()
+    layout = cfg.make_layout(64)
+    out = block_sparse_attention(q, k, v, layout, cfg.block, causal=causal)
+    ref = _dense_with_layout(q, k, v, layout, cfg.block, causal)
+    # rows that can attend nowhere are 0 in our kernel, NaN-free by design
+    assert not np.isnan(np.asarray(out)).any()
+    mask_rows = layout.sum(-1) > 0
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_layout_shapes_and_head_propagation():
+    cfg = FixedSparsityConfig(num_heads=8, block=16, num_local_blocks=4, different_layout_per_head=False)
+    layout = cfg.make_layout(256)
+    assert layout.shape == (8, 16, 16)
+    assert (layout[0] == layout[5]).all()
+    # diagonal must always be active inside a window
+    assert all(layout[0, i, i] for i in range(16))
+
+
+def test_fixed_unidirectional_is_lower_triangular():
+    cfg = FixedSparsityConfig(num_heads=2, block=16, num_local_blocks=4, attention="unidirectional")
+    layout = cfg.make_layout(128)
+    assert (np.triu(layout[0], k=1) == 0).all()
+
+
+def test_bigbird_window_and_global():
+    cfg = BigBirdSparsityConfig(num_heads=2, block=16, num_random_blocks=0, num_sliding_window_blocks=3, num_global_blocks=1)
+    layout = cfg.make_layout(128)
+    nb = 8
+    for r in range(1, nb - 1):
+        assert layout[0, r, r - 1] and layout[0, r, r] and layout[0, r, r + 1]
+    assert layout[0, :, 0].all() and layout[0, 0, :].all()  # global first block
+    assert layout[0, :, nb - 1].all() and layout[0, nb - 1, :].all()  # bidirectional last block
+
+
+def test_sparse_self_attention_wrapper_and_padding():
+    q, k, v = _qkv(T=64)
+    att = SparseSelfAttention(BSLongformerSparsityConfig(num_heads=4, block=16))
+    out = att(q, k, v)
+    assert out.shape == q.shape
+    # key padding mask zeroes attention to masked keys
+    kp = np.ones((2, 64), bool)
+    kp[:, 48:] = False
+    out_masked = att(q, k, v, key_padding_mask=jnp.asarray(kp))
+    layout = att.get_layout(64)
+    m = np.kron(layout.astype(np.float32), np.ones((16, 16), np.float32))
+    bias = np.where(m[None] > 0, 0.0, -1e30)
+    bias = bias + np.where(kp[:, None, None, :], 0.0, -1e30)
+    ref = mha_reference(q, k, v, causal=False, bias=jnp.asarray(bias))
+    np.testing.assert_allclose(np.asarray(out_masked), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_pad_to_block_size_utils():
+    from deepspeed_tpu.ops.attention.sparse import SparseAttentionUtils
+
+    toks = np.arange(2 * 30, dtype=np.int32).reshape(2, 30)
+    padded, mask, pad = SparseAttentionUtils.pad_to_block_size(16, toks, pad_token_id=0)
+    assert padded.shape == (2, 32) and pad == 2
+    assert mask[:, :30].all() and not mask[:, 30:].any()
+    out = SparseAttentionUtils.unpad_sequence_output(pad, padded)
+    np.testing.assert_array_equal(out, toks)
+    pe = SparseAttentionUtils.extend_position_embedding(np.eye(4, 3, dtype=np.float32), 10)
+    assert pe.shape == (10, 3)
+
+
+def test_sparsity_saves_compute():
+    """The gather degree (compute proxy) must be well under nb for sparse
+    configs at long seq."""
+    from deepspeed_tpu.ops.attention.sparse import _layout_gather_indices
+
+    cfg = BigBirdSparsityConfig(
+        num_heads=1, block=16, num_random_blocks=1, num_sliding_window_blocks=3, num_global_blocks=1
+    )
+    layout = cfg.make_layout(1024)  # 64 blocks
+    idx, valid, drows, dvalid = _layout_gather_indices(layout)
+    # sparse rows pad to window+random+global-col degree, not 64
+    assert idx.shape[-1] <= 8
+    # only the horizontal-global rows land in the dense bucket
+    assert drows.shape[1] <= 2
