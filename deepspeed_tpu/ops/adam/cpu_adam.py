@@ -1,0 +1,128 @@
+"""Host (CPU) Adam — the ZeRO-Offload optimizer.
+
+Reference: ``ops/adam/cpu_adam.py`` (``DeepSpeedCPUAdam`` :13) over the
+AVX kernel in ``csrc/adam/cpu_adam.cpp``; used when
+``zero_optimization.offload_optimizer.device != 'none'`` so fp32 master
+weights + moments live in host RAM (or NVMe via the swapper) and the
+update runs on host cores while device memory holds only bf16 params.
+
+This wrapper operates on **flat numpy fp32 buffers** (one per logical
+parameter); the engine's offload path (runtime/zero/offload.py) owns the
+host<->device movement.  Falls back to a vectorized numpy implementation
+when no compiler is available (same numerics, slower).
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from deepspeed_tpu.ops.registry import register_op
+from deepspeed_tpu.utils.logging import logger
+
+_LIB = None
+_LIB_TRIED = False
+
+
+def _native_lib():
+    global _LIB, _LIB_TRIED
+    if _LIB_TRIED:
+        return _LIB
+    _LIB_TRIED = True
+    try:
+        from deepspeed_tpu.ops.op_builder import load_native
+
+        lib = load_native("ds_cpu_adam", ["adam/cpu_adam.cpp"])
+        lib.ds_cpu_adam_step.argtypes = [
+            ctypes.POINTER(ctypes.c_float),  # params
+            ctypes.POINTER(ctypes.c_float),  # grads
+            ctypes.POINTER(ctypes.c_float),  # exp_avg
+            ctypes.POINTER(ctypes.c_float),  # exp_avg_sq
+            ctypes.c_int64,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_int64, ctypes.c_int,
+        ]
+        lib.ds_cpu_sgd_step.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float,
+        ]
+        _LIB = lib
+    except Exception as e:
+        logger.warning(f"cpu_adam: native kernel unavailable ({e}); using numpy fallback")
+        _LIB = None
+    return _LIB
+
+
+def _fptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+class DeepSpeedCPUAdam:
+    """Flat-buffer host Adam (reference ``DeepSpeedCPUAdam``).
+
+    ``step(params, grads, exp_avg, exp_avg_sq, step_count, lr=None)``
+    updates ``params`` (fp32, C-contiguous numpy) **in place**.
+    """
+
+    def __init__(
+        self,
+        lr: float = 1e-3,
+        betas=(0.9, 0.999),
+        eps: float = 1e-8,
+        weight_decay: float = 0.0,
+        adamw_mode: bool = True,
+        fp32_optimizer_states: bool = True,
+    ):
+        self.lr = lr
+        self.betas = tuple(betas)
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adamw_mode = adamw_mode
+        self._lib = _native_lib()
+
+    @property
+    def uses_native(self) -> bool:
+        return self._lib is not None
+
+    def step(
+        self,
+        params: np.ndarray,
+        grads: np.ndarray,
+        exp_avg: np.ndarray,
+        exp_avg_sq: np.ndarray,
+        step_count: int,
+        lr: Optional[float] = None,
+    ) -> None:
+        assert params.dtype == np.float32 and params.flags["C_CONTIGUOUS"]
+        lr = self.lr if lr is None else float(lr)
+        b1, b2 = self.betas
+        n = params.size
+        if self._lib is not None:
+            grads32 = np.ascontiguousarray(grads, np.float32)
+            self._lib.ds_cpu_adam_step(
+                _fptr(params), _fptr(grads32), _fptr(exp_avg), _fptr(exp_avg_sq),
+                n, lr, b1, b2, self.eps, self.weight_decay, step_count, int(self.adamw_mode),
+            )
+            return
+        # numpy fallback — identical math
+        g = grads.astype(np.float32, copy=False)
+        if not self.adamw_mode and self.weight_decay > 0:
+            g = g + self.weight_decay * params
+        exp_avg *= b1
+        exp_avg += (1 - b1) * g
+        exp_avg_sq *= b2
+        exp_avg_sq += (1 - b2) * np.square(g)
+        bc1 = 1 - b1 ** step_count
+        bc2 = 1 - b2 ** step_count
+        update = (exp_avg / bc1) / (np.sqrt(exp_avg_sq / bc2) + self.eps)
+        if self.adamw_mode and self.weight_decay > 0:
+            update = update + self.weight_decay * params
+        params -= lr * update
+
+
+@register_op("cpu_adam", "native", "OpenMP/auto-vectorized host Adam for ZeRO-Offload (AVX cpu_adam analog)")
+def _load_cpu_adam():
+    opt = DeepSpeedCPUAdam()
+    return {"DeepSpeedCPUAdam": DeepSpeedCPUAdam, "native": opt.uses_native}

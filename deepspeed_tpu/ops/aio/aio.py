@@ -1,0 +1,149 @@
+"""Async host I/O — Python surface over the native engine.
+
+Reference: ``ops/aio/__init__.py`` exposing ``AsyncIOBuilder().load()`` →
+``aio_handle(block_size, queue_depth, single_submit, overlap_events,
+thread_count)`` with sync/async pread/pwrite + ``wait()``
+(``csrc/aio/py_lib/py_ds_aio.cpp:12-41``).  Same handle surface here,
+ctypes-bound to ``csrc/aio/ds_aio.cpp``; a pure-Python thread-pool
+fallback keeps the API alive where g++ is unavailable.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.ops.registry import register_op
+from deepspeed_tpu.utils.logging import logger
+
+
+class AioHandle:
+    """``aio_handle`` analog.  Buffers are numpy arrays (any dtype);
+    reads/writes are raw bytes at an optional file offset."""
+
+    def __init__(
+        self,
+        block_size: int = 1 << 20,
+        queue_depth: int = 8,
+        single_submit: bool = False,
+        overlap_events: bool = True,
+        thread_count: int = 4,
+    ):
+        self.block_size = block_size
+        self.queue_depth = queue_depth
+        self.thread_count = thread_count
+        self._lib = None
+        self._h = None
+        self._futures: List[Future] = []
+        self._pool: Optional[ThreadPoolExecutor] = None
+        try:
+            from deepspeed_tpu.ops.op_builder import load_native
+
+            lib = load_native("ds_aio", ["aio/ds_aio.cpp"], extra_flags=["-pthread"])
+            lib.ds_aio_create.restype = ctypes.c_void_p
+            lib.ds_aio_create.argtypes = [ctypes.c_int64, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int]
+            lib.ds_aio_destroy.argtypes = [ctypes.c_void_p]
+            for fn in (lib.ds_aio_pread, lib.ds_aio_pwrite):
+                fn.restype = ctypes.c_int64
+                fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64]
+            lib.ds_aio_wait.restype = ctypes.c_int64
+            lib.ds_aio_wait.argtypes = [ctypes.c_void_p]
+            self._lib = lib
+            self._h = lib.ds_aio_create(block_size, queue_depth, int(single_submit), int(overlap_events), thread_count)
+        except Exception as e:
+            logger.warning(f"aio: native engine unavailable ({e}); using Python thread-pool fallback")
+            self._pool = ThreadPoolExecutor(max_workers=max(1, thread_count))
+
+    # -- raw byte ops ------------------------------------------------------
+    def _buf_ptr(self, arr: np.ndarray):
+        assert arr.flags["C_CONTIGUOUS"], "aio buffers must be contiguous"
+        return arr.ctypes.data_as(ctypes.c_char_p)
+
+    def async_pread(self, buffer: np.ndarray, path: str, file_offset: int = 0) -> int:
+        nbytes = buffer.nbytes
+        if self._h is not None:
+            r = self._lib.ds_aio_pread(self._h, self._buf_ptr(buffer), nbytes, path.encode(), file_offset)
+            if r < 0:
+                raise IOError(f"aio pread submit failed for {path}")
+            return int(r)
+
+        def do():
+            with open(path, "rb") as f:
+                f.seek(file_offset)
+                data = f.read(nbytes)
+            flat = buffer.reshape(-1).view(np.uint8)
+            flat[: len(data)] = np.frombuffer(data, np.uint8)
+
+        self._futures.append(self._pool.submit(do))
+        return 1
+
+    def async_pwrite(self, buffer: np.ndarray, path: str, file_offset: int = 0) -> int:
+        nbytes = buffer.nbytes
+        if self._h is not None:
+            r = self._lib.ds_aio_pwrite(self._h, self._buf_ptr(buffer), nbytes, path.encode(), file_offset)
+            if r < 0:
+                raise IOError(f"aio pwrite submit failed for {path}")
+            return int(r)
+        data = buffer.tobytes()  # snapshot before returning (async semantics)
+
+        def do():
+            flags = os.O_WRONLY | os.O_CREAT
+            fd = os.open(path, flags, 0o644)
+            try:
+                os.pwrite(fd, data, file_offset)
+            finally:
+                os.close(fd)
+
+        self._futures.append(self._pool.submit(do))
+        return 1
+
+    def wait(self) -> int:
+        if self._h is not None:
+            n = self._lib.ds_aio_wait(self._h)
+            if n < 0:
+                raise IOError("aio: one or more requests failed")
+            return int(n)
+        n = 0
+        for f in self._futures:
+            f.result()
+            n += 1
+        self._futures.clear()
+        return n
+
+    # -- sync conveniences (reference sync_pread/sync_pwrite) -------------
+    def sync_pread(self, buffer: np.ndarray, path: str, file_offset: int = 0) -> int:
+        self.async_pread(buffer, path, file_offset)
+        return self.wait()
+
+    def sync_pwrite(self, buffer: np.ndarray, path: str, file_offset: int = 0) -> int:
+        self.async_pwrite(buffer, path, file_offset)
+        return self.wait()
+
+    @property
+    def uses_native(self) -> bool:
+        return self._h is not None
+
+    def __del__(self):
+        try:
+            if self._h is not None:
+                self._lib.ds_aio_destroy(self._h)
+                self._h = None
+            if self._pool is not None:
+                self._pool.shutdown(wait=False)
+        except Exception:
+            pass
+
+
+def aio_handle(block_size=1 << 20, queue_depth=8, single_submit=False, overlap_events=True, thread_count=4):
+    """Reference factory-name shim (``py_ds_aio.cpp`` binds the class as
+    ``aio_handle``)."""
+    return AioHandle(block_size, queue_depth, single_submit, overlap_events, thread_count)
+
+
+@register_op("async_io", "native", "thread-pool chunked pread/pwrite host I/O engine (DeepNVMe analog)")
+def _load_async_io():
+    h = AioHandle(thread_count=1)
+    return {"aio_handle": aio_handle, "native": h.uses_native}

@@ -53,6 +53,13 @@ def save_checkpoint(
     ckptr.save(os.path.join(path, "state"), engine.state, force=True)
     ckptr.wait_until_finished()
 
+    # ZeRO-Offload/Infinity: fp32 masters + moments live on host, outside
+    # engine.state — persist them beside the sharded state (reference
+    # writes *_optim_states.pt per rank; host state is process-local here)
+    host_opt = getattr(engine, "_host_opt", None)
+    if host_opt is not None:
+        host_opt.save(os.path.join(path, f"host_optimizer_rank{jax.process_index()}.npz"))
+
     meta = {
         "tag": str(tag),
         "global_step": int(engine.state["global_step"]),
@@ -106,7 +113,27 @@ def load_checkpoint(
         return jax.ShapeDtypeStruct(np.shape(x), x.dtype, sharding=sharding)
 
     target = jax.tree.map(abstract, engine.state, engine._state_shardings)
-    restored = ckptr.restore(os.path.join(path, "state"), target)
+    try:
+        restored = ckptr.restore(os.path.join(path, "state"), target)
+    except ValueError:
+        if getattr(engine, "_host_opt", None) is None:
+            raise
+        # offload engine restoring a non-offload checkpoint: the saved
+        # tree has real opt_state arrays while our target has {} — restore
+        # everything except opt_state and keep the host masters path below
+        import orbax.checkpoint as ocp
+
+        partial_target = {k: v for k, v in target.items() if k != "opt_state"}
+        partial = ocp.PyTreeCheckpointer().restore(
+            os.path.join(path, "state"),
+            args=ocp.args.PyTreeRestore(
+                item=jax.tree.map(lambda a: np.zeros(a.shape, a.dtype), partial_target),
+                partial_restore=True,
+            ),
+        )
+        restored = dict(partial)
+        restored["params"] = jax.device_put(restored["params"], engine._state_shardings["params"])
+        restored["opt_state"] = {}
 
     if load_module_only or not load_optimizer_states:
         engine.state["params"] = restored["params"]
@@ -115,6 +142,16 @@ def load_checkpoint(
                 engine.state[key] = restored[key]
     else:
         engine.state = restored
+
+    host_opt = getattr(engine, "_host_opt", None)
+    if host_opt is not None:
+        host_path = os.path.join(path, f"host_optimizer_rank{jax.process_index()}.npz")
+        if os.path.exists(host_path) and load_optimizer_states and not load_module_only:
+            host_opt.load(host_path)
+        else:
+            # no host state saved (e.g. checkpoint from a non-offload run):
+            # rebuild fp32 masters from the restored (compute-dtype) params
+            host_opt.load_masters(jax.tree.map(np.asarray, restored["params"]))
 
     meta_path = os.path.join(path, "meta.json")
     client_state: Dict[str, Any] = {}

@@ -111,16 +111,43 @@ class DeepSpeedEngine:
         self.lr_schedule = self._configure_lr_schedule(lr_scheduler)
         self.client_lr_scheduler = lr_scheduler
 
+        # -- ZeRO-Offload / Infinity (host-resident optimizer) -------------
+        # reference: cpu_offload grads→host + DeepSpeedCPUAdam
+        # (stage2.py:898-1023, engine.py:776-780); NVMe moments via the
+        # pipelined swapper.  Device keeps compute-dtype params only.
+        self._offload_cfg = config.zero_config.offload_optimizer
+        self._offload = bool(self._offload_cfg.enabled)
+        self._host_opt = None
+        if self._offload:
+            if optimizer is not None:
+                raise ValueError(
+                    "offload_optimizer cannot be combined with a client optimizer "
+                    "(the host step owns the update); drop optimizer= or the offload block"
+                )
+            if not getattr(self, "_use_grad_acc", True):
+                raise NotImplementedError("offload_optimizer is not supported with the pipeline engine yet")
+            if jax.process_count() > 1:
+                raise NotImplementedError(
+                    "offload_optimizer currently requires a single host (grads are "
+                    "fetched to local RAM); multi-host offload lands with host-sharded masters"
+                )
+
         # -- state ---------------------------------------------------------
         self._param_specs = self.zero_rules.tree_param_specs(params)
         self._grad_specs = self.zero_rules.tree_grad_specs(params)
-        params = self._shard_params(params)
-        opt_state = jax.eval_shape(self.optimizer.init, params)
-        self._opt_specs = opt_state_specs(opt_state, params, self.zero_rules)
-        opt_state = jax.jit(
-            self.optimizer.init,
-            out_shardings=jax.tree.map(self._sh, self._opt_specs, is_leaf=lambda x: isinstance(x, P)),
-        )(params)
+        if self._offload:
+            self._host_opt = self._configure_host_offload_optimizer(params)
+            params = self._shard_params(params, dtype=self.compute_dtype)
+            opt_state = {}
+            self._opt_specs = {}
+        else:
+            params = self._shard_params(params)
+            opt_state = jax.eval_shape(self.optimizer.init, params)
+            self._opt_specs = opt_state_specs(opt_state, params, self.zero_rules)
+            opt_state = jax.jit(
+                self.optimizer.init,
+                out_shardings=jax.tree.map(self._sh, self._opt_specs, is_leaf=lambda x: isinstance(x, P)),
+            )(params)
 
         if rng is None:
             rng = jax.random.PRNGKey(config.seed)
@@ -221,9 +248,35 @@ class DeepSpeedEngine:
         base_lr = getattr(self.optimizer, "lr", 1e-3)
         return lambda step: jnp.asarray(base_lr, jnp.float32)
 
-    def _shard_params(self, params: Any) -> Any:
+    def _shard_params(self, params: Any, dtype=jnp.float32) -> Any:
         shardings = jax.tree.map(self._sh, self._param_specs, is_leaf=lambda x: isinstance(x, P))
-        return jax.device_put(jax.tree.map(lambda p: jnp.asarray(p, jnp.float32), params), shardings)
+        return jax.device_put(jax.tree.map(lambda p: jnp.asarray(p, dtype), params), shardings)
+
+    def _configure_host_offload_optimizer(self, params):
+        """Build the host optimizer (reference _configure_basic_optimizer's
+        DeepSpeedCPUAdam branch, engine.py:776-780)."""
+        from deepspeed_tpu.runtime.zero.offload import HostOffloadOptimizer
+
+        name = self.config.optimizer.name or C.ADAM_OPTIMIZER
+        if name not in (C.ADAM_OPTIMIZER, C.ADAMW_OPTIMIZER):
+            raise ValueError(f"offload_optimizer supports Adam/AdamW, got '{name}'")
+        p = dict(self.config.optimizer.params)
+        nvme_dir = None
+        if self._offload_cfg.device == "nvme":
+            if not self._offload_cfg.nvme_path:
+                raise ValueError("offload_optimizer.device=nvme requires nvme_path")
+            nvme_dir = os.path.join(self._offload_cfg.nvme_path, "zero_infinity_swap")
+        return HostOffloadOptimizer(
+            jax.tree.map(np.asarray, params),
+            lr=p.get("lr", 1e-3),
+            betas=tuple(p.get("betas", (0.9, 0.999))),
+            eps=p.get("eps", 1e-8),
+            weight_decay=p.get("weight_decay", 0.0),
+            adamw_mode=(name == C.ADAMW_OPTIMIZER) or bool(p.get("adam_w_mode", True)),
+            nvme_swap_dir=nvme_dir,
+            aio_config=self.config.aio,
+            pipeline=self._offload_cfg.pipeline_read or self._offload_cfg.pipeline_write,
+        )
 
     # ------------------------------------------------------------------
     # properties (reference engine exposes config as methods, :227-506)
@@ -337,6 +390,58 @@ class DeepSpeedEngine:
         return self._compiled[name]
 
     # ------------------------------------------------------------------
+    # ZeRO-Offload step executor (host path)
+    # ------------------------------------------------------------------
+    def _host_apply_step(self) -> Dict[str, Any]:
+        """Optimizer step on host: averaged grads device→host, native CPU
+        Adam over fp32 masters (NVMe-pipelined moments when configured),
+        bf16 masters host→device.  Replaces the jitted ``_apply_step_impl``
+        when ``offload_optimizer`` is enabled."""
+        from deepspeed_tpu.runtime.zero.offload import host_unscale_clip_and_check
+
+        gas = self.gradient_accumulation_steps
+
+        if "fetch_grads" not in self._compiled:
+
+            def fetch(state):
+                grads = jax.tree.map(lambda g: g / gas, state["grad_acc"])
+                state = dict(state)
+                state["grad_acc"] = jax.tree.map(jnp.zeros_like, state["grad_acc"])
+                return state, grads
+
+            self._compiled["fetch_grads"] = jax.jit(fetch, donate_argnums=(0,))
+        self.state, grads = self._compiled["fetch_grads"](self.state)
+        # copy=True: device_get may hand back read-only buffers and the
+        # host path unscales/clips in place
+        g_np = jax.tree.map(lambda g: np.array(jax.device_get(g), np.float32, copy=True), grads)
+
+        scale = float(self.state["loss_scale"].scale)
+        leaves = jax.tree.leaves(g_np)
+        _, grad_norm, overflow = host_unscale_clip_and_check(
+            leaves, scale, self.config.gradient_clipping
+        )
+        lr = float(self.lr_schedule(self.state["global_step"]))
+        if not (overflow and self.loss_scaler.dynamic):
+            step_count = int(self.state["global_step"]) + 1
+            masters = self._host_opt.step(
+                jax.tree.unflatten(jax.tree.structure(g_np), leaves), lr, step_count
+            )
+            dtype = self.compute_dtype
+            self.state["params"] = jax.device_put(
+                jax.tree.map(lambda m: m.astype(dtype), masters),
+                self._state_shardings["params"],
+            )
+            self.state["global_step"] = self.state["global_step"] + 1
+        self.state["loss_scale"] = self.loss_scaler.update(
+            self.state["loss_scale"], jnp.asarray(overflow)
+        )
+        return {
+            "lr": jnp.asarray(lr),
+            "grad_norm": jnp.asarray(grad_norm, jnp.float32),
+            "overflow": jnp.asarray(overflow),
+        }
+
+    # ------------------------------------------------------------------
     # public training API
     # ------------------------------------------------------------------
     def _prepare_batch(self, batch: Any) -> Any:
@@ -384,8 +489,11 @@ class DeepSpeedEngine:
         if self.wall_clock_breakdown:
             self.timers(STEP_TIMER).start()
         if self.is_gradient_accumulation_boundary():
-            fn = self._get_compiled("apply_step", self._apply_step_impl)
-            self.state, info = fn(self.state)
+            if self._offload:
+                info = self._host_apply_step()
+            else:
+                fn = self._get_compiled("apply_step", self._apply_step_impl)
+                self.state, info = fn(self.state)
             if self.loss_scaler.dynamic and bool(info["overflow"]):
                 self.skipped_steps += 1
                 log_dist(f"step skipped on overflow; loss scale -> {self.loss_scale}")
@@ -407,14 +515,20 @@ class DeepSpeedEngine:
         batch = jax.tree.map(lambda x: np.asarray(x) if not isinstance(x, jax.Array) else x, batch)
 
         if "train_batch" not in self._compiled:
+            # with offload, the compiled program ends after the micro-batch
+            # scan — the optimizer step runs on host (ZeRO-Offload splits
+            # exactly here)
+            apply_in_graph = not self._offload
 
             def full_step(state, stacked):
                 def body(st, mb):
                     return self._micro_step_impl(st, mb)
 
                 state, losses = jax.lax.scan(body, state, stacked)
-                state, info = self._apply_step_impl(state)
-                return state, jnp.mean(losses), info
+                if apply_in_graph:
+                    state, info = self._apply_step_impl(state)
+                    return state, jnp.mean(losses), info
+                return state, jnp.mean(losses)
 
             self._compiled["train_batch"] = jax.jit(full_step, donate_argnums=(0,))
 
@@ -429,7 +543,11 @@ class DeepSpeedEngine:
             ),
             stacked,
         )
-        self.state, loss, info = self._compiled["train_batch"](self.state, stacked)
+        if self._offload:
+            self.state, loss = self._compiled["train_batch"](self.state, stacked)
+            info = self._host_apply_step()
+        else:
+            self.state, loss, info = self._compiled["train_batch"](self.state, stacked)
         # host sync on the overflow flag only when dynamic scaling is live
         if self.loss_scaler.dynamic and bool(info["overflow"]):
             self.skipped_steps += 1

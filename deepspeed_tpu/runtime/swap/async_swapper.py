@@ -1,0 +1,92 @@
+"""Async tensor swapping (ZeRO-Infinity building block).
+
+Reference: ``runtime/swap_tensor/async_swapper.py`` (``AsyncTensorSwapper``
+:16) — move tensors between accelerator/host memory and NVMe files using
+the aio engine, overlapping I/O with compute.
+
+Here tensors are host numpy arrays (the engine's host-offload path owns
+device<->host movement); each logical tensor maps to one file in the
+swap folder and swaps ride the native aio handle.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+from deepspeed_tpu.ops.aio.aio import AioHandle
+from deepspeed_tpu.utils.logging import logger
+
+
+class AsyncTensorSwapper:
+    def __init__(self, swap_dir: str, aio_handle: Optional[AioHandle] = None, aio_config=None):
+        os.makedirs(swap_dir, exist_ok=True)
+        self.swap_dir = swap_dir
+        if aio_handle is None:
+            kw = {}
+            if aio_config is not None:
+                kw = dict(
+                    block_size=aio_config.block_size,
+                    queue_depth=aio_config.queue_depth,
+                    single_submit=aio_config.single_submit,
+                    overlap_events=aio_config.overlap_events,
+                    thread_count=max(1, aio_config.thread_count),
+                )
+            aio_handle = AioHandle(**kw)
+        self.aio = aio_handle
+        # key -> (path, shape, dtype) for swapped-out tensors
+        self._index: Dict[str, tuple] = {}
+        self._pending = 0
+        # buffers owned by in-flight async writes — the native engine
+        # reads them from worker threads, so they must stay alive until
+        # the next synchronize() (dropping the ref frees the memory mid-
+        # write and corrupts the file)
+        self._inflight_bufs: list = []
+
+    def _path(self, key: str) -> str:
+        safe = key.replace("/", "__")
+        return os.path.join(self.swap_dir, f"{safe}.swp")
+
+    def swap_out(self, key: str, array: np.ndarray, async_op: bool = True) -> None:
+        """Write ``array`` to the swap file for ``key``.  With
+        ``async_op`` the caller must not mutate ``array`` until
+        ``synchronize()`` (aio reads the buffer in worker threads)."""
+        arr = np.ascontiguousarray(array)
+        path = self._path(key)
+        self._index[key] = (path, arr.shape, arr.dtype)
+        self._inflight_bufs.append(arr)
+        self.aio.async_pwrite(arr, path)
+        self._pending += 1
+        if not async_op:
+            self.synchronize()
+
+    def swap_in(self, key: str, out: Optional[np.ndarray] = None, async_op: bool = True) -> np.ndarray:
+        """Read ``key`` into ``out`` (allocated if None).  With
+        ``async_op`` the data is valid only after ``synchronize()``."""
+        if key not in self._index:
+            raise KeyError(f"tensor '{key}' was never swapped out")
+        path, shape, dtype = self._index[key]
+        if out is None:
+            out = np.empty(shape, dtype)
+        assert out.nbytes == int(np.prod(shape)) * np.dtype(dtype).itemsize
+        self.aio.async_pread(out, path)
+        self._pending += 1
+        if not async_op:
+            self.synchronize()
+        return out
+
+    def synchronize(self) -> int:
+        n = self.aio.wait()
+        self._pending = 0
+        self._inflight_bufs.clear()
+        return n
+
+    def release(self, key: str) -> None:
+        info = self._index.pop(key, None)
+        if info and os.path.exists(info[0]):
+            os.unlink(info[0])
+
+    @property
+    def swapped_keys(self):
+        return list(self._index)
