@@ -305,7 +305,9 @@ class ActivationCheckpointingConfig:
 @dataclass
 class FlopsProfilerConfig:
     enabled: bool = False
-    profile_step: int = 1
+    # default 2, not the reference's 1: under JAX, step 1 includes the XLA
+    # compile, which would make the timed window meaningless
+    profile_step: int = 2
     module_depth: int = -1
     top_modules: int = 1
     detailed: bool = True
@@ -318,7 +320,7 @@ class FlopsProfilerConfig:
         d = dict(d)
         out = cls(
             enabled=bool(_pop(d, "enabled", False)),
-            profile_step=int(_pop(d, "profile_step", 1)),
+            profile_step=int(_pop(d, "profile_step", 2)),
             module_depth=int(_pop(d, "module_depth", -1)),
             top_modules=int(_pop(d, "top_modules", 1)),
             detailed=bool(_pop(d, "detailed", True)),

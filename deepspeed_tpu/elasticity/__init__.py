@@ -1,0 +1,13 @@
+from deepspeed_tpu.elasticity.config import (
+    ElasticityConfig,
+    ElasticityConfigError,
+    ElasticityError,
+    ElasticityIncompatibleWorldSize,
+)
+from deepspeed_tpu.elasticity.elasticity import (
+    compute_elastic_config,
+    elasticity_enabled,
+    get_candidate_batch_sizes,
+    get_best_candidates,
+    get_valid_gpus,
+)

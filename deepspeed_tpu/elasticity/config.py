@@ -1,0 +1,42 @@
+"""Elasticity config (reference ``elasticity/config.py``:
+``ElasticityConfig`` :30 + error types)."""
+from __future__ import annotations
+
+from typing import Dict, List
+
+
+class ElasticityError(Exception):
+    """Base elasticity error (reference elasticity/config.py)."""
+
+
+class ElasticityConfigError(ElasticityError):
+    pass
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    pass
+
+
+class ElasticityConfig:
+    """Keys (reference docstring): enabled, max_train_batch_size,
+    micro_batch_sizes, min_gpus, max_gpus, min_time, version,
+    prefer_larger_batch, ignore_non_elastic_batch_info."""
+
+    def __init__(self, param_dict: Dict):
+        self.enabled = bool(param_dict.get("enabled", False))
+        if "max_train_batch_size" not in param_dict:
+            raise ElasticityConfigError("elasticity requires 'max_train_batch_size'")
+        self.max_acceptable_batch_size = int(param_dict["max_train_batch_size"])
+        if "micro_batch_sizes" not in param_dict:
+            raise ElasticityConfigError("elasticity requires 'micro_batch_sizes'")
+        self.micro_batches: List[int] = [int(m) for m in param_dict["micro_batch_sizes"]]
+        if not self.micro_batches or any(m <= 0 for m in self.micro_batches):
+            raise ElasticityConfigError(f"micro_batch_sizes must be positive, got {self.micro_batches}")
+        self.min_gpus = int(param_dict.get("min_gpus", 1))
+        self.max_gpus = int(param_dict.get("max_gpus", 10000))
+        if self.min_gpus < 1 or self.max_gpus < self.min_gpus:
+            raise ElasticityConfigError(f"invalid gpu range [{self.min_gpus}, {self.max_gpus}]")
+        self.min_time = int(param_dict.get("min_time", 0))
+        self.version = float(param_dict.get("version", 0.1))
+        self.prefer_larger_batch_size = bool(param_dict.get("prefer_larger_batch", True))
+        self.ignore_non_elastic_batch_info = bool(param_dict.get("ignore_non_elastic_batch_info", False))

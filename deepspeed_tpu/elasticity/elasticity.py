@@ -1,0 +1,146 @@
+"""Elastic training schedule math.
+
+Reference: ``elasticity/elasticity.py`` — ahead-of-time batch-size
+compatibility (SURVEY §5.3): given ``max_train_batch_size``, a menu of
+``micro_batch_sizes`` and an accelerator-count range, pick the global
+batch size valid for the *most* world sizes, so a preempted job can
+resume at a different scale with identical training math
+(``compute_elastic_config`` :226, candidate math :63-174).
+
+The algorithm (re-derived from the documented behavior, not a port):
+
+1. candidate global batch sizes = micro_batch × c for "highly composite"
+   multipliers c (many divisors → many valid world sizes), capped at
+   ``max_train_batch_size``;
+2. a world size g is valid for batch b iff b == mb × gas × g for some
+   menu micro-batch mb and integer gas ≥ 1, i.e. b % (mb·g) == 0;
+3. score candidates by |valid world sizes| (ties → larger batch when
+   ``prefer_larger_batch``);
+4. at runtime, given the actual world size, pick the largest menu
+   micro-batch compatible with the chosen batch.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from deepspeed_tpu.elasticity.config import (
+    ElasticityConfig,
+    ElasticityConfigError,
+    ElasticityError,
+    ElasticityIncompatibleWorldSize,
+)
+
+LATEST_ELASTICITY_VERSION = 0.1
+MINIMUM_DEEPSPEED_VERSION = "0.3.8"
+
+# divisor-rich multipliers (1..large): highly-composite-style ladder
+_HCN = [1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 36, 48, 60, 64, 96, 120, 128,
+        144, 180, 192, 240, 256, 360, 384, 480, 512, 720, 768, 960, 1024,
+        1260, 1440, 1680, 2048, 2520, 4096, 5040, 7560, 10080]
+
+
+def get_candidate_batch_sizes(micro_batches: List[int], max_acceptable_batch_size: int) -> List[int]:
+    candidates = set()
+    for mb in micro_batches:
+        for c in _HCN:
+            b = mb * c
+            if b > max_acceptable_batch_size:
+                break
+            candidates.add(b)
+    return sorted(candidates)
+
+
+def get_valid_gpus(batch_size: int, micro_batches: List[int], min_valid_gpus: int, max_valid_gpus: int) -> List[int]:
+    valid = []
+    for g in range(min_valid_gpus, max_valid_gpus + 1):
+        if any(batch_size % (mb * g) == 0 for mb in micro_batches):
+            valid.append(g)
+    return valid
+
+
+def get_best_candidates(
+    candidate_batch_sizes: List[int],
+    micro_batches: List[int],
+    min_gpus: int,
+    max_gpus: int,
+    prefer_larger: bool = True,
+) -> Tuple[int, List[int]]:
+    best_batch, best_gpus = -1, []
+    for b in candidate_batch_sizes:
+        gpus = get_valid_gpus(b, micro_batches, min_gpus, max_gpus)
+        better = len(gpus) > len(best_gpus) or (
+            len(gpus) == len(best_gpus) and ((b > best_batch) == prefer_larger) and b != best_batch
+        )
+        if better:
+            best_batch, best_gpus = b, gpus
+    return best_batch, best_gpus
+
+
+def _compatible_micro_batch(final_batch_size: int, micro_batches: List[int], world_size: int) -> Tuple[int, int]:
+    """Largest menu micro-batch (and its gas) compatible with the chosen
+    global batch at this world size."""
+    for mb in sorted(micro_batches, reverse=True):
+        if final_batch_size % (mb * world_size) == 0:
+            return mb, final_batch_size // (mb * world_size)
+    raise ElasticityIncompatibleWorldSize(
+        f"world size {world_size} is not valid for batch {final_batch_size} with micro-batch menu {micro_batches}"
+    )
+
+
+def _version_tuple(v: str) -> Tuple[int, ...]:
+    out = []
+    for part in v.split(".")[:3]:
+        digits = "".join(ch for ch in part if ch.isdigit())
+        out.append(int(digits) if digits else 0)
+    return tuple(out)
+
+
+def elasticity_enabled(ds_config: Dict) -> bool:
+    return bool(ds_config.get("elasticity", {}).get("enabled", False))
+
+
+def compute_elastic_config(ds_config: Dict, target_deepspeed_version: str, world_size: int = 0):
+    """Reference ``compute_elastic_config`` (:226).
+
+    Returns ``(final_batch_size, valid_gpus)`` — plus
+    ``micro_batch_size`` when ``world_size`` > 0 (then also validates the
+    world size).
+    """
+    if "elasticity" not in ds_config:
+        raise ElasticityError("no 'elasticity' block in the config")
+    cfg = ElasticityConfig(ds_config["elasticity"])
+    if not cfg.enabled:
+        raise ElasticityError("elasticity.enabled is false")
+    if cfg.version > LATEST_ELASTICITY_VERSION:
+        raise ElasticityConfigError(
+            f"elasticity version {cfg.version} is newer than supported {LATEST_ELASTICITY_VERSION}"
+        )
+    if _version_tuple(target_deepspeed_version) < _version_tuple(MINIMUM_DEEPSPEED_VERSION):
+        raise ElasticityError(
+            f"elasticity requires version >= {MINIMUM_DEEPSPEED_VERSION}, got {target_deepspeed_version}"
+        )
+    if not cfg.ignore_non_elastic_batch_info:
+        for key in ("train_batch_size", "train_micro_batch_size_per_gpu", "gradient_accumulation_steps"):
+            if key in ds_config:
+                raise ElasticityConfigError(
+                    f"elasticity owns the batch schedule; remove '{key}' or set "
+                    "elasticity.ignore_non_elastic_batch_info"
+                )
+
+    candidates = get_candidate_batch_sizes(cfg.micro_batches, cfg.max_acceptable_batch_size)
+    final_batch_size, valid_gpus = get_best_candidates(
+        candidates, cfg.micro_batches, cfg.min_gpus, cfg.max_gpus, cfg.prefer_larger_batch_size
+    )
+    if final_batch_size <= 0:
+        raise ElasticityError(
+            f"no valid batch size for micro-batches {cfg.micro_batches} under max "
+            f"{cfg.max_acceptable_batch_size}"
+        )
+    if world_size > 0:
+        if world_size not in valid_gpus:
+            raise ElasticityIncompatibleWorldSize(
+                f"world size {world_size} not in valid set {valid_gpus} for batch {final_batch_size}"
+            )
+        mb, _gas = _compatible_micro_batch(final_batch_size, cfg.micro_batches, world_size)
+        return final_batch_size, valid_gpus, mb
+    return final_batch_size, valid_gpus

@@ -1,0 +1,6 @@
+from deepspeed_tpu.launcher.runner import (
+    encode_world_info,
+    fetch_hostfile,
+    main,
+    parse_resource_filter,
+)

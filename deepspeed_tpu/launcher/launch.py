@@ -1,0 +1,120 @@
+"""Per-node launcher.
+
+Reference: ``deepspeed/launcher/launch.py`` (``main`` :67) — decode the
+world info, set ``MASTER_*``/rank env vars, spawn one process per local
+accelerator, kill the pack if any child dies (:129-167).
+
+TPU difference: JAX runs **one process per host** that owns all local
+chips (SURVEY §3.1 TPU note), so the per-rank fan-out collapses to a
+single child per node — but the contract stays: env-var bootstrap
+(MASTER_ADDR/PORT, RANK, WORLD_SIZE consumed by
+``comm/distributed.init_distributed``), signal propagation, non-zero
+exit on child failure.  ``--procs_per_node`` > 1 is supported for
+CPU-cluster/debug runs (each child gets a distinct RANK and a
+``JAX_LOCAL_DEVICE`` hint).
+"""
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import signal
+import subprocess
+import sys
+from typing import List
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(description="deepspeed_tpu per-node launcher")
+    parser.add_argument("--node_rank", type=int, default=0)
+    parser.add_argument("--master_addr", default="127.0.0.1", type=str)
+    parser.add_argument("--master_port", default=29500, type=int)
+    parser.add_argument("--world_info", default="e30=", type=str, help="base64 json {host: [slots]}")
+    parser.add_argument("--procs_per_node", type=int, default=1)
+    parser.add_argument("training_script", type=str)
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def decode_world_info(encoded: str) -> dict:
+    return json.loads(base64.urlsafe_b64decode(encoded).decode())
+
+
+def main(args=None):
+    args = parse_args(args)
+    world_info = decode_world_info(args.world_info)
+    hosts = list(world_info.keys())
+    if hosts:
+        # ranks come from the world info itself (supports heterogeneous
+        # slot counts: rank = slots of earlier hosts + local_rank)
+        slots = [len(v) for v in world_info.values()]
+        world_size = sum(slots)
+        procs_per_node = slots[args.node_rank]
+        rank_offset = sum(slots[: args.node_rank])
+    else:
+        procs_per_node = max(1, args.procs_per_node)
+        world_size = procs_per_node
+        rank_offset = args.node_rank * procs_per_node
+
+    children: List[subprocess.Popen] = []
+
+    def kill_all(signum=None, frame=None):
+        for p in children:
+            if p.poll() is None:
+                p.terminate()
+        for p in children:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        if signum is not None:
+            sys.exit(128 + signum)
+
+    signal.signal(signal.SIGINT, kill_all)
+    signal.signal(signal.SIGTERM, kill_all)
+
+    for local_rank in range(procs_per_node):
+        rank = rank_offset + local_rank
+        env = os.environ.copy()
+        env.update(
+            MASTER_ADDR=args.master_addr,
+            MASTER_PORT=str(args.master_port),
+            RANK=str(rank),
+            LOCAL_RANK=str(local_rank),
+            WORLD_SIZE=str(world_size),
+        )
+        cmd = [sys.executable, "-u", args.training_script, *args.training_script_args]
+        logger.info(f"launch: rank {rank}/{world_size} -> {' '.join(cmd)}")
+        children.append(subprocess.Popen(cmd, env=env))
+
+    # reference behavior: first non-zero exit kills every sibling and
+    # propagates the code (launch.py:129-167)
+    exit_code = 0
+    alive = set(range(len(children)))
+    while alive and exit_code == 0:
+        for i in list(alive):
+            code = children[i].poll()
+            if code is not None:
+                alive.discard(i)
+                if code != 0:
+                    logger.error(f"launch: rank process {i} exited with {code}; terminating job")
+                    exit_code = code
+        if alive and exit_code == 0:
+            # poll() above already reaps; a waitpid(-1) here would steal
+            # exit statuses from Popen and break code propagation
+            import time
+
+            time.sleep(0.2)
+    if exit_code != 0:
+        kill_all()
+    else:
+        for p in children:
+            p.wait()
+    sys.exit(exit_code)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,200 @@
+"""Job launcher front-end (the ``deepspeed`` CLI).
+
+Reference: ``deepspeed/launcher/runner.py`` — ``main`` (:259): parse the
+hostfile (:120), apply ``--include/--exclude`` filters (:151), base64 the
+world info (:253), then either exec the local per-node launcher or fan
+out through a multi-node runner.  Behavior preserved; the per-node story
+changes to one-JAX-process-per-host (SURVEY §3.1 TPU note).
+"""
+from __future__ import annotations
+
+import argparse
+import base64
+import collections
+import json
+import os
+import re
+import subprocess
+import sys
+from typing import Dict, List
+
+from deepspeed_tpu.utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="deepspeed_tpu launcher", formatter_class=argparse.ArgumentDefaultsHelpFormatter
+    )
+    parser.add_argument("-H", "--hostfile", type=str, default=DLTS_HOSTFILE,
+                        help="hostfile of 'hostname slots=N' lines")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help='e.g. "host1,host2" or "host1:0,2@host2:1"')
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help="inverse of --include")
+    parser.add_argument("--num_nodes", type=int, default=-1)
+    parser.add_argument("--num_gpus", "--num_hosts_procs", dest="num_gpus", type=int, default=-1,
+                        help="processes per node (reference flag name kept)")
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--master_addr", type=str, default="")
+    parser.add_argument("--launcher", type=str, default="pdsh", help="pdsh|ssh|openmpi|mvapich")
+    parser.add_argument("--launcher_args", type=str, default="")
+    parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def fetch_hostfile(hostfile_path: str) -> Dict[str, int]:
+    """Parse 'hostname slots=N' lines (reference :120); returns an
+    ordered {host: slot_count}."""
+    if not os.path.isfile(hostfile_path):
+        return {}
+    resource_pool = collections.OrderedDict()
+    with open(hostfile_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            m = re.match(r"^(\S+)\s+slots=(\d+)\s*$", line)
+            if m is None:
+                raise ValueError(f"hostfile line malformed: '{line}' (want 'host slots=N')")
+            host, slots = m.group(1), int(m.group(2))
+            if host in resource_pool:
+                raise ValueError(f"hostfile contains duplicate host '{host}'")
+            resource_pool[host] = slots
+    return resource_pool
+
+
+def _parse_filter(spec: str) -> Dict[str, List[int]]:
+    """'h1:0,2@h2' → {'h1': [0, 2], 'h2': []} (reference inclusion/
+    exclusion grammar, runner.py:151)."""
+    out: Dict[str, List[int]] = collections.OrderedDict()
+    if not spec:
+        return out
+    for part in spec.split("@"):
+        if ":" in part:
+            host, slots = part.split(":", 1)
+            out[host] = [int(s) for s in slots.split(",") if s != ""]
+        else:
+            out[part] = []
+    return out
+
+
+def parse_resource_filter(
+    resource_pool: Dict[str, int], include_str: str = "", exclude_str: str = ""
+) -> Dict[str, List[int]]:
+    """Apply --include/--exclude to the pool (reference :151-240).
+    Returns {host: [slot ids]}."""
+    if include_str and exclude_str:
+        raise ValueError("--include and --exclude are mutually exclusive")
+    full = collections.OrderedDict((h, list(range(n))) for h, n in resource_pool.items())
+    if not include_str and not exclude_str:
+        return full
+    if include_str:
+        spec = _parse_filter(include_str)
+        out = collections.OrderedDict()
+        for host, slots in spec.items():
+            if host not in full:
+                raise ValueError(f"--include host '{host}' not in hostfile")
+            bad = [s for s in slots if s not in full[host]]
+            if bad:
+                raise ValueError(f"--include slots {bad} invalid for host '{host}'")
+            out[host] = slots or full[host]
+        return out
+    spec = _parse_filter(exclude_str)
+    out = collections.OrderedDict()
+    for host, slots in full.items():
+        if host in spec:
+            drop = spec[host] or slots
+            bad = [s for s in spec[host] if s not in slots]
+            if bad:
+                raise ValueError(f"--exclude slots {bad} invalid for host '{host}'")
+            keep = [s for s in slots if s not in drop]
+            if keep:
+                out[host] = keep
+        else:
+            out[host] = slots
+    return out
+
+
+def encode_world_info(active_resources: Dict[str, List[int]]) -> str:
+    return base64.urlsafe_b64encode(json.dumps(active_resources).encode()).decode()
+
+
+def main(args=None):
+    args = parse_args(args)
+    resource_pool = fetch_hostfile(args.hostfile)
+
+    if not resource_pool:
+        # single-node path (reference :314-324): localhost, all local chips
+        procs = args.num_gpus if args.num_gpus > 0 else 1
+        active = {"localhost": list(range(procs))}
+        cmd = [
+            sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
+            "--node_rank=0",
+            f"--master_addr={args.master_addr or '127.0.0.1'}",
+            f"--master_port={args.master_port}",
+            f"--world_info={encode_world_info(active)}",
+            f"--procs_per_node={procs}",
+            args.user_script, *args.user_args,
+        ]
+        logger.info(f"runner: single-node cmd: {' '.join(cmd)}")
+        result = subprocess.Popen(cmd)
+        result.wait()
+        sys.exit(result.returncode)
+
+    active = parse_resource_filter(resource_pool, args.include, args.exclude)
+    if args.num_nodes > 0:
+        active = collections.OrderedDict(list(active.items())[: args.num_nodes])
+    world_info = encode_world_info(active)
+    args.master_addr = args.master_addr or next(iter(active))
+
+    from deepspeed_tpu.launcher.multinode_runner import (
+        MVAPICHRunner, OpenMPIRunner, PDSHRunner, SSHRunner,
+    )
+
+    runners = {"pdsh": PDSHRunner, "ssh": SSHRunner, "openmpi": OpenMPIRunner, "mvapich": MVAPICHRunner}
+    if args.launcher not in runners:
+        raise ValueError(f"unknown launcher {args.launcher} (choose from {sorted(runners)})")
+    runner = runners[args.launcher](args, world_info)
+    if not runner.backend_exists():
+        raise RuntimeError(f"launcher backend '{runner.name}' not found on PATH")
+    env = os.environ.copy()
+    cmd = runner.get_cmd(env, active)
+    if isinstance(cmd[0], list):  # ssh runner: one command per host
+        import time
+
+        procs = [subprocess.Popen(c, env=env) for c in cmd]
+        code = 0
+        alive = set(range(len(procs)))
+        # cross-node pack-kill (mirrors launch.py's per-node contract):
+        # first non-zero exit terminates the remaining hosts
+        while alive and code == 0:
+            for i in list(alive):
+                rc = procs[i].poll()
+                if rc is not None:
+                    alive.discard(i)
+                    if rc != 0:
+                        logger.error(f"runner: node {i} exited with {rc}; terminating remaining hosts")
+                        code = rc
+            if alive and code == 0:
+                time.sleep(0.5)
+        for i in alive:
+            procs[i].terminate()
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        sys.exit(code)
+    logger.info(f"runner: {' '.join(map(str, cmd))}")
+    result = subprocess.Popen(cmd, env=env)
+    result.wait()
+    sys.exit(result.returncode)
+
+
+if __name__ == "__main__":
+    main()

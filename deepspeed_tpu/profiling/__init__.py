@@ -1,0 +1,6 @@
+from deepspeed_tpu.profiling.flops_profiler import (
+    FlopsProfiler,
+    analyze_fn,
+    get_model_profile,
+    see_memory_usage,
+)

@@ -180,6 +180,11 @@ class DeepSpeedEngine:
             "loss_scale": jax.tree.map(lambda _: self._sh(P()), self.state["loss_scale"]),
             "rng": self._sh(P()),
         }
+        # Place every state leaf with its NamedSharding now: leaves created
+        # by plain jnp ops otherwise enter the first compiled call with a
+        # default GSPMDSharding, which differs from the NamedSharding the
+        # step's outputs carry — forcing a silent full recompile at step 2.
+        self.state = jax.device_put(self.state, self._state_shardings)
 
         # -- activation checkpointing (reference _configure_checkpointing,
         # engine.py:523) — publish the config block to the module-level
@@ -189,6 +194,9 @@ class DeepSpeedEngine:
         act_ckpt.configure(deepspeed_config=config)
 
         # -- host-side bookkeeping ----------------------------------------
+        from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
+
+        self.flops_profiler = FlopsProfiler(config.flops_profiler, engine=self)
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
             batch_size=config.train_batch_size, steps_per_output=config.steps_per_print
@@ -196,6 +204,7 @@ class DeepSpeedEngine:
         self.wall_clock_breakdown = config.wall_clock_breakdown
         self._cached_loss = None
         self._compiled = {}
+        self._train_step_cost: Dict[str, float] = {}
         self.skipped_steps = 0
 
         log_dist(
@@ -514,7 +523,20 @@ class DeepSpeedEngine:
         gas = self.gradient_accumulation_steps
         batch = jax.tree.map(lambda x: np.asarray(x) if not isinstance(x, jax.Array) else x, batch)
 
-        if "train_batch" not in self._compiled:
+        def stack(x):
+            mb = x.shape[0] // gas
+            return x.reshape((gas, mb) + x.shape[1:])
+
+        stacked = jax.tree.map(stack, batch)
+        stacked = jax.tree.map(
+            lambda x: jax.device_put(
+                x, self._sh(P(*([None] + list(batch_pspec(np.ndim(x) - 1, seq_sharded=self.mesh_info.seq_parallel_world_size > 1)))))
+            ),
+            stacked,
+        )
+
+        tb_key = ("train_batch", tuple(np.shape(x) for x in jax.tree.leaves(stacked)))
+        if tb_key not in self._compiled:
             # with offload, the compiled program ends after the micro-batch
             # scan — the optimizer step runs on host (ZeRO-Offload splits
             # exactly here)
@@ -530,24 +552,39 @@ class DeepSpeedEngine:
                     return state, jnp.mean(losses), info
                 return state, jnp.mean(losses)
 
-            self._compiled["train_batch"] = jax.jit(full_step, donate_argnums=(0,))
-
-        def stack(x):
-            mb = x.shape[0] // gas
-            return x.reshape((gas, mb) + x.shape[1:])
-
-        stacked = jax.tree.map(stack, batch)
-        stacked = jax.tree.map(
-            lambda x: jax.device_put(
-                x, self._sh(P(*([None] + list(batch_pspec(np.ndim(x) - 1, seq_sharded=self.mesh_info.seq_parallel_world_size > 1)))))
-            ),
-            stacked,
-        )
+            # AOT compile: the executable's cost_analysis feeds the flops
+            # profiler for free (no second trace/compile at profile time).
+            # out_shardings pin the output state to the input layout —
+            # without them GSPMD may pick different output shardings and
+            # the next call would mismatch (plain jit hides that as a
+            # silent recompile).
+            scalar = self._sh(P())
+            if apply_in_graph:
+                out_sh = (self._state_shardings, scalar,
+                          {"lr": scalar, "grad_norm": scalar, "overflow": scalar})
+            else:
+                out_sh = (self._state_shardings, scalar)
+            executable = (
+                jax.jit(full_step, donate_argnums=(0,), out_shardings=out_sh)
+                .lower(self.state, stacked)
+                .compile()
+            )
+            self._compiled[tb_key] = executable
+            try:
+                cost = executable.cost_analysis() or {}
+                if isinstance(cost, list):
+                    cost = cost[0] if cost else {}
+                self._train_step_cost = {k: float(v) for k, v in cost.items() if np.isscalar(v)}
+            except Exception:
+                self._train_step_cost = {}
+        profile_step = int(self.state["global_step"]) + 1
+        self.flops_profiler.start_step(profile_step)
         if self._offload:
-            self.state, loss = self._compiled["train_batch"](self.state, stacked)
+            self.state, loss = self._compiled[tb_key](self.state, stacked)
             info = self._host_apply_step()
         else:
-            self.state, loss, info = self._compiled["train_batch"](self.state, stacked)
+            self.state, loss, info = self._compiled[tb_key](self.state, stacked)
+        self.flops_profiler.end_step(profile_step, cost=self._train_step_cost, sync_token=loss)
         # host sync on the overflow flag only when dynamic scaling is live
         if self.loss_scaler.dynamic and bool(info["overflow"]):
             self.skipped_steps += 1

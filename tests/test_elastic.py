@@ -1,0 +1,92 @@
+"""Elasticity tests (reference tests/unit/test_elastic.py)."""
+import pytest
+
+from deepspeed_tpu.elasticity import (
+    ElasticityConfig,
+    ElasticityConfigError,
+    ElasticityError,
+    ElasticityIncompatibleWorldSize,
+    compute_elastic_config,
+    get_candidate_batch_sizes,
+    get_valid_gpus,
+)
+
+BASE = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 10000,
+        "micro_batch_sizes": [8, 12, 16, 17],
+        "min_gpus": 32,
+        "max_gpus": 1500,
+        "min_time": 20,
+        "version": 0.1,
+    }
+}
+DS_VERSION = "0.4.5"
+
+
+def test_basic_config_and_determinism():
+    b1, g1 = compute_elastic_config(BASE, DS_VERSION)
+    b2, g2 = compute_elastic_config(BASE, DS_VERSION)
+    assert b1 == b2 and g1 == g2
+    assert 0 < b1 <= 10000
+    assert all(32 <= g <= 1500 for g in g1)
+    # every reported gpu count must actually divide into a (mb, gas) pair
+    for g in g1:
+        assert any(b1 % (mb * g) == 0 for mb in BASE["elasticity"]["micro_batch_sizes"])
+
+
+def test_world_size_compatibility_and_micro_batch():
+    _, valid_all = compute_elastic_config(BASE, DS_VERSION)
+    ws = valid_all[2]
+    batch, valid, mb = compute_elastic_config(BASE, DS_VERSION, world_size=ws)
+    assert ws in valid
+    assert mb in BASE["elasticity"]["micro_batch_sizes"]
+    assert batch % (mb * ws) == 0
+
+
+def test_incompatible_world_size():
+    cfg = {"elasticity": {**BASE["elasticity"], "micro_batch_sizes": [8, 16], "min_gpus": 32}}
+    batch, valid = compute_elastic_config(cfg, DS_VERSION)
+    bad = max(valid) + 1
+    while bad in valid:
+        bad += 1
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(cfg, DS_VERSION, world_size=bad)
+
+
+def test_candidate_math():
+    cands = get_candidate_batch_sizes([8], 128)
+    assert all(c % 8 == 0 and c <= 128 for c in cands)
+    assert 96 in cands  # 8 * 12
+    gpus = get_valid_gpus(96, [8, 12], 1, 20)
+    # 96 = 8*g*gas or 12*g*gas
+    assert 12 in gpus and 8 in gpus and 5 not in gpus
+
+
+def test_guards():
+    with pytest.raises(ElasticityError):
+        compute_elastic_config({"train_batch_size": 4}, DS_VERSION)  # no block
+    off = {"elasticity": {**BASE["elasticity"], "enabled": False}}
+    with pytest.raises(ElasticityError):
+        compute_elastic_config(off, DS_VERSION)
+    with pytest.raises(ElasticityError, match="requires version"):
+        compute_elastic_config(BASE, "0.2.0")
+    newer = {"elasticity": {**BASE["elasticity"], "version": 99.0}}
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(newer, DS_VERSION)
+    # non-elastic batch keys rejected unless explicitly ignored
+    mixed = {"train_batch_size": 512, **BASE}
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(mixed, DS_VERSION)
+    mixed["elasticity"] = {**BASE["elasticity"], "ignore_non_elastic_batch_info": True}
+    compute_elastic_config(mixed, DS_VERSION)  # no raise
+
+
+def test_config_validation():
+    with pytest.raises(ElasticityConfigError):
+        ElasticityConfig({"enabled": True, "micro_batch_sizes": [8]})  # no max batch
+    with pytest.raises(ElasticityConfigError):
+        ElasticityConfig({"enabled": True, "max_train_batch_size": 100, "micro_batch_sizes": [0]})
+    with pytest.raises(ElasticityConfigError):
+        ElasticityConfig({"enabled": True, "max_train_batch_size": 100, "micro_batch_sizes": [8], "min_gpus": 5, "max_gpus": 2})

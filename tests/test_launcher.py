@@ -1,0 +1,151 @@
+"""Launcher tests: hostfile parsing, include/exclude filters, world-info
+encoding, runner command construction, per-node spawn (reference
+tests/unit/test_run.py — pure logic, no cluster)."""
+import base64
+import json
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.launcher.launch import decode_world_info
+from deepspeed_tpu.launcher.runner import (
+    encode_world_info,
+    fetch_hostfile,
+    parse_args,
+    parse_resource_filter,
+)
+
+
+@pytest.fixture
+def hostfile(tmp_path):
+    p = tmp_path / "hostfile"
+    p.write_text(
+        """
+# comment line
+worker-0 slots=4
+worker-1 slots=4
+worker-2 slots=2
+""".strip()
+    )
+    return str(p)
+
+
+def test_fetch_hostfile(hostfile):
+    pool = fetch_hostfile(hostfile)
+    assert pool == {"worker-0": 4, "worker-1": 4, "worker-2": 2}
+    assert list(pool) == ["worker-0", "worker-1", "worker-2"]
+
+
+def test_fetch_hostfile_missing_returns_empty(tmp_path):
+    assert fetch_hostfile(str(tmp_path / "nope")) == {}
+
+
+def test_fetch_hostfile_malformed(tmp_path):
+    p = tmp_path / "bad"
+    p.write_text("worker-0 gpus=4\n")
+    with pytest.raises(ValueError, match="malformed"):
+        fetch_hostfile(str(p))
+
+
+def test_fetch_hostfile_duplicate(tmp_path):
+    p = tmp_path / "dup"
+    p.write_text("w slots=2\nw slots=4\n")
+    with pytest.raises(ValueError, match="duplicate"):
+        fetch_hostfile(str(p))
+
+
+def test_include_filter(hostfile):
+    pool = fetch_hostfile(hostfile)
+    # whole-host include
+    act = parse_resource_filter(pool, include_str="worker-1")
+    assert act == {"worker-1": [0, 1, 2, 3]}
+    # per-slot include
+    act = parse_resource_filter(pool, include_str="worker-0:0,2@worker-2:1")
+    assert act == {"worker-0": [0, 2], "worker-2": [1]}
+
+
+def test_exclude_filter(hostfile):
+    pool = fetch_hostfile(hostfile)
+    act = parse_resource_filter(pool, exclude_str="worker-1")
+    assert act == {"worker-0": [0, 1, 2, 3], "worker-2": [0, 1]}
+    act = parse_resource_filter(pool, exclude_str="worker-0:1,3")
+    assert act["worker-0"] == [0, 2]
+
+
+def test_filter_validation(hostfile):
+    pool = fetch_hostfile(hostfile)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        parse_resource_filter(pool, include_str="worker-0", exclude_str="worker-1")
+    with pytest.raises(ValueError, match="not in hostfile"):
+        parse_resource_filter(pool, include_str="worker-9")
+    with pytest.raises(ValueError, match="invalid"):
+        parse_resource_filter(pool, include_str="worker-2:5")
+
+
+def test_world_info_roundtrip():
+    active = {"a": [0, 1], "b": [0]}
+    enc = encode_world_info(active)
+    assert decode_world_info(enc) == active
+
+
+def test_multinode_runner_commands(hostfile):
+    from deepspeed_tpu.launcher.multinode_runner import OpenMPIRunner, PDSHRunner, SSHRunner
+
+    args = parse_args(["--hostfile", hostfile, "--master_port", "29501", "train.py", "--lr", "0.1"])
+    args.master_addr = "worker-0"
+    pool = fetch_hostfile(hostfile)
+    active = parse_resource_filter(pool)
+    enc = encode_world_info(active)
+
+    pdsh_cmd = PDSHRunner(args, enc).get_cmd({}, active)
+    assert pdsh_cmd[0] == "pdsh"
+    assert "worker-0,worker-1,worker-2" in pdsh_cmd
+    assert "deepspeed_tpu.launcher.launch" in pdsh_cmd[-1]
+
+    ssh_cmds = SSHRunner(args, enc).get_cmd({}, active)
+    assert len(ssh_cmds) == 3 and all(c[0] == "ssh" for c in ssh_cmds)
+    assert "--node_rank=2" in ssh_cmds[2][-1]
+
+    mpi_cmd = OpenMPIRunner(args, enc).get_cmd({}, active)
+    assert mpi_cmd[0] == "mpirun" and "train.py" in mpi_cmd
+
+
+def test_launch_spawns_and_propagates_env(tmp_path):
+    """End-to-end single-node: launch.py must spawn children with the
+    rank/world env contract and propagate failure codes."""
+    script = tmp_path / "child.py"
+    # write to per-rank files — child stdout interleaves under the pack
+    script.write_text(
+        "import os\n"
+        f"open(os.path.join({str(tmp_path)!r}, 'rank' + os.environ['RANK']), 'w').write(\n"
+        "    os.environ['WORLD_SIZE'] + ':' + os.environ['MASTER_ADDR'] + ':' + os.environ['LOCAL_RANK'])\n"
+    )
+    enc = encode_world_info({"localhost": [0, 1]})
+    res = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+         "--node_rank=0", "--world_info", enc, "--procs_per_node", "2", str(script)],
+        capture_output=True, text=True, timeout=60,
+        env={"PATH": "/usr/bin:/bin", "PYTHONPATH": "/root/repo", "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""},
+    )
+    assert res.returncode == 0, res.stderr
+    assert (tmp_path / "rank0").read_text() == "2:127.0.0.1:0"
+    assert (tmp_path / "rank1").read_text() == "2:127.0.0.1:1"
+
+
+def test_launch_kills_pack_on_failure(tmp_path):
+    script = tmp_path / "child.py"
+    script.write_text(
+        "import os, sys, time\n"
+        "if os.environ['RANK'] == '1':\n"
+        "    sys.exit(3)\n"
+        "time.sleep(30)\n"
+    )
+    enc = encode_world_info({"localhost": [0, 1]})
+    res = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+         "--node_rank=0", "--world_info", enc, "--procs_per_node", "2", str(script)],
+        capture_output=True, text=True, timeout=60,
+        env={"PATH": "/usr/bin:/bin", "PYTHONPATH": "/root/repo", "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""},
+    )
+    assert res.returncode == 3
