@@ -226,12 +226,15 @@ def _block(cfg: GPT2Config, x, lp, rng, deterministic: bool, token_mask=None):
     return x, aux
 
 
-def apply(params: Dict[str, Any], tokens: jnp.ndarray, cfg: GPT2Config, rng=None, deterministic: bool = True, return_aux: bool = False, token_mask=None):
+def apply(params: Dict[str, Any], tokens: jnp.ndarray, cfg: GPT2Config, rng=None, deterministic: bool = True, return_aux: bool = False, token_mask=None, pld_theta=None):
     """Forward pass: ``tokens (B, T) int32`` → logits ``(B, T, V)``.
 
     ``return_aux=True`` additionally returns the summed MoE
     load-balancing loss (zero for dense models).  ``token_mask (B, T)``
-    excludes padding from MoE routing/aux."""
+    excludes padding from MoE routing/aux.  ``pld_theta`` (traced scalar)
+    enables progressive layer drop: layer l of L is kept with probability
+    ``1 - (l+1)/L·(1-theta)`` via ``lax.cond`` — dropped layers skip
+    their compute entirely (runtime/progressive_layer_drop.py)."""
     B, T = tokens.shape
     x = jnp.take(params["wte"], tokens, axis=0) + params["wpe"][:T][None]
     x = x.astype(params["blocks"]["qkv_w"].dtype)
@@ -243,19 +246,37 @@ def apply(params: Dict[str, Any], tokens: jnp.ndarray, cfg: GPT2Config, rng=None
         layer_rngs = jnp.zeros((n_layer, 2), jnp.uint32)
 
     block_fn = functools.partial(_block, cfg)
+    use_pld = pld_theta is not None and rng is not None and not deterministic
+    keep_probs = None
+    if use_pld:
+        from deepspeed_tpu.runtime.progressive_layer_drop import layer_keep_probs
+
+        keep_probs = layer_keep_probs(pld_theta, n_layer)
 
     def scan_body(carry, xs):
         x, aux_acc = carry
-        lp, lr = xs
+        if use_pld:
+            lp, lr, keep_p = xs
+        else:
+            lp, lr = xs
         r = lr if rng is not None else None
-        y, aux = block_fn(x, lp, r, deterministic, token_mask)
+
+        def run_block(x_in):
+            return block_fn(x_in, lp, r, deterministic, token_mask)
+
+        if use_pld:
+            keep = jax.random.bernoulli(jax.random.fold_in(lr, 7), keep_p)
+            y, aux = jax.lax.cond(keep, run_block, lambda x_in: (x_in, jnp.zeros((), jnp.float32)), x)
+        else:
+            y, aux = run_block(x)
         return (y, aux_acc + aux), None
 
     if cfg.remat:
         policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
         scan_body = jax.checkpoint(scan_body, policy=policy, prevent_cse=False)
 
-    (x, aux_total), _ = jax.lax.scan(scan_body, (x, jnp.zeros((), jnp.float32)), (params["blocks"], layer_rngs))
+    scan_xs = (params["blocks"], layer_rngs, keep_probs) if use_pld else (params["blocks"], layer_rngs)
+    (x, aux_total), _ = jax.lax.scan(scan_body, (x, jnp.zeros((), jnp.float32)), scan_xs)
     x = _layer_norm(x, params["lnf_g"], params["lnf_b"], cfg.layer_norm_epsilon)
     logits = x @ params["wte"].T.astype(x.dtype)  # tied embedding head
     if return_aux:
@@ -266,10 +287,13 @@ def apply(params: Dict[str, Any], tokens: jnp.ndarray, cfg: GPT2Config, rng=None
 def loss_fn(params: Dict[str, Any], batch: Dict[str, Any], rng=None, cfg: GPT2Config = None, deterministic: bool = False) -> jnp.ndarray:
     """Next-token cross entropy.  ``batch``: {"input_ids": (B, T)} with
     optional "labels" (default: shifted input_ids) and "attention_mask"."""
+    from deepspeed_tpu.runtime.progressive_layer_drop import PLD_THETA_KEY
+
     tokens = batch["input_ids"]
     logits, moe_aux = apply(
         params, tokens, cfg, rng=rng, deterministic=deterministic, return_aux=True,
         token_mask=batch.get("attention_mask") if cfg.n_experts > 0 else None,
+        pld_theta=batch.get(PLD_THETA_KEY),
     )
     if "labels" in batch:
         labels = batch["labels"]

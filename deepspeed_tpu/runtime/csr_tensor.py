@@ -1,0 +1,72 @@
+"""CSR sparse tensor (sparse embedding gradients).
+
+Reference: ``runtime/csr_tensor.py`` (``CSRTensor`` :11) + the engine's
+sparse-gradient path (``engine.py:199-205``, ``csr_allreduce`` :1559):
+``nn.Embedding`` gradients are converted to CSR before the DP allreduce
+so only touched rows move over the wire.
+
+TPU note: inside the compiled step, embedding grads are produced by XLA
+scatter ops and reduced with ``psum`` — XLA already exploits the
+scatter structure, and dynamic-nnz tensors can't live under jit (static
+shapes).  This class therefore serves the *host-side* uses: compressed
+checkpoint/state shipping and host-side gradient exchange for the
+offload path, matching the reference's API shape (``sparse_size``,
+``to_dense``, add/scale ops).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+class CSRTensor:
+    def __init__(self, values: np.ndarray, indices: np.ndarray, dense_shape: Tuple[int, int]):
+        """``values``: (nnz_rows, ncols) — row-sparse layout (embedding
+        grads are row-sparse); ``indices``: (nnz_rows,) row ids."""
+        self.values = np.asarray(values)
+        self.indices = np.asarray(indices, np.int64)
+        self.dense_shape = tuple(dense_shape)
+
+    @classmethod
+    def from_dense(cls, dense: np.ndarray, tol: float = 0.0) -> "CSRTensor":
+        dense = np.asarray(dense)
+        assert dense.ndim == 2, "CSRTensor is row-sparse over 2-D tensors"
+        nonzero = np.where(np.abs(dense).max(axis=1) > tol)[0]
+        return cls(dense[nonzero], nonzero, dense.shape)
+
+    def to_dense(self) -> np.ndarray:
+        out = np.zeros(self.dense_shape, self.values.dtype)
+        out[self.indices] = self.values
+        return out
+
+    def sparse_size(self) -> int:
+        """Elements actually stored (reference ``sparse_size``)."""
+        return int(self.values.size + self.indices.size)
+
+    @property
+    def density(self) -> float:
+        return self.values.shape[0] / max(1, self.dense_shape[0])
+
+    def scale(self, factor: float) -> "CSRTensor":
+        return CSRTensor(self.values * factor, self.indices, self.dense_shape)
+
+    def add(self, other: "CSRTensor") -> "CSRTensor":
+        assert self.dense_shape == other.dense_shape
+        rows = np.union1d(self.indices, other.indices)
+        vals = np.zeros((len(rows), self.dense_shape[1]), np.result_type(self.values, other.values))
+        pos = {r: i for i, r in enumerate(rows)}
+        for src in (self, other):
+            for r, v in zip(src.indices, src.values):
+                vals[pos[int(r)]] += v
+        return CSRTensor(vals, rows, self.dense_shape)
+
+
+def csr_allreduce_host(csr: CSRTensor, all_csrs) -> CSRTensor:
+    """Host-side allreduce of row-sparse grads (reference
+    ``csr_allreduce``): union of rows, summed values."""
+    out = csr
+    for other in all_csrs:
+        if other is not csr:
+            out = out.add(other)
+    return out

@@ -1,0 +1,86 @@
+"""Curvature estimation via power iteration (MoQ's precision gate).
+
+Reference: ``runtime/eigenvalue.py`` (``Eigenvalue`` :7) — estimates the
+dominant Hessian eigenvalue per layer with power iteration over
+Hessian-vector products, used by MoQ to decide when a layer is "flat
+enough" to drop precision (engine.step hook, ``engine.py:1334-1341``).
+
+TPU-native form: HVPs come from ``jax.jvp`` over ``jax.grad`` (forward-
+over-reverse) — exact, compiled, no double-backward graph surgery — and
+the whole power iteration is one jitted ``lax``-style loop per call.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def _normalize(tree: Any) -> Tuple[Any, jnp.ndarray]:
+    sq = sum(jnp.sum(jnp.square(v.astype(jnp.float32))) for v in jax.tree.leaves(tree))
+    norm = jnp.sqrt(sq)
+    safe = jnp.maximum(norm, 1e-12)
+    return jax.tree.map(lambda v: (v / safe).astype(v.dtype), tree), norm
+
+
+class Eigenvalue:
+    """Reference signature subset: verbose, max_iter, tol, stability
+    (+ eigenvalue is computed over the whole params tree or a sub-tree)."""
+
+    def __init__(
+        self,
+        verbose: bool = False,
+        max_iter: int = 100,
+        tol: float = 1e-2,
+        stability: float = 1e-6,
+        gas_boundary_resolution: int = 1,
+        layer_name: str = "",
+        layer_num: int = 0,
+    ):
+        self.verbose = verbose
+        self.max_iter = max_iter
+        self.tol = tol
+        self.stability = stability
+        self.gas_boundary_resolution = gas_boundary_resolution
+        self.layer_name = layer_name
+        self.layer_num = layer_num
+
+    def compute_eigenvalue(
+        self,
+        loss_fn: Callable[[Any], jnp.ndarray],
+        params: Any,
+        rng: Optional[jax.Array] = None,
+    ) -> float:
+        """Dominant eigenvalue of the Hessian of ``loss_fn`` at
+        ``params`` by power iteration on exact HVPs."""
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        leaves, treedef = jax.tree.flatten(params)
+        keys = jax.random.split(rng, len(leaves))
+        # tangents must match the primal dtype (bf16/fp16 params included)
+        v = jax.tree.unflatten(
+            treedef,
+            [jax.random.normal(k, l.shape, jnp.float32).astype(l.dtype) for k, l in zip(keys, leaves)],
+        )
+        v, _ = _normalize(v)
+        grad_fn = jax.grad(lambda p: jnp.asarray(loss_fn(p), jnp.float32))
+
+        @jax.jit
+        def hvp(p, vec):
+            return jax.jvp(grad_fn, (p,), (vec,))[1]
+
+        eig = 0.0
+        for i in range(self.max_iter):
+            hv = hvp(params, v)
+            v, norm = _normalize(hv)
+            new_eig = float(norm)
+            if self.verbose:
+                logger.info(f"eigenvalue iter {i}: {new_eig:.4e}")
+            if eig and abs(new_eig - eig) / (abs(eig) + self.stability) < self.tol:
+                eig = new_eig
+                break
+            eig = new_eig
+        return max(eig, self.stability)

@@ -193,6 +193,29 @@ class DeepSpeedEngine:
 
         act_ckpt.configure(deepspeed_config=config)
 
+        # -- MoQ quantize-training + progressive layer drop ----------------
+        # (reference engine hooks: _take_model_step :1284-1290 for MoQ,
+        # forward :1101 / step :1343 for PLD)
+        self.quantizer = None
+        if config.quantize_training.enabled:
+            if self._offload:
+                raise NotImplementedError("quantize_training (MoQ) is not supported with offload_optimizer")
+            from deepspeed_tpu.runtime.quantize import Quantizer
+
+            self.quantizer = Quantizer(config.quantize_training)
+        self.progressive_layer_drop = None
+        if config.progressive_layer_drop.enabled:
+            if not getattr(self, "_use_grad_acc", True):
+                raise NotImplementedError(
+                    "progressive_layer_drop is not wired into the pipeline engine yet "
+                    "(theta injection lives in the micro-step path)"
+                )
+            from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=config.progressive_layer_drop.theta, gamma=config.progressive_layer_drop.gamma
+            )
+
         # -- host-side bookkeeping ----------------------------------------
         from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
 
@@ -343,6 +366,11 @@ class DeepSpeedEngine:
 
     def _micro_step_impl(self, state, batch):
         """One micro-batch: fused forward+backward, accumulate grads."""
+        if self.progressive_layer_drop is not None and isinstance(batch, dict):
+            from deepspeed_tpu.runtime.progressive_layer_drop import PLD_THETA_KEY
+
+            batch = dict(batch)
+            batch[PLD_THETA_KEY] = self.progressive_layer_drop.get_theta(state["global_step"])
         rng = jax.random.fold_in(state["rng"], state["micro_step"])
         (scaled_loss, loss), grads = jax.value_and_grad(
             lambda p: self._compute_loss(p, batch, rng, state["loss_scale"]), has_aux=True
@@ -386,6 +414,13 @@ class DeepSpeedEngine:
             state["opt_state"],
             new_opt,
         )
+        if self.quantizer is not None:
+            # MoQ: fake-quantize weights right after the update
+            # (reference _take_model_step :1284-1290); an overflow step is
+            # a no-op, so keep the un-quantized (== previous) params then
+            qrng = jax.random.fold_in(state["rng"], state["global_step"] + 1_000_003)
+            quantized = self.quantizer.quantize_params(new_params, state["global_step"], rng=qrng)
+            new_params = jax.tree.map(lambda p, q: jnp.where(overflow, p, q), new_params, quantized)
         state = dict(state)
         state["params"] = new_params
         state["opt_state"] = new_opt
@@ -619,6 +654,10 @@ class DeepSpeedEngine:
 
     def _maybe_report_progress(self):
         step = int(self.state["global_step"])
+        if self.quantizer is not None:
+            self.quantizer.maybe_log(step)
+        if self.progressive_layer_drop is not None:
+            self.progressive_layer_drop.update_state(step)
         if step > 0 and step % self.config.steps_per_print == 0:
             log_dist(f"step={step} lr={self.get_lr()[0]:.3e} loss_scale={self.loss_scale:.1f}")
 

@@ -1,0 +1,49 @@
+"""Progressive Layer Drop (PLD).
+
+Reference: ``runtime/progressive_layer_drop.py`` (``ProgressiveLayerDrop``
+:5) — the theta schedule from "Accelerating Training of Transformer-Based
+Language Models with Progressive Layer Dropping" (Zhang & He, 2020):
+``theta(t) = (1 - theta_bar) * exp(-gamma * t) + theta_bar``, so early
+steps keep almost every layer and the keep-probability anneals down to
+``theta_bar``.  The engine hooks it at forward (theta into the model) and
+step (advance t) — reference ``engine.py:1101`` / ``:1343``.
+
+TPU-native integration: theta must be a *traced* value (it changes every
+step inside the compiled train step), so the engine computes
+``theta(global_step)`` in-graph and injects it into the batch dict as
+``PLD_THETA_KEY``; models that support PLD (models/gpt2.py) pop it and
+apply per-layer stochastic depth inside their ``lax.scan``: layer l of L
+is kept with probability ``1 - (l+1)/L * (1 - theta)`` (deeper layers
+drop more, matching the paper's progressive schedule along depth).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+PLD_THETA_KEY = "__pld_theta__"
+
+
+class ProgressiveLayerDrop:
+    """Reference signature: ``ProgressiveLayerDrop(theta, gamma)``."""
+
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = float(theta)
+        self.gamma = float(gamma)
+        self.current_theta = 1.0
+
+    def get_theta(self, global_step) -> jnp.ndarray:
+        """Traced schedule — safe to call inside jit."""
+        t = jnp.asarray(global_step, jnp.float32)
+        return (1.0 - self.theta) * jnp.exp(-self.gamma * t) + self.theta
+
+    def update_state(self, global_step: int) -> None:
+        self.current_theta = float(self.get_theta(global_step))
+
+    def get_state(self) -> dict:
+        return {"progressive_layer_drop": True, "pld_theta": self.current_theta}
+
+
+def layer_keep_probs(theta, n_layers: int) -> jnp.ndarray:
+    """Per-layer keep probability: p_l = 1 - (l+1)/L * (1 - theta)."""
+    depth_frac = (jnp.arange(n_layers, dtype=jnp.float32) + 1.0) / n_layers
+    return 1.0 - depth_frac * (1.0 - jnp.asarray(theta, jnp.float32))

@@ -1,0 +1,85 @@
+"""Offline checkpoint → full fp32 state dict.
+
+Reference: ``deepspeed/utils/zero_to_fp32.py`` (:119 core) — stitches the
+per-rank ZeRO shard files back into one fp32 ``state_dict`` without a
+live engine (the script the reference copies into every checkpoint dir).
+
+Here the sharded-checkpoint format is orbax/tensorstore, which reshards
+transparently on read — so "consolidation" is a metadata-driven restore
+of the params subtree into host numpy, then an optional dump to ``.npz``
+or a torch ``.pt`` (for handing weights back to torch tooling).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def _resolve_tag(checkpoint_dir: str, tag: Optional[str]) -> str:
+    if tag is None:
+        latest = os.path.join(checkpoint_dir, "latest")
+        if not os.path.exists(latest):
+            raise FileNotFoundError(f"no 'latest' file in {checkpoint_dir}; pass tag explicitly")
+        with open(latest) as f:
+            tag = f.read().strip()
+    return tag
+
+
+def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir: str, tag: Optional[str] = None) -> Dict[str, np.ndarray]:
+    """Reference entry point of the same name: returns a flat
+    {'path/to/param': fp32 ndarray} dict from a training checkpoint."""
+    import orbax.checkpoint as ocp
+
+    checkpoint_dir = os.path.abspath(checkpoint_dir)
+    state_dir = os.path.join(checkpoint_dir, _resolve_tag(checkpoint_dir, tag), "state")
+    ckptr = ocp.PyTreeCheckpointer()
+    meta = ckptr.metadata(state_dir)
+    meta_params = meta["params"] if isinstance(meta, dict) else meta.item_metadata.tree["params"]
+    target = {
+        "params": jax.tree.map(
+            lambda m: np.zeros(m.shape, np.float32), meta_params, is_leaf=lambda m: hasattr(m, "shape")
+        )
+    }
+    restored = ckptr.restore(state_dir, args=ocp.args.PyTreeRestore(item=target, partial_restore=True))
+
+    flat: Dict[str, np.ndarray] = {}
+
+    def visit(path, leaf):
+        key = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+        flat[key] = np.asarray(leaf, np.float32)
+
+    jax.tree_util.tree_map_with_path(visit, restored["params"])
+    return flat
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir: str, output_file: str, tag: Optional[str] = None) -> None:
+    """Reference entry point of the same name: write the consolidated
+    weights to ``output_file`` (.npz, or .pt when torch is available)."""
+    sd = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag=tag)
+    n_params = sum(v.size for v in sd.values())
+    if output_file.endswith(".pt") or output_file.endswith(".bin"):
+        import torch
+
+        torch.save({k: torch.from_numpy(v.copy()) for k, v in sd.items()}, output_file)
+    else:
+        np.savez(output_file, **{k.replace("/", "::"): v for k, v in sd.items()})
+    logger.info(f"saved {len(sd)} tensors ({n_params / 1e6:.1f}M params) to {output_file}")
+
+
+def main():
+    parser = argparse.ArgumentParser(description="consolidate a sharded checkpoint into full fp32 weights")
+    parser.add_argument("checkpoint_dir", help="training checkpoint dir (contains 'latest')")
+    parser.add_argument("output_file", help=".npz / .pt output path")
+    parser.add_argument("-t", "--tag", default=None)
+    args = parser.parse_args()
+    convert_zero_checkpoint_to_fp32_state_dict(args.checkpoint_dir, args.output_file, tag=args.tag)
+
+
+if __name__ == "__main__":
+    main()

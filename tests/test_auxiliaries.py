@@ -1,0 +1,221 @@
+"""Auxiliary subsystems: progressive layer drop, MoQ quantize-training +
+eigenvalue, CSR tensors, TiledLinear, zero_to_fp32 (reference coverage:
+test_pld.py, MoQ cases, test_csr.py, test_zero_tiled.py)."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import gpt2
+
+
+# ---------------------------------------------------------------------------
+# progressive layer drop
+# ---------------------------------------------------------------------------
+
+def test_pld_theta_schedule():
+    from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop, layer_keep_probs
+
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+    t0 = float(pld.get_theta(0))
+    t_inf = float(pld.get_theta(10_000))
+    assert abs(t0 - 1.0) < 1e-6          # keep everything at step 0
+    assert abs(t_inf - 0.5) < 1e-3       # anneals to theta_bar
+    probs = np.asarray(layer_keep_probs(0.5, 4))
+    assert probs[0] > probs[-1]          # deeper layers drop more
+    np.testing.assert_allclose(probs, [0.875, 0.75, 0.625, 0.5])
+    pld.update_state(100)
+    st = pld.get_state()
+    assert st["progressive_layer_drop"] and 0.5 <= st["pld_theta"] <= 1.0
+
+
+def test_pld_training_end_to_end():
+    cfg = dataclasses.replace(gpt2.GPT2_TINY, remat=False, dropout=0.1)
+    model_fn, init_fn, tp_fn = gpt2.make_model(cfg)
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "progressive_layer_drop": {"enabled": True, "theta": 0.5, "gamma": 0.01},
+        "steps_per_print": 1000,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model_fn, model_parameters=init_fn(), config=config, tp_spec_fn=tp_fn
+    )
+    assert engine.progressive_layer_drop is not None
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (16, 16), dtype=np.int32)}
+    losses = [float(engine.train_batch(batch)) for _ in range(6)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_pld_drop_actually_skips_layers():
+    """With theta→0 (drop everything deep), logits must equal the
+    network with blocks bypassed more often than not — check variance
+    against the no-PLD forward."""
+    cfg = dataclasses.replace(gpt2.GPT2_TINY, remat=False)
+    params = jax.tree.map(jnp.asarray, gpt2.init_params(cfg, seed=0))
+    toks = jnp.asarray(np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 8), dtype=np.int32))
+    rng = jax.random.PRNGKey(0)
+    full = gpt2.apply(params, toks, cfg, rng=rng, deterministic=False)
+    dropped = gpt2.apply(params, toks, cfg, rng=rng, deterministic=False, pld_theta=jnp.asarray(0.0))
+    kept = gpt2.apply(params, toks, cfg, rng=rng, deterministic=False, pld_theta=jnp.asarray(1.0))
+    # theta=1 keeps every layer → identical to the plain forward
+    np.testing.assert_allclose(np.asarray(kept), np.asarray(full), rtol=1e-5, atol=1e-5)
+    # theta=0 drops layers with high probability → different logits
+    assert np.abs(np.asarray(dropped) - np.asarray(full)).max() > 1e-3
+
+
+# ---------------------------------------------------------------------------
+# MoQ + eigenvalue
+# ---------------------------------------------------------------------------
+
+def test_moq_bits_schedule():
+    from deepspeed_tpu.config.config import QuantizeTrainingConfig
+    from deepspeed_tpu.runtime.quantize import Quantizer
+
+    q = Quantizer(QuantizeTrainingConfig(enabled=True, quantize_bits_start=16, quantize_bits_target=8, quantize_schedule_offset=100))
+    assert int(q.current_bits(0)) == 16
+    assert int(q.current_bits(99)) == 16
+    assert int(q.current_bits(100)) == 15
+    assert int(q.current_bits(100 + 700)) == 8
+    assert int(q.current_bits(10_000)) == 8  # clamps at target
+    period0 = q.q_period
+    q.scale_period_by_eigenvalue(2.0, 2.0)
+    assert q.q_period > period0  # sharp layer → slower precision drop
+
+
+def test_moq_training_quantizes_weights():
+    cfg = dataclasses.replace(gpt2.GPT2_TINY, remat=False)
+    model_fn, init_fn, tp_fn = gpt2.make_model(cfg)
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "quantize_training": {"enabled": True, "quantize_bits_start": 8, "quantize_bits_target": 8, "quantize_schedule_offset": 1, "quantize_groups": 1},
+        "steps_per_print": 1000,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model_fn, model_parameters=init_fn(), config=config, tp_spec_fn=tp_fn
+    )
+    batch = {"input_ids": np.random.default_rng(0).integers(0, cfg.vocab_size, (16, 16), dtype=np.int32)}
+    for _ in range(3):
+        loss = engine.train_batch(batch)
+    assert np.isfinite(float(loss))
+    # weights should now sit on a small quantization grid: 8-bit symmetric
+    # → at most 255 distinct values per group
+    w = np.asarray(jax.device_get(engine.state["params"]["blocks"]["qkv_w"]), np.float32)
+    assert len(np.unique(w.round(6))) <= 256 * 2  # grid + numerical noise
+
+
+def test_eigenvalue_power_iteration_quadratic():
+    """For f(x) = x^T A x / 2 the dominant Hessian eigenvalue is known."""
+    from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+    rng = np.random.default_rng(0)
+    Q, _ = np.linalg.qr(rng.standard_normal((8, 8)))
+    eigs = np.array([5.0, 3.0, 1.0, 0.5, 0.3, 0.2, 0.1, 0.05], np.float32)
+    A = (Q * eigs) @ Q.T
+    A = jnp.asarray((A + A.T) / 2)
+
+    def loss(p):
+        x = p["x"]
+        return 0.5 * x @ A @ x
+
+    est = Eigenvalue(max_iter=200, tol=1e-4).compute_eigenvalue(loss, {"x": jnp.ones(8, jnp.float32)})
+    assert abs(est - 5.0) < 0.05, est
+    # bf16 params must work too (mixed-precision default)
+    def loss16(p):
+        x = p["x"].astype(jnp.float32)
+        return 0.5 * x @ A @ x
+
+    est16 = Eigenvalue(max_iter=100, tol=1e-2).compute_eigenvalue(loss16, {"x": jnp.ones(8, jnp.bfloat16)})
+    assert abs(est16 - 5.0) < 0.5, est16
+
+
+# ---------------------------------------------------------------------------
+# CSR
+# ---------------------------------------------------------------------------
+
+def test_csr_roundtrip_and_ops():
+    from deepspeed_tpu.runtime.csr_tensor import CSRTensor, csr_allreduce_host
+
+    dense = np.zeros((100, 8), np.float32)
+    dense[[3, 17, 50]] = np.random.default_rng(0).standard_normal((3, 8)).astype(np.float32)
+    csr = CSRTensor.from_dense(dense)
+    assert csr.values.shape == (3, 8) and list(csr.indices) == [3, 17, 50]
+    np.testing.assert_array_equal(csr.to_dense(), dense)
+    assert csr.sparse_size() < dense.size
+    assert abs(csr.density - 0.03) < 1e-9
+
+    other = np.zeros_like(dense)
+    other[[17, 60]] = 1.0
+    combined = csr_allreduce_host(csr, [csr, CSRTensor.from_dense(other)])
+    np.testing.assert_allclose(combined.to_dense(), dense + other)
+
+
+# ---------------------------------------------------------------------------
+# TiledLinear
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("in_splits,out_splits", [(1, 1), (2, 3), (4, 2)])
+def test_tiled_linear_matches_dense(in_splits, out_splits):
+    from deepspeed_tpu.runtime.zero.tiling import TiledLinear
+
+    rng = np.random.default_rng(1)
+    W = rng.standard_normal((30, 21)).astype(np.float32)
+    b = rng.standard_normal(21).astype(np.float32)
+    x = rng.standard_normal((4, 30)).astype(np.float32)
+    tl = TiledLinear(30, 21, in_splits=in_splits, out_splits=out_splits)
+    tl.copy_params_from(W, b)
+    np.testing.assert_allclose(np.asarray(tl(x)), x @ W + b, rtol=1e-5, atol=1e-5)
+
+
+def test_tiled_linear_grads_flow():
+    from deepspeed_tpu.runtime.zero.tiling import init_tiled_linear, tiled_linear
+
+    params = jax.tree.map(jnp.asarray, init_tiled_linear(16, 12, in_splits=2, out_splits=2))
+    x = jnp.ones((2, 16))
+    grads = jax.grad(lambda p: jnp.sum(tiled_linear(p, x) ** 2))(params)
+    for k, g in grads.items():
+        if k.endswith("_w"):
+            assert np.abs(np.asarray(g)).max() > 0, k
+
+
+# ---------------------------------------------------------------------------
+# zero_to_fp32
+# ---------------------------------------------------------------------------
+
+def test_zero_to_fp32_consolidation(tmp_path):
+    from deepspeed_tpu.utils.zero_to_fp32 import (
+        convert_zero_checkpoint_to_fp32_state_dict,
+        get_fp32_state_dict_from_zero_checkpoint,
+    )
+
+    cfg = dataclasses.replace(gpt2.GPT2_TINY, remat=False)
+    model_fn, init_fn, tp_fn = gpt2.make_model(cfg)
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 3},
+        "mesh": {"fsdp": 8, "data": 1},
+        "steps_per_print": 1000,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model_fn, model_parameters=init_fn(seed=5), config=config, tp_spec_fn=tp_fn
+    )
+    batch = {"input_ids": np.random.default_rng(0).integers(0, cfg.vocab_size, (16, 16), dtype=np.int32)}
+    engine.train_batch(batch)
+    engine.save_checkpoint(str(tmp_path / "ck"))
+
+    sd = get_fp32_state_dict_from_zero_checkpoint(str(tmp_path / "ck"))
+    assert "lnf_g" in sd and sd["blocks/qkv_w"].shape == (cfg.n_layer, cfg.n_embd, 3 * cfg.n_embd)
+    np.testing.assert_allclose(
+        sd["lnf_g"], np.asarray(jax.device_get(engine.state["params"]["lnf_g"]), np.float32), rtol=1e-6
+    )
+    out = tmp_path / "weights.npz"
+    convert_zero_checkpoint_to_fp32_state_dict(str(tmp_path / "ck"), str(out))
+    with np.load(str(out)) as z:
+        assert "lnf_g" in [k.replace("::", "/") for k in z.files]
