@@ -266,7 +266,16 @@ def apply(params: Dict[str, Any], tokens: jnp.ndarray, cfg: GPT2Config, rng=None
 
         if use_pld:
             keep = jax.random.bernoulli(jax.random.fold_in(lr, 7), keep_p)
-            y, aux = jax.lax.cond(keep, run_block, lambda x_in: (x_in, jnp.zeros((), jnp.float32)), x)
+
+            def kept_branch(x_in):
+                # inverted stochastic-depth scaling: the block's residual
+                # delta is scaled by 1/keep_p so the training-time
+                # expectation matches the deterministic eval forward
+                y_in, aux_in = run_block(x_in)
+                y_scaled = x_in + (y_in - x_in) / keep_p.astype(y_in.dtype)
+                return y_scaled, aux_in
+
+            y, aux = jax.lax.cond(keep, kept_branch, lambda x_in: (x_in, jnp.zeros((), jnp.float32)), x)
         else:
             y, aux = run_block(x)
         return (y, aux_acc + aux), None

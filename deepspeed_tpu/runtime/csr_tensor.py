@@ -55,10 +55,9 @@ class CSRTensor:
         assert self.dense_shape == other.dense_shape
         rows = np.union1d(self.indices, other.indices)
         vals = np.zeros((len(rows), self.dense_shape[1]), np.result_type(self.values, other.values))
-        pos = {r: i for i, r in enumerate(rows)}
-        for src in (self, other):
-            for r, v in zip(src.indices, src.values):
-                vals[pos[int(r)]] += v
+        # vectorized scatter-add per operand (rows is sorted by union1d)
+        np.add.at(vals, np.searchsorted(rows, self.indices), self.values)
+        np.add.at(vals, np.searchsorted(rows, other.indices), other.values)
         return CSRTensor(vals, rows, self.dense_shape)
 
 

@@ -66,11 +66,15 @@ class Eigenvalue:
             [jax.random.normal(k, l.shape, jnp.float32).astype(l.dtype) for k, l in zip(keys, leaves)],
         )
         v, _ = _normalize(v)
-        grad_fn = jax.grad(lambda p: jnp.asarray(loss_fn(p), jnp.float32))
-
-        @jax.jit
-        def hvp(p, vec):
-            return jax.jvp(grad_fn, (p,), (vec,))[1]
+        # cache the jitted HVP per loss_fn — repeated calibration probes
+        # (MoQ calls this per layer/boundary) must not recompile each time
+        if not hasattr(self, "_hvp_cache"):
+            self._hvp_cache = {}
+        hvp = self._hvp_cache.get(id(loss_fn))
+        if hvp is None:
+            grad_fn = jax.grad(lambda p: jnp.asarray(loss_fn(p), jnp.float32))
+            hvp = jax.jit(lambda p, vec: jax.jvp(grad_fn, (p,), (vec,))[1])
+            self._hvp_cache[id(loss_fn)] = hvp
 
         eig = 0.0
         for i in range(self.max_iter):

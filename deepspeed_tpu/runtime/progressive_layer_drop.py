@@ -37,7 +37,10 @@ class ProgressiveLayerDrop:
         return (1.0 - self.theta) * jnp.exp(-self.gamma * t) + self.theta
 
     def update_state(self, global_step: int) -> None:
-        self.current_theta = float(self.get_theta(global_step))
+        # closed-form host math — no device dispatch on the hot path
+        import math
+
+        self.current_theta = (1.0 - self.theta) * math.exp(-self.gamma * int(global_step)) + self.theta
 
     def get_state(self) -> dict:
         return {"progressive_layer_drop": True, "pld_theta": self.current_theta}

@@ -58,13 +58,28 @@ class Quantizer:
 
     # -- application -------------------------------------------------------
     def _qdq_leaf(self, w: jnp.ndarray, bits: jnp.ndarray, key) -> jnp.ndarray:
-        groups = self.cfg.quantize_groups
-        if w.size % groups != 0:
-            logger.warning(
-                f"MoQ: tensor of {w.size} elements not divisible by quantize_groups="
-                f"{groups}; falling back to one scale group for this tensor"
-            )
-            groups = 1
+        # stacked (L, in, out) weights quantize per layer — scale groups
+        # must never straddle the layer boundary (a loud layer would
+        # crush its co-grouped neighbor's resolution)
+        g = self.cfg.quantize_groups
+        if w.ndim >= 3:
+            L = w.shape[0]
+            per_layer = w.size // L
+            if per_layer % g != 0:
+                logger.warning(
+                    f"MoQ: per-layer size {per_layer} not divisible by quantize_groups="
+                    f"{g}; using one scale group per layer for this tensor"
+                )
+                g = 1
+            groups = L * g
+        else:
+            if w.size % g != 0:
+                logger.warning(
+                    f"MoQ: tensor of {w.size} elements not divisible by quantize_groups="
+                    f"{g}; falling back to one scale group for this tensor"
+                )
+                g = 1
+            groups = g
         # bits is traced; the grouped quantizer computes 2.0**(bits-1)
         return grouped_qdq(
             w,

@@ -1,0 +1,150 @@
+"""Checkpoint save/load tests (reference tests/unit/test_checkpointing.py:
+14 cases across optimizer wrappers, latest-tag semantics, elastic resize).
+
+The headline TPU-native property: ONE sharded checkpoint serves every
+mesh — saving under mesh A and restoring under mesh B (different DP/FSDP
+or TP degree) reshards transparently, subsuming the reference's elastic
+ZeRO checkpoints (stage2.py:1828-2004) and MegatronSDLoader MP resize."""
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import gpt2
+
+TINY = dataclasses.replace(gpt2.GPT2_TINY, remat=False)
+
+
+def make_engine(mesh=None, stage=0, opt="Adam", fp16=False, seed=7, scheduler=None):
+    model_fn, init_fn, tp_fn = gpt2.make_model(TINY)
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": opt, "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": stage},
+        "steps_per_print": 1000,
+    }
+    if mesh:
+        config["mesh"] = mesh
+    if fp16:
+        config["fp16"] = {"enabled": True, "initial_scale_power": 8}
+    if scheduler:
+        config["scheduler"] = scheduler
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model_fn, model_parameters=init_fn(seed=seed), config=config, tp_spec_fn=tp_fn
+    )
+    return engine
+
+
+def batches(n, bs=16, seq=16, seed=3):
+    rng = np.random.default_rng(seed)
+    return [{"input_ids": rng.integers(0, TINY.vocab_size, (bs, seq), dtype=np.int32)} for _ in range(n)]
+
+
+def trajectory_match(e1, e2, batch):
+    l1 = float(e1.train_batch(batch))
+    l2 = float(e2.train_batch(batch))
+    assert abs(l1 - l2) < 1e-5, (l1, l2)
+
+
+@pytest.mark.parametrize("stage,opt", [(0, "Adam"), (2, "Adam"), (3, "AdamW"), (1, "Lamb")])
+def test_roundtrip_across_optimizer_wrappers(tmp_path, stage, opt):
+    eng = make_engine(stage=stage, opt=opt)
+    bs = batches(3)
+    eng.train_batch(bs[0])
+    eng.train_batch(bs[1])
+    eng.save_checkpoint(str(tmp_path), tag="ck")
+    eng2 = make_engine(stage=stage, opt=opt, seed=99)  # different init
+    path, _ = eng2.load_checkpoint(str(tmp_path), tag="ck")
+    assert path is not None
+    assert eng2.global_steps == 2
+    trajectory_match(eng, eng2, bs[2])
+
+
+def test_latest_tag_and_client_state(tmp_path):
+    eng = make_engine()
+    eng.train_batch(batches(1)[0])
+    eng.save_checkpoint(str(tmp_path), client_state={"epoch": 3, "note": "hi"})
+    eng.train_batch(batches(1)[0])
+    eng.save_checkpoint(str(tmp_path), client_state={"epoch": 4})
+    # latest file points at the newest tag
+    assert (tmp_path / "latest").read_text().strip() == "global_step2"
+    eng2 = make_engine(seed=1)
+    path, client = eng2.load_checkpoint(str(tmp_path))
+    assert path.endswith("global_step2") and client["epoch"] == 4
+    # explicit older tag still loads
+    eng3 = make_engine(seed=2)
+    _, client1 = eng3.load_checkpoint(str(tmp_path), tag="global_step1")
+    assert client1["epoch"] == 3 and eng3.global_steps == 1
+
+
+def test_missing_checkpoint_returns_none(tmp_path):
+    eng = make_engine()
+    path, client = eng.load_checkpoint(str(tmp_path / "nothing"))
+    assert path is None and client == {}
+
+
+def test_elastic_dp_resize(tmp_path):
+    """Save with fsdp=8 ZeRO-3, restore with fsdp=2×data=4 ZeRO-2 — the
+    orbax reshard replaces the reference's elastic-checkpoint machinery."""
+    eng = make_engine(mesh={"fsdp": 8, "data": 1}, stage=3)
+    bs = batches(3)
+    eng.train_batch(bs[0])
+    eng.train_batch(bs[1])
+    eng.save_checkpoint(str(tmp_path), tag="ck")
+
+    eng2 = make_engine(mesh={"fsdp": 2, "data": 4}, stage=2, seed=42)
+    eng2.load_checkpoint(str(tmp_path), tag="ck")
+    assert eng2.global_steps == 2
+    trajectory_match(eng, eng2, bs[2])
+
+
+def test_tp_resize(tmp_path):
+    """Save with model=2 TP, restore with model=4 (MegatronSDLoader
+    merge/split analog)."""
+    eng = make_engine(mesh={"model": 2, "data": 4})
+    bs = batches(3)
+    eng.train_batch(bs[0])
+    eng.save_checkpoint(str(tmp_path), tag="ck")
+    eng2 = make_engine(mesh={"model": 4, "data": 2}, seed=11)
+    eng2.load_checkpoint(str(tmp_path), tag="ck")
+    trajectory_match(eng, eng2, bs[1])
+
+
+def test_load_module_only(tmp_path):
+    eng = make_engine()
+    bs = batches(2)
+    eng.train_batch(bs[0])
+    eng.save_checkpoint(str(tmp_path), tag="ck")
+    eng2 = make_engine(seed=50)
+    eng2.load_checkpoint(str(tmp_path), tag="ck", load_module_only=True)
+    # params match but optimizer state/counters stay fresh
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(eng2.state["params"]["lnf_g"])),
+        np.asarray(jax.device_get(eng.state["params"]["lnf_g"])),
+        rtol=1e-6,
+    )
+    assert eng2.global_steps == 0
+
+
+def test_fp16_loss_scale_state_roundtrip(tmp_path):
+    eng = make_engine(fp16=True)
+    eng.train_batch(batches(1)[0])
+    scale_before = eng.loss_scale
+    eng.save_checkpoint(str(tmp_path), tag="ck")
+    eng2 = make_engine(fp16=True, seed=9)
+    eng2.load_checkpoint(str(tmp_path), tag="ck")
+    assert eng2.loss_scale == scale_before
+
+
+def test_lr_scheduler_position_restored(tmp_path):
+    sched = {"type": "WarmupLR", "params": {"warmup_max_lr": 0.1, "warmup_num_steps": 10}}
+    eng = make_engine(scheduler=sched)
+    for b in batches(3):
+        eng.train_batch(b)
+    lr_before = eng.get_lr()[0]
+    eng.save_checkpoint(str(tmp_path), tag="ck")
+    eng2 = make_engine(scheduler=sched, seed=3)
+    eng2.load_checkpoint(str(tmp_path), tag="ck")
+    assert eng2.get_lr()[0] == lr_before  # schedule is a pure fn of step

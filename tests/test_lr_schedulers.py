@@ -1,0 +1,74 @@
+"""LR schedule tests (reference tests/unit/test_lr_schedulers.py)."""
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (
+    LRScheduler,
+    get_lr_schedule,
+    one_cycle_momentum,
+)
+
+
+def _vals(sched, steps):
+    return [float(sched(s)) for s in steps]
+
+
+def test_warmup_lr_log_and_linear():
+    log_s = get_lr_schedule("WarmupLR", {"warmup_min_lr": 0.0, "warmup_max_lr": 0.1, "warmup_num_steps": 100, "warmup_type": "log"})
+    lin_s = get_lr_schedule("WarmupLR", {"warmup_min_lr": 0.0, "warmup_max_lr": 0.1, "warmup_num_steps": 100, "warmup_type": "linear"})
+    for s in (log_s, lin_s):
+        assert float(s(0)) <= 1e-3
+        assert abs(float(s(100)) - 0.1) < 1e-7
+        assert abs(float(s(10_000)) - 0.1) < 1e-7  # holds after warmup
+        v = _vals(s, range(0, 101, 10))
+        assert all(b >= a for a, b in zip(v, v[1:]))  # monotone ramp
+    # log ramps faster early
+    assert float(log_s(10)) > float(lin_s(10))
+
+
+def test_warmup_decay_lr():
+    s = get_lr_schedule("WarmupDecayLR", {"total_num_steps": 1000, "warmup_max_lr": 0.1, "warmup_num_steps": 100})
+    assert abs(float(s(100)) - 0.1) < 1e-7
+    assert abs(float(s(550)) - 0.05) < 1e-3  # halfway through decay
+    assert float(s(1000)) < 1e-7
+    assert float(s(2000)) == 0.0  # clamps at zero past the end
+
+
+def test_lr_range_test():
+    s = get_lr_schedule("LRRangeTest", {"lr_range_test_min_lr": 1e-4, "lr_range_test_step_size": 10, "lr_range_test_step_rate": 1.0})
+    assert abs(float(s(0)) - 1e-4) < 1e-9
+    assert float(s(100)) > float(s(50)) > float(s(0))
+    stair = get_lr_schedule("LRRangeTest", {"lr_range_test_min_lr": 1e-4, "lr_range_test_step_size": 10, "lr_range_test_step_rate": 1.0, "lr_range_test_staircase": True})
+    assert float(stair(5)) == float(stair(9))  # flat within a stair
+    assert float(stair(10)) > float(stair(9))
+
+
+def test_one_cycle_lr_and_momentum():
+    params = {"cycle_min_lr": 0.01, "cycle_max_lr": 0.1, "cycle_first_step_size": 100, "decay_lr_rate": 0.001, "decay_step_size": 10}
+    s = get_lr_schedule("OneCycle", params)
+    assert abs(float(s(0)) - 0.01) < 1e-7
+    assert abs(float(s(100)) - 0.1) < 1e-3  # peak at end of first leg
+    assert abs(float(s(200)) - 0.01) < 2e-3  # back to min after second leg
+    assert float(s(1000)) < 0.01  # post-cycle decay
+    m = one_cycle_momentum(cycle_min_mom=0.8, cycle_max_mom=0.9, cycle_first_step_size=100)
+    assert abs(float(m(0)) - 0.9) < 1e-6  # momentum moves inversely
+    assert abs(float(m(100)) - 0.8) < 1e-3
+    assert abs(float(m(200)) - 0.9) < 1e-3
+
+
+def test_unknown_schedule_raises():
+    with pytest.raises(ValueError, match="Unknown lr schedule"):
+        get_lr_schedule("CosineAnnealingWarmRestarts", {})
+
+
+def test_scheduler_object_api():
+    s = get_lr_schedule("WarmupLR", {"warmup_max_lr": 0.1, "warmup_num_steps": 10})
+    sched = LRScheduler(s)
+    for _ in range(5):
+        sched.step()
+    lr5 = sched.get_lr()[0]
+    sd = sched.state_dict()
+    sched2 = LRScheduler(s)
+    sched2.load_state_dict(sd)
+    assert sched2.get_lr()[0] == lr5
+    assert sched2.last_batch_iteration == sched.last_batch_iteration

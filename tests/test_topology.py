@@ -1,0 +1,60 @@
+"""Process topology tests (reference tests/unit/test_topology.py — pure
+logic, no devices)."""
+import pytest
+
+from deepspeed_tpu.comm.topology import (
+    PipeDataParallelTopology,
+    PipeModelDataParallelTopology,
+    ProcessTopology,
+)
+
+
+def test_rank_coord_roundtrip():
+    topo = ProcessTopology(axes=["pipe", "data"], dims=[2, 3])
+    assert topo.world_size == 6
+    # last axis varies fastest
+    assert topo.get_rank(pipe=0, data=0) == 0
+    assert topo.get_rank(pipe=0, data=2) == 2
+    assert topo.get_rank(pipe=1, data=0) == 3
+    for r in range(6):
+        c = topo.get_coord(r)
+        assert topo.get_rank(pipe=c.pipe, data=c.data) == r
+
+
+def test_rank_validation():
+    topo = ProcessTopology(axes=["a", "b"], dims=[2, 2])
+    with pytest.raises(ValueError):
+        topo.get_rank(a=0)  # missing axis
+    with pytest.raises(ValueError):
+        topo.get_rank(a=5, b=0)  # out of range
+    with pytest.raises(ValueError):
+        ProcessTopology(axes=["a"], dims=[2, 3])
+
+
+def test_axis_comm_lists():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    assert topo.world_size == 8
+    dp_lists = topo.get_axis_comm_lists("data")
+    assert len(dp_lists) == 4 and all(len(l) == 2 for l in dp_lists)
+    # every rank appears exactly once across the data groups
+    flat = sorted(r for l in dp_lists for r in l)
+    assert flat == list(range(8))
+    pipe_lists = topo.get_axis_comm_lists("pipe")
+    assert len(pipe_lists) == 4
+    # comm lists for a missing axis are empty
+    assert topo.get_axis_comm_lists("expert") == []
+
+
+def test_filter_match_and_axis_list():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=4)
+    stage0 = topo.filter_match(pipe=0)
+    assert stage0 == [0, 1, 2, 3]
+    assert topo.get_axis_list("data", 1) == [1, 5]
+    assert topo.get_dim("pipe") == 2 and topo.get_dim("bogus") == 0
+
+
+def test_rank_repr():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=1)
+    # data/pipe omitted by default → only the model coord shows
+    assert topo.get_rank_repr(0) == "model_00"
+    assert topo.get_rank_repr(1) == "model_01"
