@@ -67,7 +67,7 @@ def mha_reference(
 # Pallas forward kernel
 # ---------------------------------------------------------------------------
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float, causal: bool, block_k: int):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float, causal: bool, block_k: int):
     block_q, d = q_ref.shape[1], q_ref.shape[2]
     seq_k = k_ref.shape[1]
     seq_q_total = pl.num_programs(1) * block_q
@@ -111,8 +111,12 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float, causal: bo
         jnp.zeros((block_q, 1), jnp.float32),
     )
     acc, m, l = jax.lax.fori_loop(0, hi, body, init)
+    lse = jnp.where(l[:, 0] == 0.0, jnp.inf, m[:, 0] + jnp.log(jnp.maximum(l[:, 0], 1e-37)))
     l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows
     o_ref[0] = (acc / l).astype(o_ref.dtype)
+    # per-row logsumexp of the SCALED scores (bwd input); stored with an
+    # 8-sublane broadcast dim to satisfy TPU block-layout constraints
+    lse_ref[0] = jnp.broadcast_to(lse[None, :], (8, lse.shape[0]))
 
 
 def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: int, interpret: bool):
@@ -127,7 +131,7 @@ def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float, block_q: int, bloc
     assert sq % block_q == 0 and sk % block_k == 0, (sq, sk, block_q, block_k)
 
     grid = (bh, sq // block_q)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(_flash_fwd_kernel, sm_scale=sm_scale, causal=causal, block_k=block_k),
         grid=grid,
         in_specs=[
@@ -135,11 +139,17 @@ def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float, block_q: int, bloc
             pl.BlockSpec((1, sk, d), lambda bh_, qi: (bh_, 0, 0)),
             pl.BlockSpec((1, sk, d), lambda bh_, qi: (bh_, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda bh_, qi: (bh_, 0, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 8, sq), jnp.float32),
+        ],
         interpret=interpret,
     )(qr, kr, vr)
-    return out.reshape(b, h, sq, d)
+    return out.reshape(b, h, sq, d), lse[:, 0, :].reshape(b, h, sq)
 
 
 # ---------------------------------------------------------------------------
@@ -188,23 +198,167 @@ def _blockwise_xla(q, k, v, causal: bool, sm_scale: float, block_k: int):
 
 
 # ---------------------------------------------------------------------------
+# Pallas backward kernels (FlashAttention-2 style)
+#
+# With S = QKᵀ·sc, P = exp(S − lse), Δ = rowsum(dO ∘ O):
+#   dV = Pᵀ dO
+#   dS = P ∘ (dO Vᵀ − Δ)
+#   dQ = dS K · sc          dK = dSᵀ Q · sc
+# Both kernels recompute P from (Q, K, lse) — O(seq) memory like the
+# forward; the fwd saves only O and the per-row logsumexp.
+# ---------------------------------------------------------------------------
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, sm_scale, causal, block_k):
+    block_q, d = q_ref.shape[1], q_ref.shape[2]
+    seq_k = k_ref.shape[1]
+    seq_q_total = pl.num_programs(1) * block_q
+    q_idx = pl.program_id(1)
+    causal_offset = seq_k - seq_q_total
+
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0, :][:, None]
+    delta = delta_ref[0, 0, :][:, None]
+
+    num_kv = seq_k // block_k
+    if causal:
+        q_end = causal_offset + (q_idx + 1) * block_q
+        hi = jnp.clip(jax.lax.div(q_end + block_k - 1, block_k), 0, num_kv)
+    else:
+        hi = num_kv
+
+    def body(i, dq):
+        k = k_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = causal_offset + q_idx * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = i * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
+        p = jnp.exp(s - lse)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, sm_scale, causal, block_q):
+    block_k, d = k_ref.shape[1], k_ref.shape[2]
+    seq_q = q_ref.shape[1]
+    seq_k_total = pl.num_programs(1) * block_k
+    kv_idx = pl.program_id(1)
+    causal_offset = seq_k_total - seq_q
+
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+
+    num_q = seq_q // block_q
+    if causal:
+        # first q block whose end position reaches this kv block's start
+        k_start = kv_idx * block_k
+        lo = jnp.clip(jax.lax.div(k_start - causal_offset, block_q), 0, num_q)
+    else:
+        lo = 0
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.dslice(i * block_q, block_q)][:, None]
+        delta = delta_ref[0, 0, pl.dslice(i * block_q, block_q)][:, None]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = causal_offset + i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = kv_idx * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
+        p = jnp.exp(s - lse)
+        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        return dk, dv
+
+    init = (jnp.zeros((block_k, d), jnp.float32), jnp.zeros((block_k, d), jnp.float32))
+    dk, dv = jax.lax.fori_loop(lo, num_q, body, init)
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bh = b * h
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    qr, kr, vr = (t.reshape(bh, t.shape[2], d) for t in (q, k, v))
+    dor = g.reshape(bh, sq, d)
+    # 8-sublane broadcast layout (TPU block constraint: last two dims
+    # must be 8/128-aligned or full)
+    lser = jnp.broadcast_to(lse.reshape(bh, 1, sq), (bh, 8, sq))
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta.reshape(bh, 1, sq), (bh, 8, sq))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, sm_scale=sm_scale, causal=causal, block_k=block_k),
+        grid=(bh, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh_, qi: (bh_, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh_, qi: (bh_, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda bh_, qi: (bh_, 0, qi)),
+            pl.BlockSpec((1, 8, block_q), lambda bh_, qi: (bh_, 0, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q),
+        grid=(bh, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, sq, d), lambda bh_, ki: (bh_, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, ki: (bh_, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, ki: (bh_, ki, 0)),
+            pl.BlockSpec((1, sq, d), lambda bh_, ki: (bh_, 0, 0)),
+            pl.BlockSpec((1, 8, sq), lambda bh_, ki: (bh_, 0, 0)),
+            pl.BlockSpec((1, 8, sq), lambda bh_, ki: (bh_, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh_, ki: (bh_, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, ki: (bh_, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, delta)
+
+    return dq.reshape(q.shape), dk.reshape(k.shape), dv.reshape(v.shape)
+
+
+# ---------------------------------------------------------------------------
 # Public API with custom VJP
 # ---------------------------------------------------------------------------
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash_attention(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    return _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    return _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k, interpret)[0]
 
 
 def _flash_fwd_rule(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    out = _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q_, k_, v_: _blockwise_xla(q_, k_, v_, causal, sm_scale, block_k), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_bwd_pallas(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret)
 
 
 _flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)

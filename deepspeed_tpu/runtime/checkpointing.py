@@ -164,7 +164,10 @@ def load_checkpoint(
             sd = client_state.get("__lr_scheduler__")
             if sd:
                 engine.client_lr_scheduler.load_state_dict(sd)
-    log_dist(f"loaded checkpoint {path} (global_step={int(engine.state['global_step'])})")
+    # reconcile the engine's host-side step mirrors with the restored state
+    engine._host_global_step = int(engine.state["global_step"])
+    engine._host_micro_step = int(engine.state["micro_step"])
+    log_dist(f"loaded checkpoint {path} (global_step={engine._host_global_step})")
     return path, client_state
 
 

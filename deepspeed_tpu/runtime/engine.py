@@ -50,6 +50,17 @@ from deepspeed_tpu.utils.timer import (
 MEMORY_OPT_ALLREDUCE_SIZE = 500_000_000
 
 
+class _PlacedBatch:
+    """Explicit marker for batches already stacked + device-placed by
+    ``engine.prefetch_loader`` — lets ``train_batch`` skip re-placement
+    without guessing from shapes."""
+
+    __slots__ = ("tree",)
+
+    def __init__(self, tree: Any):
+        self.tree = tree
+
+
 def _global_norm(tree: Any) -> jnp.ndarray:
     leaves = [jnp.sum(jnp.square(g.astype(jnp.float32))) for g in jax.tree.leaves(tree)]
     return jnp.sqrt(sum(leaves)) if leaves else jnp.zeros((), jnp.float32)
@@ -218,7 +229,15 @@ class DeepSpeedEngine:
 
         # -- host-side bookkeeping ----------------------------------------
         from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
+        from deepspeed_tpu.utils.monitor import TensorBoardMonitor
 
+        self.monitor = TensorBoardMonitor(
+            output_path=config.tensorboard.output_path,
+            job_name=config.tensorboard.job_name,
+            enabled=config.tensorboard.enabled,
+            rank=self.global_rank,
+        )
+        self._last_loss = None
         self.flops_profiler = FlopsProfiler(config.flops_profiler, engine=self)
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
@@ -229,6 +248,13 @@ class DeepSpeedEngine:
         self._compiled = {}
         self._train_step_cost: Dict[str, float] = {}
         self.skipped_steps = 0
+        # Host-side mirror of state["global_step"].  Reading the device
+        # scalar costs a full host<->device round trip (on a remote/
+        # tunneled TPU that is ~100ms), so the hot path must never sync
+        # on it; the mirror advances with every non-skipped step and is
+        # reconciled from the device value at checkpoint load.
+        self._host_global_step = 0
+        self._host_micro_step = 0
 
         log_dist(
             f"engine: zero_stage={self.zero_stage} dtype={self.compute_dtype.__name__} "
@@ -332,11 +358,11 @@ class DeepSpeedEngine:
 
     @property
     def global_steps(self) -> int:
-        return int(self.state["global_step"])
+        return self._host_global_step
 
     @property
     def micro_steps(self) -> int:
-        return int(self.state["micro_step"])
+        return self._host_micro_step
 
     @property
     def loss_scale(self) -> float:
@@ -347,10 +373,10 @@ class DeepSpeedEngine:
         return self._model_fn
 
     def get_lr(self):
-        return [float(self.lr_schedule(self.state["global_step"]))]
+        return [float(self.lr_schedule(self._host_global_step))]
 
     def is_gradient_accumulation_boundary(self) -> bool:
-        return int(self.state["micro_step"]) % self.gradient_accumulation_steps == 0
+        return self._host_micro_step % self.gradient_accumulation_steps == 0
 
     # ------------------------------------------------------------------
     # core compiled steps
@@ -464,9 +490,9 @@ class DeepSpeedEngine:
         _, grad_norm, overflow = host_unscale_clip_and_check(
             leaves, scale, self.config.gradient_clipping
         )
-        lr = float(self.lr_schedule(self.state["global_step"]))
+        lr = float(self.lr_schedule(self._host_global_step))
         if not (overflow and self.loss_scaler.dynamic):
-            step_count = int(self.state["global_step"]) + 1
+            step_count = self._host_global_step + 1
             masters = self._host_opt.step(
                 jax.tree.unflatten(jax.tree.structure(g_np), leaves), lr, step_count
             )
@@ -476,6 +502,7 @@ class DeepSpeedEngine:
                 self._state_shardings["params"],
             )
             self.state["global_step"] = self.state["global_step"] + 1
+            self._host_global_step += 1
         self.state["loss_scale"] = self.loss_scaler.update(
             self.state["loss_scale"], jnp.asarray(overflow)
         )
@@ -488,6 +515,40 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # public training API
     # ------------------------------------------------------------------
+    def _stacked_sharding(self, ndim_stacked: int):
+        return self._sh(
+            P(*([None] + list(batch_pspec(ndim_stacked - 1, seq_sharded=self.mesh_info.seq_parallel_world_size > 1))))
+        )
+
+    def _stack_and_place(self, batch: Any) -> Any:
+        """Reshape a flat (gas·mb, ...) batch to (gas, mb, ...) and place
+        it with the engine's batch sharding.  Batches already processed
+        (wrapped in ``_PlacedBatch`` by ``prefetch_loader``) unwrap and
+        pass straight through — no shape heuristics."""
+        if isinstance(batch, _PlacedBatch):
+            return batch.tree
+        gas = self.gradient_accumulation_steps
+
+        def one(x):
+            x = np.asarray(x) if not isinstance(x, (jax.Array, np.ndarray)) else x
+            mb = x.shape[0] // gas
+            x = x.reshape((gas, mb) + x.shape[1:])
+            return jax.device_put(x, self._stacked_sharding(np.ndim(x)))
+
+        return jax.tree.map(one, batch)
+
+    def prefetch_loader(self, loader, prefetch_depth: int = 2):
+        """Wrap a host batch iterator so stacking + device placement run
+        ahead in a worker thread (runtime/dataloader.py
+        ``DevicePrefetchLoader``); feed the result to ``train_batch``."""
+        from deepspeed_tpu.runtime.dataloader import DevicePrefetchLoader
+
+        return DevicePrefetchLoader(
+            loader,
+            prefetch_depth=prefetch_depth,
+            transform=lambda b: _PlacedBatch(self._stack_and_place(b)),
+        )
+
     def _prepare_batch(self, batch: Any) -> Any:
         def put(x):
             x = np.asarray(x) if not isinstance(x, (jax.Array, np.ndarray)) else x
@@ -508,6 +569,7 @@ class DeepSpeedEngine:
         batch = self._prepare_batch(batch)
         fn = self._get_compiled("micro_step", self._micro_step_impl)
         self.state, loss = fn(self.state, batch)
+        self._host_micro_step += 1
         self._cached_loss = loss
         if self.wall_clock_breakdown:
             self.timers(FORWARD_TIMER).stop(sync_token=loss)
@@ -538,9 +600,14 @@ class DeepSpeedEngine:
             else:
                 fn = self._get_compiled("apply_step", self._apply_step_impl)
                 self.state, info = fn(self.state)
-            if self.loss_scaler.dynamic and bool(info["overflow"]):
-                self.skipped_steps += 1
-                log_dist(f"step skipped on overflow; loss scale -> {self.loss_scale}")
+            if self.loss_scaler.dynamic:
+                if bool(info["overflow"]):
+                    self.skipped_steps += 1
+                    log_dist(f"step skipped on overflow; loss scale -> {self.loss_scale}")
+                elif not self._offload:
+                    self._host_global_step += 1
+            elif not self._offload:
+                self._host_global_step += 1
             self._maybe_report_progress()
         if self.wall_clock_breakdown:
             self.timers(STEP_TIMER).stop(sync_token=self.state["global_step"])
@@ -552,23 +619,13 @@ class DeepSpeedEngine:
 
         ``batch`` leaves must have leading dim ``gas * micro_batch`` (one
         full train_batch worth of per-replica samples) or ``micro_batch``
-        (gas==1).
+        (gas==1).  Batches already stacked/placed by
+        ``prefetch_loader()`` pass through untouched (no re-put — on
+        remote TPU backends ``device_put`` is a synchronous host RPC and
+        must stay off the hot path).
         """
         self.tput_timer.start()
-        gas = self.gradient_accumulation_steps
-        batch = jax.tree.map(lambda x: np.asarray(x) if not isinstance(x, jax.Array) else x, batch)
-
-        def stack(x):
-            mb = x.shape[0] // gas
-            return x.reshape((gas, mb) + x.shape[1:])
-
-        stacked = jax.tree.map(stack, batch)
-        stacked = jax.tree.map(
-            lambda x: jax.device_put(
-                x, self._sh(P(*([None] + list(batch_pspec(np.ndim(x) - 1, seq_sharded=self.mesh_info.seq_parallel_world_size > 1)))))
-            ),
-            stacked,
-        )
+        stacked = self._stack_and_place(batch)
 
         tb_key = ("train_batch", tuple(np.shape(x) for x in jax.tree.leaves(stacked)))
         if tb_key not in self._compiled:
@@ -612,7 +669,7 @@ class DeepSpeedEngine:
                 self._train_step_cost = {k: float(v) for k, v in cost.items() if np.isscalar(v)}
             except Exception:
                 self._train_step_cost = {}
-        profile_step = int(self.state["global_step"]) + 1
+        profile_step = self._host_global_step + 1
         self.flops_profiler.start_step(profile_step)
         if self._offload:
             self.state, loss = self._compiled[tb_key](self.state, stacked)
@@ -620,10 +677,17 @@ class DeepSpeedEngine:
         else:
             self.state, loss, info = self._compiled[tb_key](self.state, stacked)
         self.flops_profiler.end_step(profile_step, cost=self._train_step_cost, sync_token=loss)
+        self._last_loss = loss
         # host sync on the overflow flag only when dynamic scaling is live
-        if self.loss_scaler.dynamic and bool(info["overflow"]):
-            self.skipped_steps += 1
-            log_dist(f"step skipped on overflow; loss scale -> {self.loss_scale}")
+        if self.loss_scaler.dynamic:
+            if bool(info["overflow"]):
+                self.skipped_steps += 1
+                log_dist(f"step skipped on overflow; loss scale -> {self.loss_scale}")
+            elif not self._offload:
+                self._host_global_step += 1
+        elif not self._offload:
+            self._host_global_step += 1
+        self._host_micro_step += self.gradient_accumulation_steps
         self.tput_timer.stop(sync_token=loss)
         self._maybe_report_progress()
         return loss
@@ -653,13 +717,24 @@ class DeepSpeedEngine:
         return self._compiled["predict"](self.state, batch)
 
     def _maybe_report_progress(self):
-        step = int(self.state["global_step"])
+        step = self._host_global_step
         if self.quantizer is not None:
             self.quantizer.maybe_log(step)
         if self.progressive_layer_drop is not None:
             self.progressive_layer_drop.update_state(step)
         if step > 0 and step % self.config.steps_per_print == 0:
             log_dist(f"step={step} lr={self.get_lr()[0]:.3e} loss_scale={self.loss_scale:.1f}")
+            if self.monitor.enabled:
+                # reference tags (engine.py:1178-1188, :1356-1382)
+                samples = int(self.state["global_samples"])
+                events = [
+                    (f"Train/Samples/lr", self.get_lr()[0]),
+                    (f"Train/Samples/loss_scale", self.loss_scale),
+                ]
+                if self._last_loss is not None:
+                    events.append((f"Train/Samples/train_loss", float(self._last_loss)))
+                self.monitor.write_events(events, samples)
+                self.monitor.flush()
 
     # ------------------------------------------------------------------
     # checkpointing (engine.save_checkpoint, reference :1854)

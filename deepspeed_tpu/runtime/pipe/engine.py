@@ -274,9 +274,15 @@ class PipelineEngine(DeepSpeedEngine):
             self._compiled["pipe_train"] = jax.jit(full_step, donate_argnums=(0,))
 
         self.state, loss, info = self._compiled["pipe_train"](self.state, full)
-        if self.loss_scaler.dynamic and bool(info["overflow"]):
-            self.skipped_steps += 1
-            log_dist(f"step skipped on overflow; loss scale -> {self.loss_scale}")
+        if self.loss_scaler.dynamic:
+            if bool(info["overflow"]):
+                self.skipped_steps += 1
+                log_dist(f"step skipped on overflow; loss scale -> {self.loss_scale}")
+            else:
+                self._host_global_step += 1
+        else:
+            self._host_global_step += 1
+        self._host_micro_step += self._micro_batches
         self.tput_timer.stop(sync_token=loss)
         self._maybe_report_progress()
         return loss

@@ -120,6 +120,8 @@ class ThroughputTimer:
         self.steps_per_output = steps_per_output
         self.monitor_memory = monitor_memory
         self.logging = logging_fn or (lambda msg: log_dist(msg))
+        self._window_start = 0.0
+        self._window_step0 = 0
 
     def update_epoch_count(self) -> None:
         self.epoch_count += 1
@@ -140,17 +142,28 @@ class ThroughputTimer:
         self.micro_step_count += 1
         self.global_step_count += 1
         if self.start_time > 0:
-            _sync(sync_token)
+            # sync only on reporting steps: a per-step device sync costs a
+            # full host<->device round trip and defeats async dispatch
+            # (the telescoped sum across a report window stays correct)
+            will_report = report_speed and self.global_step_count % self.steps_per_output == 0
+            if will_report:
+                _sync(sync_token)
             self.end_time = time.time()
             duration = self.end_time - self.start_time
             self.total_elapsed_time += duration
-            if report_speed and self.global_step_count % self.steps_per_output == 0:
+            if will_report:
+                # "current" speed over the whole report window — per-step
+                # durations are meaningless without per-step syncs
+                window = self.end_time - self._window_start if self._window_start else duration
+                window_steps = self.global_step_count - self._window_step0
                 self.logging(
                     f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
                     f"global_step={self.global_step_count}, "
                     f"RunningAvgSamplesPerSec={self.avg_samples_per_sec():.3f}, "
-                    f"CurrSamplesPerSec={self.batch_size / duration:.3f}"
+                    f"CurrSamplesPerSec={self.batch_size * window_steps / max(window, 1e-9):.3f}"
                 )
+                self._window_start = self.end_time
+                self._window_step0 = self.global_step_count
 
     def avg_samples_per_sec(self) -> float:
         if self.global_step_count > self.start_step and self.total_elapsed_time > 0:
