@@ -44,9 +44,14 @@ class GPT2Config:
     dropout: float = 0.0
     layer_norm_epsilon: float = 1e-5
     use_flash_attention: bool = True
-    # "flash" | "ring" | "ulysses" — ring/ulysses run sequence-parallel
-    # over the mesh's `seq` axis (parallel/sequence.py)
+    # "flash" | "ring" | "ulysses" | "sparse" — ring/ulysses run
+    # sequence-parallel over the mesh's `seq` axis (parallel/sequence.py);
+    # sparse uses the block-sparse kernel with `sparsity_config`
+    # (default: unidirectional BigBird), the reference's long-sequence
+    # recipe (SURVEY §5.7)
     attention_mode: str = "flash"
+    # a SparsityConfig instance (ops/attention/sparse.py); None ⇒ BigBird
+    sparsity_config: Any = None
     # MoE: >0 replaces every block's FFN with an n_experts MoE layer
     # (experts sharded over the `expert` mesh axis, moe/layer.py)
     n_experts: int = 0
@@ -167,6 +172,37 @@ def tp_spec_fn(path: str, shape) -> Optional[P]:
     return None
 
 
+# per-(config-values, seq) layout cache: layouts are static numpy, built once
+_SPARSE_LAYOUTS: Dict[Any, Any] = {}
+
+
+def _sparsity_cache_key(sc, T: int):
+    # value-based key (id() would collide after gc and never hit for
+    # per-call default configs)
+    vals = tuple(
+        (k, tuple(v) if isinstance(v, list) else v)
+        for k, v in sorted(vars(sc).items())
+        if isinstance(v, (int, float, str, bool, list, tuple, type(None)))
+    )
+    return (type(sc).__name__, vals, T)
+
+
+def _sparse_attn(cfg: GPT2Config, q, k, v, T: int):
+    from deepspeed_tpu.ops.attention.sparse import BigBirdSparsityConfig, block_sparse_attention
+
+    sc = cfg.sparsity_config
+    if sc is None:
+        block = 64 if T % 64 == 0 else 16
+        sc = BigBirdSparsityConfig(
+            num_heads=cfg.n_head, block=block, num_random_blocks=1,
+            num_sliding_window_blocks=3, num_global_blocks=1, attention="unidirectional",
+        )
+    key = _sparsity_cache_key(sc, T)
+    if key not in _SPARSE_LAYOUTS:
+        _SPARSE_LAYOUTS[key] = sc.make_layout(T)
+    return block_sparse_attention(q, k, v, _SPARSE_LAYOUTS[key], sc.block, causal=True)
+
+
 def _block(cfg: GPT2Config, x, lp, rng, deterministic: bool, token_mask=None):
     """One transformer block; ``lp`` holds this layer's slice of the
     stacked params."""
@@ -192,8 +228,10 @@ def _block(cfg: GPT2Config, x, lp, rng, deterministic: bool, token_mask=None):
         from deepspeed_tpu.parallel.sequence import ulysses_attention
 
         attn = ulysses_attention(q, k, v, causal=True, use_flash=cfg.use_flash_attention)
+    elif cfg.attention_mode == "sparse":
+        attn = _sparse_attn(cfg, q, k, v, T)
     elif cfg.attention_mode != "flash":
-        raise ValueError(f"unknown attention_mode {cfg.attention_mode!r} (flash|ring|ulysses)")
+        raise ValueError(f"unknown attention_mode {cfg.attention_mode!r} (flash|ring|ulysses|sparse)")
     elif cfg.use_flash_attention and T >= 128:
         attn = flash_attention(q, k, v, causal=True)
     else:

@@ -67,7 +67,7 @@ def mha_reference(
 # Pallas forward kernel
 # ---------------------------------------------------------------------------
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float, causal: bool, block_k: int):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, sm_scale: float, causal: bool, block_k: int):
     block_q, d = q_ref.shape[1], q_ref.shape[2]
     seq_k = k_ref.shape[1]
     seq_q_total = pl.num_programs(1) * block_q
@@ -114,12 +114,14 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float, c
     lse = jnp.where(l[:, 0] == 0.0, jnp.inf, m[:, 0] + jnp.log(jnp.maximum(l[:, 0], 1e-37)))
     l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows
     o_ref[0] = (acc / l).astype(o_ref.dtype)
-    # per-row logsumexp of the SCALED scores (bwd input); stored with an
-    # 8-sublane broadcast dim to satisfy TPU block-layout constraints
-    lse_ref[0] = jnp.broadcast_to(lse[None, :], (8, lse.shape[0]))
+    if maybe_lse_ref:
+        # per-row logsumexp of the SCALED scores (bwd input); stored with
+        # an 8-sublane broadcast dim for TPU block-layout constraints.
+        # Omitted on the inference-only path (no grad → no buffer).
+        maybe_lse_ref[0][0] = jnp.broadcast_to(lse[None, :], (8, lse.shape[0]))
 
 
-def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: int, interpret: bool):
+def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: int, interpret: bool, want_lse: bool = True):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bh = b * h
@@ -131,22 +133,26 @@ def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float, block_q: int, bloc
     assert sq % block_q == 0 and sk % block_k == 0, (sq, sk, block_q, block_k)
 
     grid = (bh, sq // block_q)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0)),
+        pl.BlockSpec((1, sk, d), lambda bh_, qi: (bh_, 0, 0)),
+        pl.BlockSpec((1, sk, d), lambda bh_, qi: (bh_, 0, 0)),
+    ]
+    o_spec = pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0))
+    o_shape = jax.ShapeDtypeStruct((bh, sq, d), q.dtype)
+    kern = functools.partial(_flash_fwd_kernel, sm_scale=sm_scale, causal=causal, block_k=block_k)
+    if not want_lse:
+        # inference/eval path: skip the logsumexp output entirely
+        out = pl.pallas_call(
+            kern, grid=grid, in_specs=in_specs, out_specs=o_spec, out_shape=o_shape, interpret=interpret
+        )(qr, kr, vr)
+        return out.reshape(b, h, sq, d), None
     out, lse = pl.pallas_call(
-        functools.partial(_flash_fwd_kernel, sm_scale=sm_scale, causal=causal, block_k=block_k),
+        kern,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0)),
-            pl.BlockSpec((1, sk, d), lambda bh_, qi: (bh_, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda bh_, qi: (bh_, 0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0)),
-            pl.BlockSpec((1, 8, block_q), lambda bh_, qi: (bh_, 0, qi)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, 8, sq), jnp.float32),
-        ],
+        in_specs=in_specs,
+        out_specs=[o_spec, pl.BlockSpec((1, 8, block_q), lambda bh_, qi: (bh_, 0, qi))],
+        out_shape=[o_shape, jax.ShapeDtypeStruct((bh, 8, sq), jnp.float32)],
         interpret=interpret,
     )(qr, kr, vr)
     return out.reshape(b, h, sq, d), lse[:, 0, :].reshape(b, h, sq)
@@ -348,7 +354,8 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash_attention(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    return _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k, interpret)[0]
+    # non-differentiated primal (inference/eval): no lse buffer
+    return _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k, interpret, want_lse=False)[0]
 
 
 def _flash_fwd_rule(q, k, v, causal, sm_scale, block_q, block_k, interpret):

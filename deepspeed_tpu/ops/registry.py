@@ -80,6 +80,7 @@ def all_ops() -> Dict[str, OpSpec]:
         "deepspeed_tpu.ops.transformer.transformer",
         "deepspeed_tpu.ops.transformer.inference",
         "deepspeed_tpu.ops.attention.sparse",
+        "deepspeed_tpu.ops.utils_op",
     ):
         try:
             __import__(mod)

@@ -1,0 +1,40 @@
+"""flatten/unflatten shim.
+
+Reference: ``csrc/utils/flatten_unflatten.cpp`` exposing torch's
+``_flatten_dense_tensors`` (loaded at engine init, engine.py:222-225).
+XLA owns memory layout on TPU, so a native kernel is unnecessary
+(SURVEY §2.3: "keep API shim") — these are the same contiguous
+pack/unpack semantics over jnp arrays for code that used the op
+directly.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.registry import register_op
+
+
+def flatten(tensors: Sequence[Any]) -> jnp.ndarray:
+    """Pack a list of arrays into one contiguous 1-D buffer."""
+    return jnp.concatenate([jnp.ravel(jnp.asarray(t)) for t in tensors]) if tensors else jnp.zeros((0,))
+
+
+def unflatten(flat: jnp.ndarray, tensors: Sequence[Any]) -> List[jnp.ndarray]:
+    """Slice a flat buffer back into the shapes of ``tensors``."""
+    outs, offset = [], 0
+    for t in tensors:
+        shape = jnp.shape(t)
+        n = 1
+        for s in shape:
+            n *= int(s)
+        outs.append(flat[offset : offset + n].reshape(shape))
+        offset += n
+    return outs
+
+
+@register_op("utils", "xla", "flatten/unflatten contiguous packing (csrc/utils shim; XLA owns layout)")
+def _load_utils():
+    return {"flatten": flatten, "unflatten": unflatten}

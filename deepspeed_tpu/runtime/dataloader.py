@@ -172,4 +172,7 @@ class DevicePrefetchLoader:
                 yield out.result()
 
     def __len__(self):
-        return len(self.loader)
+        try:
+            return len(self.loader)
+        except TypeError:
+            raise TypeError("wrapped loader is a generator with no len()") from None

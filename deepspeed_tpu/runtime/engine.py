@@ -589,6 +589,14 @@ class DeepSpeedEngine:
         self._cached_loss = None
         return loss
 
+    def allreduce_gradients(self, bucket_size: int = MEMORY_OPT_ALLREDUCE_SIZE) -> None:
+        """Reference API shim (engine.py:1147).  Gradient reduction is
+        in-graph here: ``psum``/``psum_scatter`` over the data/fsdp axes
+        are inserted by GSPMD from the grad sharding constraints
+        (zero/stages.py) — there is nothing to launch from the host, and
+        bucketing/overlap are XLA scheduler decisions."""
+        return None
+
     def step(self) -> None:
         """Apply the optimizer step at the gradient-accumulation boundary
         (reference engine.step, :1318)."""

@@ -208,3 +208,69 @@ class LRScheduler:
 
     def load_state_dict(self, sd: Dict[str, Any]) -> None:
         self.last_batch_iteration = sd["last_batch_iteration"]
+
+
+def add_tuning_arguments(parser):
+    """Reference ``add_tuning_arguments`` (lr_schedules.py:54-240): the
+    argparse group exposing every schedule knob so recipes can override
+    the JSON config from the command line."""
+    def str2bool(v: str) -> bool:
+        if v.lower() in ("true", "1", "yes", "y"):
+            return True
+        if v.lower() in ("false", "0", "no", "n"):
+            return False
+        raise ValueError(f"expected a boolean, got {v!r}")
+
+    # All defaults are None so override_lr_schedule_params only applies
+    # flags the user actually passed (argparse defaults must never
+    # clobber JSON-configured values).
+    group = parser.add_argument_group("Convergence Tuning", "Convergence tuning configurations")
+    group.add_argument("--lr_schedule", type=str, default=None, help="LR schedule for training")
+    # LRRangeTest
+    group.add_argument("--lr_range_test_min_lr", type=float, default=None)
+    group.add_argument("--lr_range_test_step_rate", type=float, default=None)
+    group.add_argument("--lr_range_test_step_size", type=int, default=None)
+    group.add_argument("--lr_range_test_staircase", type=str2bool, default=None)
+    # OneCycle
+    group.add_argument("--cycle_first_step_size", type=int, default=None)
+    group.add_argument("--cycle_first_stair_count", type=int, default=None)
+    group.add_argument("--cycle_second_step_size", type=int, default=None)
+    group.add_argument("--cycle_second_stair_count", type=int, default=None)
+    group.add_argument("--decay_step_size", type=int, default=None)
+    group.add_argument("--cycle_min_lr", type=float, default=None)
+    group.add_argument("--cycle_max_lr", type=float, default=None)
+    group.add_argument("--decay_lr_rate", type=float, default=None)
+    group.add_argument("--cycle_momentum", type=str2bool, default=None)
+    group.add_argument("--cycle_min_mom", type=float, default=None)
+    group.add_argument("--cycle_max_mom", type=float, default=None)
+    group.add_argument("--decay_mom_rate", type=float, default=None)
+    # Warmup
+    group.add_argument("--warmup_min_lr", type=float, default=None)
+    group.add_argument("--warmup_max_lr", type=float, default=None)
+    group.add_argument("--warmup_num_steps", type=int, default=None)
+    group.add_argument("--warmup_type", type=str, default=None)
+    return parser
+
+
+def parse_arguments():
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    return add_tuning_arguments(parser).parse_known_args()
+
+
+def override_lr_schedule_params(args, params: Dict[str, Any]) -> Dict[str, Any]:
+    """Fold CLI overrides into a scheduler params dict (reference
+    override_*_params helpers)."""
+    out = dict(params)
+    for key in list(vars(args)):
+        val = getattr(args, key)
+        if key in (
+            "lr_range_test_min_lr", "lr_range_test_step_rate", "lr_range_test_step_size",
+            "lr_range_test_staircase", "cycle_first_step_size", "cycle_second_step_size",
+            "decay_step_size", "cycle_min_lr", "cycle_max_lr", "decay_lr_rate",
+            "cycle_min_mom", "cycle_max_mom", "decay_mom_rate",
+            "warmup_min_lr", "warmup_max_lr", "warmup_num_steps", "warmup_type",
+        ) and val is not None:
+            out[key] = val
+    return out

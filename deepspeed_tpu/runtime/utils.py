@@ -167,3 +167,19 @@ def call_to_str(base: str, *args, **kwargs) -> str:
         name += ", ".join(f"{k}={v!r}" for k, v in kwargs.items())
     name += ")"
     return name
+
+
+def memory_status(msg: str = "", print_rank: int = 0) -> Dict[str, int]:
+    """Reference ``memory_status`` (runtime/utils.py:546) — the pipeline
+    engine's per-stage memory print; same device-stats source as
+    ``see_memory_usage``."""
+    import jax
+
+    from deepspeed_tpu.utils.logging import logger
+
+    stats = device_memory_stats()
+    if jax.process_index() == print_rank:
+        used = stats.get("bytes_in_use", 0) / 2**30
+        peak = stats.get("peak_bytes_in_use", 0) / 2**30
+        logger.info(f"memory_status {msg}: in_use={used:.2f}GB peak={peak:.2f}GB")
+    return stats

@@ -1,0 +1,56 @@
+"""Debug helpers.
+
+Reference: ``utils/debug.py`` — rank-interleaved printing with a file
+lock (:61-118) plus tensor fingerprinting used when chasing divergence
+across ranks.
+"""
+from __future__ import annotations
+
+import fcntl
+import os
+import sys
+from typing import Any
+
+import numpy as np
+
+_LOCK_PATH = "/tmp/deepspeed_tpu_debug.lock"
+
+
+def _rank() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return int(os.environ.get("RANK", 0))
+
+
+def print_rank_0(message: str) -> None:
+    if _rank() == 0:
+        print(message, flush=True)
+
+
+def printflock(*msgs: Any) -> None:
+    """Serialized cross-process print (reference ``printflock``): takes a
+    file lock so concurrent ranks don't interleave lines."""
+    with open(_LOCK_PATH, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            print(f"[rank {_rank()}]", *msgs, flush=True)
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
+
+
+def log_rank_file(*msgs: Any, path_template: str = "/tmp/ds_tpu_debug_rank{rank}.txt") -> None:
+    """Per-rank debug files (reference ``log_rank_file``)."""
+    with open(path_template.format(rank=_rank()), "a") as f:
+        print(*msgs, file=f, flush=True)
+
+
+def tensor_fingerprint(x: Any) -> str:
+    """Small stable summary for divergence hunts: shape/dtype/norm/head."""
+    arr = np.asarray(x)
+    flat = arr.reshape(-1).astype(np.float64) if arr.size else arr.reshape(-1)
+    head = np.array2string(flat[:4], precision=5) if arr.size else "[]"
+    norm = float(np.linalg.norm(flat)) if arr.size else 0.0
+    return f"shape={arr.shape} dtype={arr.dtype} l2={norm:.6g} head={head}"

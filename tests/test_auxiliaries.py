@@ -219,3 +219,52 @@ def test_zero_to_fp32_consolidation(tmp_path):
     convert_zero_checkpoint_to_fp32_state_dict(str(tmp_path / "ck"), str(out))
     with np.load(str(out)) as z:
         assert "lnf_g" in [k.replace("::", "/") for k in z.files]
+
+
+def test_zero_memory_estimators(capsys):
+    from deepspeed_tpu.runtime.zero.utils import (
+        estimate_zero2_model_states_mem_needs,
+        estimate_zero2_model_states_mem_needs_all_live,
+        estimate_zero3_model_states_mem_needs,
+        estimate_zero3_model_states_mem_needs_all_live,
+    )
+
+    N = 1_000_000_000
+    cpu, dev = estimate_zero2_model_states_mem_needs(N, 8, 4, cpu_offload=False)
+    cpu_off, dev_off = estimate_zero2_model_states_mem_needs(N, 8, 4, cpu_offload=True)
+    assert dev_off < dev  # offload must shrink device memory
+    assert cpu_off > cpu
+    cpu3, dev3, live = estimate_zero3_model_states_mem_needs(N, 50_000_000, 8, 4, cpu_offload=False)
+    assert dev3 < dev  # stage 3 shards params too
+    assert live == 4 * 50_000_000
+    # live-params overloads accept pytrees
+    import numpy as np
+
+    params = {"a": np.zeros((1000, 1000)), "b": np.zeros(500)}
+    estimate_zero2_model_states_mem_needs_all_live(params)
+    estimate_zero3_model_states_mem_needs_all_live(params, largest_layer_params=1000)
+    out = capsys.readouterr().out
+    assert "ZeRO-2" in out and "ZeRO-3" in out and "offload" in out
+
+
+def test_flatten_unflatten_shim():
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.ops.utils_op import flatten, unflatten
+
+    ts = [np.arange(6, dtype=np.float32).reshape(2, 3), np.ones(4, np.float32)]
+    flat = flatten(ts)
+    assert flat.shape == (10,)
+    outs = unflatten(flat, ts)
+    np.testing.assert_array_equal(np.asarray(outs[0]), ts[0])
+    np.testing.assert_array_equal(np.asarray(outs[1]), ts[1])
+
+
+def test_debug_helpers(tmp_path):
+    from deepspeed_tpu.utils.debug import log_rank_file, printflock, tensor_fingerprint
+
+    fp = tensor_fingerprint(np.ones((2, 2)))
+    assert "shape=(2, 2)" in fp and "l2=2" in fp
+    printflock("hello")  # must not raise
+    log_rank_file("x", path_template=str(tmp_path / "r{rank}.txt"))
+    assert (tmp_path / "r0.txt").read_text().strip() == "x"
