@@ -59,6 +59,10 @@ class GPT2Config:
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
     remat: bool = True  # activation checkpointing per block
+    # >0: cross-entropy computed in time-chunks of this size under remat,
+    # so the (B, T, vocab) logits tensor never materializes whole —
+    # memory drops by ~B*T*V*6 bytes at ~10% extra logit-matmul flops
+    xent_chunk_size: int = 0
     remat_policy: str = "nothing_saveable"  # or "dots_with_no_batch_dims_saveable"
     dtype: Any = jnp.float32  # activation dtype is set by the engine cast
 
@@ -264,7 +268,7 @@ def _block(cfg: GPT2Config, x, lp, rng, deterministic: bool, token_mask=None):
     return x, aux
 
 
-def apply(params: Dict[str, Any], tokens: jnp.ndarray, cfg: GPT2Config, rng=None, deterministic: bool = True, return_aux: bool = False, token_mask=None, pld_theta=None):
+def apply(params: Dict[str, Any], tokens: jnp.ndarray, cfg: GPT2Config, rng=None, deterministic: bool = True, return_aux: bool = False, token_mask=None, pld_theta=None, return_hidden: bool = False):
     """Forward pass: ``tokens (B, T) int32`` → logits ``(B, T, V)``.
 
     ``return_aux=True`` additionally returns the summed MoE
@@ -272,7 +276,10 @@ def apply(params: Dict[str, Any], tokens: jnp.ndarray, cfg: GPT2Config, rng=None
     excludes padding from MoE routing/aux.  ``pld_theta`` (traced scalar)
     enables progressive layer drop: layer l of L is kept with probability
     ``1 - (l+1)/L·(1-theta)`` via ``lax.cond`` — dropped layers skip
-    their compute entirely (runtime/progressive_layer_drop.py)."""
+    their compute entirely (runtime/progressive_layer_drop.py).
+    ``return_hidden=True`` returns the post-final-LN hidden states
+    (B, T, D) instead of logits (used by the chunked-xent loss so the
+    full logits tensor never materializes)."""
     B, T = tokens.shape
     x = jnp.take(params["wte"], tokens, axis=0) + params["wpe"][:T][None]
     x = x.astype(params["blocks"]["qkv_w"].dtype)
@@ -325,10 +332,42 @@ def apply(params: Dict[str, Any], tokens: jnp.ndarray, cfg: GPT2Config, rng=None
     scan_xs = (params["blocks"], layer_rngs, keep_probs) if use_pld else (params["blocks"], layer_rngs)
     (x, aux_total), _ = jax.lax.scan(scan_body, (x, jnp.zeros((), jnp.float32)), scan_xs)
     x = _layer_norm(x, params["lnf_g"], params["lnf_b"], cfg.layer_norm_epsilon)
+    if return_hidden:
+        return (x, aux_total) if return_aux else x
     logits = x @ params["wte"].T.astype(x.dtype)  # tied embedding head
     if return_aux:
         return logits, aux_total
     return logits
+
+
+def _chunked_xent(hidden: jnp.ndarray, wte: jnp.ndarray, labels: jnp.ndarray, mask: jnp.ndarray, chunk: int) -> jnp.ndarray:
+    """Masked-mean next-token NLL computed per time-chunk under remat:
+    each chunk's (B, C, V) logits are built, reduced, and discarded —
+    the backward recomputes them chunk-by-chunk, so peak memory holds
+    one chunk of logits instead of the whole (B, T, V) tensor."""
+    B, T, D = hidden.shape
+    pad = (-T) % chunk
+    if pad:
+        hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
+        labels = jnp.pad(labels, ((0, 0), (0, pad)))
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))
+    n = (T + pad) // chunk
+    hs = hidden.reshape(B, n, chunk, D).transpose(1, 0, 2, 3)
+    ls = labels.reshape(B, n, chunk).transpose(1, 0, 2)
+    ms = mask.reshape(B, n, chunk).transpose(1, 0, 2)
+
+    @functools.partial(jax.checkpoint, prevent_cse=False)
+    def body(carry, inp):
+        xc, lc, mc = inp
+        logits = (xc @ wte.T.astype(xc.dtype)).astype(jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, lc[..., None], axis=-1)[..., 0]
+        nll = (logz - gold) * mc
+        s, c = carry
+        return (s + jnp.sum(nll), c + jnp.sum(mc)), None
+
+    (total, count), _ = jax.lax.scan(body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)), (hs, ls, ms))
+    return total / jnp.maximum(count, 1.0)
 
 
 def loss_fn(params: Dict[str, Any], batch: Dict[str, Any], rng=None, cfg: GPT2Config = None, deterministic: bool = False) -> jnp.ndarray:
@@ -337,25 +376,33 @@ def loss_fn(params: Dict[str, Any], batch: Dict[str, Any], rng=None, cfg: GPT2Co
     from deepspeed_tpu.runtime.progressive_layer_drop import PLD_THETA_KEY
 
     tokens = batch["input_ids"]
-    logits, moe_aux = apply(
+    chunked = cfg.xent_chunk_size > 0
+    out, moe_aux = apply(
         params, tokens, cfg, rng=rng, deterministic=deterministic, return_aux=True,
         token_mask=batch.get("attention_mask") if cfg.n_experts > 0 else None,
-        pld_theta=batch.get(PLD_THETA_KEY),
+        pld_theta=batch.get(PLD_THETA_KEY), return_hidden=chunked,
     )
+    # one shared shift/mask derivation for both reductions: mask indexes
+    # the *label* position (tokens[:, 1:]), not the query
     if "labels" in batch:
-        labels = batch["labels"]
-        logits_shift = logits
+        labels, out_shift = batch["labels"], out
+        mask = batch.get("attention_mask")
+        mask = mask[:, : labels.shape[1]].astype(jnp.float32) if mask is not None else None
     else:
-        labels = tokens[:, 1:]
-        logits_shift = logits[:, :-1]
-    logits32 = logits_shift.astype(jnp.float32)
+        labels, out_shift = tokens[:, 1:], out[:, :-1]
+        mask = batch.get("attention_mask")
+        mask = mask[:, 1 : 1 + labels.shape[1]].astype(jnp.float32) if mask is not None else None
+    aux = cfg.moe_aux_weight * moe_aux if cfg.n_experts > 0 else 0.0
+
+    if chunked:
+        ones = jnp.ones(labels.shape, jnp.float32) if mask is None else mask
+        return _chunked_xent(out_shift, params["wte"], labels, ones, cfg.xent_chunk_size) + aux
+
+    logits32 = out_shift.astype(jnp.float32)
     logz = jax.nn.logsumexp(logits32, axis=-1)
     gold = jnp.take_along_axis(logits32, labels[..., None], axis=-1)[..., 0]
     nll = logz - gold
-    aux = cfg.moe_aux_weight * moe_aux if cfg.n_experts > 0 else 0.0
-    if "attention_mask" in batch:
-        # mask indexed at the *label* position (tokens[:, 1:]), not the query
-        mask = batch["attention_mask"][:, 1 : 1 + nll.shape[1]].astype(jnp.float32) if "labels" not in batch else batch["attention_mask"][:, : nll.shape[1]].astype(jnp.float32)
+    if mask is not None:
         return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0) + aux
     return jnp.mean(nll) + aux
 

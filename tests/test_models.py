@@ -115,3 +115,34 @@ def test_bert_tiny_trains():
 def test_gpt2_param_count():
     assert abs(gpt2.GPT2_SMALL.num_params() - 124_000_000) / 124e6 < 0.05
     assert abs(gpt2.GPT2_XL.num_params() - 1_558_000_000) / 1.558e9 < 0.05
+
+
+def test_chunked_xent_matches_full():
+    """xent_chunk_size > 0 must give identical loss AND grads to the
+    full-logits path (memory optimization, not a numerics change)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    cfg_full = dataclasses.replace(gpt2.GPT2_TINY, remat=False)
+    cfg_chunk = dataclasses.replace(cfg_full, xent_chunk_size=32)
+    params = jax.tree.map(jnp.asarray, gpt2.init_params(cfg_full, seed=0))
+    rng = np.random.default_rng(0)
+    batch = {
+        "input_ids": rng.integers(0, cfg_full.vocab_size, (2, 48), dtype=np.int32),
+        "attention_mask": (rng.random((2, 48)) > 0.1).astype(np.int32),
+    }
+    l_full, g_full = jax.value_and_grad(lambda p: gpt2.loss_fn(p, batch, cfg=cfg_full, deterministic=True))(params)
+    l_chunk, g_chunk = jax.value_and_grad(lambda p: gpt2.loss_fn(p, batch, cfg=cfg_chunk, deterministic=True))(params)
+    np.testing.assert_allclose(float(l_full), float(l_chunk), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5),
+        g_full, g_chunk,
+    )
+    # explicit-labels arm (different mask slice) must also agree
+    batch_lbl = dict(batch)
+    batch_lbl["labels"] = rng.integers(0, cfg_full.vocab_size, (2, 48), dtype=np.int32)
+    l_f2 = float(gpt2.loss_fn(params, batch_lbl, cfg=cfg_full, deterministic=True))
+    l_c2 = float(gpt2.loss_fn(params, batch_lbl, cfg=cfg_chunk, deterministic=True))
+    np.testing.assert_allclose(l_f2, l_c2, rtol=1e-5)
