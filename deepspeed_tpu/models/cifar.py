@@ -1,0 +1,108 @@
+"""CIFAR-10 tiny CNN — the first rung of the workload ladder.
+
+Reference: the DeepSpeedExamples ``cifar`` recipe (BASELINE config 1:
+CIFAR-10 tiny CNN, ZeRO-0, single device) — the smoke-test model every
+engine feature must be able to drive end-to-end.
+
+TPU-idiomatic: convs via ``lax.conv_general_dilated`` in NHWC (the TPU-
+native conv layout), pooling via ``lax.reduce_window``; params are a
+plain pytree like the transformer models, so the same engine/ZeRO/
+checkpoint machinery applies unchanged.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class CifarConfig:
+    num_classes: int = 10
+    channels: int = 3
+    image_size: int = 32
+    conv1_filters: int = 32
+    conv2_filters: int = 64
+    hidden: int = 256
+
+    def num_params(self) -> int:
+        c1, c2, h = self.conv1_filters, self.conv2_filters, self.hidden
+        flat = (self.image_size // 4) ** 2 * c2
+        return (
+            3 * 3 * self.channels * c1 + c1
+            + 3 * 3 * c1 * c2 + c2
+            + flat * h + h
+            + h * self.num_classes + self.num_classes
+        )
+
+
+CIFAR_TINY = CifarConfig()
+
+
+def init_params(cfg: CifarConfig = CIFAR_TINY, seed: int = 0) -> Dict[str, Any]:
+    rng = np.random.default_rng(seed)
+
+    def he(*shape, fan_in):
+        return (rng.standard_normal(shape) * np.sqrt(2.0 / fan_in)).astype(np.float32)
+
+    flat = (cfg.image_size // 4) ** 2 * cfg.conv2_filters
+    return {
+        "conv1_w": he(3, 3, cfg.channels, cfg.conv1_filters, fan_in=9 * cfg.channels),
+        "conv1_b": np.zeros(cfg.conv1_filters, np.float32),
+        "conv2_w": he(3, 3, cfg.conv1_filters, cfg.conv2_filters, fan_in=9 * cfg.conv1_filters),
+        "conv2_b": np.zeros(cfg.conv2_filters, np.float32),
+        "fc1_w": he(flat, cfg.hidden, fan_in=flat),
+        "fc1_b": np.zeros(cfg.hidden, np.float32),
+        "fc2_w": he(cfg.hidden, cfg.num_classes, fan_in=cfg.hidden),
+        "fc2_b": np.zeros(cfg.num_classes, np.float32),
+    }
+
+
+def _conv(x, w, b):
+    # NHWC x HWIO -> NHWC, SAME padding (TPU-native layout)
+    y = jax.lax.conv_general_dilated(
+        x, w.astype(x.dtype), window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    return y + b.astype(x.dtype)
+
+
+def _maxpool2(x):
+    return jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, window_dimensions=(1, 2, 2, 1), window_strides=(1, 2, 2, 1), padding="VALID"
+    )
+
+
+def apply(params: Dict[str, Any], images: jnp.ndarray, cfg: CifarConfig = CIFAR_TINY) -> jnp.ndarray:
+    """``images``: (B, H, W, C) float → logits (B, num_classes)."""
+    x = images
+    x = jax.nn.relu(_conv(x, params["conv1_w"], params["conv1_b"]))
+    x = _maxpool2(x)
+    x = jax.nn.relu(_conv(x, params["conv2_w"], params["conv2_b"]))
+    x = _maxpool2(x)
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(x @ params["fc1_w"].astype(x.dtype) + params["fc1_b"].astype(x.dtype))
+    return x @ params["fc2_w"].astype(x.dtype) + params["fc2_b"].astype(x.dtype)
+
+
+def loss_fn(params: Dict[str, Any], batch: Dict[str, Any], rng=None, cfg: CifarConfig = CIFAR_TINY) -> jnp.ndarray:
+    """``batch``: {"images": (B,H,W,C), "labels": (B,)} → mean xent."""
+    from deepspeed_tpu.ops.normalize import token_nll
+
+    logits = apply(params, batch["images"], cfg)
+    return jnp.mean(token_nll(logits, batch["labels"]))
+
+
+def accuracy(params: Dict[str, Any], batch: Dict[str, Any], cfg: CifarConfig = CIFAR_TINY) -> jnp.ndarray:
+    logits = apply(params, batch["images"], cfg)
+    return jnp.mean((jnp.argmax(logits, axis=-1) == batch["labels"]).astype(jnp.float32))
+
+
+def make_model(cfg: CifarConfig = CIFAR_TINY):
+    def model_fn(params, batch, rng):
+        return loss_fn(params, batch, rng=rng, cfg=cfg)
+
+    return model_fn, lambda seed=0: init_params(cfg, seed=seed), None

@@ -31,7 +31,7 @@ from jax.sharding import PartitionSpec as P
 from deepspeed_tpu.ops.attention.flash_attention import flash_attention, mha_reference
 # single shared implementation (ops/normalize.py); aliased because
 # models/bert.py imports these names from here
-from deepspeed_tpu.ops.normalize import dropout as _dropout, layer_norm as _layer_norm
+from deepspeed_tpu.ops.normalize import dropout as _dropout, layer_norm as _layer_norm, token_nll
 
 
 @dataclasses.dataclass(frozen=True)
@@ -359,10 +359,8 @@ def _chunked_xent(hidden: jnp.ndarray, wte: jnp.ndarray, labels: jnp.ndarray, ma
     @functools.partial(jax.checkpoint, prevent_cse=False)
     def body(carry, inp):
         xc, lc, mc = inp
-        logits = (xc @ wte.T.astype(xc.dtype)).astype(jnp.float32)
-        logz = jax.nn.logsumexp(logits, axis=-1)
-        gold = jnp.take_along_axis(logits, lc[..., None], axis=-1)[..., 0]
-        nll = (logz - gold) * mc
+        logits = xc @ wte.T.astype(xc.dtype)
+        nll = token_nll(logits, lc) * mc
         s, c = carry
         return (s + jnp.sum(nll), c + jnp.sum(mc)), None
 
@@ -398,10 +396,7 @@ def loss_fn(params: Dict[str, Any], batch: Dict[str, Any], rng=None, cfg: GPT2Co
         ones = jnp.ones(labels.shape, jnp.float32) if mask is None else mask
         return _chunked_xent(out_shift, params["wte"], labels, ones, cfg.xent_chunk_size) + aux
 
-    logits32 = out_shift.astype(jnp.float32)
-    logz = jax.nn.logsumexp(logits32, axis=-1)
-    gold = jnp.take_along_axis(logits32, labels[..., None], axis=-1)[..., 0]
-    nll = logz - gold
+    nll = token_nll(out_shift, labels)
     if mask is not None:
         return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0) + aux
     return jnp.mean(nll) + aux

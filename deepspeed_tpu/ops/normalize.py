@@ -28,3 +28,12 @@ def dropout(x: jnp.ndarray, rate: float, rng, deterministic: bool) -> jnp.ndarra
         return x
     keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
     return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
+
+
+def token_nll(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Per-position negative log-likelihood, fp32 (shared by every model
+    loss — one place for future label smoothing / ignore-index)."""
+    logits32 = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits32, axis=-1)
+    gold = jnp.take_along_axis(logits32, labels[..., None], axis=-1)[..., 0]
+    return logz - gold

@@ -1,0 +1,46 @@
+"""Workload-ladder rung 1: CIFAR-10 tiny CNN, ZeRO-0 (reference
+DeepSpeedExamples/cifar).  Uses synthetic data so it runs anywhere:
+swap `synthetic_batches` for a real CIFAR loader."""
+import argparse
+
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models import cifar
+
+
+def synthetic_batches(n, bs, seed=0):
+    rng = np.random.default_rng(seed)
+    # class-dependent mean shift makes the task learnable
+    for _ in range(n):
+        labels = rng.integers(0, 10, bs).astype(np.int32)
+        images = rng.standard_normal((bs, 32, 32, 3)).astype(np.float32) * 0.5
+        images += labels[:, None, None, None] / 10.0
+        yield {"images": images, "labels": labels}
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    deepspeed_tpu.add_config_arguments(parser)
+    parser.add_argument("--steps", type=int, default=30)
+    parser.add_argument("--batch_size", type=int, default=64)
+    args = parser.parse_args()
+
+    model_fn, init_fn, _ = cifar.make_model()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        args=args,
+        model=model_fn,
+        model_parameters=init_fn(),
+        config=args.deepspeed_config or {
+            "train_micro_batch_size_per_gpu": args.batch_size,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "steps_per_print": 10,
+        },
+    )
+    for i, batch in enumerate(engine.prefetch_loader(synthetic_batches(args.steps, args.batch_size * engine.mesh_info.dp_world_size))):
+        loss = engine.train_batch(batch)
+    print(f"final loss after {engine.global_steps} steps: {float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""Workload-ladder rung 5: inference with kernel injection (reference
+DeepSpeed-Inference GPT-Neo recipe).  Loads a HF model when transformers
+weights are available locally, else serves a randomly initialized native
+GPT-2."""
+import argparse
+
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models import gpt2
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="gpt2")
+    parser.add_argument("--mp_size", type=int, default=1)
+    parser.add_argument("--hf", action="store_true", help="load HF weights via kernel injection")
+    parser.add_argument("--max_new_tokens", type=int, default=32)
+    args = parser.parse_args()
+
+    if args.hf:
+        import transformers
+
+        hf_model = transformers.AutoModelForCausalLM.from_pretrained(args.model)
+        engine = deepspeed_tpu.init_inference(model=hf_model, mp_size=args.mp_size)
+    else:
+        engine = deepspeed_tpu.init_inference(model=args.model, mp_size=args.mp_size)
+
+    prompt = np.array([[464, 3290, 318, 257]], dtype=np.int32)  # arbitrary ids
+    out = engine.generate(prompt, max_new_tokens=args.max_new_tokens, do_sample=True, top_k=50)
+    print("generated ids:", np.asarray(out)[0].tolist())
+
+
+if __name__ == "__main__":
+    main()

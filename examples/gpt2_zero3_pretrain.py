@@ -1,0 +1,57 @@
+"""Workload-ladder rung 3: GPT-2 ZeRO-3 pretraining (reference
+Megatron-GPT2 recipe).  Synthetic token stream; point `batches` at a real
+corpus loader for actual pretraining.  Run on a pod via:
+
+    bin/deepspeed --hostfile hostfile examples/gpt2_zero3_pretrain.py \
+        --model gpt2-xl --deepspeed_config ds_config.json
+"""
+import argparse
+
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models import gpt2
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    deepspeed_tpu.add_config_arguments(parser)
+    parser.add_argument("--model", default="gpt2", choices=sorted(gpt2.PRESETS))
+    parser.add_argument("--steps", type=int, default=10)
+    parser.add_argument("--seq", type=int, default=1024)
+    args = parser.parse_args()
+
+    cfg = gpt2.PRESETS[args.model]
+    model_fn, init_fn, tp_fn = gpt2.make_model(cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        args=args,
+        model=model_fn,
+        model_parameters=init_fn(),
+        tp_spec_fn=tp_fn,
+        config=args.deepspeed_config or {
+            "train_micro_batch_size_per_gpu": 8,
+            "gradient_accumulation_steps": 1,
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 3},
+            "mesh": {"fsdp": -1, "data": 1},
+            "optimizer": {"type": "AdamW", "params": {"lr": 6e-4, "weight_decay": 0.1}},
+            "scheduler": {"type": "WarmupDecayLR", "params": {"warmup_num_steps": 2000, "total_num_steps": 300_000}},
+            "flops_profiler": {"enabled": True, "profile_step": 3},
+            "steps_per_print": 10,
+        },
+    )
+    rng = np.random.default_rng(0)
+    gb = engine.train_batch_size
+
+    def batches(n):
+        for _ in range(n):
+            yield {"input_ids": rng.integers(0, cfg.vocab_size, (gb, args.seq), dtype=np.int32)}
+
+    for batch in engine.prefetch_loader(batches(args.steps)):
+        loss = engine.train_batch(batch)
+    print(f"steps={engine.global_steps} loss={float(loss):.3f}")
+    engine.save_checkpoint("ckpts_gpt2")
+
+
+if __name__ == "__main__":
+    main()
