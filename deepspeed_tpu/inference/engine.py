@@ -215,7 +215,7 @@ class InferenceEngine:
     # ----------------------------------------------------------------------
     # generation (GPT family)
     # ----------------------------------------------------------------------
-    def _build_generate(self, B: int, T: int, N: int, do_sample: bool, temperature: float, top_k: int, eos_token_id):
+    def _build_generate(self, B: int, T: int, N: int, do_sample: bool, temperature: float, top_k: int, eos_token_id, masked: bool = False):
         from deepspeed_tpu.ops.transformer.inference import (
             DeepSpeedInferenceConfig,
             forward_with_cache,
@@ -243,23 +243,46 @@ class InferenceEngine:
                 logits32 = jnp.where(logits32 < kth, -jnp.inf, logits32)
             return jax.random.categorical(r, logits32, axis=-1).astype(jnp.int32)
 
-        def gen(params, tokens, rng):
+        def gen(params, tokens, rng, attention_mask):
             k_cache, v_cache = init_kv_cache(cfg.n_layer, B, cfg.n_head, T + N, cfg.head_dim, self.dtype)
-            logits, k_cache, v_cache = forward_with_cache(params, tokens, k_cache, v_cache, 0, icfg)
+            if masked:
+                # left-padded prompts: real positions start at 0 per
+                # example; padded cache slots are never attendable
+                prompt_mask = attention_mask.astype(bool)  # (B, T)
+                position_ids = jnp.maximum(jnp.cumsum(prompt_mask.astype(jnp.int32), axis=1) - 1, 0)
+                real_len = jnp.sum(prompt_mask.astype(jnp.int32), axis=1)  # (B,)
+                full_mask = jnp.concatenate([prompt_mask, jnp.ones((B, N), bool)], axis=1)
+                logits, k_cache, v_cache = forward_with_cache(
+                    params, tokens, k_cache, v_cache, 0, icfg,
+                    key_padding_mask=full_mask, position_ids=position_ids,
+                )
+            else:
+                real_len = jnp.full((B,), T, jnp.int32)
+                full_mask = None
+                logits, k_cache, v_cache = forward_with_cache(params, tokens, k_cache, v_cache, 0, icfg)
             r0, rng = jax.random.split(rng)
             first = sample_token(logits[:, -1].astype(jnp.float32), r0)
             finished = first == eos
 
-            def body(carry, r):
+            def body(carry, xs):
                 tok, kc, vc, pos, fin = carry
-                lg, kc, vc = forward_with_cache(params, tok[:, None], kc, vc, pos, icfg)
+                r, step = xs
+                # the token fed at scan step s was generated at step s-1,
+                # so its logical position is real_len + (s-1)
+                pos_ids = (real_len + step - 1)[:, None] if masked else None
+                lg, kc, vc = forward_with_cache(
+                    params, tok[:, None], kc, vc, pos, icfg,
+                    key_padding_mask=full_mask, position_ids=pos_ids,
+                )
                 nxt = sample_token(lg[:, -1].astype(jnp.float32), r)
                 nxt = jnp.where(fin, eos if eos >= 0 else 0, nxt)
                 fin = fin | (nxt == eos)
                 return (nxt, kc, vc, pos + 1, fin), nxt
 
             (_, _, _, _, _), rest = jax.lax.scan(
-                body, (first, k_cache, v_cache, jnp.int32(T), finished), jax.random.split(rng, N - 1)
+                body,
+                (first, k_cache, v_cache, jnp.int32(T), finished),
+                (jax.random.split(rng, N - 1), jnp.arange(1, N, dtype=jnp.int32)),
             )
             return jnp.concatenate([tokens, first[:, None], rest.T], axis=1)
 
@@ -274,10 +297,13 @@ class InferenceEngine:
         top_k: int = 0,
         eos_token_id: Optional[int] = None,
         seed: int = 0,
+        attention_mask=None,
     ):
         """Autoregressive generation (KV-cache decode).  ``input_ids``
-        (B, T) — all prompts the same length (pad+mask support is a later
-        round).  Returns (B, T + max_new_tokens)."""
+        (B, T); ragged prompts are LEFT-padded with ``attention_mask``
+        (B, T, 1=real) — positions and attention then follow each
+        example's real length (HF convention).  Returns
+        (B, T + max_new_tokens)."""
         if not self._is_gpt:
             raise ValueError("generate() requires a causal-LM (GPT-family) model")
         if getattr(self.model_config, "n_experts", 0) > 0:
@@ -294,7 +320,14 @@ class InferenceEngine:
                 f"T+max_new_tokens={T + max_new_tokens} exceeds the engine's "
                 f"max_out_tokens={self.max_out_tokens} (raise it in init_inference)"
             )
-        key = ("gen", B, T, max_new_tokens, do_sample, float(temperature), int(top_k), eos_token_id)
+        masked = attention_mask is not None
+        if masked:
+            attention_mask = jnp.asarray(np.asarray(attention_mask), jnp.int32)
+        else:
+            attention_mask = jnp.ones((B, T), jnp.int32)
+        key = ("gen", B, T, max_new_tokens, do_sample, float(temperature), int(top_k), eos_token_id, masked)
         if key not in self._compiled:
-            self._compiled[key] = self._build_generate(B, T, max_new_tokens, do_sample, temperature, top_k, eos_token_id)
-        return self._compiled[key](self.params, input_ids, jax.random.PRNGKey(seed))
+            self._compiled[key] = self._build_generate(
+                B, T, max_new_tokens, do_sample, temperature, top_k, eos_token_id, masked=masked
+            )
+        return self._compiled[key](self.params, input_ids, jax.random.PRNGKey(seed), attention_mask)

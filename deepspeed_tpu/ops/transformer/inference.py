@@ -68,12 +68,14 @@ def init_kv_cache(n_layer: int, batch: int, heads: int, max_len: int, head_dim: 
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
-def cache_attention(q, k_cache, v_cache, pos, sm_scale: Optional[float] = None):
+def cache_attention(q, k_cache, v_cache, pos, sm_scale: Optional[float] = None, key_padding_mask=None):
     """Attend queries (B,H,T,d) against a static cache (B,H,S,d).
 
     Allowed keys for query i: cache index j <= pos + i (``pos`` = write
     offset of the first query).  Covers both prefill (pos=0 → causal) and
-    decode (T=1, pos=n → full-prefix attention).  Reference decode softmax:
+    decode (T=1, pos=n → full-prefix attention).
+    ``key_padding_mask`` (B, S) True=attendable additionally masks
+    left-padded prompt slots.  Reference decode softmax:
     ``csrc/transformer/inference/csrc/softmax.cu``.
     """
     B, H, T, d = q.shape
@@ -83,7 +85,10 @@ def cache_attention(q, k_cache, v_cache, pos, sm_scale: Optional[float] = None):
     s = jnp.einsum("bhtd,bhsd->bhts", q.astype(jnp.float32), k_cache.astype(jnp.float32)) * sm_scale
     key_idx = jnp.arange(S)[None, None, None, :]
     q_idx = pos + jnp.arange(T)[None, None, :, None]
-    s = jnp.where(key_idx <= q_idx, s, NEG_INF)
+    allowed = key_idx <= q_idx
+    if key_padding_mask is not None:
+        allowed = allowed & key_padding_mask[:, None, None, :].astype(bool)
+    s = jnp.where(allowed, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bhts,bhsd->bhtd", p, v_cache.astype(jnp.float32))
     return out.astype(q.dtype)
@@ -96,6 +101,7 @@ def inference_block(
     k_cache: jnp.ndarray,
     v_cache: jnp.ndarray,
     pos: jnp.ndarray,
+    key_padding_mask=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One transformer layer with cache update.
 
@@ -124,15 +130,15 @@ def inference_block(
     v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, 0, pos, 0))
 
     is_initial_prefill = isinstance(pos, int) and pos == 0
-    if is_initial_prefill and T > 1 and cfg.use_flash_attention and T >= 128:
+    if is_initial_prefill and T > 1 and key_padding_mask is None and cfg.use_flash_attention and T >= 128:
         # prefill fast path: pure causal attention over the prompt block
         attn = flash_attention(q, k, v, causal=True)
-    elif is_initial_prefill and T > 1:
+    elif is_initial_prefill and T > 1 and key_padding_mask is None:
         attn = mha_reference(q, k, v, causal=True)
     else:
-        # decode or mid-stream continuation: attend against the whole
-        # cache (correct for any pos, incl. T>1 chunked appends)
-        attn = cache_attention(q, k_cache, v_cache, pos)
+        # decode, mid-stream continuation, or left-padded prompts: attend
+        # against the whole cache with position + padding masks
+        attn = cache_attention(q, k_cache, v_cache, pos, key_padding_mask=key_padding_mask)
     attn = attn.transpose(0, 2, 1, 3).reshape(B, T, D)
     attn = attn @ lp["proj_w"].astype(attn.dtype) + lp["proj_b"].astype(attn.dtype)
     x = x + attn
@@ -144,22 +150,37 @@ def inference_block(
     return x + h, k_cache, v_cache
 
 
-def forward_with_cache(params: Dict[str, Any], tokens: jnp.ndarray, k_cache, v_cache, pos, cfg: DeepSpeedInferenceConfig):
+def forward_with_cache(
+    params: Dict[str, Any],
+    tokens: jnp.ndarray,
+    k_cache,
+    v_cache,
+    pos,
+    cfg: DeepSpeedInferenceConfig,
+    key_padding_mask=None,
+    position_ids=None,
+):
     """Full GPT-2-layout network step with cache: embeddings → scanned
     cached blocks → final LN → tied-embedding logits.
 
     ``tokens``: (B, T) int32 (T static).  ``pos``: scalar int32 write
-    offset.  Returns (logits (B,T,V), new_k, new_v).
+    offset.  ``key_padding_mask`` (B, cache_len) True=attendable masks
+    left-padded prompt slots; ``position_ids`` (B, T) overrides the
+    default ``pos + arange(T)`` positions (per-example real positions
+    under left padding).  Returns (logits (B,T,V), new_k, new_v).
     """
     B, T = tokens.shape
     d = params["wte"].shape[1]
-    wpe_slice = jax.lax.dynamic_slice(params["wpe"], (pos, 0), (T, d))
-    x = jnp.take(params["wte"], tokens, axis=0) + wpe_slice[None]
+    if position_ids is not None:
+        pos_emb = jnp.take(params["wpe"], position_ids, axis=0)  # (B, T, d)
+    else:
+        pos_emb = jax.lax.dynamic_slice(params["wpe"], (pos, 0), (T, d))[None]
+    x = jnp.take(params["wte"], tokens, axis=0) + pos_emb
     x = x.astype(cfg.dtype)
 
     def body(carry, xs):
         lp, ck, cv = xs
-        y, ck, cv = inference_block(cfg, lp, carry, ck, cv, pos)
+        y, ck, cv = inference_block(cfg, lp, carry, ck, cv, pos, key_padding_mask=key_padding_mask)
         return y, (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(body, x, (params["blocks"], k_cache, v_cache))
