@@ -56,8 +56,39 @@ def resolve_mesh_shape(cfg: MeshConfig, n_devices: int) -> Dict[str, int]:
     return sizes
 
 
+def split_dcn_ici(sizes: Dict[str, int], n_processes: int) -> Optional[Tuple[Dict[str, int], Dict[str, int]]]:
+    """Factor each axis into (DCN, ICI) parts for a multi-host mesh: the
+    process count is absorbed by the outermost (most DCN-tolerant) axes
+    first — ``pipe`` and ``data`` ride the slow inter-host links, while
+    ``model``/``seq`` stay inside a host's ICI domain (SURVEY §2.6 /
+    scaling-book mesh recipe).  Returns (dcn_sizes, ici_sizes) or None
+    when the process count cannot be factored into the axis sizes."""
+    import math
+
+    dcn = {ax: 1 for ax in sizes}
+    ici = dict(sizes)
+    left = n_processes
+    for ax in MESH_AXES:  # outermost first
+        if left == 1:
+            break
+        f = math.gcd(left, ici[ax])
+        # absorb the largest factor of `left` that divides this axis
+        while f > 1 and left % f == 0 and ici[ax] % f == 0:
+            dcn[ax] *= f
+            ici[ax] //= f
+            left //= f
+            f = math.gcd(left, ici[ax])
+    return None if left != 1 else (dcn, ici)
+
+
 def make_mesh(cfg: Optional[MeshConfig] = None, devices: Optional[Sequence] = None):
-    """Build the framework mesh over the given (default: all) devices."""
+    """Build the framework mesh over the given (default: all) devices.
+
+    Multi-host: devices are arranged with
+    ``mesh_utils.create_hybrid_device_mesh`` so axis neighbors inside a
+    host connect over ICI and only the DCN-tolerant outer axes cross
+    hosts (the reference tunes NCCL hierarchies for the same reason,
+    SURVEY §2.6)."""
     import jax
     from jax.sharding import Mesh
 
@@ -67,7 +98,38 @@ def make_mesh(cfg: Optional[MeshConfig] = None, devices: Optional[Sequence] = No
         devices = jax.devices()
     sizes = resolve_mesh_shape(cfg, len(devices))
     shape = tuple(sizes[ax] for ax in MESH_AXES)
-    dev_array = np.asarray(devices).reshape(shape)
+
+    dev_array = None
+    if jax.process_count() > 1 and len(devices) == jax.device_count():
+        split = split_dcn_ici(sizes, jax.process_count())
+        if split is not None:
+            from jax.experimental import mesh_utils
+
+            dcn, ici = split
+            try:
+                # process_is_granule: our dcn factors come from the
+                # process count, so each process is one granule (the
+                # default groups by slice_index, which only matches when
+                # processes == slices)
+                dev_array = mesh_utils.create_hybrid_device_mesh(
+                    tuple(ici[ax] for ax in MESH_AXES),
+                    tuple(dcn[ax] for ax in MESH_AXES),
+                    devices=devices,
+                    process_is_granule=True,
+                )
+                logger.info(
+                    "hybrid mesh: dcn=" + "×".join(str(dcn[ax]) for ax in MESH_AXES)
+                    + " ici=" + "×".join(str(ici[ax]) for ax in MESH_AXES)
+                )
+            except Exception as e:
+                logger.warning(f"hybrid mesh construction failed ({e}); using flat device order")
+        else:
+            logger.warning(
+                f"process count {jax.process_count()} does not factor into mesh {sizes}; "
+                "using flat device order (cross-host collectives may ride slow links)"
+            )
+    if dev_array is None:
+        dev_array = np.asarray(devices).reshape(shape)
     mesh = Mesh(dev_array, MESH_AXES)
     logger.info(
         "mesh: " + " × ".join(f"{ax}={sizes[ax]}" for ax in MESH_AXES if sizes[ax] > 1 or ax == "data")

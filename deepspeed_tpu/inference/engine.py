@@ -322,7 +322,15 @@ class InferenceEngine:
             )
         masked = attention_mask is not None
         if masked:
-            attention_mask = jnp.asarray(np.asarray(attention_mask), jnp.int32)
+            am_np = np.asarray(attention_mask)
+            if not np.array_equal(np.sort(am_np, axis=1), am_np):
+                raise ValueError(
+                    "attention_mask must be LEFT-padded (rows of 0s then 1s); "
+                    "right-padded prompts would silently generate from a pad position"
+                )
+            if np.all(am_np == 1):
+                masked = False  # all-real prompts: take the unmasked fast path
+            attention_mask = jnp.asarray(am_np, jnp.int32)
         else:
             attention_mask = jnp.ones((B, T), jnp.int32)
         key = ("gen", B, T, max_new_tokens, do_sample, float(temperature), int(top_k), eos_token_id, masked)

@@ -135,9 +135,14 @@ def inference_block(
         attn = flash_attention(q, k, v, causal=True)
     elif is_initial_prefill and T > 1 and key_padding_mask is None:
         attn = mha_reference(q, k, v, causal=True)
+    elif is_initial_prefill and T > 1:
+        # masked prefill: keys beyond the prompt block are causally dead —
+        # slice the cache so scores stay (T, T), not (T, T+N)
+        kp = key_padding_mask[:, :T] if key_padding_mask is not None else None
+        attn = cache_attention(q, k_cache[:, :, :T], v_cache[:, :, :T], 0, key_padding_mask=kp)
     else:
-        # decode, mid-stream continuation, or left-padded prompts: attend
-        # against the whole cache with position + padding masks
+        # decode or mid-stream continuation: attend against the whole
+        # cache with position + padding masks
         attn = cache_attention(q, k_cache, v_cache, pos, key_padding_mask=key_padding_mask)
     attn = attn.transpose(0, 2, 1, 3).reshape(B, T, D)
     attn = attn @ lp["proj_w"].astype(attn.dtype) + lp["proj_b"].astype(attn.dtype)

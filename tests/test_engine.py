@@ -137,3 +137,19 @@ def test_scheduler_in_engine():
     lr6 = engine.get_lr()[0]
     assert lr6 > lr0
     assert abs(lr6 - 1e-2) < 1e-6
+
+
+def test_split_dcn_ici_factoring():
+    """Hybrid-mesh factoring: process count lands on the outermost
+    (DCN-tolerant) axes; model/seq stay intra-host."""
+    from deepspeed_tpu.comm.mesh import MESH_AXES, split_dcn_ici
+
+    sizes = dict(zip(MESH_AXES, [2, 8, 4, 1, 4, 1]))  # pipe,data,fsdp,seq,model,expert
+    dcn, ici = split_dcn_ici(sizes, 16)  # 16 hosts
+    assert dcn["pipe"] == 2 and dcn["data"] == 8  # outer axes absorb hosts
+    assert dcn["model"] == 1 and ici["model"] == 4  # TP stays on ICI
+    for ax in MESH_AXES:
+        assert dcn[ax] * ici[ax] == sizes[ax]
+    assert np.prod(list(dcn.values())) == 16
+    # non-factorable process count → None (caller falls back)
+    assert split_dcn_ici(dict(zip(MESH_AXES, [1, 3, 1, 1, 1, 1])), 2) is None

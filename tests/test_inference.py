@@ -296,3 +296,14 @@ def test_ragged_batch_generate():
     out = np.asarray(eng.generate(batch, max_new_tokens=4, attention_mask=mask))
     np.testing.assert_array_equal(out[0, 8:], ref1[0])
     np.testing.assert_array_equal(out[1, 8:], ref2[0])
+
+
+def test_right_padded_mask_rejected_and_all_ones_fast_path():
+    eng = deepspeed_tpu.init_inference(model_config=TINY, dtype=jnp.float32)
+    toks = np.ones((1, 6), np.int32)
+    with pytest.raises(ValueError, match="LEFT-padded"):
+        eng.generate(toks, max_new_tokens=2, attention_mask=np.array([[1, 1, 1, 1, 0, 0]]))
+    # all-ones mask must produce the identical result to no mask
+    a = np.asarray(eng.generate(toks, max_new_tokens=4))
+    b = np.asarray(eng.generate(toks, max_new_tokens=4, attention_mask=np.ones((1, 6), np.int32)))
+    np.testing.assert_array_equal(a, b)
