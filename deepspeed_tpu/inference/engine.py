@@ -231,6 +231,8 @@ class InferenceEngine:
             dtype=self.dtype,
             max_out_tokens=T + N,
             use_flash_attention=cfg.use_flash_attention,
+            moe_top_k=getattr(cfg, "moe_top_k", 2),
+            moe_eval_capacity_factor=getattr(cfg, "moe_capacity_factor", 1.25),
         )
         eos = -1 if eos_token_id is None else int(eos_token_id)
 
@@ -306,11 +308,6 @@ class InferenceEngine:
         (B, T + max_new_tokens)."""
         if not self._is_gpt:
             raise ValueError("generate() requires a causal-LM (GPT-family) model")
-        if getattr(self.model_config, "n_experts", 0) > 0:
-            raise NotImplementedError(
-                "generate() does not yet support MoE models (the KV-cache block "
-                "is dense-FFN only); use forward() or a dense config"
-            )
         input_ids = jnp.asarray(np.asarray(input_ids), jnp.int32)
         B, T = input_ids.shape
         if T + max_new_tokens > self.model_config.n_positions:

@@ -54,6 +54,9 @@ class DeepSpeedInferenceConfig:
     max_out_tokens: int = 1024  # static KV-cache capacity
     pre_layer_norm: bool = True
     use_flash_attention: bool = True
+    # MoE decode (used when the layer params carry gate_w/w1/b1/w2/b2)
+    moe_top_k: int = 2
+    moe_eval_capacity_factor: float = 2.0
 
     @property
     def head_dim(self) -> int:
@@ -149,9 +152,20 @@ def inference_block(
     x = x + attn
 
     h = _ln(x, lp["ln2_g"], lp["ln2_b"], cfg.layer_norm_eps)
-    h = h @ lp["fc_w"].astype(h.dtype) + lp["fc_b"].astype(h.dtype)
-    h = jax.nn.gelu(h, approximate=True)  # fused bias+gelu (gelu.cu analog)
-    h = h @ lp["fc_proj_w"].astype(h.dtype) + lp["fc_proj_b"].astype(h.dtype)
+    if "gate_w" in lp:
+        # MoE block: route through the expert layer (eval mode — no
+        # jitter/aux; experts stay sharded over the `expert` axis)
+        from deepspeed_tpu.moe.layer import MoEConfig, moe_ffn
+
+        mcfg = MoEConfig(
+            num_experts=lp["gate_w"].shape[-1], d_model=D, d_ff=lp["w1"].shape[-1],
+            top_k=cfg.moe_top_k, capacity_factor=cfg.moe_eval_capacity_factor,
+        )
+        h, _ = moe_ffn({k: lp[k] for k in ("gate_w", "w1", "b1", "w2", "b2")}, h, mcfg, training=False)
+    else:
+        h = h @ lp["fc_w"].astype(h.dtype) + lp["fc_b"].astype(h.dtype)
+        h = jax.nn.gelu(h, approximate=True)  # fused bias+gelu (gelu.cu analog)
+        h = h @ lp["fc_proj_w"].astype(h.dtype) + lp["fc_proj_b"].astype(h.dtype)
     return x + h, k_cache, v_cache
 
 

@@ -129,6 +129,17 @@ class DeepSpeedEngine:
         self._offload_cfg = config.zero_config.offload_optimizer
         self._offload = bool(self._offload_cfg.enabled)
         self._host_opt = None
+        if config.zero_config.offload_param.enabled:
+            # Param offload is accepted for config compatibility but is a
+            # no-op: ZeRO-3 already shards params 1/W per chip and the
+            # engine keeps only the compute-dtype copy in HBM — the
+            # reference's fp16-param NVMe swap targets 16GB GPUs hosting
+            # the FULL fp16 params (partitioned_param_swapper.py).
+            logger.warning(
+                "offload_param is accepted but inert on TPU: params stay "
+                "HBM-resident (sharded 1/fsdp per chip, compute dtype); use "
+                "zero stage 3 + offload_optimizer for host-resident state"
+            )
         if self._offload:
             if optimizer is not None:
                 raise ValueError(
