@@ -232,7 +232,6 @@ class InferenceEngine:
             max_out_tokens=T + N,
             use_flash_attention=cfg.use_flash_attention,
             moe_top_k=getattr(cfg, "moe_top_k", 2),
-            moe_eval_capacity_factor=getattr(cfg, "moe_capacity_factor", 1.25),
         )
         eos = -1 if eos_token_id is None else int(eos_token_id)
 

@@ -246,18 +246,13 @@ def _block(cfg: GPT2Config, x, lp, rng, deterministic: bool, token_mask=None):
 
     h = _layer_norm(x, lp["ln2_g"], lp["ln2_b"], cfg.layer_norm_epsilon)
     if cfg.n_experts > 0:
-        from deepspeed_tpu.moe.layer import MoEConfig, moe_ffn
+        from deepspeed_tpu.moe.layer import moe_ffn_from_block
 
-        mcfg = MoEConfig(
-            num_experts=cfg.n_experts,
-            d_model=D,
-            d_ff=4 * D,
-            top_k=cfg.moe_top_k,
-            capacity_factor=cfg.moe_capacity_factor,
-        )
-        moe_params = {k: lp[k] for k in ("gate_w", "w1", "b1", "w2", "b2")}
         # training ⇔ a dropout/jitter rng was threaded in (eval passes None)
-        h, aux = moe_ffn(moe_params, h, mcfg, rng=r2, training=rng is not None, token_mask=token_mask)
+        h, aux = moe_ffn_from_block(
+            lp, h, top_k=cfg.moe_top_k, capacity_factor=cfg.moe_capacity_factor,
+            rng=r2, training=rng is not None, token_mask=token_mask,
+        )
     else:
         h = h @ lp["fc_w"].astype(h.dtype) + lp["fc_b"].astype(h.dtype)
         h = jax.nn.gelu(h, approximate=True)

@@ -209,3 +209,26 @@ def moe_ffn(
 @register_op("moe", "xla", "GShard-style top-k MoE dispatch/combine (GSPMD all-to-all over expert axis)")
 def _load_moe():
     return moe_ffn
+
+
+MOE_PARAM_KEYS = ("gate_w", "w1", "b1", "w2", "b2")
+
+
+def moe_ffn_from_block(lp: Dict[str, Any], h: jnp.ndarray, *, top_k: int = 2,
+                       capacity_factor: float = 1.25, eval_capacity_factor: float = 2.0,
+                       rng: Optional[jax.Array] = None, training: bool = False,
+                       token_mask: Optional[jnp.ndarray] = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Apply a block's MoE FFN from its stacked layer params ``lp``
+    (shapes determine num_experts/d_ff) — the ONE place the train block
+    (models/gpt2.py) and the inference block (ops/transformer/inference)
+    build their MoEConfig, so capacity semantics can't drift."""
+    cfg = MoEConfig(
+        num_experts=lp["gate_w"].shape[-1],
+        d_model=h.shape[-1],
+        d_ff=lp["w1"].shape[-1],
+        top_k=top_k,
+        capacity_factor=capacity_factor,
+        eval_capacity_factor=eval_capacity_factor,
+    )
+    params = {k: lp[k] for k in MOE_PARAM_KEYS}
+    return moe_ffn(params, h, cfg, rng=rng, training=training, token_mask=token_mask)

@@ -54,7 +54,9 @@ class DeepSpeedInferenceConfig:
     max_out_tokens: int = 1024  # static KV-cache capacity
     pre_layer_norm: bool = True
     use_flash_attention: bool = True
-    # MoE decode (used when the layer params carry gate_w/w1/b1/w2/b2)
+    # MoE decode (used when the layer params carry gate_w/w1/b1/w2/b2);
+    # eval capacity must match the train model's EVAL path (moe/layer.py
+    # MoEConfig.eval_capacity_factor default) or decode diverges
     moe_top_k: int = 2
     moe_eval_capacity_factor: float = 2.0
 
@@ -154,14 +156,16 @@ def inference_block(
     h = _ln(x, lp["ln2_g"], lp["ln2_b"], cfg.layer_norm_eps)
     if "gate_w" in lp:
         # MoE block: route through the expert layer (eval mode — no
-        # jitter/aux; experts stay sharded over the `expert` axis)
-        from deepspeed_tpu.moe.layer import MoEConfig, moe_ffn
+        # jitter/aux; experts stay sharded over the `expert` axis).
+        # NB: decode routes only the current step's tokens, so capacity
+        # saturation can differ from a full teacher-forced forward when
+        # the router is heavily skewed — eval_capacity_factor (2.0 by
+        # default, matching the train model's eval path) keeps drops rare.
+        from deepspeed_tpu.moe.layer import moe_ffn_from_block
 
-        mcfg = MoEConfig(
-            num_experts=lp["gate_w"].shape[-1], d_model=D, d_ff=lp["w1"].shape[-1],
-            top_k=cfg.moe_top_k, capacity_factor=cfg.moe_eval_capacity_factor,
+        h, _ = moe_ffn_from_block(
+            lp, h, top_k=cfg.moe_top_k, eval_capacity_factor=cfg.moe_eval_capacity_factor, training=False
         )
-        h, _ = moe_ffn({k: lp[k] for k in ("gate_w", "w1", "b1", "w2", "b2")}, h, mcfg, training=False)
     else:
         h = h @ lp["fc_w"].astype(h.dtype) + lp["fc_b"].astype(h.dtype)
         h = jax.nn.gelu(h, approximate=True)  # fused bias+gelu (gelu.cu analog)
