@@ -1,0 +1,79 @@
+"""Model-framework adapters.
+
+The engine's native contract is a pure callable ``(params, batch, rng)
+-> loss`` over a plain param pytree (SURVEY §7: the engine is a compiled
+train step, not a module wrapper).  These helpers wrap the common JAX
+model libraries into that contract so their users keep their module code
+— the analog of the reference accepting any ``nn.Module``.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+
+def from_flax(
+    module: Any,
+    loss_fn: Callable,
+    init_batch: Any,
+    seed: int = 0,
+    mutable: bool = False,
+    dropout_rng_name: str = "dropout",
+):
+    """Wrap a ``flax.linen.Module``.
+
+    ``loss_fn(outputs, batch) -> scalar`` consumes the module's output.
+    ``init_batch`` — one example batch used to initialize parameters
+    (its array shapes matter, not its values).
+
+    Returns ``(model_fn, params)`` ready for
+    ``deepspeed_tpu.initialize(model=model_fn, model_parameters=params,
+    loss_fn=None)`` — the loss is already folded in.
+
+    Example::
+
+        model = MyFlaxTransformer(...)
+        model_fn, params = from_flax(model, xent, {"input_ids": ids})
+        engine, *_ = deepspeed_tpu.initialize(model=model_fn,
+                                              model_parameters=params,
+                                              config=cfg)
+    """
+    import jax
+
+    variables = module.init(jax.random.PRNGKey(seed), _module_input(init_batch))
+    params = variables["params"]
+    if mutable and len(variables) > 1:
+        raise ValueError(
+            "module has non-param collections (batch_stats?); carry them in the "
+            "batch or freeze them — the engine state holds params only"
+        )
+
+    def model_fn(p, batch, rng):
+        rngs = {dropout_rng_name: rng} if rng is not None else {}
+        out = module.apply({"params": p}, _module_input(batch), rngs=rngs)
+        return loss_fn(out, batch)
+
+    return model_fn, params
+
+
+def from_haiku(transformed: Any, loss_fn: Callable, init_batch: Any, seed: int = 0):
+    """Wrap a ``haiku.transform``-ed function pair.  Returns
+    ``(model_fn, params)`` like :func:`from_flax`."""
+    import jax
+
+    params = transformed.init(jax.random.PRNGKey(seed), _module_input(init_batch))
+
+    def model_fn(p, batch, rng):
+        out = transformed.apply(p, rng, _module_input(batch))
+        return loss_fn(out, batch)
+
+    return model_fn, params
+
+
+def _module_input(batch: Any) -> Any:
+    """Models usually take the input tensor, not the whole batch dict —
+    pull the conventional key when present."""
+    if isinstance(batch, dict):
+        for key in ("input_ids", "inputs", "x", "images"):
+            if key in batch:
+                return batch[key]
+    return batch

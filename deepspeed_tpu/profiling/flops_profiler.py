@@ -13,6 +13,12 @@ epilogues, and the backward pass).  The profiler therefore:
 
 * profiles any jittable ``fn(*args)`` via AOT lowering (no execution
   needed for the static numbers);
+
+  CAVEAT: XLA cost analysis counts a ``lax.scan`` body ONCE, not per
+  trip — models that scan over layers (models/gpt2.py) or engines that
+  scan over micro-batches under-report flops by that factor.  For MFU
+  use an analytic count (bench.py does: flops/token ≈ 6N + attention),
+  or unroll the scan for profiling;
 * measures wall clock around real calls for achieved FLOPS / MFU against
   a configurable peak;
 * integrates with the engine: ``profile_step`` triggers a one-shot

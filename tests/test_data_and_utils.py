@@ -133,3 +133,69 @@ def test_multi_output_model_with_loss_fn():
     batch = {"x": x, "y": np.tanh(x @ rng.standard_normal((8, 8)).astype(np.float32) * 0.3)}
     losses = [float(engine.train_batch(batch)) for _ in range(8)]
     assert losses[-1] < losses[0]
+
+
+# ---------------------------------------------------------------------------
+# framework adapters
+# ---------------------------------------------------------------------------
+
+def test_flax_adapter_trains():
+    flax = pytest.importorskip("flax")
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.adapters import from_flax
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Dense(32)(x)
+            x = nn.relu(x)
+            return nn.Dense(8)(x)
+
+    rng = np.random.default_rng(0)
+    xb = rng.standard_normal((16, 8)).astype(np.float32)
+    batch = {"x": xb, "y": np.tanh(xb @ rng.standard_normal((8, 8)).astype(np.float32))}
+
+    def loss(outputs, b):
+        return jnp.mean((outputs - b["y"]) ** 2)
+
+    model_fn, params = from_flax(MLP(), loss, batch)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model_fn, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "zero_optimization": {"stage": 2},
+                "mesh": {"fsdp": 8, "data": 1},
+                "steps_per_print": 1000},
+    )
+    losses = [float(engine.train_batch(batch)) for _ in range(8)]
+    assert losses[-1] < losses[0]
+
+
+def test_haiku_adapter_trains():
+    hk = pytest.importorskip("haiku")
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.adapters import from_haiku
+
+    def net(x):
+        return hk.Sequential([hk.Linear(32), jnp.tanh, hk.Linear(8)])(x)
+
+    transformed = hk.transform(net)
+    rng = np.random.default_rng(1)
+    xb = rng.standard_normal((16, 8)).astype(np.float32)
+    batch = {"x": xb, "y": np.tanh(xb @ rng.standard_normal((8, 8)).astype(np.float32))}
+
+    def loss(outputs, b):
+        return jnp.mean((outputs - b["y"]) ** 2)
+
+    model_fn, params = from_haiku(transformed, loss, batch)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model_fn, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "steps_per_print": 1000},
+    )
+    losses = [float(engine.train_batch(batch)) for _ in range(8)]
+    assert losses[-1] < losses[0]
