@@ -115,10 +115,19 @@ class InferenceEngine:
         if params is None:
             init = gpt2_mod.init_params if self._is_gpt else bert_mod.init_params
             params = init(self.model_config, seed=seed)
+        self._packed_int8 = False
         if quantize_bits:
-            from deepspeed_tpu.runtime.weight_quantizer import WeightQuantization
+            if quantize_bits == 8 and self._is_gpt:
+                # true int8 serving: weights stay int8 in HBM and matmuls
+                # run as (x @ q) * s in the fused decode path
+                from deepspeed_tpu.runtime.weight_quantizer import pack_int8_tree
 
-            params = WeightQuantization(bits=quantize_bits, groups=quantize_groups).quantize_dequantize_tree(params)
+                params = pack_int8_tree(params)
+                self._packed_int8 = True
+            else:
+                from deepspeed_tpu.runtime.weight_quantizer import WeightQuantization
+
+                params = WeightQuantization(bits=quantize_bits, groups=quantize_groups).quantize_dequantize_tree(params)
         self.params = self._shard_params(params)
         n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(self.params))
         log_dist(
@@ -135,14 +144,28 @@ class InferenceEngine:
     def _tp_spec(self, path: str, shape) -> P:
         if self.mp_world_size <= 1:
             return P()
+        # int8-packed weights nest one level: .../<name>_w/q carries the
+        # weight spec; .../<name>_w/s drops the contracted (input) dim
+        parts = path.split("/")
+        packed_kind = parts[-1] if parts[-1] in ("q", "s") else None
+        if packed_kind:
+            path = "/".join(parts[:-1])
         spec = self._family.tp_spec_fn(path, shape)
-        return spec if spec is not None else P()
+        if spec is None:
+            return P()
+        if packed_kind == "s":
+            dims = tuple(spec)
+            spec = P(*(dims[:-2] + (dims[-1],))) if len(dims) >= 2 else P()
+        return spec
 
     def _shard_params(self, params):
         def put(path, leaf):
             pstr = "/".join(str(getattr(k, "key", k)) for k in path)
             sh = NamedSharding(self.mesh, self._tp_spec(pstr, np.shape(leaf)))
-            return jax.device_put(jnp.asarray(leaf, self.dtype), sh)
+            # int8 payloads must stay int8; scales stay f32
+            arr = np.asarray(leaf)
+            dtype = arr.dtype if arr.dtype == np.int8 else (jnp.float32 if pstr.endswith("/s") else self.dtype)
+            return jax.device_put(jnp.asarray(arr, dtype), sh)
 
         return jax.tree_util.tree_map_with_path(put, params)
 
@@ -203,7 +226,28 @@ class InferenceEngine:
         key = ("fwd", input_ids.shape, tuple(sorted(kw)))
         if key not in self._compiled:
             cfg = self.model_config
-            if self._is_gpt:
+            if self._is_gpt and self._packed_int8:
+                # packed weights are only understood by the fused
+                # inference blocks — run the full sequence through the
+                # cache path (pos=0 prefill over the whole input)
+                from deepspeed_tpu.ops.transformer.inference import (
+                    DeepSpeedInferenceConfig,
+                    forward_with_cache,
+                    init_kv_cache,
+                )
+
+                B, T = input_ids.shape
+                icfg = DeepSpeedInferenceConfig(
+                    hidden_size=cfg.n_embd, heads=cfg.n_head,
+                    layer_norm_eps=cfg.layer_norm_epsilon, dtype=self.dtype,
+                    max_out_tokens=T, use_flash_attention=cfg.use_flash_attention,
+                )
+
+                def fn(p, ids):
+                    k0, v0 = init_kv_cache(cfg.n_layer, B, cfg.n_head, T, cfg.head_dim, self.dtype)
+                    return forward_with_cache(p, ids, k0, v0, 0, icfg)[0]
+
+            elif self._is_gpt:
                 fn = lambda p, ids: self._family.apply(p, ids, cfg, deterministic=True)
             else:
                 fn = lambda p, ids, **k: self._family.encode(p, ids, cfg, deterministic=True, **k)

@@ -95,3 +95,25 @@ def ds_quantizer(input, groups: int = 1, bit_num: int = 8, sr: bool = False, asy
 @register_op("quantizer", "xla", "Grouped sym/asym (stochastic) quantization; fuses to one XLA kernel")
 def _load_quantizer():
     return ds_quantizer
+
+
+def quantize_per_channel(w: jnp.ndarray):
+    """Per-OUTPUT-channel symmetric int8: ``w (..., in, out)`` →
+    ``(q int8 same shape, s (..., out) f32)`` with ``w ≈ q * s``.
+
+    The serving identity ``x @ W = (x @ q) * s`` means the matmul runs
+    directly on int8 weights (upcast happens tile-wise in VMEM) and no
+    dequantized copy ever hits HBM — the reference's int8 GEMM+dequant
+    path (``csrc/transformer/inference/csrc/dequantize.cu``) collapses
+    into one fused XLA dot."""
+    w32 = jnp.asarray(w, jnp.float32)
+    absmax = jnp.max(jnp.abs(w32), axis=-2, keepdims=True)  # over the IN dim
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return q, jnp.squeeze(scale, axis=-2)
+
+
+def int8_matmul(x: jnp.ndarray, q: jnp.ndarray, s: jnp.ndarray) -> jnp.ndarray:
+    """``x @ (q * s)`` computed as ``(x @ q) * s`` — int8 weights at rest."""
+    y = x @ q.astype(x.dtype)
+    return y * s.astype(x.dtype)

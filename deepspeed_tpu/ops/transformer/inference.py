@@ -65,6 +65,17 @@ class DeepSpeedInferenceConfig:
         return self.hidden_size // self.heads
 
 
+def _wmm(h: jnp.ndarray, w) -> jnp.ndarray:
+    """Weight matmul that understands int8-packed weights
+    (``{"q": int8, "s": f32}`` from ``pack_int8_tree``): computes
+    ``(h @ q) * s`` so the int8 tensor is what streams from HBM."""
+    if isinstance(w, dict):
+        from deepspeed_tpu.ops.quantizer.quantizer import int8_matmul
+
+        return int8_matmul(h, w["q"], w["s"])
+    return h @ w.astype(h.dtype)
+
+
 def init_kv_cache(n_layer: int, batch: int, heads: int, max_len: int, head_dim: int, dtype=jnp.bfloat16):
     """Static-capacity KV cache, stacked on a leading layer dim so it scans
     with the stacked blocks (the reference grows ``layer_past`` tensors
@@ -123,7 +134,7 @@ def inference_block(
     H, hd = cfg.heads, cfg.head_dim
 
     h = _ln(x, lp["ln1_g"], lp["ln1_b"], cfg.layer_norm_eps)
-    qkv = h @ lp["qkv_w"].astype(h.dtype) + lp["qkv_b"].astype(h.dtype)
+    qkv = _wmm(h, lp["qkv_w"]) + lp["qkv_b"].astype(h.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
 
     def heads(t):
@@ -150,7 +161,7 @@ def inference_block(
         # cache with position + padding masks
         attn = cache_attention(q, k_cache, v_cache, pos, key_padding_mask=key_padding_mask)
     attn = attn.transpose(0, 2, 1, 3).reshape(B, T, D)
-    attn = attn @ lp["proj_w"].astype(attn.dtype) + lp["proj_b"].astype(attn.dtype)
+    attn = _wmm(attn, lp["proj_w"]) + lp["proj_b"].astype(attn.dtype)
     x = x + attn
 
     h = _ln(x, lp["ln2_g"], lp["ln2_b"], cfg.layer_norm_eps)
@@ -167,9 +178,9 @@ def inference_block(
             lp, h, top_k=cfg.moe_top_k, eval_capacity_factor=cfg.moe_eval_capacity_factor, training=False
         )
     else:
-        h = h @ lp["fc_w"].astype(h.dtype) + lp["fc_b"].astype(h.dtype)
+        h = _wmm(h, lp["fc_w"]) + lp["fc_b"].astype(h.dtype)
         h = jax.nn.gelu(h, approximate=True)  # fused bias+gelu (gelu.cu analog)
-        h = h @ lp["fc_proj_w"].astype(h.dtype) + lp["fc_proj_b"].astype(h.dtype)
+        h = _wmm(h, lp["fc_proj_w"]) + lp["fc_proj_b"].astype(h.dtype)
     return x + h, k_cache, v_cache
 
 

@@ -98,3 +98,24 @@ class WeightQuantization:
 def quantize_transformer_layer(params: Any, bits: int = 8, groups: int = 1) -> Any:
     """Name-compat shim for ``module_inject/module_quantize.py``."""
     return WeightQuantization(bits=bits, groups=groups).quantize_dequantize_tree(params)
+
+
+def pack_int8_tree(params: Any) -> Any:
+    """True-int8 packing for the serving path: every matmul weight
+    (``*_w``, ndim>=2, non-embedding) becomes ``{"q": int8, "s": f32}``
+    with per-output-channel scales (``ops/quantizer.quantize_per_channel``);
+    the inference block computes ``(x @ q) * s`` so weights stay int8 in
+    HBM — halving decode weight bandwidth vs bf16."""
+    from deepspeed_tpu.ops.quantizer.quantizer import quantize_per_channel
+
+    def visit(path, leaf):
+        name = str(getattr(path[-1], "key", path[-1])) if path else ""
+        arr = np.asarray(leaf)
+        if arr.ndim >= 2 and name.endswith("_w") and "emb" not in name:
+            q, s = quantize_per_channel(arr)
+            return {"q": np.asarray(q), "s": np.asarray(s)}
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(
+        visit, params, is_leaf=lambda x: not isinstance(x, dict)
+    )

