@@ -5,12 +5,14 @@ the inference decode path in ``csrc/transformer/inference/csrc/softmax.cu``).
 
 Design:
 * **Forward**: Pallas TPU kernel, online-softmax over KV blocks held in
-  VMEM, fp32 accumulation, grid over (batch×heads, q-blocks) so the MXU
-  sees (block_q × d) @ (d × block_k) matmuls back-to-back.
-* **Backward**: blockwise-rematerialized XLA computation (lax.scan over KV
-  blocks under jax.checkpoint) — O(seq) memory like flash-attention-2's
-  backward, fused by XLA.  (A full Pallas backward is a later-round
-  optimization; the contract and tests don't change.)
+  VMEM, grid over (batch×heads, q-blocks).  Dots run in the input dtype
+  (bf16 on the training path — the MXU's native rate; fp32 operands
+  decompose into multiple MXU passes and measured ~4× slower) with fp32
+  accumulation and fp32 softmax state.
+* **Backward**: Pallas FA-2-style kernels (dq, then dk/dv) recomputing P
+  from (Q, K, lse) — O(seq) memory; same bf16-dot/fp32-accumulate
+  treatment.  ``_blockwise_xla`` remains as the interpretable
+  long-sequence fallback used when shapes don't fit the kernel grid.
 * On non-TPU backends the same kernel runs under ``interpret=True`` so
   unit tests execute on the CPU mesh.
 
@@ -77,7 +79,11 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, sm_scale: floa
     # tril(k=klen-qlen)).
     causal_offset = seq_k - seq_q_total
 
-    q = q_ref[0].astype(jnp.float32) * sm_scale  # (block_q, d)
+    # Keep q/k/v in the input dtype for the dots: the MXU multiplies
+    # bf16×bf16 natively at full rate (fp32 operands decompose into
+    # multiple passes — measured ~4× slower end-to-end); accumulation is
+    # fp32 via preferred_element_type, and the softmax math stays fp32.
+    q = q_ref[0]  # (block_q, d)
 
     num_kv = seq_k // block_k
     if causal:
@@ -90,9 +96,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, sm_scale: floa
 
     def body(i, carry):
         acc, m_prev, l_prev = carry
-        k = k_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # (block_q, block_k)
+        k = k_ref[0, pl.dslice(i * block_k, block_k), :]
+        v = v_ref[0, pl.dslice(i * block_k, block_k), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale  # (block_q, block_k) fp32
         if causal:
             q_pos = causal_offset + q_idx * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             k_pos = i * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -102,7 +108,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, sm_scale: floa
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_prev - m_new)
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
-        acc = acc * alpha + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        acc = acc * alpha + jnp.dot(p.astype(v.dtype), v, preferred_element_type=jnp.float32)
         return acc, m_new, l_new
 
     init = (
@@ -167,12 +173,18 @@ def _blockwise_xla(q, k, v, causal: bool, sm_scale: float, block_k: int):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     block_k = min(block_k, sk)
-    assert sk % block_k == 0
-    num_kv = sk // block_k
+    # Ragged sk: pad K/V up to a block multiple and mask the padded keys
+    # (the l==0 guard below already handles fully-masked rows).
+    pad = (-sk) % block_k
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    num_kv = (sk + pad) // block_k
     qf = q.astype(jnp.float32) * sm_scale
     kf = k.astype(jnp.float32).reshape(b, h, num_kv, block_k, d)
     vf = v.astype(jnp.float32).reshape(b, h, num_kv, block_k, d)
-    # end-aligned causal positions (match mha_reference tril(k=klen-qlen))
+    # end-aligned causal positions (match mha_reference tril(k=klen-qlen));
+    # alignment uses the ORIGINAL sk, not the padded length
     q_pos = (sk - sq) + jnp.arange(sq)[:, None]
 
     @functools.partial(jax.checkpoint, prevent_cse=False)
@@ -180,9 +192,11 @@ def _blockwise_xla(q, k, v, causal: bool, sm_scale: float, block_k: int):
         acc, m_prev, l_prev = carry
         kb, vb, kv_i = inputs
         s = jnp.einsum("bhqd,bhkd->bhqk", qf, kb)
+        k_pos = kv_i * block_k + jnp.arange(block_k)[None, :]
         if causal:
-            k_pos = kv_i * block_k + jnp.arange(block_k)[None, :]
             s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
+        if pad:
+            s = jnp.where(k_pos < sk, s, DEFAULT_MASK_VALUE)
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)
@@ -222,8 +236,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref
     q_idx = pl.program_id(1)
     causal_offset = seq_k - seq_q_total
 
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
+    q = q_ref[0]
+    do = do_ref[0]
     lse = lse_ref[0, 0, :][:, None]
     delta = delta_ref[0, 0, :][:, None]
 
@@ -235,8 +249,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref
         hi = num_kv
 
     def body(i, dq):
-        k = k_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.dslice(i * block_k, block_k), :]
+        v = v_ref[0, pl.dslice(i * block_k, block_k), :]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
         if causal:
             q_pos = causal_offset + q_idx * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
@@ -244,7 +258,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref
             s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
         p = jnp.exp(s - lse)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * sm_scale
+        ds = (p * (dp - delta) * sm_scale).astype(k.dtype)
         return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
     dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((block_q, d), jnp.float32))
@@ -258,8 +272,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_re
     kv_idx = pl.program_id(1)
     causal_offset = seq_k_total - seq_q
 
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]
+    v = v_ref[0]
 
     num_q = seq_q // block_q
     if causal:
@@ -271,8 +285,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_re
 
     def body(i, carry):
         dk, dv = carry
-        q = q_ref[0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
+        q = q_ref[0, pl.dslice(i * block_q, block_q), :]
+        do = do_ref[0, pl.dslice(i * block_q, block_q), :]
         lse = lse_ref[0, 0, pl.dslice(i * block_q, block_q)][:, None]
         delta = delta_ref[0, 0, pl.dslice(i * block_q, block_q)][:, None]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
@@ -281,9 +295,9 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_re
             k_pos = kv_idx * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
         p = jnp.exp(s - lse)
-        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dv = dv + jnp.dot(p.astype(do.dtype).T, do, preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * sm_scale
+        ds = (p * (dp - delta) * sm_scale).astype(q.dtype)
         dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
         return dk, dv
 
@@ -377,24 +391,53 @@ def flash_attention(
     v: jnp.ndarray,
     causal: bool = False,
     sm_scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 256,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Flash attention over ``(batch, heads, seq, head_dim)`` inputs.
 
-    Differentiable; forward runs the Pallas kernel, backward the blockwise
-    rematerialized path.  ``interpret`` defaults to True off-TPU.
+    Differentiable; forward and backward both run Pallas kernels (FA-2
+    style dq/dkv backward with P recomputed from Q, K, lse).  Shapes the
+    kernel grid can't serve fall back to the blockwise-rematerialized
+    XLA path (large) or ``mha_reference`` (small).  ``interpret``
+    defaults to True off-TPU.
     """
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     if interpret is None:
         interpret = not _on_tpu()
     sq, sk = q.shape[2], k.shape[2]
-    if sq % min(block_q, sq) != 0 or sk % min(block_k, sk) != 0 or sq < 8 or sk < 8:
-        # Ragged tiny shapes: reference path (still differentiable).
+    # Caller-supplied blocks are honored when they divide the sequence;
+    # otherwise halve down to 128 looking for a divisor (so e.g. seq 384
+    # runs the kernel at block 128 instead of silently falling back to
+    # the materializing reference path).
+    def pick(n, pref):
+        b = min(pref, n)
+        if n % b == 0:
+            return b
+        while b > 128:
+            b //= 2
+            if n % b == 0:
+                return b
+        return None
+
+    bq, bk = pick(sq, block_q), pick(sk, block_k)
+    if bq is None or bk is None or sq < 8 or sk < 8:
+        bh = q.shape[0] * q.shape[1]
+        if sq >= 8 and sk >= 8 and bh * sq * sk * 4 > 2**28:
+            # No kernel-compatible blocking but the (b,h,sq,sk) fp32
+            # score tensor would exceed ~256MB: blockwise-rematerialized
+            # XLA path (handles ragged sk by pad+mask).
+            return _blockwise_xla(q, k, v, causal=causal, sm_scale=sm_scale, block_k=min(block_k, sk))
         return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
-    return _flash_attention(q, k, v, causal, float(sm_scale), block_q, block_k, interpret)
+    # VMEM guard (bytes): the fwd kernel keeps full K/V per
+    # (batch,head) program resident, and the dkv backward keeps full
+    # Q/dO — bound both sides at ~8MB for the two resident operands.
+    itemsize = jnp.dtype(q.dtype).itemsize
+    if max(sq, sk) * q.shape[3] * itemsize * 2 > 2**23:
+        return _blockwise_xla(q, k, v, causal=causal, sm_scale=sm_scale, block_k=bk)
+    return _flash_attention(q, k, v, causal, float(sm_scale), bq, bk, interpret)
 
 
 @register_op("flash_attention", "pallas", "Online-softmax fused attention kernel (fwd) + blockwise remat bwd")
