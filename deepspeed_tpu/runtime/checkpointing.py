@@ -50,7 +50,9 @@ def save_checkpoint(
     os.makedirs(os.path.dirname(path), exist_ok=True)
 
     ckptr = _checkpointer()
-    ckptr.save(os.path.join(path, "state"), engine.state, force=True)
+    # flat-padded ZeRO leaves are stored in their natural shapes so the
+    # checkpoint is independent of this job's fsdp degree
+    ckptr.save(os.path.join(path, "state"), engine._to_portable_state(engine.state), force=True)
     ckptr.wait_until_finished()
 
     # ZeRO-Offload/Infinity: fp32 masters + moments live on host, outside
@@ -107,12 +109,12 @@ def load_checkpoint(
         return None, {}
 
     ckptr = _checkpointer()
-    # Abstract target: current shapes + *current* shardings — orbax
-    # reshards on read, giving elastic DP/MP resize on load.
-    def abstract(x, sharding):
-        return jax.ShapeDtypeStruct(np.shape(x), x.dtype, sharding=sharding)
-
-    target = jax.tree.map(abstract, engine.state, engine._state_shardings)
+    # Abstract target: checkpoint-layout shapes + *current* shardings —
+    # orbax reshards on read, giving elastic DP/MP resize on load.
+    # (Flat-padded ZeRO leaves are stored in natural shapes; the engine
+    # re-pads them for its own mesh below.)
+    target = engine._portable_target()
+    from_partial = False
     try:
         restored = ckptr.restore(os.path.join(path, "state"), target)
     except ValueError:
@@ -132,8 +134,16 @@ def load_checkpoint(
             ),
         )
         restored = dict(partial)
-        restored["params"] = jax.device_put(restored["params"], engine._state_shardings["params"])
         restored["opt_state"] = {}
+        from_partial = True
+
+    # checkpoint layout -> this engine's state layout (re-pad flat
+    # leaves for the current mesh), then pin the state shardings
+    restored = engine._from_portable_state(restored)
+    if engine._flat_plan:
+        restored = jax.device_put(restored, engine._state_shardings)
+    elif from_partial:
+        restored["params"] = jax.device_put(restored["params"], engine._state_shardings["params"])
 
     if load_module_only or not load_optimizer_states:
         engine.state["params"] = restored["params"]
@@ -184,7 +194,7 @@ def consolidate_fp32_state_dict(engine) -> Dict[str, np.ndarray]:
 
         flat[_path_str(path)] = arr
 
-    jax.tree_util.tree_map_with_path(visit, engine.state["params"])
+    jax.tree_util.tree_map_with_path(visit, engine._unflatten_state_leaves(engine.state["params"]))
     return flat
 
 

@@ -154,6 +154,23 @@ class DeepSpeedEngine:
                     "fetched to local RAM); multi-host offload lands with host-sharded masters"
                 )
 
+        # -- flat-fallback leaves (reference flattened partitions,
+        # stage2.py:432 / partition_parameters.py:688): leaves with no
+        # fsdp-divisible dim live in engine state as zero-padded 1-D
+        # vectors sharded over fsdp; the model sees them re-materialized
+        # inside the differentiated step, so their grads come back flat
+        # (and reduce-scattered) automatically.  Disabled for the
+        # pipeline engine, which owns its own parameter layout.
+        self._flat_plan = (
+            self.zero_rules.plan_flat(params) if getattr(self, "_use_grad_acc", True) else {}
+        )
+        if self._flat_plan:
+            params = self._flatten_state_leaves(params)
+            log_dist(
+                f"zero: {len(self._flat_plan)} param(s) with no fsdp-divisible dim "
+                f"stored flat-padded over fsdp={self.mesh_info.fsdp_world_size}"
+            )
+
         # -- state ---------------------------------------------------------
         self._param_specs = self.zero_rules.tree_param_specs(params)
         self._grad_specs = self.zero_rules.tree_grad_specs(params)
@@ -390,10 +407,129 @@ class DeepSpeedEngine:
         return self._host_micro_step % self.gradient_accumulation_steps == 0
 
     # ------------------------------------------------------------------
+    # flat-fallback leaf layout (see __init__)
+    # ------------------------------------------------------------------
+    def _flatten_state_leaves(self, tree: Any) -> Any:
+        """Natural layout → state layout (flat-pad leaves in the plan)."""
+        from deepspeed_tpu.runtime.zero.stages import _path_str
+
+        def f(path, leaf):
+            info = self._flat_plan.get(_path_str(path))
+            if info is None:
+                return leaf
+            _, n, padded = info
+            flat = jnp.ravel(jnp.asarray(leaf))
+            return jnp.pad(flat, (0, padded - n))
+
+        return jax.tree_util.tree_map_with_path(f, tree)
+
+    def _unflatten_state_leaves(self, tree: Any) -> Any:
+        """State layout → natural layout (no dtype change)."""
+        from deepspeed_tpu.runtime.zero.stages import _path_str
+
+        def f(path, leaf):
+            info = self._flat_plan.get(_path_str(path))
+            if info is None:
+                return leaf
+            shape, n, _ = info
+            return leaf[:n].reshape(shape)
+
+        return jax.tree_util.tree_map_with_path(f, tree)
+
+    def _materialize_params(self, params: Any, dtype) -> Any:
+        """State-layout params → full-shape compute-dtype params (traced
+        inside the step; for flat leaves the replicate-constraint turns
+        the fsdp shards into an all-gather at first use)."""
+        from deepspeed_tpu.runtime.zero.stages import _path_str
+
+        def f(path, leaf):
+            info = self._flat_plan.get(_path_str(path)) if self._flat_plan else None
+            x = leaf
+            if info is not None:
+                shape, n, _ = info
+                x = jax.lax.with_sharding_constraint(x, self._sh(P()))
+                x = x[:n].reshape(shape)
+            return x.astype(dtype)
+
+        return jax.tree_util.tree_map_with_path(f, params)
+
+    def _map_param_shaped_subtrees(self, tree: Any, ref: Any, fn) -> Any:
+        """Convert optimizer-state m/v mirrors between layouts (shared
+        traversal lives in zero/stages.py)."""
+        from deepspeed_tpu.runtime.zero.stages import map_param_shaped_subtrees
+
+        return map_param_shaped_subtrees(tree, ref, fn)
+
+    # -- portable (natural-layout) checkpoint conversion ----------------
+    # Flat-padded leaf sizes depend on fsdp_size, so checkpoints store
+    # the natural layout: a job restoring at a different fsdp degree
+    # re-pads for its own mesh (the elastic-resize story stays intact).
+    def _to_portable_state(self, state: Any) -> Any:
+        if not self._flat_plan:
+            return state
+        ref = state["params"]  # state layout — the shape reference for m/v mirrors
+        out = dict(state)
+        out["params"] = self._unflatten_state_leaves(state["params"])
+        if self._use_grad_acc and out.get("grad_acc"):
+            out["grad_acc"] = self._unflatten_state_leaves(state["grad_acc"])
+        if out.get("opt_state"):
+            out["opt_state"] = self._map_param_shaped_subtrees(
+                state["opt_state"], ref, self._unflatten_state_leaves
+            )
+        return out
+
+    def _from_portable_state(self, portable: Any) -> Any:
+        if not self._flat_plan:
+            return portable
+        out = dict(portable)
+        if out.get("opt_state"):
+            out["opt_state"] = self._map_param_shaped_subtrees(
+                portable["opt_state"], portable["params"], self._flatten_state_leaves
+            )
+        out["params"] = self._flatten_state_leaves(portable["params"])
+        if self._use_grad_acc and out.get("grad_acc"):
+            out["grad_acc"] = self._flatten_state_leaves(portable["grad_acc"])
+        return out
+
+    def _portable_target(self) -> Any:
+        """Abstract (ShapeDtypeStruct) tree describing the on-disk
+        checkpoint layout, with shardings for orbax resharding-on-read."""
+        abstract = jax.tree.map(
+            lambda x, s: jax.ShapeDtypeStruct(np.shape(x), x.dtype, sharding=s),
+            self.state,
+            self._state_shardings,
+        )
+        if not self._flat_plan:
+            return abstract
+        from deepspeed_tpu.runtime.zero.stages import _path_str
+
+        repl = self._sh(P())
+
+        def unflat_abs(tree):
+            def f(path, leaf):
+                info = self._flat_plan.get(_path_str(path))
+                if info is None:
+                    return leaf
+                shape, _, _ = info
+                return jax.ShapeDtypeStruct(shape, leaf.dtype, sharding=repl)
+
+            return jax.tree_util.tree_map_with_path(f, tree)
+
+        out = dict(abstract)
+        out["params"] = unflat_abs(abstract["params"])
+        if self._use_grad_acc and out.get("grad_acc"):
+            out["grad_acc"] = unflat_abs(abstract["grad_acc"])
+        if out.get("opt_state"):
+            out["opt_state"] = self._map_param_shaped_subtrees(
+                out["opt_state"], abstract["params"], unflat_abs
+            )
+        return out
+
+    # ------------------------------------------------------------------
     # core compiled steps
     # ------------------------------------------------------------------
     def _compute_loss(self, params, batch, rng, ls_state):
-        cparams = jax.tree.map(lambda p: p.astype(self.compute_dtype), params)
+        cparams = self._materialize_params(params, self.compute_dtype)
         out = self._model_fn(cparams, batch, rng)
         loss = self._loss_fn(out, batch) if self._loss_fn is not None else out
         loss = jnp.asarray(loss)
@@ -729,7 +865,7 @@ class DeepSpeedEngine:
         if "predict" not in self._compiled:
 
             def pred_fn(state, b):
-                cparams = jax.tree.map(lambda p: p.astype(self.compute_dtype), state["params"])
+                cparams = self._materialize_params(state["params"], self.compute_dtype)
                 return self._model_fn(cparams, b, None)
 
             self._compiled["predict"] = jax.jit(pred_fn)

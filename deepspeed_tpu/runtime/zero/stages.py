@@ -95,9 +95,49 @@ class ZeroShardingRules:
         self.stage = zero_config.stage
         self.fsdp_size = fsdp_size
         self.tp_spec_fn = tp_spec_fn or (lambda path, shape: None)
+        # paths stored flat-padded in engine state (see plan_flat)
+        self.flat_paths: set = set()
+
+    # -- flat-fallback plan ------------------------------------------------
+    def plan_flat(self, params: Any) -> dict:
+        """Choose leaves that dimension-wise sharding cannot cover — no
+        axis divisible by ``fsdp_size`` and no tensor-parallel spec — and
+        return ``{path: (shape, size, padded_size)}`` for them.
+
+        The engine stores those leaves (params / grads / optimizer state)
+        as zero-padded 1-D fp32 vectors sharded over ``fsdp``, the JAX
+        analog of the reference's flattened contiguous partitions
+        (``stage2.py:432``, ``partition_parameters.py:688``): every
+        element shards 1/W regardless of tensor shape.
+        """
+        plan: dict = {}
+        if self.fsdp_size <= 1 or self.stage < 1:
+            self.flat_paths = set()
+            return plan
+        threshold = self.config.param_persistence_threshold if self.stage >= 3 else 0
+
+        def visit(path, leaf):
+            p = _path_str(path)
+            shape = tuple(np.shape(leaf))
+            n = int(np.prod(shape)) if shape else 1
+            if not shape or n < max(self.fsdp_size, threshold):
+                return
+            if self.tp_spec_fn(p, shape) is not None:
+                return
+            spec = add_fsdp_axis(shape, None, self.fsdp_size)
+            if any(a == "fsdp" for a in _spec_tuple(spec, len(shape))):
+                return  # dim-shardable: the normal path covers it
+            padded = -(-n // self.fsdp_size) * self.fsdp_size
+            plan[p] = (shape, n, padded)
+
+        jax.tree_util.tree_map_with_path(visit, params)
+        self.flat_paths = set(plan)
+        return plan
 
     # -- params ------------------------------------------------------------
     def param_spec(self, path, shape) -> P:
+        if path in self.flat_paths:
+            return P("fsdp") if self.stage >= 3 else P()
         base = self.tp_spec_fn(path, shape)
         if self.stage >= 3 and self.fsdp_size > 1:
             return add_fsdp_axis(shape, base, self.fsdp_size, min_size=self.config.param_persistence_threshold)
@@ -105,6 +145,8 @@ class ZeroShardingRules:
 
     # -- grads -------------------------------------------------------------
     def grad_spec(self, path, shape) -> P:
+        if path in self.flat_paths:
+            return P("fsdp") if self.stage >= 2 else P()
         base = self.tp_spec_fn(path, shape)
         if self.stage >= 2 and self.fsdp_size > 1:
             # stage 3 grads are sharded the same way as the param so the
@@ -115,6 +157,8 @@ class ZeroShardingRules:
 
     # -- optimizer state ---------------------------------------------------
     def opt_spec(self, path, shape) -> P:
+        if path in self.flat_paths:
+            return P("fsdp")
         base = self.tp_spec_fn(path, shape)
         if self.stage >= 1 and self.fsdp_size > 1:
             min_size = self.config.param_persistence_threshold if self.stage >= 3 else 0
@@ -151,43 +195,45 @@ def _tree_specs_with_paths(tree: Any, spec_fn) -> Any:
     return jax.tree_util.tree_map_with_path(lambda path, leaf: spec_fn(_path_str(path), leaf.shape), tree)
 
 
-def opt_state_specs(opt_state: Any, params: Any, rules: ZeroShardingRules) -> Any:
-    """Specs for an arbitrary optimizer-state pytree: leaves whose shape
-    matches a param get that param's opt spec; scalars are replicated.
+def map_param_shaped_subtrees(tree: Any, ref: Any, fn, default=None) -> Any:
+    """Apply ``fn`` (a tree transform) to every subtree of ``tree`` whose
+    structure and leaf shapes match ``ref`` (the params tree); everything
+    else is left as-is, or replaced by ``default(leaf)`` when given.
 
-    Works by matching on shape within params-shaped subtrees (AdamState's
-    exp_avg/exp_avg_sq mirror the params treedef).
+    The shape-matching-within-structure trick is how optimizer-state m/v
+    mirrors (AdamState's exp_avg/exp_avg_sq follow the params treedef)
+    are located without knowing the optimizer's state schema.
     """
-    param_leaves = jax.tree.leaves(params)
-    param_struct = jax.tree.structure(params)
-    opt_spec_tree = rules.tree_opt_specs_like(params)
-    spec_leaves = jax.tree.leaves(opt_spec_tree, is_leaf=lambda x: isinstance(x, P))
+    ref_struct = jax.tree.structure(ref)
+    ref_leaves = jax.tree.leaves(ref)
 
-    def leaf_spec(leaf):
-        return None  # placeholder (handled below)
-
-    # Strategy: traverse the opt_state; any subtree whose structure equals
-    # the params structure gets mapped with the param opt specs; any other
-    # leaf (steps, scalars) is replicated.
     def convert(node):
         try:
-            if jax.tree.structure(node) == param_struct:
+            if jax.tree.structure(node) == ref_struct:
                 leaves = jax.tree.leaves(node)
                 if all(
-                    hasattr(l, "shape") and l.shape == p.shape
-                    for l, p in zip(leaves, param_leaves)
+                    hasattr(l, "shape") and tuple(l.shape) == tuple(np.shape(p))
+                    for l, p in zip(leaves, ref_leaves)
                 ):
-                    return jax.tree.unflatten(param_struct, spec_leaves)
+                    return fn(node)
         except Exception:
             pass
         if hasattr(node, "shape"):  # array leaf not matching params
-            return P()
-        # container: recurse over children
+            return node if default is None else default(node)
         if isinstance(node, (list, tuple)):
             converted = [convert(c) for c in node]
             return type(node)(converted) if not hasattr(node, "_fields") else type(node)(*converted)
         if isinstance(node, dict):
             return {k: convert(v) for k, v in node.items()}
-        return P()
+        return node if default is None else default(node)
 
-    return convert(opt_state)
+    return convert(tree)
+
+
+def opt_state_specs(opt_state: Any, params: Any, rules: ZeroShardingRules) -> Any:
+    """Specs for an arbitrary optimizer-state pytree: leaves whose shape
+    matches a param get that param's opt spec; scalars are replicated."""
+    opt_spec_tree = rules.tree_opt_specs_like(params)
+    return map_param_shaped_subtrees(
+        opt_state, params, lambda node: opt_spec_tree, default=lambda leaf: P()
+    )
