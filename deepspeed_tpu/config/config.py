@@ -358,6 +358,10 @@ class PipelineConfig:
     partition: str = "best"
     seed_layers: bool = False
     activation_checkpoint_interval: int = 0
+    # "1f1b" (reference TrainSchedule, schedule.py:182 — live activations
+    # bounded by the stage count) or "gpipe" (all-forward-then-all-
+    # backward — lower bubble in the compiled formulation, O(M) memory)
+    schedule: str = "1f1b"
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "PipelineConfig":
@@ -369,7 +373,10 @@ class PipelineConfig:
             partition=_pop(d, "partition", "best"),
             seed_layers=bool(_pop(d, "seed_layers", False)),
             activation_checkpoint_interval=int(_pop(d, "activation_checkpoint_interval", 0)),
+            schedule=str(_pop(d, "schedule", "1f1b")).lower(),
         )
+        if out.schedule not in ("1f1b", "gpipe"):
+            raise ValueError(f"pipeline.schedule must be '1f1b' or 'gpipe', got {out.schedule!r}")
         _check_empty(d, C.PIPELINE)
         return out
 

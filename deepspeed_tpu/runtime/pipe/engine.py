@@ -19,12 +19,26 @@ Shape handshakes disappear (static shapes), and XLA overlaps the
 permute transfers with stage compute — the role of the reference's
 even/odd send/recv interleave (schedule.py:249).
 
-Scheduling semantics match ``GPipe`` (all-forward then all-backward per
-batch with per-microbatch remat); the 1F1B instruction stream in
-``schedule.py`` remains the documented per-rank equivalent and is used
-for buffer/bubble accounting.  Like the reference (pipe/engine.py:56),
-ZeRO stages >= 2 are rejected; stage 0/1 compose (optimizer state
-sharded over ``fsdp``).
+Two schedules (``pipeline.schedule`` config key):
+
+* ``"1f1b"`` (default) — true one-forward-one-backward: a single scan
+  of ``M + 2(S-1)`` ticks where each tick runs one forward slot and one
+  backward slot per stage, with explicit per-micro-batch ``jax.vjp``
+  recompute in the backward slot.  Slots execute unconditionally with
+  MASKED data (``lax.cond`` would let GSPMD place auto-axis resharding
+  collectives inside stage-divergent branches and deadlock); backward
+  masking is exact because VJPs are linear in the cotangent.  Saved
+  stage inputs live in a ring buffer of ``2S-1`` slots, so activation
+  memory is **O(S), independent of M** — the property the reference's
+  ``TrainSchedule`` (schedule.py:182, engine.py:540-1005) exists to
+  provide.  The loss head runs inside the last stage's tick so backward
+  of micro-batch m starts as soon as its forward completes.
+* ``"gpipe"`` — all-forward-then-all-backward via autodiff of the tick
+  scan: lower bubble in this compiled formulation (the transposed scan
+  reuses the forward's tick count) but activation live-set grows with M.
+
+Like the reference (pipe/engine.py:56), ZeRO stages >= 2 are rejected;
+stage 0/1 compose (optimizer state sharded over ``fsdp``).
 
 Tied layers (embedding ⇄ head) live outside the pipelined body and are
 replicated over ``pipe``, so the reference's tied-grad all-reduce
@@ -43,7 +57,6 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from deepspeed_tpu.config.config import DeepSpeedConfig
 from deepspeed_tpu.runtime.engine import DeepSpeedEngine
 from deepspeed_tpu.runtime.pipe.module import PipelineModule
-from deepspeed_tpu.runtime.pipe.schedule import TrainSchedule
 from deepspeed_tpu.utils.logging import log_dist
 
 
@@ -90,10 +103,18 @@ class PipelineEngine(DeepSpeedEngine):
             **kw,
         )
 
-        sched = TrainSchedule(self._micro_batches, self.num_stages, 0)
+        self._schedule = config.pipeline.schedule
+        M, S = self._micro_batches, self.num_stages
+        # compiled-formulation bubble: GPipe pays (S-1) idle ticks each
+        # way but its transpose reuses the forward tick count; the
+        # masked 1F1B loop runs M+2(S-1) uniform ticks for M of work
+        bubble = (2 * (S - 1) / (M + 2 * (S - 1))) if self._schedule == "1f1b" else (
+            (S - 1) / (M + S - 1)
+        )
         log_dist(
-            f"pipeline engine: stages={self.num_stages} micro_batches={self._micro_batches} "
-            f"body_layers={module.body_len} bubble={sched.bubble_fraction():.1%}"
+            f"pipeline engine: stages={S} micro_batches={M} "
+            f"body_layers={module.body_len} schedule={self._schedule} "
+            f"bubble={bubble:.1%}"
         )
 
     # ------------------------------------------------------------------
@@ -153,16 +174,11 @@ class PipelineEngine(DeepSpeedEngine):
         loss = jnp.asarray(loss)
         return jnp.mean(loss) if loss.ndim else loss
 
-    def _pipeline_body(self, block_params: Any, x_mb: jnp.ndarray, rng) -> jnp.ndarray:
-        """GPipe over the stacked body under shard_map('pipe').
-
-        ``block_params`` leaves: [L, ...] sharded P('pipe') → local [K, ...].
-        ``x_mb``: [M, mb, ...] replicated over pipe (sharded over data on
-        the mb dim by the automatic axes).
-        """
+    def _stage_pass_fn(self) -> Callable:
+        """One stage's forward over its local K stacked blocks — shared
+        by the GPipe body and the 1F1B slots (the per-layer rng fold and
+        remat wrapping must stay identical between the two schedules)."""
         module = self.pipe_module
-        S = self.num_stages
-        M = self._micro_batches
         apply_blk = module.apply_block
         if module.activation_checkpoint_interval > 0:
             # per-microbatch-per-stage remat: the GPipe memory recipe
@@ -179,6 +195,20 @@ class PipelineEngine(DeepSpeedEngine):
 
             (h, _), _ = jax.lax.scan(body, (h, layer0), bp_local)
             return h
+
+        return stage_pass
+
+    def _pipeline_body(self, block_params: Any, x_mb: jnp.ndarray, rng) -> jnp.ndarray:
+        """GPipe over the stacked body under shard_map('pipe').
+
+        ``block_params`` leaves: [L, ...] sharded P('pipe') → local [K, ...].
+        ``x_mb``: [M, mb, ...] replicated over pipe (sharded over data on
+        the mb dim by the automatic axes).
+        """
+        module = self.pipe_module
+        S = self.num_stages
+        M = self._micro_batches
+        stage_pass = self._stage_pass_fn()
 
         def pipelined(bp_local, x_local, r):
             stage = jax.lax.axis_index("pipe")
@@ -229,6 +259,187 @@ class PipelineEngine(DeepSpeedEngine):
         )(block_params, x_mb, rng)
 
     # ------------------------------------------------------------------
+    # 1F1B: manual forward/backward interleave (reference TrainSchedule
+    # semantics, schedule.py:182 + engine.py:540-1005)
+    # ------------------------------------------------------------------
+    def _1f1b_loss_and_grads(self, params: Any, batch: Any, rng, ls_state):
+        """Returns ``(mean_loss, grads)`` with grads already loss-scaled
+        (what ``value_and_grad`` of the scaled loss would produce), via an
+        explicit 1F1B tick loop: live saved activations are bounded by the
+        ring buffer (2S-1 micro-batch inputs per stage) instead of
+        growing with the micro-batch count."""
+        module = self.pipe_module
+        M = self._micro_batches
+        S = self.num_stages
+        K = module.body_len // S
+        inputs, labels = self._split_batch(batch)
+
+        cparams = jax.tree.map(lambda p: p.astype(self.compute_dtype), params)
+        pre_sub = {"pre": cparams.get("pre", {}), "tied": cparams.get("tied", {})}
+        post_sub = {"post": cparams.get("post", {}), "tied": cparams.get("tied", {})}
+        bp = cparams["blocks"]
+
+        def stack_micro(tree):
+            def one(x):
+                B = x.shape[0]
+                assert B % M == 0, f"batch {B} not divisible by {M} micro-batches"
+                x = x.reshape((M, B // M) + x.shape[1:])
+                return jax.lax.with_sharding_constraint(x, self._sh(P(None, ("data", "fsdp"))))
+
+            return jax.tree.map(one, tree)
+
+        inp_mb = stack_micro(inputs)
+        lab_mb = stack_micro(labels)
+        # cotangent seeded per micro-batch: d(scale·mean_m loss_m)/d loss_m
+        cot = (self.loss_scaler.scale_loss(jnp.float32(1.0), ls_state) / M).astype(jnp.float32)
+
+        def dyn(tree, i):
+            return jax.tree.map(lambda a: jax.lax.dynamic_index_in_dim(a, i, 0, keepdims=False), tree)
+
+        def pre_apply(ps, inp, r):
+            full = {"pre": ps["pre"], "tied": ps["tied"]}
+            return module.apply_pre(full, inp, r)
+
+        def post_loss(ps, y, lab, r):
+            full = {"post": ps["post"], "tied": ps["tied"]}
+            out = module.apply_post(full, y, r)
+            loss = module.loss_fn(out, lab) if module.loss_fn is not None else out
+            loss = jnp.asarray(loss)
+            return (jnp.mean(loss) if loss.ndim else loss).astype(jnp.float32)
+
+        stage_pass = self._stage_pass_fn()
+        zeros32 = lambda tree: jax.tree.map(lambda x: jnp.zeros(np.shape(x), jnp.float32), tree)
+
+        # local-activation template (shapes as seen inside the shard_map:
+        # global along auto axes, so eval_shape outside matches)
+        h_abs = jax.eval_shape(lambda ps, im: pre_apply(ps, im, None), pre_sub, dyn(inp_mb, 0))
+
+        def pipelined(bp_all, inp_mb, lab_mb, pre_sub, post_sub, r):
+            stage = jax.lax.axis_index("pipe")
+            layer0 = stage * K
+            T = M + 2 * (S - 1)
+            R = 2 * S - 1
+            hz = jnp.zeros(h_abs.shape, h_abs.dtype)
+
+            def tick(carry, t):
+                # Every slot computes every tick with MASKED data — no
+                # lax.cond: divergent branches would let GSPMD place
+                # auto-axis resharding collectives inside stage-dependent
+                # control flow (= deadlock).  Backward masking is free:
+                # VJPs are linear in the cotangent, so zeroing the seed
+                # zeroes every grad contribution exactly.
+                ring, recv_f, recv_b, dblocks, dpre, dpost, loss_sum = carry
+
+                # ---- forward slot: micro t - stage -------------------
+                mf_raw = t - stage
+                active_f = jnp.logical_and(mf_raw >= 0, mf_raw < M)
+                mf = jnp.clip(mf_raw, 0, M - 1)
+                r_f = None if r is None else jax.random.fold_in(r, mf)
+
+                x_pre = pre_apply(pre_sub, dyn(inp_mb, mf), r_f).astype(hz.dtype)
+                h_in = jnp.where(stage == 0, x_pre, recv_f)
+                y = stage_pass(bp_all, h_in, r_f, layer0)
+                slot = jax.lax.rem(mf, R)
+                cur = jax.lax.dynamic_index_in_dim(ring, slot, 0, keepdims=False)
+                ring = jax.lax.dynamic_update_index_in_dim(
+                    ring, jnp.where(active_f, h_in, cur), slot, 0
+                )
+
+                # ---- loss head: last stage, same tick ----------------
+                head_mask = jnp.logical_and(active_f, stage == S - 1)
+                lab_m = dyn(lab_mb, mf)
+
+                def pf(ps, yy):
+                    return post_loss(ps, yy, lab_m, r_f)
+
+                l_m, head_vjp = jax.vjp(pf, post_sub, y)
+                dpost_d, dy_self = head_vjp(jnp.where(head_mask, cot, 0.0))
+                loss_sum = loss_sum + jnp.where(head_mask, l_m, 0.0)
+                dpost = jax.tree.map(lambda a, d: a + d.astype(jnp.float32), dpost, dpost_d)
+
+                # ---- backward slot: micro t - 2(S-1) + stage ---------
+                mb_raw = t - 2 * (S - 1) + stage
+                active_b = jnp.logical_and(mb_raw >= 0, mb_raw < M)
+                mb_i = jnp.clip(mb_raw, 0, M - 1)
+                r_b = None if r is None else jax.random.fold_in(r, mb_i)
+                dy_in = jnp.where(
+                    active_b, jnp.where(stage == S - 1, dy_self, recv_b), jnp.zeros_like(hz)
+                )
+                x_saved = jax.lax.dynamic_index_in_dim(ring, jax.lax.rem(mb_i, R), 0, keepdims=False)
+
+                def f_blk(bpp, xx):
+                    return stage_pass(bpp, xx, r_b, layer0)
+
+                _, blk_vjp = jax.vjp(f_blk, bp_all, x_saved)
+                dbp_d, dx = blk_vjp(dy_in)
+                dblocks = jax.tree.map(lambda a, d: a + d.astype(jnp.float32), dblocks, dbp_d)
+
+                def f_pre(ps):
+                    return pre_apply(ps, dyn(inp_mb, mb_i), r_b).astype(hz.dtype)
+
+                _, pre_vjp = jax.vjp(f_pre, pre_sub)
+                (dpre_d,) = pre_vjp(jnp.where(stage == 0, dx, jnp.zeros_like(dx)))
+                dpre = jax.tree.map(lambda a, d: a + d.astype(jnp.float32), dpre, dpre_d)
+
+                # ---- rotate --------------------------------------------
+                recv_f = jax.lax.ppermute(y, "pipe", [(i, (i + 1) % S) for i in range(S)])
+                recv_b = jax.lax.ppermute(dx, "pipe", [(i, (i - 1) % S) for i in range(S)])
+                return (ring, recv_f, recv_b, dblocks, dpre, dpost, loss_sum), None
+
+            carry0 = (
+                jnp.zeros((R,) + h_abs.shape, h_abs.dtype),
+                hz,
+                hz,
+                zeros32(bp_all),
+                zeros32(pre_sub),
+                zeros32(post_sub),
+                jnp.float32(0.0),
+            )
+            (ring, _, _, dblocks, dpre, dpost, loss_sum), _ = jax.lax.scan(
+                tick, carry0, jnp.arange(T)
+            )
+            # only one stage contributed to each of these: psum = select+broadcast
+            loss_sum = jax.lax.psum(loss_sum, "pipe")
+            dpre = jax.lax.psum(dpre, "pipe")
+            dpost = jax.lax.psum(dpost, "pipe")
+            return loss_sum / M, dblocks, dpre, dpost
+
+        in_specs = [
+            jax.tree.map(lambda _: P("pipe"), bp),
+            jax.tree.map(lambda _: P(), inp_mb),
+            jax.tree.map(lambda _: P(), lab_mb),
+            jax.tree.map(lambda _: P(), pre_sub),
+            jax.tree.map(lambda _: P(), post_sub),
+        ]
+        out_specs = (
+            P(),
+            jax.tree.map(lambda _: P("pipe"), bp),
+            jax.tree.map(lambda _: P(), pre_sub),
+            jax.tree.map(lambda _: P(), post_sub),
+        )
+        args = [bp, inp_mb, lab_mb, pre_sub, post_sub]
+        if rng is not None:
+            in_specs.append(P())
+            args.append(rng)
+            fn = pipelined
+        else:
+            fn = lambda b_, i_, l_, pr_, po_: pipelined(b_, i_, l_, pr_, po_, None)
+        loss, dblocks, dpre, dpost = jax.shard_map(
+            fn, mesh=self.mesh, in_specs=tuple(in_specs), out_specs=out_specs,
+            axis_names={"pipe"}, check_vma=False,
+        )(*args)
+
+        grads = {
+            "pre": dpre["pre"],
+            "blocks": dblocks,
+            "post": dpost["post"],
+            "tied": jax.tree.map(jnp.add, dpre["tied"], dpost["tied"]),
+        }
+        # match the params tree exactly (build_params always has all keys)
+        grads = {k: grads[k] if k in grads else zeros32(v) for k, v in params.items()}
+        return loss, grads
+
+    # ------------------------------------------------------------------
     # public API (reference train_batch, pipe/engine.py:250)
     # ------------------------------------------------------------------
     def _full_batch_from(self, data_iter_or_batch: Any) -> Any:
@@ -252,12 +463,20 @@ class PipelineEngine(DeepSpeedEngine):
         )
 
         if "pipe_train" not in self._compiled:
+            use_1f1b = (
+                self._schedule == "1f1b" and self.num_stages > 1 and bool(self.pipe_module.body_ids)
+            )
 
             def full_step(state, b):
                 rng = jax.random.fold_in(state["rng"], state["global_step"])
-                (scaled_loss, loss), grads = jax.value_and_grad(
-                    lambda p: self._compute_loss(p, b, rng, state["loss_scale"]), has_aux=True
-                )(state["params"])
+                if use_1f1b:
+                    loss, grads = self._1f1b_loss_and_grads(
+                        state["params"], b, rng, state["loss_scale"]
+                    )
+                else:
+                    (scaled_loss, loss), grads = jax.value_and_grad(
+                        lambda p: self._compute_loss(p, b, rng, state["loss_scale"]), has_aux=True
+                    )(state["params"])
                 grads = jax.lax.with_sharding_constraint(
                     grads, jax.tree.map(self._sh, self._grad_specs, is_leaf=lambda s: isinstance(s, P))
                 )
