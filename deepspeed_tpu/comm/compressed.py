@@ -16,7 +16,6 @@ State (worker_error, server_error) lives in the optimizer state.
 """
 from __future__ import annotations
 
-import functools
 from typing import Tuple
 
 import jax
@@ -76,6 +75,27 @@ def _body(x, worker_error, server_error, *, axis_name: str):
     return out[None], new_werr[None], new_serr[None]
 
 
+def _exchange(x_per_rank, worker_error, server_error, mesh, axis_name: str, replicated_out: bool):
+    from jax.sharding import PartitionSpec as P
+
+    n, m = x_per_rank.shape
+    if m % n:
+        raise ValueError(f"tensor length {m} not divisible by axis size {n}")
+
+    def body(x, werr, serr):
+        out, new_werr, new_serr = _body(x, werr, serr, axis_name=axis_name)
+        return (out[0] if replicated_out else out), new_werr, new_serr
+
+    mapped = _shard_map()(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis_name), P(axis_name), P(axis_name)),
+        out_specs=(P() if replicated_out else P(axis_name), P(axis_name), P(axis_name)),
+        check_vma=False,
+    )
+    return mapped(x_per_rank, worker_error, server_error)
+
+
 def compressed_allreduce(x_per_rank, worker_error, server_error, mesh, axis_name: str = "data"):
     """1-bit error-feedback averaged allreduce.
 
@@ -84,18 +104,13 @@ def compressed_allreduce(x_per_rank, worker_error, server_error, mesh, axis_name
     Returns (avg (n, M) — every row identical, new_worker_error,
     new_server_error), all sharded over ``axis_name``.
     """
-    from jax.sharding import PartitionSpec as P
+    return _exchange(x_per_rank, worker_error, server_error, mesh, axis_name, replicated_out=False)
 
-    n = x_per_rank.shape[0]
-    m = x_per_rank.shape[1]
-    if m % n:
-        raise ValueError(f"tensor length {m} not divisible by axis size {n}")
-    fn = functools.partial(_body, axis_name=axis_name)
-    mapped = _shard_map()(
-        fn,
-        mesh=mesh,
-        in_specs=(P(axis_name), P(axis_name), P(axis_name)),
-        out_specs=(P(axis_name), P(axis_name), P(axis_name)),
-        check_vma=False,
-    )
-    return mapped(x_per_rank, worker_error, server_error)
+
+def compressed_allreduce_replicated(x_per_rank, worker_error, server_error, mesh, axis_name: str = "data"):
+    """Like :func:`compressed_allreduce` but returns the averaged vector
+    as a single replicated ``(M,)`` array — free, because phase 3's
+    all-gather already leaves the full result on every rank; declaring
+    the output replicated avoids a redundant broadcast at the engine
+    boundary (this is the training-path entry point)."""
+    return _exchange(x_per_rank, worker_error, server_error, mesh, axis_name, replicated_out=True)

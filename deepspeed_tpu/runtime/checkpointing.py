@@ -108,6 +108,18 @@ def load_checkpoint(
         logger.warning(f"checkpoint {path} not found")
         return None, {}
 
+    # phase-dependent state layouts (1-bit Adam's compressed phase) must
+    # be aligned with the tag's step count BEFORE the restore target is
+    # built, or the on-disk tree won't match
+    meta_path = os.path.join(path, "meta.json")
+    meta: Dict[str, Any] = {}
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+        sync_phase = getattr(engine, "_sync_onebit_phase", None)
+        if sync_phase is not None:
+            sync_phase(int(meta.get("global_step", 0)))
+
     ckptr = _checkpointer()
     # Abstract target: checkpoint-layout shapes + *current* shardings —
     # orbax reshards on read, giving elastic DP/MP resize on load.
@@ -163,11 +175,8 @@ def load_checkpoint(
             # rebuild fp32 masters from the restored (compute-dtype) params
             host_opt.load_masters(jax.tree.map(np.asarray, restored["params"]))
 
-    meta_path = os.path.join(path, "meta.json")
     client_state: Dict[str, Any] = {}
-    if os.path.exists(meta_path):
-        with open(meta_path) as f:
-            meta = json.load(f)
+    if meta:
         client_state = meta.get("client_state", {})
         engine.skipped_steps = meta.get("skipped_steps", 0)
         if load_lr_scheduler_states and engine.client_lr_scheduler is not None and hasattr(engine.client_lr_scheduler, "load_state_dict"):

@@ -255,6 +255,28 @@ class DeepSpeedEngine:
                 theta=config.progressive_layer_drop.theta, gamma=config.progressive_layer_drop.gamma
             )
 
+        # -- 1-bit Adam compressed-exchange phase --------------------------
+        # After freeze_step the engine switches to a SECOND compiled
+        # train step that keeps per-rank gradients UNREDUCED (vmap over
+        # data-axis slices) and exchanges the momentum through the
+        # error-feedback 1-bit collective (comm/compressed.py) — the
+        # reference's comm-volume saving (onebit/adam.py:110-220 over
+        # nccl.py:47-186), realized as two executables because a single
+        # program would pay for both exchange paths every step.
+        from deepspeed_tpu.runtime.fp16.onebit.adam import OnebitAdam
+
+        self._onebit_frozen = False
+        self._onebit_exchange_ok = (
+            isinstance(self.optimizer, OnebitAdam)
+            and self.mesh_info.sizes.get("data", 1) > 1
+            and self.mesh_info.fsdp_world_size == 1
+            and self._use_grad_acc
+            and not self._offload
+            and self.quantizer is None
+            and self.progressive_layer_drop is None
+            and self.config.gradient_clipping <= 0.0
+        )
+
         # -- host-side bookkeeping ----------------------------------------
         from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
         from deepspeed_tpu.utils.monitor import TensorBoardMonitor
@@ -660,6 +682,133 @@ class DeepSpeedEngine:
         }
 
     # ------------------------------------------------------------------
+    # 1-bit Adam frozen phase
+    # ------------------------------------------------------------------
+    def _sync_onebit_phase(self, global_step: int) -> None:
+        """Align the compressed-exchange phase with a tag's step count
+        (called before checkpoint restore so state layouts match).  A
+        tag at exactly freeze_step is still warm-layout — the phase
+        flips lazily at the start of the NEXT train_batch — and loading
+        a pre-freeze tag into a frozen engine rolls the layout back."""
+        if not self._onebit_exchange_ok:
+            return
+        if not self._onebit_frozen and global_step > self.optimizer.freeze_step:
+            self._enter_onebit_frozen()
+        elif self._onebit_frozen and global_step <= self.optimizer.freeze_step:
+            self._exit_onebit_frozen()
+
+    def _enter_onebit_frozen(self) -> None:
+        from deepspeed_tpu.runtime.fp16.onebit.adam import FrozenOnebitAdamState
+
+        n = self.mesh_info.sizes["data"]
+        sh = FrozenOnebitAdamState(
+            step=self._sh(P()),
+            m_flat=self._sh(P()),
+            v_flat=self._sh(P()),
+            worker_error=self._sh(P("data")),
+            server_error=self._sh(P("data")),
+        )
+        self.state["opt_state"] = jax.jit(
+            lambda s: self.optimizer.make_frozen_state(s, n), out_shardings=sh
+        )(self.state["opt_state"])
+        self._state_shardings["opt_state"] = sh
+        self._opt_specs = FrozenOnebitAdamState(
+            step=P(), m_flat=P(), v_flat=P(), worker_error=P("data"), server_error=P("data")
+        )
+        # the frozen path accumulates into its own (n, Mp) rows buffer —
+        # free the params-sized fp32 accumulator
+        self.state["grad_acc"] = {}
+        self._state_shardings["grad_acc"] = {}
+        # the warmup executables close over the old opt-state layout
+        self._compiled = {k: v for k, v in self._compiled.items() if not (
+            isinstance(k, tuple) and k[0] == "train_batch"
+        ) and k not in ("micro_step", "apply_step")}
+        self._onebit_frozen = True
+        log_dist(
+            f"1-bit Adam: entering compressed-exchange phase at step "
+            f"{self._host_global_step} (freeze_step={self.optimizer.freeze_step}, data={n})"
+        )
+
+    def _exit_onebit_frozen(self) -> None:
+        """Frozen → warmup layout (pre-freeze checkpoint rollback): the
+        values are about to be overwritten by the restore, so fresh
+        zero-initialized warm state with the right shapes suffices."""
+        params = self.state["params"]
+        opt_state = jax.eval_shape(self.optimizer.init, params)
+        self._opt_specs = opt_state_specs(opt_state, params, self.zero_rules)
+        opt_sh = jax.tree.map(self._sh, self._opt_specs, is_leaf=lambda x: isinstance(x, P))
+        self.state["opt_state"] = jax.jit(self.optimizer.init, out_shardings=opt_sh)(params)
+        self._state_shardings["opt_state"] = opt_sh
+        grad_sh = jax.tree.map(self._sh, self._grad_specs, is_leaf=lambda x: isinstance(x, P))
+        self.state["grad_acc"] = jax.jit(
+            lambda p: jax.tree.map(lambda x: jnp.zeros(x.shape, jnp.float32), p),
+            out_shardings=grad_sh,
+        )(params)
+        self._state_shardings["grad_acc"] = grad_sh
+        self._compiled = {k: v for k, v in self._compiled.items() if not (
+            isinstance(k, tuple) and k[0] == "train_batch"
+        ) and k not in ("micro_step", "apply_step")}
+        self._onebit_frozen = False
+        log_dist("1-bit Adam: rolled back to warmup (pre-freeze) state layout")
+
+    def _frozen_full_step(self, state, stacked):
+        """Compiled train step for the compressed phase: per-rank grads
+        stay unreduced; only 1-bit momentum crosses the wire."""
+        from deepspeed_tpu.runtime.fp16.onebit.adam import pack_flat, pack_rows, unpack_flat
+
+        n = self.mesh_info.sizes["data"]
+        gas = self.gradient_accumulation_steps
+        mp = state["opt_state"].m_flat.shape[0]
+        acc0 = jax.lax.with_sharding_constraint(
+            jnp.zeros((n, mp), jnp.float32), self._sh(P("data"))
+        )
+
+        def body(carry, mb):
+            st, acc = carry
+            rng = jax.random.fold_in(st["rng"], st["micro_step"])
+
+            def rows_of(x):
+                return x.reshape((n, x.shape[0] // n) + x.shape[1:])
+
+            b_rows = jax.tree.map(rows_of, mb)
+
+            def slice_loss(p, b):
+                return self._compute_loss(p, b, rng, st["loss_scale"])
+
+            (_, loss), g = jax.vmap(
+                jax.value_and_grad(slice_loss, has_aux=True), in_axes=(None, 0)
+            )(st["params"], b_rows)
+            g_rows = jax.lax.with_sharding_constraint(
+                pack_rows(g, n, n), self._sh(P("data"))
+            )
+            st = dict(st)
+            st["micro_step"] = st["micro_step"] + 1
+            st["global_samples"] = (
+                st["global_samples"]
+                + self.train_micro_batch_size_per_gpu * self.mesh_info.dp_world_size
+            )
+            return (st, acc + g_rows), jnp.mean(loss)
+
+        (state, acc), losses = jax.lax.scan(body, (state, acc0), stacked)
+        scale = self.loss_scaler.scale_loss(jnp.float32(1.0), state["loss_scale"])
+        g_rows = acc / (gas * scale)
+        overflow = ~jnp.isfinite(jnp.sum(g_rows))
+        lr = jnp.asarray(self.lr_schedule(state["global_step"]), jnp.float32)
+        p_flat = pack_flat(state["params"], n)
+        upd, new_opt = self.optimizer.frozen_apply(
+            g_rows, state["opt_state"], p_flat, lr, self.mesh, "data"
+        )
+        state = dict(state)
+        state["params"] = unpack_flat(jnp.where(overflow, p_flat, p_flat + upd), state["params"])
+        state["opt_state"] = jax.tree.map(
+            lambda old, new: jnp.where(overflow, old, new), state["opt_state"], new_opt
+        )
+        state["global_step"] = state["global_step"] + jnp.where(overflow, 0, 1)
+        state["loss_scale"] = self.loss_scaler.update(state["loss_scale"], overflow)
+        info = {"lr": lr, "grad_norm": jnp.zeros((), jnp.float32), "overflow": overflow}
+        return state, jnp.mean(losses), info
+
+    # ------------------------------------------------------------------
     # public training API
     # ------------------------------------------------------------------
     def _stacked_sharding(self, ndim_stacked: int):
@@ -711,6 +860,11 @@ class DeepSpeedEngine:
         be split across Python calls, so gradients are produced here and
         folded into the accumulator; ``backward()`` validates ordering.
         """
+        if self._onebit_frozen:
+            raise RuntimeError(
+                "the 1-bit compressed phase runs whole batches (its gradient "
+                "accumulator lives inside the compiled step); use train_batch()"
+            )
         if self.wall_clock_breakdown:
             self.timers(FORWARD_TIMER).start()
         batch = self._prepare_batch(batch)
@@ -780,24 +934,38 @@ class DeepSpeedEngine:
         must stay off the hot path).
         """
         self.tput_timer.start()
+        if (
+            self._onebit_exchange_ok
+            and not self._onebit_frozen
+            and self._host_global_step >= self.optimizer.freeze_step
+        ):
+            self._enter_onebit_frozen()
         stacked = self._stack_and_place(batch)
 
-        tb_key = ("train_batch", tuple(np.shape(x) for x in jax.tree.leaves(stacked)))
+        tb_key = (
+            "train_batch",
+            self._onebit_frozen,
+            tuple(np.shape(x) for x in jax.tree.leaves(stacked)),
+        )
         if tb_key not in self._compiled:
             # with offload, the compiled program ends after the micro-batch
             # scan — the optimizer step runs on host (ZeRO-Offload splits
             # exactly here)
             apply_in_graph = not self._offload
 
-            def full_step(state, stacked):
-                def body(st, mb):
-                    return self._micro_step_impl(st, mb)
+            if self._onebit_frozen:
+                full_step = self._frozen_full_step
+            else:
 
-                state, losses = jax.lax.scan(body, state, stacked)
-                if apply_in_graph:
-                    state, info = self._apply_step_impl(state)
-                    return state, jnp.mean(losses), info
-                return state, jnp.mean(losses)
+                def full_step(state, stacked):
+                    def body(st, mb):
+                        return self._micro_step_impl(st, mb)
+
+                    state, losses = jax.lax.scan(body, state, stacked)
+                    if apply_in_graph:
+                        state, info = self._apply_step_impl(state)
+                        return state, jnp.mean(losses), info
+                    return state, jnp.mean(losses)
 
             # AOT compile: the executable's cost_analysis feeds the flops
             # profiler for free (no second trace/compile at profile time).

@@ -22,6 +22,7 @@ from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from deepspeed_tpu.ops.adam.fused_adam import _map_multi
 
@@ -31,6 +32,50 @@ class OnebitAdamState(NamedTuple):
     exp_avg: Any
     exp_avg_sq: Any  # frozen after freeze_step
     worker_error: Any  # error-feedback residual per param
+
+
+class FrozenOnebitAdamState(NamedTuple):
+    """Compressed-exchange phase state (engine's frozen train path).
+
+    The momentum and frozen variance live as one fused flat fp32 vector
+    (padded to a multiple of the data-axis size), matching the
+    reference's flattened fused buffer (onebit/adam.py:141); the
+    error-feedback residuals are PER-RANK rows sharded over ``data``
+    (reference worker_error/server_error, comm/nccl.py:47-186)."""
+
+    step: jnp.ndarray
+    m_flat: jnp.ndarray  # (Mp,) replicated — synced momentum
+    v_flat: jnp.ndarray  # (Mp,) replicated — frozen variance
+    worker_error: jnp.ndarray  # (n, Mp) sharded over data
+    server_error: jnp.ndarray  # (n, Mp // n) sharded over data
+
+
+def pack_flat(tree: Any, multiple: int) -> jnp.ndarray:
+    """Concat ravelled fp32 leaves, zero-padded to a length multiple."""
+    leaves = jax.tree.leaves(tree)
+    flat = jnp.concatenate([l.reshape(-1).astype(jnp.float32) for l in leaves])
+    pad = (-flat.shape[0]) % multiple
+    return jnp.pad(flat, (0, pad)) if pad else flat
+
+
+def pack_rows(tree: Any, n: int, multiple: int) -> jnp.ndarray:
+    """Leaves shaped (n, *shape) → one (n, Mp) fp32 matrix (padded)."""
+    leaves = jax.tree.leaves(tree)
+    flat = jnp.concatenate([l.reshape(n, -1).astype(jnp.float32) for l in leaves], axis=1)
+    pad = (-flat.shape[1]) % multiple
+    return jnp.pad(flat, ((0, 0), (0, pad))) if pad else flat
+
+
+def unpack_flat(flat: jnp.ndarray, template: Any) -> Any:
+    """Inverse of pack_flat: slice/reshape back to the template's leaves
+    (original dtypes restored)."""
+    leaves, treedef = jax.tree.flatten(template)
+    out, off = [], 0
+    for l in leaves:
+        size = int(np.prod(np.shape(l))) if np.shape(l) else 1
+        out.append(flat[off : off + size].reshape(np.shape(l)).astype(l.dtype))
+        off += size
+    return jax.tree.unflatten(treedef, out)
 
 
 class OnebitAdam:
@@ -97,3 +142,50 @@ class OnebitAdam:
 
         updates, m, v, werr = _map_multi(one, 4, grads, state.exp_avg, state.exp_avg_sq, state.worker_error, params)
         return updates, OnebitAdamState(step=step, exp_avg=m, exp_avg_sq=v, worker_error=werr)
+
+    # ------------------------------------------------------------------
+    # compressed-exchange (frozen) phase — used by the engine's frozen
+    # train executable (reference onebit/adam.py:110-220 + nccl.py:47)
+    # ------------------------------------------------------------------
+    def make_frozen_state(self, state: OnebitAdamState, n_ranks: int) -> FrozenOnebitAdamState:
+        """One-time warmup→frozen layout conversion at the freeze step."""
+        m_flat = pack_flat(state.exp_avg, n_ranks)
+        v_flat = pack_flat(state.exp_avg_sq, n_ranks)
+        mp = m_flat.shape[0]
+        return FrozenOnebitAdamState(
+            step=state.step,
+            m_flat=m_flat,
+            v_flat=v_flat,
+            worker_error=jnp.zeros((n_ranks, mp), jnp.float32),
+            server_error=jnp.zeros((n_ranks, mp // n_ranks), jnp.float32),
+        )
+
+    def frozen_apply(
+        self,
+        g_rows: jnp.ndarray,  # (n, Mp) per-rank UNREDUCED averaged grads
+        fstate: FrozenOnebitAdamState,
+        p_flat: jnp.ndarray,  # (Mp,) fp32 packed params
+        lr,
+        mesh,
+        axis_name: str = "data",
+    ):
+        """One compressed-momentum step: every rank folds its LOCAL
+        gradient into the synced momentum, the momenta are exchanged
+        1-bit with error feedback, and the update uses the frozen
+        variance (reference onebit/adam.py:148-205)."""
+        from deepspeed_tpu.comm.compressed import compressed_allreduce_replicated
+
+        step = fstate.step + 1
+        m_rows = self.b1 * fstate.m_flat[None, :] + (1.0 - self.b1) * g_rows
+        m_synced, werr, serr = compressed_allreduce_replicated(
+            m_rows, fstate.worker_error, fstate.server_error, mesh, axis_name
+        )
+        c2 = 1.0 - self.b2 ** jnp.float32(self.freeze_step)
+        denom = jnp.sqrt(fstate.v_flat / c2) + self.eps
+        upd = -lr * m_synced / denom
+        if self.weight_decay > 0.0:
+            upd = upd - lr * self.weight_decay * p_flat
+        new_state = FrozenOnebitAdamState(
+            step=step, m_flat=m_synced, v_flat=fstate.v_flat, worker_error=werr, server_error=serr
+        )
+        return upd, new_state

@@ -82,3 +82,154 @@ def test_onebit_optimizers_train(opt_name, freeze, lr):
     # compressed phase active: worker_error populated after freeze
     werr = jax.tree.leaves(engine.state["opt_state"].worker_error)[0]
     assert float(jnp.abs(werr).mean()) > 0
+
+
+# ---------------------------------------------------------------------------
+# compressed-exchange training path (engine frozen phase)
+# ---------------------------------------------------------------------------
+
+_COLLECTIVES = ("all-reduce", "all-gather", "all-to-all", "collective-permute", "reduce-scatter")
+_DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s64": 8, "u64": 8,
+                "s32": 4, "u32": 4, "s16": 2, "u16": 2, "s8": 1, "u8": 1, "pred": 1}
+
+
+def _collective_bytes(hlo_text: str, dtype_filter=None) -> int:
+    """Estimated wire bytes of the collectives in an HLO dump: a ring
+    all-reduce moves ~2x its payload (reduce-scatter + all-gather
+    phases); all-gather / all-to-all / reduce-scatter / permute move ~1x.
+    ``dtype_filter`` restricts the count to one dtype (e.g. "f32")."""
+    import re
+
+    total = 0
+    for line in hlo_text.splitlines():
+        parts = line.split(" = ", 1)
+        if len(parts) != 2:
+            continue
+        rhs = parts[1]
+        # shapes sit between '=' and the op name: "(f32[64]{0}, ...) all-reduce(..."
+        cut = -1
+        weight = 1
+        for c in _COLLECTIVES:
+            for op in (f" {c}(", f" {c}-start("):
+                i = rhs.find(op)
+                if i >= 0 and (cut < 0 or i < cut):
+                    cut = i
+                    weight = 2 if c == "all-reduce" else 1
+        if cut < 0:
+            continue
+        for dt, dims in re.findall(r"(\w+)\[([\d,]*)\]", rhs[:cut]):
+            if dt not in _DTYPE_BYTES or (dtype_filter and dt != dtype_filter):
+                continue
+            n = 1
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            total += n * _DTYPE_BYTES[dt] * weight
+    return total
+
+
+def _train_engine(opt_cfg, steps, gas=2):
+    cfg = base_config(stage=0, mesh={"data": 8}, gas=gas)
+    cfg["optimizer"] = opt_cfg
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_model_loss, model_parameters=simple_model_init(HIDDEN), config=cfg
+    )
+    bs = engine.train_micro_batch_size_per_gpu * gas * engine.mesh_info.dp_world_size
+    batch = random_batches(1, bs, HIDDEN)[0]
+    losses = [float(engine.train_batch(batch)) for _ in range(steps)]
+    return engine, losses
+
+
+def test_onebit_engine_enters_frozen_phase_and_trains():
+    engine, losses = _train_engine(
+        {"type": "OneBitAdam", "params": {"lr": 1e-2, "freeze_step": 3}}, steps=10
+    )
+    assert engine._onebit_exchange_ok and engine._onebit_frozen
+    from deepspeed_tpu.runtime.fp16.onebit.adam import FrozenOnebitAdamState
+
+    assert isinstance(engine.state["opt_state"], FrozenOnebitAdamState)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+    # per-rank error feedback is live
+    assert float(jnp.abs(engine.state["opt_state"].worker_error).mean()) > 0
+
+
+def test_onebit_frozen_collective_bytes_drop_4x():
+    """The point of 1-bit Adam: the compressed phase's train step moves
+    ~4x fewer wire bytes than plain Adam's full-precision grad exchange
+    (int8 signs over all-to-all + all-gather ≈ 2·M bytes vs a ring
+    fp32 all-reduce ≈ 2·4·M — the reference claims up to 5x with true
+    bit-packing, BASELINE.md), and its FULL-PRECISION collective traffic
+    all but disappears (only the per-rank scales and the loss mean)."""
+    adam_engine, _ = _train_engine({"type": "Adam", "params": {"lr": 1e-2}}, steps=1)
+    onebit_engine, _ = _train_engine(
+        {"type": "OneBitAdam", "params": {"lr": 1e-2, "freeze_step": 1}}, steps=3
+    )
+    assert onebit_engine._onebit_frozen
+
+    def tb_text(engine, frozen):
+        key = next(
+            k for k in engine._compiled
+            if isinstance(k, tuple) and k[0] == "train_batch" and k[1] == frozen
+        )
+        return engine._compiled[key].as_text()
+
+    plain_txt = tb_text(adam_engine, False)
+    frozen_txt = tb_text(onebit_engine, True)
+    plain = _collective_bytes(plain_txt)
+    compressed = _collective_bytes(frozen_txt)
+    assert plain > 0 and compressed > 0
+    # structural ratio 8M/(2M+scales) — just under 4x; 3.8 allows the
+    # scale/padding epsilon while still failing for any uncompressed path
+    assert compressed * 3.8 <= plain, (compressed, plain)
+    # fp32 traffic: the grads no longer cross the wire at all
+    assert _collective_bytes(frozen_txt, "f32") * 20 <= _collective_bytes(plain_txt, "f32")
+
+
+def test_onebit_frozen_checkpoint_roundtrip(tmp_path):
+    ck = str(tmp_path / "ck")
+    engine, _ = _train_engine(
+        {"type": "OneBitAdam", "params": {"lr": 1e-2, "freeze_step": 2}}, steps=5
+    )
+    assert engine._onebit_frozen
+    engine.save_checkpoint(ck)
+    ref = [float(engine.train_batch(random_batches(1, 32, HIDDEN)[0])) for _ in range(2)]
+
+    cfg = base_config(stage=0, mesh={"data": 8}, gas=2)
+    cfg["optimizer"] = {"type": "OneBitAdam", "params": {"lr": 1e-2, "freeze_step": 2}}
+    engine2, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_model_loss, model_parameters=simple_model_init(HIDDEN), config=cfg
+    )
+    path, _ = engine2.load_checkpoint(ck)
+    assert path is not None and engine2._onebit_frozen
+    got = [float(engine2.train_batch(random_batches(1, 32, HIDDEN)[0])) for _ in range(2)]
+    np.testing.assert_allclose(ref, got, rtol=1e-5, atol=1e-6)
+
+
+def test_onebit_checkpoint_at_freeze_boundary_and_rollback(tmp_path):
+    """A tag at exactly freeze_step is warm-layout; a post-freeze engine
+    can roll back to it (frozen -> warm layout reversal on load)."""
+    ck = str(tmp_path / "ck")
+    engine, _ = _train_engine(
+        {"type": "OneBitAdam", "params": {"lr": 1e-2, "freeze_step": 2}}, steps=2
+    )
+    assert not engine._onebit_frozen  # phase flips at the NEXT train_batch
+    engine.save_checkpoint(ck, tag="warm")
+    # drive past freeze, then roll back to the warm tag in the same engine
+    batch = random_batches(1, 32, HIDDEN)[0]
+    engine.train_batch(batch)
+    assert engine._onebit_frozen
+    path, _ = engine.load_checkpoint(ck, tag="warm")
+    assert path is not None and not engine._onebit_frozen
+    assert engine.global_steps == 2
+    # and a fresh engine restores the warm tag cleanly too
+    cfg = base_config(stage=0, mesh={"data": 8}, gas=2)
+    cfg["optimizer"] = {"type": "OneBitAdam", "params": {"lr": 1e-2, "freeze_step": 2}}
+    engine2, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_model_loss, model_parameters=simple_model_init(HIDDEN), config=cfg
+    )
+    path, _ = engine2.load_checkpoint(ck, tag="warm")
+    assert path is not None and not engine2._onebit_frozen
+    l1 = float(engine.train_batch(batch))
+    l2 = float(engine2.train_batch(batch))
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
