@@ -113,9 +113,9 @@ def tp_spec_fn(path: str, shape) -> Optional[P]:
 def _bert_block(cfg: BertConfig, x, lp, mask_bias, rng, deterministic):
     B, T, D = x.shape
     H, hd = cfg.num_attention_heads, cfg.head_dim
-    r1 = r2 = None
+    r1 = r2 = r_attn = None
     if rng is not None:
-        r1, r2 = jax.random.split(rng)
+        r1, r2, r_attn = jax.random.split(rng, 3)
 
     def attn_part(h):
         qkv = h @ lp["qkv_w"].astype(h.dtype) + lp["qkv_b"].astype(h.dtype)
@@ -123,10 +123,17 @@ def _bert_block(cfg: BertConfig, x, lp, mask_bias, rng, deterministic):
         def heads(t):
             return t.reshape(B, T, H, hd).transpose(0, 2, 1, 3)
         q, k, v = heads(q), heads(k), heads(v)
-        if mask_bias is None and cfg.use_flash_attention and T >= 128:
-            out = flash_attention(q, k, v, causal=False)
+        # padding-mask bias + attention-probability dropout go through
+        # the fused path natively (flash_attention falls back to
+        # mha_reference for shapes its grid can't serve)
+        rate = 0.0 if deterministic or r_attn is None else cfg.attention_probs_dropout_prob
+        if cfg.use_flash_attention:
+            out = flash_attention(q, k, v, causal=False, bias=mask_bias, dropout_rate=rate, dropout_rng=r_attn)
         else:
-            out = mha_reference(q, k, v, causal=False, bias=mask_bias)
+            m4 = None
+            if rate > 0.0:
+                m4 = jax.random.bernoulli(r_attn, 1.0 - rate, (B, H, T, T)).astype(jnp.uint8)
+            out = mha_reference(q, k, v, causal=False, bias=mask_bias, dropout_mask=m4, keep_prob=1.0 - rate)
         out = out.transpose(0, 2, 1, 3).reshape(B, T, D)
         return out @ lp["proj_w"].astype(out.dtype) + lp["proj_b"].astype(out.dtype)
 

@@ -50,8 +50,12 @@ def mha_reference(
     causal: bool = False,
     sm_scale: Optional[float] = None,
     bias: Optional[jnp.ndarray] = None,
+    dropout_mask: Optional[jnp.ndarray] = None,
+    keep_prob: float = 1.0,
 ) -> jnp.ndarray:
-    """Plain XLA attention; numerics ground truth for the Pallas kernel."""
+    """Plain XLA attention; numerics ground truth for the Pallas kernel.
+    ``dropout_mask``: (B, H, Tq, Tk) {0,1}, applied to the softmax output
+    (softmax-then-dropout, matching the fused kernels)."""
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * sm_scale
@@ -62,6 +66,8 @@ def mha_reference(
         mask = jnp.tril(jnp.ones((qlen, klen), bool), k=klen - qlen)
         s = jnp.where(mask, s, DEFAULT_MASK_VALUE)
     p = jax.nn.softmax(s, axis=-1)
+    if dropout_mask is not None:
+        p = p * (dropout_mask.astype(jnp.float32) / keep_prob)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
@@ -69,7 +75,17 @@ def mha_reference(
 # Pallas forward kernel
 # ---------------------------------------------------------------------------
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, sm_scale: float, causal: bool, block_k: int):
+def _flash_fwd_kernel(
+    q_ref, k_ref, v_ref, *rest, sm_scale: float, causal: bool, block_k: int,
+    kbias: bool, fbias: bool, keep_prob: float,
+):
+    # optional trailing inputs: [bias], [drop-mask]; outputs: o, [lse]
+    refs = list(rest)
+    bias_ref = refs.pop(0) if (kbias or fbias) else None
+    mask_ref = refs.pop(0) if keep_prob < 1.0 else None
+    o_ref = refs.pop(0)
+    maybe_lse_ref = refs
+
     block_q, d = q_ref.shape[1], q_ref.shape[2]
     seq_k = k_ref.shape[1]
     seq_q_total = pl.num_programs(1) * block_q
@@ -99,6 +115,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, sm_scale: floa
         k = k_ref[0, pl.dslice(i * block_k, block_k), :]
         v = v_ref[0, pl.dslice(i * block_k, block_k), :]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale  # (block_q, block_k) fp32
+        if kbias:
+            s = s + bias_ref[0, pl.dslice(i * block_k, block_k)].astype(jnp.float32)[None, :]
+        elif fbias:
+            s = s + bias_ref[0, :, pl.dslice(i * block_k, block_k)].astype(jnp.float32)
         if causal:
             q_pos = causal_offset + q_idx * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             k_pos = i * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -107,7 +127,13 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, sm_scale: floa
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_prev - m_new)
+        # softmax statistics use the FULL p; dropout zeroes entries only
+        # on the value path (reference softmax-then-dropout semantics,
+        # csrc/transformer/dropout_kernels.cu)
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        if keep_prob < 1.0:
+            keep = mask_ref[0, :, pl.dslice(i * block_k, block_k)]
+            p = p * (keep.astype(jnp.float32) / keep_prob)
         acc = acc * alpha + jnp.dot(p.astype(v.dtype), v, preferred_element_type=jnp.float32)
         return acc, m_new, l_new
 
@@ -127,7 +153,40 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, sm_scale: floa
         maybe_lse_ref[0][0] = jnp.broadcast_to(lse[None, :], (8, lse.shape[0]))
 
 
-def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: int, interpret: bool, want_lse: bool = True):
+def _bias_mode(bias, b, h, sq, sk):
+    """Classify/normalize an additive bias: (B,1,1,Tk) key-broadcast →
+    ("kbias", (B, Tk)); anything broadcastable to (B,H,Tq,Tk) →
+    ("fbias", (B*H, Tq, Tk))."""
+    if bias is None:
+        return None, None
+    if bias.ndim != 4:
+        raise ValueError(f"bias must be 4-D broadcastable to (B,H,Tq,Tk), got {bias.shape}")
+    if bias.shape[1] == 1 and bias.shape[2] == 1 and bias.shape[3] == sk:
+        return "kbias", bias.reshape(bias.shape[0], sk)
+    full = jnp.broadcast_to(bias, (b, h, sq, sk)).reshape(b * h, sq, sk)
+    return "fbias", full
+
+
+def _fwd_extra_specs(mode, bias2, mask, b, h, sq, sk, block_q):
+    """in_specs + arrays for the optional bias/mask inputs of the fwd/dq
+    kernels (block over the q dim; the kv dim is sliced in-kernel)."""
+    specs, args = [], []
+    if mode == "kbias":
+        specs.append(pl.BlockSpec((1, sk), lambda bh_, qi, h=h: (bh_ // h, 0)))
+        args.append(bias2)
+    elif mode == "fbias":
+        specs.append(pl.BlockSpec((1, block_q, sk), lambda bh_, qi: (bh_, qi, 0)))
+        args.append(bias2)
+    if mask is not None:
+        specs.append(pl.BlockSpec((1, block_q, sk), lambda bh_, qi: (bh_, qi, 0)))
+        args.append(mask)
+    return specs, args
+
+
+def _flash_fwd_pallas(
+    q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: int, interpret: bool,
+    want_lse: bool = True, bias=None, mask=None, keep_prob: float = 1.0,
+):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bh = b * h
@@ -137,6 +196,7 @@ def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float, block_q: int, bloc
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     assert sq % block_q == 0 and sk % block_k == 0, (sq, sk, block_q, block_k)
+    mode, bias2 = _bias_mode(bias, b, h, sq, sk)
 
     grid = (bh, sq // block_q)
     in_specs = [
@@ -144,14 +204,19 @@ def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float, block_q: int, bloc
         pl.BlockSpec((1, sk, d), lambda bh_, qi: (bh_, 0, 0)),
         pl.BlockSpec((1, sk, d), lambda bh_, qi: (bh_, 0, 0)),
     ]
+    extra_specs, extra_args = _fwd_extra_specs(mode, bias2, mask, b, h, sq, sk, block_q)
+    in_specs += extra_specs
     o_spec = pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0))
     o_shape = jax.ShapeDtypeStruct((bh, sq, d), q.dtype)
-    kern = functools.partial(_flash_fwd_kernel, sm_scale=sm_scale, causal=causal, block_k=block_k)
+    kern = functools.partial(
+        _flash_fwd_kernel, sm_scale=sm_scale, causal=causal, block_k=block_k,
+        kbias=(mode == "kbias"), fbias=(mode == "fbias"), keep_prob=keep_prob,
+    )
     if not want_lse:
         # inference/eval path: skip the logsumexp output entirely
         out = pl.pallas_call(
             kern, grid=grid, in_specs=in_specs, out_specs=o_spec, out_shape=o_shape, interpret=interpret
-        )(qr, kr, vr)
+        )(qr, kr, vr, *extra_args)
         return out.reshape(b, h, sq, d), None
     out, lse = pl.pallas_call(
         kern,
@@ -160,7 +225,7 @@ def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float, block_q: int, bloc
         out_specs=[o_spec, pl.BlockSpec((1, 8, block_q), lambda bh_, qi: (bh_, 0, qi))],
         out_shape=[o_shape, jax.ShapeDtypeStruct((bh, 8, sq), jnp.float32)],
         interpret=interpret,
-    )(qr, kr, vr)
+    )(qr, kr, vr, *extra_args)
     return out.reshape(b, h, sq, d), lse[:, 0, :].reshape(b, h, sq)
 
 
@@ -229,7 +294,15 @@ def _blockwise_xla(q, k, v, causal: bool, sm_scale: float, block_k: int):
 # ---------------------------------------------------------------------------
 
 
-def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, sm_scale, causal, block_k):
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    sm_scale, causal, block_k, kbias, fbias, keep_prob,
+):
+    refs = list(rest)
+    bias_ref = refs.pop(0) if (kbias or fbias) else None
+    mask_ref = refs.pop(0) if keep_prob < 1.0 else None
+    dq_ref = refs.pop(0)
+
     block_q, d = q_ref.shape[1], q_ref.shape[2]
     seq_k = k_ref.shape[1]
     seq_q_total = pl.num_programs(1) * block_q
@@ -252,12 +325,19 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref
         k = k_ref[0, pl.dslice(i * block_k, block_k), :]
         v = v_ref[0, pl.dslice(i * block_k, block_k), :]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        if kbias:
+            s = s + bias_ref[0, pl.dslice(i * block_k, block_k)].astype(jnp.float32)[None, :]
+        elif fbias:
+            s = s + bias_ref[0, :, pl.dslice(i * block_k, block_k)].astype(jnp.float32)
         if causal:
             q_pos = causal_offset + q_idx * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             k_pos = i * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
         p = jnp.exp(s - lse)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        if keep_prob < 1.0:
+            keep = mask_ref[0, :, pl.dslice(i * block_k, block_k)]
+            dp = dp * (keep.astype(jnp.float32) / keep_prob)
         ds = (p * (dp - delta) * sm_scale).astype(k.dtype)
         return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
@@ -265,7 +345,15 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, sm_scale, causal, block_q):
+def _flash_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    sm_scale, causal, block_q, kbias, fbias, keep_prob,
+):
+    refs = list(rest)
+    bias_ref = refs.pop(0) if (kbias or fbias) else None
+    mask_ref = refs.pop(0) if keep_prob < 1.0 else None
+    dk_ref, dv_ref = refs
+
     block_k, d = k_ref.shape[1], k_ref.shape[2]
     seq_q = q_ref.shape[1]
     seq_k_total = pl.num_programs(1) * block_k
@@ -290,13 +378,24 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_re
         lse = lse_ref[0, 0, pl.dslice(i * block_q, block_q)][:, None]
         delta = delta_ref[0, 0, pl.dslice(i * block_q, block_q)][:, None]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        if kbias:
+            s = s + bias_ref[0].astype(jnp.float32)[None, :]
+        elif fbias:
+            s = s + bias_ref[0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
         if causal:
             q_pos = causal_offset + i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             k_pos = kv_idx * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
         p = jnp.exp(s - lse)
-        dv = dv + jnp.dot(p.astype(do.dtype).T, do, preferred_element_type=jnp.float32)
+        if keep_prob < 1.0:
+            scaled_keep = mask_ref[0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32) / keep_prob
+            d_mat = p * scaled_keep  # post-dropout probabilities
+        else:
+            d_mat = p
+        dv = dv + jnp.dot(d_mat.astype(do.dtype).T, do, preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        if keep_prob < 1.0:
+            dp = dp * scaled_keep
         ds = (p * (dp - delta) * sm_scale).astype(q.dtype)
         dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
         return dk, dv
@@ -307,7 +406,10 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_re
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _flash_bwd_pallas(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret):
+def _flash_bwd_pallas(
+    q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret,
+    bias=None, mask=None, keep_prob: float = 1.0,
+):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bh = b * h
@@ -320,9 +422,12 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, 
     lser = jnp.broadcast_to(lse.reshape(bh, 1, sq), (bh, 8, sq))
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta.reshape(bh, 1, sq), (bh, 8, sq))
+    mode, bias2 = _bias_mode(bias, b, h, sq, sk)
+    flags = dict(kbias=(mode == "kbias"), fbias=(mode == "fbias"), keep_prob=keep_prob)
 
+    dq_extra_specs, dq_extra_args = _fwd_extra_specs(mode, bias2, mask, b, h, sq, sk, block_q)
     dq = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, sm_scale=sm_scale, causal=causal, block_k=block_k),
+        functools.partial(_flash_bwd_dq_kernel, sm_scale=sm_scale, causal=causal, block_k=block_k, **flags),
         grid=(bh, sq // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0)),
@@ -331,14 +436,25 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, 
             pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0)),
             pl.BlockSpec((1, 8, block_q), lambda bh_, qi: (bh_, 0, qi)),
             pl.BlockSpec((1, 8, block_q), lambda bh_, qi: (bh_, 0, qi)),
-        ],
+        ] + dq_extra_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         interpret=interpret,
-    )(qr, kr, vr, dor, lser, delta)
+    )(qr, kr, vr, dor, lser, delta, *dq_extra_args)
 
+    # kv-blocked layouts for the dk/dv pass
+    kv_extra_specs, kv_extra_args = [], []
+    if mode == "kbias":
+        kv_extra_specs.append(pl.BlockSpec((1, block_k), lambda bh_, ki, h=h: (bh_ // h, ki)))
+        kv_extra_args.append(bias2)
+    elif mode == "fbias":
+        kv_extra_specs.append(pl.BlockSpec((1, sq, block_k), lambda bh_, ki: (bh_, 0, ki)))
+        kv_extra_args.append(bias2)
+    if mask is not None:
+        kv_extra_specs.append(pl.BlockSpec((1, sq, block_k), lambda bh_, ki: (bh_, 0, ki)))
+        kv_extra_args.append(mask)
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q),
+        functools.partial(_flash_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q, **flags),
         grid=(bh, sk // block_k),
         in_specs=[
             pl.BlockSpec((1, sq, d), lambda bh_, ki: (bh_, 0, 0)),
@@ -347,7 +463,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, 
             pl.BlockSpec((1, sq, d), lambda bh_, ki: (bh_, 0, 0)),
             pl.BlockSpec((1, 8, sq), lambda bh_, ki: (bh_, 0, 0)),
             pl.BlockSpec((1, 8, sq), lambda bh_, ki: (bh_, 0, 0)),
-        ],
+        ] + kv_extra_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda bh_, ki: (bh_, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh_, ki: (bh_, ki, 0)),
@@ -357,7 +473,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, 
             jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
         ],
         interpret=interpret,
-    )(qr, kr, vr, dor, lser, delta)
+    )(qr, kr, vr, dor, lser, delta, *kv_extra_args)
 
     return dq.reshape(q.shape), dk.reshape(k.shape), dv.reshape(v.shape)
 
@@ -366,20 +482,35 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, 
 # Public API with custom VJP
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_attention(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash_attention(q, k, v, bias, mask, causal, sm_scale, block_q, block_k, interpret, keep_prob):
     # non-differentiated primal (inference/eval): no lse buffer
-    return _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k, interpret, want_lse=False)[0]
+    return _flash_fwd_pallas(
+        q, k, v, causal, sm_scale, block_q, block_k, interpret,
+        want_lse=False, bias=bias, mask=mask, keep_prob=keep_prob,
+    )[0]
 
 
-def _flash_fwd_rule(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    out, lse = _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k, interpret)
-    return out, (q, k, v, out, lse)
+def _flash_fwd_rule(q, k, v, bias, mask, causal, sm_scale, block_q, block_k, interpret, keep_prob):
+    out, lse = _flash_fwd_pallas(
+        q, k, v, causal, sm_scale, block_q, block_k, interpret,
+        bias=bias, mask=mask, keep_prob=keep_prob,
+    )
+    return out, (q, k, v, out, lse, bias, mask)
 
 
-def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, res, g):
-    q, k, v, out, lse = res
-    return _flash_bwd_pallas(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret)
+def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, keep_prob, res, g):
+    q, k, v, out, lse, bias, mask = res
+    dq, dk, dv = _flash_bwd_pallas(
+        q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret,
+        bias=bias, mask=mask, keep_prob=keep_prob,
+    )
+    # bias is a mask/additive-offset input here, not a trained weight:
+    # its cotangent is declared zero (use mha_reference for a
+    # differentiable bias)
+    dbias = None if bias is None else jnp.zeros_like(bias)
+    dmask = None if mask is None else jnp.zeros_like(mask)
+    return dq, dk, dv, dbias, dmask
 
 
 _flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -391,6 +522,9 @@ def flash_attention(
     v: jnp.ndarray,
     causal: bool = False,
     sm_scale: Optional[float] = None,
+    bias: Optional[jnp.ndarray] = None,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
     block_q: int = 512,
     block_k: int = 256,
     interpret: Optional[bool] = None,
@@ -402,42 +536,70 @@ def flash_attention(
     kernel grid can't serve fall back to the blockwise-rematerialized
     XLA path (large) or ``mha_reference`` (small).  ``interpret``
     defaults to True off-TPU.
+
+    ``bias``: additive score bias broadcastable to (B, H, Tq, Tk) — e.g.
+    a (B, 1, 1, Tk) padding mask.  Treated as non-differentiable through
+    the kernel path (zero cotangent).  ``dropout_rate`` applies
+    attention-probability dropout (softmax-then-dropout, the reference's
+    stochastic-transformer mode, csrc/transformer/dropout_kernels.cu):
+    the keep-mask is drawn host-graph-side from ``dropout_rng`` and fed
+    to both kernels, so it costs O(Tq·Tk) bytes — intended for the
+    BERT-era sequence lengths that use it; keep it 0 for long-context.
     """
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     if interpret is None:
         interpret = not _on_tpu()
-    sq, sk = q.shape[2], k.shape[2]
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    keep_prob = 1.0 - float(dropout_rate)
+    mask3 = None  # (B*H, Tq, Tk) uint8 for the kernels
+    if dropout_rate > 0.0:
+        if dropout_rng is None:
+            raise ValueError("dropout_rate > 0 requires dropout_rng")
+        mask3 = jax.random.bernoulli(dropout_rng, keep_prob, (b * h, sq, sk)).astype(jnp.uint8)
+
+    def reference():
+        m4 = None if mask3 is None else mask3.reshape(b, h, sq, sk)
+        return mha_reference(
+            q, k, v, causal=causal, sm_scale=sm_scale, bias=bias,
+            dropout_mask=m4, keep_prob=keep_prob,
+        )
+
     # Caller-supplied blocks are honored when they divide the sequence;
     # otherwise halve down to 128 looking for a divisor (so e.g. seq 384
     # runs the kernel at block 128 instead of silently falling back to
     # the materializing reference path).
     def pick(n, pref):
-        b = min(pref, n)
-        if n % b == 0:
-            return b
-        while b > 128:
-            b //= 2
-            if n % b == 0:
-                return b
+        b_ = min(pref, n)
+        if n % b_ == 0:
+            return b_
+        while b_ > 128:
+            b_ //= 2
+            if n % b_ == 0:
+                return b_
         return None
 
     bq, bk = pick(sq, block_q), pick(sk, block_k)
     if bq is None or bk is None or sq < 8 or sk < 8:
-        bh = q.shape[0] * q.shape[1]
-        if sq >= 8 and sk >= 8 and bh * sq * sk * 4 > 2**28:
+        if sq >= 8 and sk >= 8 and b * h * sq * sk * 4 > 2**28 and bias is None and mask3 is None:
             # No kernel-compatible blocking but the (b,h,sq,sk) fp32
             # score tensor would exceed ~256MB: blockwise-rematerialized
             # XLA path (handles ragged sk by pad+mask).
             return _blockwise_xla(q, k, v, causal=causal, sm_scale=sm_scale, block_k=min(block_k, sk))
-        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+        # bias/dropout on ragged shapes: materializing scores is the only
+        # correct path (the pre-kernel behavior of every caller)
+        return reference()
     # VMEM guard (bytes): the fwd kernel keeps full K/V per
     # (batch,head) program resident, and the dkv backward keeps full
     # Q/dO — bound both sides at ~8MB for the two resident operands.
     itemsize = jnp.dtype(q.dtype).itemsize
-    if max(sq, sk) * q.shape[3] * itemsize * 2 > 2**23:
+    if max(sq, sk) * d * itemsize * 2 > 2**23:
+        if bias is not None or mask3 is not None:
+            # the O(T^2) mask already dominates memory at these sizes
+            return reference()
         return _blockwise_xla(q, k, v, causal=causal, sm_scale=sm_scale, block_k=bk)
-    return _flash_attention(q, k, v, causal, float(sm_scale), bq, bk, interpret)
+    return _flash_attention(q, k, v, bias, mask3, causal, float(sm_scale), bq, bk, interpret, keep_prob)
 
 
 @register_op("flash_attention", "pallas", "Online-softmax fused attention kernel (fwd) + blockwise remat bwd")

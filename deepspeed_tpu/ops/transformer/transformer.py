@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deepspeed_tpu.ops.attention.flash_attention import flash_attention, mha_reference
+from deepspeed_tpu.ops.attention.flash_attention import flash_attention
 from deepspeed_tpu.ops.normalize import dropout, layer_norm as _ln
 from deepspeed_tpu.ops.registry import register_op
 
@@ -124,14 +124,12 @@ def transformer_layer_fn(
             return t.reshape(B, T, H, hd).transpose(0, 2, 1, 3)
 
         q, k, v = heads(q), heads(k), heads(v)
-        if bias is None and T >= 128:
-            o = flash_attention(q, k, v, causal=False)
-        else:
-            o = mha_reference(q, k, v, causal=False, bias=bias)
-        # attention-probability dropout is folded after the PV matmul
-        # (equivalent in expectation; keeps the flash kernel stateless —
-        # the reference's attn_dropout applies to the prob matrix)
-        o = _dropout(o, cfg.attn_dropout_ratio, r1, training)
+        # true attention-PROBABILITY dropout through the fused path
+        # (reference softmax_kernels.cu + dropout_kernels.cu semantics);
+        # flash_attention handles the bias natively and falls back to
+        # mha_reference for shapes its grid can't serve
+        rate = cfg.attn_dropout_ratio if (training and r1 is not None) else 0.0
+        o = flash_attention(q, k, v, causal=False, bias=bias, dropout_rate=rate, dropout_rng=r1)
         o = o.transpose(0, 2, 1, 3).reshape(B, T, D)
         return o @ params["proj_w"].astype(o.dtype) + params["proj_b"].astype(o.dtype)
 

@@ -266,16 +266,25 @@ class DeepSpeedEngine:
         from deepspeed_tpu.runtime.fp16.onebit.adam import OnebitAdam
 
         self._onebit_frozen = False
-        self._onebit_exchange_ok = (
-            isinstance(self.optimizer, OnebitAdam)
-            and self.mesh_info.sizes.get("data", 1) > 1
-            and self.mesh_info.fsdp_world_size == 1
-            and self._use_grad_acc
-            and not self._offload
-            and self.quantizer is None
-            and self.progressive_layer_drop is None
-            and self.config.gradient_clipping <= 0.0
+        onebit_blockers = {
+            "data axis must be > 1": self.mesh_info.sizes.get("data", 1) > 1,
+            "fsdp must be 1": self.mesh_info.fsdp_world_size == 1,
+            "pipeline engine unsupported": self._use_grad_acc,
+            "offload_optimizer unsupported": not self._offload,
+            "quantize_training (MoQ) unsupported": self.quantizer is None,
+            "progressive_layer_drop unsupported": self.progressive_layer_drop is None,
+            "gradient_clipping must be 0": self.config.gradient_clipping <= 0.0,
+        }
+        self._onebit_exchange_ok = isinstance(self.optimizer, OnebitAdam) and all(
+            onebit_blockers.values()
         )
+        if isinstance(self.optimizer, OnebitAdam) and not self._onebit_exchange_ok:
+            failed = [k for k, ok in onebit_blockers.items() if not ok]
+            logger.warning(
+                "1-bit Adam: compressed gradient exchange DISABLED — the "
+                "optimizer will fall back to local momentum quantization "
+                f"with full-precision allreduce ({'; '.join(failed)})"
+            )
 
         # -- host-side bookkeeping ----------------------------------------
         from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
@@ -719,10 +728,7 @@ class DeepSpeedEngine:
         # free the params-sized fp32 accumulator
         self.state["grad_acc"] = {}
         self._state_shardings["grad_acc"] = {}
-        # the warmup executables close over the old opt-state layout
-        self._compiled = {k: v for k, v in self._compiled.items() if not (
-            isinstance(k, tuple) and k[0] == "train_batch"
-        ) and k not in ("micro_step", "apply_step")}
+        self._purge_train_executables()
         self._onebit_frozen = True
         log_dist(
             f"1-bit Adam: entering compressed-exchange phase at step "
@@ -745,11 +751,19 @@ class DeepSpeedEngine:
             out_shardings=grad_sh,
         )(params)
         self._state_shardings["grad_acc"] = grad_sh
-        self._compiled = {k: v for k, v in self._compiled.items() if not (
-            isinstance(k, tuple) and k[0] == "train_batch"
-        ) and k not in ("micro_step", "apply_step")}
+        self._purge_train_executables()
         self._onebit_frozen = False
         log_dist("1-bit Adam: rolled back to warmup (pre-freeze) state layout")
+
+    def _purge_train_executables(self) -> None:
+        """Drop compiled steps that close over the opt-state layout
+        (called at every 1-bit phase transition)."""
+        self._compiled = {
+            k: v
+            for k, v in self._compiled.items()
+            if not (isinstance(k, tuple) and k[0] == "train_batch")
+            and k not in ("micro_step", "apply_step")
+        }
 
     def _frozen_full_step(self, state, stacked):
         """Compiled train step for the compressed phase: per-rank grads
@@ -772,12 +786,14 @@ class DeepSpeedEngine:
 
             b_rows = jax.tree.map(rows_of, mb)
 
-            def slice_loss(p, b):
-                return self._compute_loss(p, b, rng, st["loss_scale"])
+            def slice_loss(p, b, r):
+                return self._compute_loss(p, b, r, st["loss_scale"])
 
+            # independent rng per DP slice — dropout noise must not
+            # repeat across the n slices of the global batch
             (_, loss), g = jax.vmap(
-                jax.value_and_grad(slice_loss, has_aux=True), in_axes=(None, 0)
-            )(st["params"], b_rows)
+                jax.value_and_grad(slice_loss, has_aux=True), in_axes=(None, 0, 0)
+            )(st["params"], b_rows, jax.random.split(rng, n))
             g_rows = jax.lax.with_sharding_constraint(
                 pack_rows(g, n, n), self._sh(P("data"))
             )
