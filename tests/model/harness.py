@@ -1,0 +1,119 @@
+"""Model-level regression harness (reference ``tests/model/`` +
+``run_sanity_check.py``): each recipe trains a tiny model a fixed number
+of steps on deterministic synthetic data and its loss curve is pinned
+against a recorded baseline, so cross-round drift in any engine/model
+subsystem shows up as a diff here.
+
+Regenerate baselines after an INTENTIONAL numerics change with:
+
+    python -m tests.model.record
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List
+
+import numpy as np
+
+BASELINE_PATH = os.path.join(os.path.dirname(__file__), "baselines.json")
+
+
+def _cifar_recipe():
+    import deepspeed_tpu
+    from deepspeed_tpu.models import cifar
+
+    model_fn, init_fn, tp_fn = cifar.make_model(cifar.CIFAR_TINY)
+    config = {
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 2,
+        "zero_optimization": {"stage": 0},
+        "mesh": {"data": 8},
+        "optimizer": {"type": "Adam", "params": {"lr": 2e-4}},
+        "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 4, "warmup_max_lr": 2e-4}},
+        "steps_per_print": 10_000,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model_fn, model_parameters=init_fn(seed=0), config=config, tp_spec_fn=tp_fn
+    )
+    r = np.random.default_rng(0)
+    batch = {
+        "images": r.standard_normal((64, 32, 32, 3)).astype(np.float32),
+        "labels": r.integers(0, 10, (64,), dtype=np.int32),
+    }
+    return [float(engine.train_batch(batch)) for _ in range(8)]
+
+
+def _gpt2_zero3_recipe():
+    import dataclasses
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt2
+
+    cfg = gpt2.GPT2_TINY
+    model_fn, init_fn, tp_fn = gpt2.make_model(cfg)
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 2,
+        "zero_optimization": {"stage": 3, "stage3_param_persistence_threshold": 64},
+        "mesh": {"data": 2, "fsdp": 4},
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 10_000,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model_fn, model_parameters=init_fn(seed=0), config=config, tp_spec_fn=tp_fn
+    )
+    r = np.random.default_rng(0)
+    batch = {"input_ids": r.integers(0, cfg.vocab_size, (32, 64), dtype=np.int32)}
+    return [float(engine.train_batch(batch)) for _ in range(8)]
+
+
+def _bert_zero2_recipe():
+    import deepspeed_tpu
+    from deepspeed_tpu.models import bert
+
+    cfg = bert.BERT_TINY
+    model_fn, init_fn, tp_fn = bert.make_model(cfg)
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 2,
+        "zero_optimization": {"stage": 2},
+        "mesh": {"fsdp": 8},
+        "optimizer": {"type": "Lamb", "params": {"lr": 1e-3}},
+        "steps_per_print": 10_000,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model_fn, model_parameters=init_fn(seed=0), config=config, tp_spec_fn=tp_fn
+    )
+    r = np.random.default_rng(0)
+    ids = r.integers(0, cfg.vocab_size, (32, 64), dtype=np.int32)
+    # mask ~15% of positions for the MLM objective (-100 = unmasked)
+    labels = np.where(r.random((32, 64)) < 0.15, ids, -100).astype(np.int32)
+    batch = {
+        "input_ids": ids,
+        "masked_lm_labels": labels,
+        "next_sentence_label": r.integers(0, 2, (32,), dtype=np.int32),
+    }
+    return [float(engine.train_batch(batch)) for _ in range(8)]
+
+
+RECIPES = {
+    "cifar_tiny_dp8_adam": _cifar_recipe,
+    "gpt2_tiny_zero3_tp_bf16": _gpt2_zero3_recipe,
+    "bert_tiny_zero2_lamb": _bert_zero2_recipe,
+}
+
+
+def load_baselines() -> Dict[str, List[float]]:
+    if not os.path.exists(BASELINE_PATH):
+        return {}
+    with open(BASELINE_PATH) as f:
+        return json.load(f)
+
+
+def record_baselines() -> Dict[str, List[float]]:
+    out = {name: fn() for name, fn in RECIPES.items()}
+    with open(BASELINE_PATH, "w") as f:
+        json.dump(out, f, indent=1)
+    return out

@@ -1,0 +1,7 @@
+"""Regenerate the model-regression baselines (run on the 8-device CPU
+mesh: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8)."""
+from tests.model.harness import record_baselines
+
+if __name__ == "__main__":
+    for name, losses in record_baselines().items():
+        print(f"{name}: {losses[0]:.5f} -> {losses[-1]:.5f}")

@@ -1,0 +1,23 @@
+"""Loss-curve regression vs recorded baselines (reference
+tests/model/run_func_test.py semantics)."""
+import numpy as np
+import pytest
+
+from tests.model.harness import RECIPES, load_baselines
+
+pytestmark = pytest.mark.slow
+
+_BASELINES = load_baselines()
+
+
+@pytest.mark.parametrize("name", sorted(RECIPES))
+def test_model_regression(name):
+    recorded = _BASELINES.get(name)
+    assert recorded, (
+        f"no recorded baseline for {name}; run `python -m tests.model.record`"
+    )
+    losses = RECIPES[name]()
+    # deterministic seeds + fp32/bf16 fixed math: curves must reproduce
+    # closely across rounds; drift here means an engine numerics change
+    np.testing.assert_allclose(losses, recorded, rtol=5e-3, atol=5e-4)
+    assert losses[-1] < losses[0]  # still actually learning
