@@ -248,15 +248,17 @@ def _blockwise_xla(q, k, v, causal: bool, sm_scale: float, block_k: int):
     qf = q.astype(jnp.float32) * sm_scale
     kf = k.astype(jnp.float32).reshape(b, h, num_kv, block_k, d)
     vf = v.astype(jnp.float32).reshape(b, h, num_kv, block_k, d)
-    # end-aligned causal positions (match mha_reference tril(k=klen-qlen));
-    # alignment uses the ORIGINAL sk, not the padded length
-    q_pos = (sk - sq) + jnp.arange(sq)[:, None]
 
     @functools.partial(jax.checkpoint, prevent_cse=False)
     def block(carry, inputs):
         acc, m_prev, l_prev = carry
         kb, vb, kv_i = inputs
         s = jnp.einsum("bhqd,bhkd->bhqk", qf, kb)
+        # end-aligned causal positions (match mha_reference's
+        # tril(k=klen-qlen)); generated in-body — a precomputed (sq, 1)
+        # index constant was observed to land in SMEM and overflow it at
+        # 16k sequences on TPU
+        q_pos = (sk - sq) + jax.lax.broadcasted_iota(jnp.int32, (sq, 1), 0)
         k_pos = kv_i * block_k + jnp.arange(block_k)[None, :]
         if causal:
             s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
@@ -592,9 +594,11 @@ def flash_attention(
         return reference()
     # VMEM guard (bytes): the fwd kernel keeps full K/V per
     # (batch,head) program resident, and the dkv backward keeps full
-    # Q/dO — bound both sides at ~8MB for the two resident operands.
+    # Q/dO — two operands, each DOUBLE-buffered by the pallas pipeline
+    # (measured: 16k×64 bf16 wants 16.5M scoped vmem), so budget 4×
+    # against the ~16MB/core limit.
     itemsize = jnp.dtype(q.dtype).itemsize
-    if max(sq, sk) * d * itemsize * 2 > 2**23:
+    if max(sq, sk) * d * itemsize * 4 >= 2**23:
         if bias is not None or mask3 is not None:
             # the O(T^2) mask already dominates memory at these sizes
             return reference()

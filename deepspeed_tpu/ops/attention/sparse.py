@@ -12,14 +12,21 @@ TPU-native re-design (NOT a Triton port):
 * Layouts stay: the ``SparsityConfig`` classes reproduce the reference's
   constructor surface and emit the same (heads, nb, nb) 0/1 block masks,
   so existing recipes keep working.
-* The kernel is **gather-based blockwise attention**: for each (head,
-  q-block) the static layout gives the list of active kv-blocks, padded
-  to the layout's max row degree; K/V blocks are gathered with one
-  ``take_along_axis`` and attention runs as dense (block × deg·block)
-  MXU matmuls.  Compute and memory are O(nnz_blocks), not O(nb²) — the
-  same asymptotics the Triton SDD/DSD kernels buy, expressed in a form
-  XLA tiles onto the MXU.  A hand-fused Pallas splash-attention variant
-  can swap in underneath later without changing this contract.
+* Two interchangeable kernels (``backend=`` on
+  ``block_sparse_attention``; auto prefers splash):
+
+  - **splash** (default on MXU-worthy blocks): active K/V blocks are
+    gathered into compact O(nnz) strips and a fused Pallas program per
+    (batch·head, q-row-group) runs the whole online softmax — the
+    O(nnz·block²) fp32 score/probability tensors never touch HBM.
+    Measured 1.5×/3.2× over dense causal flash at seq 4k/16k on v5e
+    (``tools/bench_sparse.py``).
+  - **gather**: the XLA formulation (one ``take`` + dense masked
+    block attention) — differentiable end-to-end; it is also the
+    splash path's backward via recompute, and the numerics oracle.
+
+  Both are O(nnz_blocks) compute, the asymptotics the Triton SDD/DSD
+  kernels buy.
 * Numerics are validated against dense attention under the equivalent
   element mask (tests/test_sparse_attention.py), mirroring the
   reference's ``test_sparse_attention.py``.
@@ -27,13 +34,16 @@ TPU-native re-design (NOT a Triton port):
 from __future__ import annotations
 
 import dataclasses
+import functools
 import random as _random
 from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental import pallas as pl
 
+from deepspeed_tpu.ops.attention.flash_attention import DEFAULT_MASK_VALUE
 from deepspeed_tpu.ops.registry import register_op
 
 # ---------------------------------------------------------------------------
@@ -357,16 +367,21 @@ def block_sparse_attention(
     causal: bool = False,
     sm_scale: Optional[float] = None,
     key_padding_mask: Optional[jnp.ndarray] = None,
+    backend: Optional[str] = None,
 ) -> jnp.ndarray:
     """Attention restricted to the active blocks of ``layout``.
 
     ``q,k,v``: (B, H, T, hd); ``layout``: (H, T//block, T//block) 0/1
-    numpy (static).  Compute is O(nb · max_row_degree): rows are padded
-    to the layout's max row degree, so layouts with *horizontal* global
-    rows (a few rows attending everywhere) pull the padding up to nb —
-    fine for the handful of global rows the configs emit, but a
-    row-bucketed variant is the follow-up optimization if profiles show
-    it.  ``causal=True`` additionally applies the elementwise causal mask
+    numpy (static).  ``backend``:
+
+    * ``"splash"`` — the streamed Pallas kernel (O(nnz) compute AND HBM
+      traffic, one K/V block DMA per active pair);
+    * ``"gather"`` — the XLA gather formulation below (O(nnz) compute,
+      differentiable end-to-end; also the splash backward's recompute);
+    * ``None`` — auto: splash when eligible (no key-padding mask, MXU-
+      worthy blocks, every row active), else gather.
+
+    ``causal=True`` additionally applies the elementwise causal mask
     inside diagonal blocks (the layout itself should already be
     lower-triangular for unidirectional configs)."""
     B, H, T, hd = q.shape
@@ -374,6 +389,19 @@ def block_sparse_attention(
     assert layout.shape == (H, nb, nb), f"layout {layout.shape} != {(H, nb, nb)}"
     if sm_scale is None:
         sm_scale = 1.0 / (hd ** 0.5)
+    if backend not in (None, "gather", "splash"):
+        raise ValueError(f"backend must be None|'gather'|'splash', got {backend!r}")
+    if backend != "gather":
+        eligible = key_padding_mask is None and block >= 64 and T % block == 0
+        if backend == "splash":
+            if not eligible:
+                raise ValueError("splash backend needs block >= 64 and no key_padding_mask")
+            return splash_attention(q, k, v, layout, block, causal=causal, sm_scale=sm_scale)
+        # auto additionally requires a TPU: the interpret-mode kernel
+        # exists as a numerics oracle; off TPU the compiled XLA gather
+        # formulation is strictly faster
+        if eligible and _on_tpu_backend():
+            return splash_attention(q, k, v, layout, block, causal=causal, sm_scale=sm_scale)
     idx_np, valid_np, drows_np, dvalid_np = _layout_gather_indices(layout)
     deg = idx_np.shape[-1]
     idx = jnp.asarray(idx_np)  # (H, nb, deg)
@@ -382,13 +410,6 @@ def block_sparse_attention(
     qb = q.reshape(B, H, nb, block, hd)
     kb = k.reshape(B, H, nb, block, hd)
     vb = v.reshape(B, H, nb, block, hd)
-
-    def _masked_softmax(s):
-        # rows with no valid key at all (fully masked) → zeros, not NaNs
-        row_max = jnp.max(s, axis=-1, keepdims=True)
-        p = jnp.exp(s - row_max)
-        denom = jnp.sum(p, axis=-1, keepdims=True)
-        return jnp.where(denom > 0, p / jnp.maximum(denom, 1e-30), 0.0)
 
     # ---- sparse bucket: gather active kv blocks per (h, q-block) --------
     gather = jax.vmap(  # over batch
@@ -413,32 +434,250 @@ def block_sparse_attention(
         mask = mask & kpg[:, :, :, None, :, :]
     s = jnp.where(mask, s, NEG_INF)
     s = s.reshape(B, H, nb, block, deg * block)
-    p = _masked_softmax(s).reshape(B, H, nb, block, deg, block)
+    # explicit re-mask after softmax: a FULLY-masked row has uniform
+    # exp(0)=1 everywhere (row_max == NEG_INF), so the denom>0 guard
+    # alone would emit a junk average instead of zeros
+    p = _masked_softmax(s).reshape(B, H, nb, block, deg, block) * mask.astype(jnp.float32)
     out = jnp.einsum("bhnqek,bhnekd->bhnqd", p, vg.astype(jnp.float32))
 
     # ---- dense bucket: the few full-degree (horizontal-global) rows -----
+    out = out.reshape(B, H, T, hd).astype(q.dtype)
     if drows_np.shape[1] > 0:
-        drows = jnp.asarray(drows_np)  # (H, M)
-        dvalid = jnp.asarray(dvalid_np)
-        M = drows_np.shape[1]
-        qd = jnp.take_along_axis(qb, drows[None, :, :, None, None], axis=2)  # (B,H,M,block,hd)
-        sd = jnp.einsum("bhmqd,bhtd->bhmqt", qd.astype(jnp.float32), k.astype(jnp.float32)) * sm_scale
-        dmask = jnp.ones((1, 1, 1, 1, T), bool)
-        if causal:
-            q_pos_d = drows[:, :, None] * block + jnp.arange(block)[None, None, :]  # (H,M,block)
-            dmask = dmask & (q_pos_d[None, :, :, :, None] >= jnp.arange(T)[None, None, None, None, :])
-        if key_padding_mask is not None:
-            dmask = dmask & key_padding_mask[:, None, None, None, :]
-        sd = jnp.where(dmask, sd, NEG_INF)
-        pd = _masked_softmax(sd)
-        od = jnp.einsum("bhmqt,bhtd->bhmqd", pd, v.astype(jnp.float32))  # (B,H,M,block,hd)
-        # scatter dense-row outputs back over the gather outputs
-        onehot = jax.nn.one_hot(drows, nb, dtype=jnp.float32) * dvalid[..., None]  # (H,M,nb)
-        od_full = jnp.einsum("hmn,bhmqd->bhnqd", onehot, od)
-        is_dense_row = (jnp.sum(onehot, axis=1) > 0)[None, :, :, None, None]  # (1,H,nb,1,1)
-        out = jnp.where(is_dense_row, od_full, out)
+        out = _apply_dense_rows(out, q, k, v, drows_np, dvalid_np, block, causal, sm_scale, key_padding_mask)
+    return out
 
-    return out.reshape(B, H, T, hd).astype(q.dtype)
+
+def _apply_dense_rows(out, q, k, v, drows_np, dvalid_np, block, causal, sm_scale, key_padding_mask):
+    """Overwrite the full-degree (horizontal-global) q-rows of ``out``
+    with dense full-T attention — shared by the gather and splash paths."""
+    B, H, T, hd = q.shape
+    nb = T // block
+    qb = q.reshape(B, H, nb, block, hd)
+    drows = jnp.asarray(drows_np)  # (H, M)
+    dvalid = jnp.asarray(dvalid_np)
+    qd = jnp.take_along_axis(qb, drows[None, :, :, None, None], axis=2)  # (B,H,M,block,hd)
+    sd = jnp.einsum("bhmqd,bhtd->bhmqt", qd.astype(jnp.float32), k.astype(jnp.float32)) * sm_scale
+    dmask = jnp.ones((1, 1, 1, 1, T), bool)
+    if causal:
+        q_pos_d = drows[:, :, None] * block + jnp.arange(block)[None, None, :]  # (H,M,block)
+        dmask = dmask & (q_pos_d[None, :, :, :, None] >= jnp.arange(T)[None, None, None, None, :])
+    if key_padding_mask is not None:
+        dmask = dmask & key_padding_mask[:, None, None, None, :]
+    sd = jnp.where(dmask, sd, NEG_INF)
+    pd = _masked_softmax(sd)
+    od = jnp.einsum("bhmqt,bhtd->bhmqd", pd, v.astype(jnp.float32))  # (B,H,M,block,hd)
+    # scatter dense-row outputs back over the sparse outputs
+    onehot = jax.nn.one_hot(drows, nb, dtype=jnp.float32) * dvalid[..., None]  # (H,M,nb)
+    od_full = jnp.einsum("hmn,bhmqd->bhnqd", onehot, od)
+    is_dense_row = (jnp.sum(onehot, axis=1) > 0)[None, :, :, None, None]  # (1,H,nb,1,1)
+    ob = out.reshape(B, H, nb, block, hd)
+    ob = jnp.where(is_dense_row, od_full.astype(out.dtype), ob)
+    return ob.reshape(B, H, T, hd)
+
+
+def _masked_softmax(s):
+    row_max = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - row_max)
+    denom = jnp.sum(p, axis=-1, keepdims=True)
+    return jnp.where(denom > 0, p / jnp.maximum(denom, 1e-30), 0.0)
+
+
+# ---------------------------------------------------------------------------
+# Pallas splash kernel: fused block-sparse attention
+# ---------------------------------------------------------------------------
+#
+# The Triton SDD/DSD/DDS stack (reference matmul.py:16-615 + trsrc/*.tr)
+# becomes gather + ONE fused kernel: the static layout's active K/V
+# blocks are gathered per (head, q-row) into a compact (…, deg, block,
+# hd) buffer — O(nnz) bytes in the input dtype — and a Pallas program
+# per (batch·head, q-row) runs the whole online softmax over its `deg`
+# blocks in registers.  This kills the gather formulation's dominant
+# cost: the O(nnz·block²) fp32 score/probability tensors never touch
+# HBM.  Horizontal-global (fully dense) rows ride the existing dense
+# bucket so they don't pad every row's degree to nb.
+
+
+def _splash_kernel(
+    idx_ref, valid_ref, q_ref, kv_ref, vv_ref, o_ref,
+    *, sm_scale: float, causal: bool, block: int, deg: int, heads: int, group: int,
+):
+    # each program handles `group` consecutive q-rows — grid-step launch
+    # overhead dominates at long sequences, so amortize it
+    h = pl.program_id(0) % heads
+    g0 = pl.program_id(1)
+    hd = q_ref.shape[-1]
+
+    def one_row(gi, _):
+        row = g0 * group + gi
+        q = q_ref[0, pl.dslice(gi * block, block), :]  # (block, hd)
+
+        def body(e, carry):
+            acc, m_prev, l_prev = carry
+            k = kv_ref[0, 0, pl.dslice(gi * deg * block + e * block, block), :]
+            v = vv_ref[0, 0, pl.dslice(gi * deg * block + e * block, block), :]
+            s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+            ki = idx_ref[h, row * deg + e]
+            ok = valid_ref[h, row * deg + e] == 1
+            if causal:
+                q_pos = row * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
+                k_pos = ki * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
+                keep = jnp.logical_and(ok, q_pos >= k_pos)
+            else:
+                keep = jnp.broadcast_to(ok, (block, block))
+            s = jnp.where(keep, s, DEFAULT_MASK_VALUE)
+            m_cur = jnp.max(s, axis=1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            # p masked EXPLICITLY: if every entry of a row is masked,
+            # m_new == MASK_VALUE and exp(s - m_new) would be 1, faking a
+            # nonzero l — the zero-degree-row guard below depends on l==0
+            p = jnp.exp(s - m_new) * keep.astype(jnp.float32)
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+            acc = acc * alpha + jnp.dot(p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+            return acc, m_new, l_new
+
+        init = (
+            jnp.zeros((block, hd), jnp.float32),
+            jnp.full((block, 1), -jnp.inf, jnp.float32),
+            jnp.zeros((block, 1), jnp.float32),
+        )
+        acc, m, l = jax.lax.fori_loop(0, deg, body, init)
+        safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, pl.dslice(gi * block, block), :] = (acc / safe).astype(o_ref.dtype)
+        return 0
+
+    jax.lax.fori_loop(0, group, one_row, 0)
+
+
+def _splash_fwd(q, k, v, layout: np.ndarray, block: int, causal: bool, sm_scale: float, interpret: bool):
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, T, hd = q.shape
+    nb = T // block
+    idx_np, valid_np, drows_np, dvalid_np = _layout_gather_indices(layout)
+    deg = idx_np.shape[-1]
+    # prefetch arrays live in SMEM, where the LAST dim pads to 128
+    # lanes — keep them 2-D (H, nb·deg) or a (H, nb, deg) layout costs
+    # 32x its logical bytes and overflows SMEM at long sequences
+    idx = jnp.asarray(idx_np)
+    idx2 = jnp.asarray(idx_np.reshape(idx_np.shape[0], -1))
+    valid2 = jnp.asarray(valid_np.astype(np.int32).reshape(valid_np.shape[0], -1))
+
+    kb = k.reshape(B, H, nb, block, hd)
+    vb = v.reshape(B, H, nb, block, hd)
+    gather = jax.vmap(
+        jax.vmap(lambda blocks, ids: jnp.take(blocks, ids, axis=0), in_axes=(0, 0)),
+        in_axes=(0, None),
+    )
+    # (B, H, nb, deg, block, hd) → (bh, nb/G, G·deg·block, hd): one
+    # compact KV strip per (batch·head, row-group), O(nnz) bytes in the
+    # input dtype.  G rows share a program to amortize grid-step launch
+    # overhead (the dominant cost at long sequences); VMEM bounds G.
+    group = 1
+    for g in (8, 4, 2):
+        if nb % g == 0 and g * deg * block * hd * q.dtype.itemsize <= (1 << 21):
+            group = g
+            break
+    kg = gather(kb, idx).reshape(B * H, nb // group, group * deg * block, hd)
+    vg = gather(vb, idx).reshape(B * H, nb // group, group * deg * block, hd)
+    qr = q.reshape(B * H, T, hd)
+
+    strip_spec = pl.BlockSpec((1, 1, group * deg * block, hd), lambda b, r, idx, valid: (b, r, 0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B * H, nb // group),
+        in_specs=[
+            pl.BlockSpec((1, group * block, hd), lambda b, r, idx, valid: (b, r, 0)),
+            strip_spec,
+            strip_spec,
+        ],
+        out_specs=pl.BlockSpec((1, group * block, hd), lambda b, r, idx, valid: (b, r, 0)),
+        scratch_shapes=[],
+    )
+    kern = functools.partial(
+        _splash_kernel, sm_scale=sm_scale, causal=causal, block=block, deg=deg, heads=H, group=group
+    )
+    out = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B * H, T, hd), q.dtype),
+        interpret=interpret,
+    )(idx2, valid2, qr, kg, vg)
+    out = out.reshape(B, H, T, hd)
+
+    # horizontal-global rows: full-T attention for the handful of dense
+    # rows (identical math to the gather path's dense bucket)
+    if drows_np.shape[1] > 0:
+        out = _apply_dense_rows(
+            out, q, k, v, drows_np, dvalid_np, block, causal, sm_scale, None
+        )
+    return out
+
+
+def _on_tpu_backend() -> bool:
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+class _LayoutKey:
+    """Hashable static-layout wrapper for custom_vjp nondiff args: the
+    key CARRIES the layout, so the backward can never lose it (a shared
+    registry would need eviction and could KeyError a held-over vjp)."""
+
+    __slots__ = ("layout", "_fp")
+
+    def __init__(self, layout: np.ndarray):
+        import hashlib
+
+        self.layout = layout
+        self._fp = (layout.shape, hashlib.sha1(np.ascontiguousarray(layout)).hexdigest())
+
+    def __hash__(self):
+        return hash(self._fp)
+
+    def __eq__(self, other):
+        return isinstance(other, _LayoutKey) and self._fp == other._fp
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _splash_attention(q, k, v, layout_key, block, causal, sm_scale, interpret):
+    return _splash_fwd(q, k, v, layout_key.layout, block, causal, sm_scale, interpret)
+
+
+def _splash_fwd_rule(q, k, v, layout_key, block, causal, sm_scale, interpret):
+    out = _splash_attention(q, k, v, layout_key, block, causal, sm_scale, interpret)
+    return out, (q, k, v)
+
+
+def _splash_bwd_rule(layout_key, block, causal, sm_scale, interpret, res, g):
+    # backward recomputes through the differentiable gather formulation —
+    # identical math (the dedicated Pallas backward is the follow-up)
+    q, k, v = res
+    layout = layout_key.layout
+
+    def f(q, k, v):
+        return block_sparse_attention(
+            q, k, v, layout, block, causal=causal, sm_scale=sm_scale, backend="gather"
+        )
+
+    _, vjp = jax.vjp(f, q, k, v)
+    return vjp(g)
+
+
+_splash_attention.defvjp(_splash_fwd_rule, _splash_bwd_rule)
+
+
+def splash_attention(q, k, v, layout: np.ndarray, block: int, causal: bool = False, sm_scale: Optional[float] = None, interpret: Optional[bool] = None):
+    """Streamed Pallas block-sparse attention (see section comment)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    if interpret is None:
+        interpret = not _on_tpu_backend()
+    return _splash_attention(
+        q, k, v, _LayoutKey(layout), int(block), bool(causal), float(sm_scale), bool(interpret)
+    )
 
 
 # ---------------------------------------------------------------------------

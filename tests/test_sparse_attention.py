@@ -3,6 +3,7 @@ dense attention under the equivalent element mask (reference
 tests/unit/test_sparse_attention.py)."""
 import numpy as np
 import pytest
+import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.ops.attention.flash_attention import mha_reference
@@ -147,3 +148,48 @@ def test_gpt2_sparse_attention_mode():
     batch = {"input_ids": np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 256), dtype=np.int32)}
     losses = [float(engine.train_batch(batch)) for _ in range(5)]
     assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+
+# ---------------------------------------------------------------------------
+# Pallas splash kernel (fused path) vs the masked-dense oracle
+# ---------------------------------------------------------------------------
+
+SPLASH_CASES = [
+    ("fixed-bi", FixedSparsityConfig(num_heads=4, block=64, num_local_blocks=2, num_global_blocks=1), False),
+    ("fixed-uni", FixedSparsityConfig(num_heads=4, block=64, num_local_blocks=2, attention="unidirectional"), True),
+    ("bigbird", BigBirdSparsityConfig(num_heads=4, block=64, num_random_blocks=1, num_sliding_window_blocks=3, num_global_blocks=1), False),
+    ("longformer", BSLongformerSparsityConfig(num_heads=4, block=64, num_sliding_window_blocks=3, global_block_indices=[0, 2]), False),
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name,cfg,causal", SPLASH_CASES, ids=[c[0] for c in SPLASH_CASES])
+def test_splash_kernel_matches_masked_dense(name, cfg, causal):
+    r = np.random.default_rng(3)
+    B, H, T, hd = 2, 4, 512, 64
+    layout = cfg.make_layout(T)
+    q = jnp.asarray(r.standard_normal((B, H, T, hd)) * 0.3, jnp.float32)
+    k = jnp.asarray(r.standard_normal((B, H, T, hd)) * 0.3, jnp.float32)
+    v = jnp.asarray(r.standard_normal((B, H, T, hd)) * 0.3, jnp.float32)
+    out = block_sparse_attention(q, k, v, layout, cfg.block, causal=causal, backend="splash")
+    ref = _dense_with_layout(q, k, v, layout, cfg.block, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.slow
+def test_splash_grads_match_gather():
+    r = np.random.default_rng(4)
+    B, H, T, hd, block = 1, 2, 256, 64, 64
+    cfg = FixedSparsityConfig(num_heads=H, block=block, num_local_blocks=2, attention="unidirectional")
+    layout = cfg.make_layout(T)
+    q, k, v = (jnp.asarray(r.standard_normal((B, H, T, hd)) * 0.3, jnp.float32) for _ in range(3))
+
+    def loss(backend):
+        return lambda q, k, v: jnp.sum(
+            block_sparse_attention(q, k, v, layout, block, causal=True, backend=backend) ** 2
+        )
+
+    g_s = jax.grad(loss("splash"), argnums=(0, 1, 2))(q, k, v)
+    g_g = jax.grad(loss("gather"), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_s, g_g):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5)
