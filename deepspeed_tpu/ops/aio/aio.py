@@ -51,6 +51,8 @@ class AioHandle:
                 fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64]
             lib.ds_aio_wait.restype = ctypes.c_int64
             lib.ds_aio_wait.argtypes = [ctypes.c_void_p]
+            lib.ds_aio_used_kernel_aio.restype = ctypes.c_int
+            lib.ds_aio_used_kernel_aio.argtypes = [ctypes.c_void_p]
             self._lib = lib
             self._h = lib.ds_aio_create(block_size, queue_depth, int(single_submit), int(overlap_events), thread_count)
         except Exception as e:
@@ -125,6 +127,12 @@ class AioHandle:
     @property
     def uses_native(self) -> bool:
         return self._h is not None
+
+    @property
+    def used_kernel_aio(self) -> bool:
+        """True once any request ran through the O_DIRECT kernel-AIO
+        engine (vs the thread-pool fallback)."""
+        return bool(self._h is not None and self._lib.ds_aio_used_kernel_aio(self._h))
 
     def __del__(self):
         try:

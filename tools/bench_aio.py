@@ -1,0 +1,50 @@
+"""Host-I/O engine micro-bench: O_DIRECT kernel-AIO vs buffered
+thread-pool (reference DeepNVMe benches, csrc/aio/py_test/).
+
+Buffered wins while the blob fits page cache; kernel-AIO's number is the
+device's sustained rate — the one ZeRO-Infinity sees once swap traffic
+exceeds RAM (the reason the reference uses O_DIRECT).
+
+Run: python tools/bench_aio.py [size_mb] [dir]
+"""
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+from deepspeed_tpu.ops.aio.aio import AioHandle
+
+
+def main():
+    size_mb = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    base = sys.argv[2] if len(sys.argv) > 2 else None
+    d = tempfile.mkdtemp(dir=base)
+    blob = np.frombuffer(np.random.default_rng(0).bytes(size_mb << 20), np.uint8).copy()
+    print(f"{size_mb} MB blob in {d}")
+    print(f"{'engine':>12s} {'write MB/s':>10s} {'read MB/s':>10s}")
+    try:
+        for name, env in (("kernel-aio", "0"), ("threadpool", "1")):
+            os.environ["DS_AIO_DISABLE_KERNEL_AIO"] = env
+            h = AioHandle(block_size=1 << 20, queue_depth=32, thread_count=8)
+            path = os.path.join(d, f"bench_{name}.bin")
+            t0 = time.perf_counter()
+            h.sync_pwrite(blob, path)
+            tw = time.perf_counter() - t0
+            back = np.zeros_like(blob)
+            t0 = time.perf_counter()
+            h.sync_pread(back, path)
+            tr = time.perf_counter() - t0
+            assert (back == blob).all()
+            tag = " (O_DIRECT)" if h.used_kernel_aio else ""
+            print(f"{name:>12s} {blob.nbytes/tw/1e6:10.0f} {blob.nbytes/tr/1e6:10.0f}{tag}")
+    finally:
+        import shutil
+
+        shutil.rmtree(d, ignore_errors=True)
+        os.environ.pop("DS_AIO_DISABLE_KERNEL_AIO", None)
+
+
+if __name__ == "__main__":
+    main()
