@@ -58,9 +58,9 @@ def save_checkpoint(
     # ZeRO-Offload/Infinity: fp32 masters + moments live on host, outside
     # engine.state — persist them beside the sharded state (reference
     # writes *_optim_states.pt per rank; host state is process-local here)
-    host_opt = getattr(engine, "_host_opt", None)
-    if host_opt is not None:
-        host_opt.save(os.path.join(path, f"host_optimizer_rank{jax.process_index()}.npz"))
+    save_host = getattr(engine, "_save_host_optimizer", None)
+    if save_host is not None:
+        save_host(path)
 
     meta = {
         "tag": str(tag),
@@ -165,15 +165,12 @@ def load_checkpoint(
     else:
         engine.state = restored
 
-    host_opt = getattr(engine, "_host_opt", None)
-    if host_opt is not None:
-        host_path = os.path.join(path, f"host_optimizer_rank{jax.process_index()}.npz")
-        if os.path.exists(host_path) and load_optimizer_states and not load_module_only:
-            host_opt.load(host_path)
-        else:
-            # no host state saved (e.g. checkpoint from a non-offload run):
-            # rebuild fp32 masters from the restored (compute-dtype) params
-            host_opt.load_masters(jax.tree.map(np.asarray, restored["params"]))
+    if getattr(engine, "_host_opt", None) is not None:
+        # restores per-shard npz when allowed and present; otherwise
+        # rebuilds fp32 masters from the restored (compute-dtype) params
+        engine._load_host_optimizer(
+            path, restored["params"], use_files=load_optimizer_states and not load_module_only
+        )
 
     client_state: Dict[str, Any] = {}
     if meta:

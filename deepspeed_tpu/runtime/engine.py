@@ -140,6 +140,23 @@ class DeepSpeedEngine:
                 "HBM-resident (sharded 1/fsdp per chip, compute dtype); use "
                 "zero stage 3 + offload_optimizer for host-resident state"
             )
+        # Multi-host offload: fp32 masters + moments are sharded 1/P per
+        # host as one flat slice (the reference's per-DP-rank partitioned
+        # CPU buffers, stage2.py:898-1023); each host steps its slice and
+        # the updated masters reassemble via a process all-gather.
+        # DS_OFFLOAD_SHARDS=K simulates K hosts in one process (tests).
+        env_shards = int(os.environ.get("DS_OFFLOAD_SHARDS", "1"))
+        if jax.process_count() > 1:
+            # real multi-host: one slice per process, always — a larger
+            # env override would leave slices no process owns
+            if env_shards > 1 and env_shards != jax.process_count():
+                logger.warning(
+                    f"DS_OFFLOAD_SHARDS={env_shards} ignored: with "
+                    f"{jax.process_count()} processes each host owns exactly one slice"
+                )
+            self._offload_shards = jax.process_count()
+        else:
+            self._offload_shards = max(1, env_shards)
         if self._offload:
             if optimizer is not None:
                 raise ValueError(
@@ -148,11 +165,6 @@ class DeepSpeedEngine:
                 )
             if not getattr(self, "_use_grad_acc", True):
                 raise NotImplementedError("offload_optimizer is not supported with the pipeline engine yet")
-            if jax.process_count() > 1:
-                raise NotImplementedError(
-                    "offload_optimizer currently requires a single host (grads are "
-                    "fetched to local RAM); multi-host offload lands with host-sharded masters"
-                )
 
         # -- flat-fallback leaves (reference flattened partitions,
         # stage2.py:432 / partition_parameters.py:688): leaves with no
@@ -367,11 +379,20 @@ class DeepSpeedEngine:
 
     def _shard_params(self, params: Any, dtype=jnp.float32) -> Any:
         shardings = jax.tree.map(self._sh, self._param_specs, is_leaf=lambda x: isinstance(x, P))
-        return jax.device_put(jax.tree.map(lambda p: jnp.asarray(p, dtype), params), shardings)
+
+        def host_cast(p):
+            # cast host-side (ml_dtypes handles bf16) so device transfer
+            # moves target-dtype bytes — no full-precision staging in HBM
+            return np.asarray(p).astype(dtype) if not isinstance(p, jax.Array) else jnp.asarray(p, dtype)
+
+        return jax.device_put(jax.tree.map(host_cast, params), shardings)
 
     def _configure_host_offload_optimizer(self, params):
         """Build the host optimizer (reference _configure_basic_optimizer's
-        DeepSpeedCPUAdam branch, engine.py:776-780)."""
+        DeepSpeedCPUAdam branch, engine.py:776-780).  With P > 1 offload
+        shards, fp32 masters + moments live as one flat 1/P slice per
+        host (reference per-DP-rank partitioned pinned buffers,
+        stage2.py:898-1023); each host steps only its slice."""
         from deepspeed_tpu.runtime.zero.offload import HostOffloadOptimizer
 
         name = self.config.optimizer.name or C.ADAM_OPTIMIZER
@@ -383,17 +404,46 @@ class DeepSpeedEngine:
             if not self._offload_cfg.nvme_path:
                 raise ValueError("offload_optimizer.device=nvme requires nvme_path")
             nvme_dir = os.path.join(self._offload_cfg.nvme_path, "zero_infinity_swap")
-        return HostOffloadOptimizer(
-            jax.tree.map(np.asarray, params),
+        kw = dict(
             lr=p.get("lr", 1e-3),
             betas=tuple(p.get("betas", (0.9, 0.999))),
             eps=p.get("eps", 1e-8),
             weight_decay=p.get("weight_decay", 0.0),
             adamw_mode=(name == C.ADAMW_OPTIMIZER) or bool(p.get("adam_w_mode", True)),
-            nvme_swap_dir=nvme_dir,
             aio_config=self.config.aio,
             pipeline=self._offload_cfg.pipeline_read or self._offload_cfg.pipeline_write,
         )
+        if self._offload_shards <= 1:
+            return HostOffloadOptimizer(
+                jax.tree.map(np.asarray, params), nvme_swap_dir=nvme_dir, **kw
+            )
+        from deepspeed_tpu.runtime.fp16.onebit.adam import pack_flat
+
+        P_shards = self._offload_shards
+        flat = np.asarray(pack_flat(jax.tree.map(np.asarray, params), P_shards))
+        L = flat.shape[0] // P_shards
+        self._offload_slice_len = L
+
+        def mk(i):
+            nv = None if nvme_dir is None else os.path.join(nvme_dir, f"shard{i}")
+            if nv is not None:
+                os.makedirs(nv, exist_ok=True)
+            return HostOffloadOptimizer({"flat": flat[i * L : (i + 1) * L].copy()}, nvme_swap_dir=nv, **kw)
+
+        if jax.process_count() > 1:
+            # one slice per host; reassembly goes through process_allgather
+            self._host_shard_ids = [jax.process_index()]
+        else:
+            # simulated multi-host (DS_OFFLOAD_SHARDS): this process owns
+            # every slice and steps them in turn — exercises the exact
+            # slice/step/assemble math single-process
+            self._host_shard_ids = list(range(P_shards))
+        self._host_opts = [mk(i) for i in self._host_shard_ids]
+        log_dist(
+            f"ZeRO-Offload: masters sharded 1/{P_shards} per host "
+            f"({L * 4 / 1e9:.2f} GB master slice/host)"
+        )
+        return self._host_opts[0]
 
     # ------------------------------------------------------------------
     # properties (reference engine exposes config as methods, :227-506)
@@ -665,18 +715,24 @@ class DeepSpeedEngine:
 
         scale = float(self.state["loss_scale"].scale)
         leaves = jax.tree.leaves(g_np)
+        # every host holds the full (replicated) grads, so the norm/
+        # overflow decision is computed identically everywhere — no
+        # cross-host exchange needed even in sharded mode
         _, grad_norm, overflow = host_unscale_clip_and_check(
             leaves, scale, self.config.gradient_clipping
         )
         lr = float(self.lr_schedule(self._host_global_step))
         if not (overflow and self.loss_scaler.dynamic):
             step_count = self._host_global_step + 1
-            masters = self._host_opt.step(
-                jax.tree.unflatten(jax.tree.structure(g_np), leaves), lr, step_count
-            )
             dtype = self.compute_dtype
+            if self._offload_shards > 1:
+                masters = self._sharded_host_step(g_np, leaves, lr, step_count)
+            else:
+                masters = self._host_opt.step(
+                    jax.tree.unflatten(jax.tree.structure(g_np), leaves), lr, step_count
+                )
             self.state["params"] = jax.device_put(
-                jax.tree.map(lambda m: m.astype(dtype), masters),
+                jax.tree.map(lambda m: np.asarray(m, dtype), masters),
                 self._state_shardings["params"],
             )
             self.state["global_step"] = self.state["global_step"] + 1
@@ -823,6 +879,88 @@ class DeepSpeedEngine:
         state["loss_scale"] = self.loss_scaler.update(state["loss_scale"], overflow)
         info = {"lr": lr, "grad_norm": jnp.zeros((), jnp.float32), "overflow": overflow}
         return state, jnp.mean(losses), info
+
+    def _save_host_optimizer(self, ckpt_dir: str) -> None:
+        """Persist host-resident optimizer state (per-shard npz files)."""
+        if self._host_opt is None:
+            return
+        if self._offload_shards <= 1:
+            self._host_opt.save(os.path.join(ckpt_dir, f"host_optimizer_rank{jax.process_index()}.npz"))
+            return
+        for j, i in enumerate(self._host_shard_ids):
+            self._host_opts[j].save(os.path.join(ckpt_dir, f"host_optimizer_shard{i}.npz"))
+
+    def _load_host_optimizer(self, ckpt_dir: str, restored_params, use_files: bool = True) -> None:
+        """Restore host optimizer state; if the tag has none (saved by a
+        non-offload run) or ``use_files`` is off, rebuild fp32 masters
+        from the restored params."""
+        if self._host_opt is None:
+            return
+        exists = lambda p: use_files and os.path.exists(p)
+
+        def warn_if_other_layout(expected: str):
+            import glob
+
+            others = glob.glob(os.path.join(ckpt_dir, "host_optimizer_*.npz"))
+            if others:
+                logger.warning(
+                    f"host optimizer state {expected} not found, but the tag has "
+                    f"{[os.path.basename(o) for o in others]} — the checkpoint was "
+                    "saved under a different offload shard layout (process count / "
+                    "DS_OFFLOAD_SHARDS); Adam moments are being RESET from params"
+                )
+
+        if self._offload_shards <= 1:
+            path = os.path.join(ckpt_dir, f"host_optimizer_rank{jax.process_index()}.npz")
+            if exists(path):
+                self._host_opt.load(path)
+            else:
+                if use_files:
+                    warn_if_other_layout(os.path.basename(path))
+                self._host_opt.load_masters(jax.tree.map(np.asarray, restored_params))
+            return
+        from deepspeed_tpu.runtime.fp16.onebit.adam import pack_flat
+
+        flat = None
+        for j, i in enumerate(self._host_shard_ids):
+            path = os.path.join(ckpt_dir, f"host_optimizer_shard{i}.npz")
+            if exists(path):
+                self._host_opts[j].load(path)
+            else:
+                if use_files:
+                    warn_if_other_layout(os.path.basename(path))
+                if flat is None:
+                    flat = np.asarray(
+                        pack_flat(jax.tree.map(np.asarray, restored_params), self._offload_shards)
+                    )
+                L = self._offload_slice_len
+                self._host_opts[j].load_masters({"flat": flat[i * L : (i + 1) * L]})
+
+    def _sharded_host_step(self, g_np, unscaled_leaves, lr, step_count):
+        """Step only this host's flat master slice(s) and reassemble the
+        full masters — the multi-host ZeRO-Offload path (each process
+        allgather-joins its 1/P slice).  With DS_OFFLOAD_SHARDS in one
+        process, every slice is stepped locally (same math, testable)."""
+        from deepspeed_tpu.runtime.fp16.onebit.adam import unpack_flat
+
+        P_shards = self._offload_shards
+        L = self._offload_slice_len
+        flat_g = np.concatenate([np.asarray(l, np.float32).reshape(-1) for l in unscaled_leaves])
+        pad = (-flat_g.shape[0]) % P_shards
+        if pad:
+            flat_g = np.concatenate([flat_g, np.zeros(pad, np.float32)])
+        slices = {}
+        for j, i in enumerate(self._host_shard_ids):
+            mt = self._host_opts[j].step({"flat": flat_g[i * L : (i + 1) * L]}, lr, step_count)
+            slices[i] = mt["flat"]
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            stacked = np.asarray(multihost_utils.process_allgather(slices[self._host_shard_ids[0]]))
+            full = stacked.reshape(-1)
+        else:
+            full = np.concatenate([slices[i] for i in sorted(slices)])
+        return unpack_flat(full, self.state["params"])
 
     # ------------------------------------------------------------------
     # public training API

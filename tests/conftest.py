@@ -21,11 +21,12 @@ import jax  # noqa: E402
 # jax_platforms to it; pin back to CPU for hermetic, fast tests.
 jax.config.update("jax_platforms", "cpu")
 
-# Persistent compilation cache: XLA compiles dominate suite wall time
-# (most tests build an engine); warm re-runs skip them entirely.
-_cache_dir = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache")
-jax.config.update("jax_compilation_cache_dir", _cache_dir)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+# NOTE: the XLA persistent compilation cache is deliberately NOT enabled
+# here.  On this class of virtualized CPU, machine-feature detection is
+# unstable across processes, and XLA:CPU loads cached AOT executables
+# compiled for a different feature set ("Machine type used for XLA:CPU
+# compilation doesn't match ... could lead to execution errors such as
+# SIGILL") — observed to silently corrupt optimizer numerics by ~1e-3.
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
