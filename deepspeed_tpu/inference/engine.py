@@ -162,10 +162,13 @@ class InferenceEngine:
         def put(path, leaf):
             pstr = "/".join(str(getattr(k, "key", k)) for k in path)
             sh = NamedSharding(self.mesh, self._tp_spec(pstr, np.shape(leaf)))
-            # int8 payloads must stay int8; scales stay f32
+            # int8 payloads must stay int8; scales stay f32.  Cast on
+            # HOST (ml_dtypes handles bf16) so no full-precision staging
+            # copy ever lands in HBM — device_put of fp32 then casting
+            # on-device doubles transfer and OOMs XL-class models.
             arr = np.asarray(leaf)
             dtype = arr.dtype if arr.dtype == np.int8 else (jnp.float32 if pstr.endswith("/s") else self.dtype)
-            return jax.device_put(jnp.asarray(arr, dtype), sh)
+            return jax.device_put(arr.astype(dtype, copy=False), sh)
 
         return jax.tree_util.tree_map_with_path(put, params)
 
