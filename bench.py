@@ -111,8 +111,6 @@ def bench_inference(model_name: str, quantize_bits: int, label: str):
     """Decode throughput: tokens/s in the steady KV-cache decode loop
     (reference inference kernels claim 2-4x fp16 / 3-5x int8,
     docs/_posts/2021-05-05-inference-kernel-optimization.md:55)."""
-    import numpy as np
-
     import deepspeed_tpu
 
     engine = deepspeed_tpu.init_inference(

@@ -527,8 +527,8 @@ def flash_attention(
     bias: Optional[jnp.ndarray] = None,
     dropout_rate: float = 0.0,
     dropout_rng: Optional[jax.Array] = None,
-    block_q: int = 512,
-    block_k: int = 256,
+    block_q: int = 1024,
+    block_k: int = 512,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Flash attention over ``(batch, heads, seq, head_dim)`` inputs.
@@ -583,6 +583,16 @@ def flash_attention(
         return None
 
     bq, bk = pick(sq, block_q), pick(sk, block_k)
+    if bq is not None and bk is not None and (bias is not None or mask3 is not None):
+        # the full-bias/mask BlockSpecs are (1, block_q, sk) fwd and
+        # (1, sq, block_k) in the dkv pass — clamp the block sizes so
+        # those auxiliary buffers stay ~2MB (VMEM is ~16MB/core and the
+        # pipeline double-buffers)
+        aux_bytes = 4 if bias is not None else 1
+        while bq > 128 and bq * sk * aux_bytes > 2**21:
+            bq = pick(sq, bq // 2) or 128
+        while bk > 128 and bk * sq * aux_bytes > 2**21:
+            bk = pick(sk, bk // 2) or 128
     if bq is None or bk is None or sq < 8 or sk < 8:
         if sq >= 8 and sk >= 8 and b * h * sq * sk * 4 > 2**28 and bias is None and mask3 is None:
             # No kernel-compatible blocking but the (b,h,sq,sk) fp32
