@@ -64,6 +64,9 @@ class GPT2Config:
     # memory drops by ~B*T*V*6 bytes at ~10% extra logit-matmul flops
     xent_chunk_size: int = 0
     remat_policy: str = "nothing_saveable"  # or "dots_with_no_batch_dims_saveable"
+    # lax.scan unroll factor for the layer loop: >1 trades compile time
+    # for fewer loop-carried copies / less per-iteration bookkeeping
+    scan_unroll: int = 1
     dtype: Any = jnp.float32  # activation dtype is set by the engine cast
 
     @property
@@ -325,7 +328,9 @@ def apply(params: Dict[str, Any], tokens: jnp.ndarray, cfg: GPT2Config, rng=None
         scan_body = jax.checkpoint(scan_body, policy=policy, prevent_cse=False)
 
     scan_xs = (params["blocks"], layer_rngs, keep_probs) if use_pld else (params["blocks"], layer_rngs)
-    (x, aux_total), _ = jax.lax.scan(scan_body, (x, jnp.zeros((), jnp.float32)), scan_xs)
+    (x, aux_total), _ = jax.lax.scan(
+        scan_body, (x, jnp.zeros((), jnp.float32)), scan_xs, unroll=max(1, cfg.scan_unroll)
+    )
     x = _layer_norm(x, params["lnf_g"], params["lnf_b"], cfg.layer_norm_epsilon)
     if return_hidden:
         return (x, aux_total) if return_aux else x
