@@ -22,6 +22,10 @@ def main():
     args = parser.parse_args()
 
     cfg = gpt2.PRESETS[args.model]
+    # sequences cannot exceed the preset's position table
+    if args.seq > cfg.n_positions:
+        print(f"--seq {args.seq} exceeds {args.model}'s n_positions; clamping to {cfg.n_positions}")
+        args.seq = cfg.n_positions
     model_fn, init_fn, tp_fn = gpt2.make_model(cfg)
     engine, _, _, _ = deepspeed_tpu.initialize(
         args=args,

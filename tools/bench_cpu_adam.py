@@ -1,0 +1,60 @@
+"""Host (CPU) Adam kernel micro-bench: the C++ OpenMP kernel vs a numpy
+baseline — the ZeRO-Offload step executor's throughput (reference
+csrc/adam/cpu_adam.cpp AVX paths; VERDICT r1 flagged ours unmeasured).
+
+Run: python tools/bench_cpu_adam.py [n_params_millions]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam
+
+
+def numpy_adamw(p, g, m, v, step, lr=1e-3, b1=0.9, b2=0.999, eps=1e-8, wd=0.01):
+    np.multiply(m, b1, out=m)
+    m += (1 - b1) * g
+    np.multiply(v, b2, out=v)
+    v += (1 - b2) * g * g
+    upd = (m / (1 - b1**step)) / (np.sqrt(v / (1 - b2**step)) + eps) + wd * p
+    p -= lr * upd
+
+
+def main():
+    n = int(float(sys.argv[1]) * 1e6) if len(sys.argv) > 1 else 100_000_000
+    rng = np.random.default_rng(0)
+    p = rng.standard_normal(n).astype(np.float32)
+    g = rng.standard_normal(n).astype(np.float32)
+    m = np.zeros(n, np.float32)
+    v = np.zeros(n, np.float32)
+    opt = DeepSpeedCPUAdam(lr=1e-3, weight_decay=0.01, adamw_mode=True)
+    print(f"{n/1e6:.0f}M fp32 params; native kernel: {opt.uses_native}")
+
+    # native
+    opt.step(p, g, m, v, 1)  # warm
+    best = float("inf")
+    for s in range(2, 5):
+        t0 = time.perf_counter()
+        opt.step(p, g, m, v, s)
+        best = min(best, time.perf_counter() - t0)
+    # params/s and effective GB/s (reads p,g,m,v + writes p,m,v = 7 arrays)
+    print(f"native : {best*1e3:7.1f} ms/step  {n/best/1e9:5.2f} Gparam/s  {7*4*n/best/1e9:5.1f} GB/s")
+
+    p2 = rng.standard_normal(n).astype(np.float32)
+    m2 = np.zeros(n, np.float32)
+    v2 = np.zeros(n, np.float32)
+    numpy_adamw(p2, g, m2, v2, 1)
+    best_np = float("inf")
+    for s in range(2, 4):
+        t0 = time.perf_counter()
+        numpy_adamw(p2, g, m2, v2, s)
+        best_np = min(best_np, time.perf_counter() - t0)
+    print(f"numpy  : {best_np*1e3:7.1f} ms/step  {n/best_np/1e9:5.2f} Gparam/s  ({best_np/best:.1f}x slower)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+"""Profile one compiled train step on the real chip and print per-source
+device-time attribution (the tool behind this round's MFU work: it
+exposed the fp32-dot flash kernels, the scan bookkeeping, and the
+per-line TFLOP/s of every matmul).
+
+Run: python tools/profile_train_step.py [preset] [micro_bs] [gas] [seq]
+"""
+import collections
+import dataclasses
+import glob
+import gzip
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt2
+    from deepspeed_tpu.runtime.engine import _PlacedBatch
+
+    preset = sys.argv[1] if len(sys.argv) > 1 else "gpt2"
+    mb = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    gas = int(sys.argv[3]) if len(sys.argv) > 3 else 4
+    seq = int(sys.argv[4]) if len(sys.argv) > 4 else 1024
+    steps = 3
+
+    cfg = dataclasses.replace(gpt2.PRESETS[preset], remat=False)
+    seq = min(seq, cfg.n_positions)
+    model_fn, init_fn, tp_fn = gpt2.make_model(cfg)
+    config = {
+        "train_micro_batch_size_per_gpu": mb,
+        "gradient_accumulation_steps": gas,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 0},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "steps_per_print": 10_000,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model_fn, model_parameters=init_fn(), config=config, tp_spec_fn=tp_fn
+    )
+    rng = np.random.default_rng(0)
+    placed = _PlacedBatch(
+        engine._stack_and_place(
+            {"input_ids": rng.integers(0, cfg.vocab_size, (mb * gas, seq), dtype=np.int32)}
+        )
+    )
+    loss = engine.train_batch(placed)
+    float(loss)  # true sync (block_until_ready is unreliable on tunnels)
+
+    trace_dir = tempfile.mkdtemp(prefix="tpu_trace_")
+    with jax.profiler.trace(trace_dir):
+        for _ in range(steps):
+            loss = engine.train_batch(placed)
+        float(loss)
+
+    f = sorted(glob.glob(os.path.join(trace_dir, "plugins/profile/*/*.trace.json.gz")))[-1]
+    with gzip.open(f) as fh:
+        data = json.load(fh)
+    ev = [
+        e
+        for e in data["traceEvents"]
+        if e.get("ph") == "X" and e.get("args") and e["args"].get("hlo_category")
+    ]
+    src_t = collections.Counter()
+    src_f = collections.Counter()
+    for e in ev:
+        if e["args"]["hlo_category"] in ("while", "conditional", "call"):
+            continue
+        s = e["args"].get("source", "?")
+        src_t[s] += e["dur"]
+        src_f[s] += int(e["args"].get("model_flops", 0) or 0)
+    print(f"{'source':68s} {'ms/step':>8s} {'TFLOP/s':>8s}")
+    for s, t in src_t.most_common(20):
+        tf = src_f[s] / (t * 1e-6) / 1e12 if t else 0
+        print(f"{s[-68:]:68s} {t/1e3/steps:8.1f} {tf:8.1f}")
+
+
+if __name__ == "__main__":
+    main()
