@@ -151,7 +151,7 @@ def aio_handle(block_size=1 << 20, queue_depth=8, single_submit=False, overlap_e
     return AioHandle(block_size, queue_depth, single_submit, overlap_events, thread_count)
 
 
-@register_op("async_io", "native", "thread-pool chunked pread/pwrite host I/O engine (DeepNVMe analog)")
+@register_op("async_io", "native", "O_DIRECT kernel-AIO (raw io_submit) host I/O engine with thread-pool fallback (DeepNVMe analog)")
 def _load_async_io():
     h = AioHandle(thread_count=1)
     return {"aio_handle": aio_handle, "native": h.uses_native}

@@ -616,6 +616,6 @@ def flash_attention(
     return _flash_attention(q, k, v, bias, mask3, causal, float(sm_scale), bq, bk, interpret, keep_prob)
 
 
-@register_op("flash_attention", "pallas", "Online-softmax fused attention kernel (fwd) + blockwise remat bwd")
+@register_op("flash_attention", "pallas", "Online-softmax fused attention, Pallas fwd + FA-2 dq/dkv bwd, bias + attention dropout")
 def _load_flash_attention():
     return flash_attention

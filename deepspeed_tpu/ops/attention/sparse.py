@@ -742,7 +742,7 @@ class SparseAttentionUtils:
         return out[:, : out.shape[1] - pad_len] if pad_len else out
 
 
-@register_op("sparse_attn", "xla", "gather-based block-sparse attention + layout configs (Triton blocksparse analog)")
+@register_op("sparse_attn", "pallas", "fused splash block-sparse attention (+ XLA gather oracle) with the SparsityConfig layout family (Triton blocksparse analog)")
 def _load_sparse_attn():
     return {
         "block_sparse_attention": block_sparse_attention,
