@@ -38,6 +38,24 @@ def peak_flops_per_chip(backend: str) -> float:
     return 1e12
 
 
+def _timed_steps(engine, batches, steps, label):
+    """Compile+warm, then best-of-2 timing windows with a true host sync
+    (block_until_ready is not a reliable barrier on tunneled backends;
+    one bad window must not poison the record)."""
+    t0 = time.time()
+    for batch in engine.prefetch_loader(batches(2)):
+        loss = engine.train_batch(batch)
+    log(f"[{label}] compile+2 steps: {time.time()-t0:.1f}s loss={float(loss):.3f}")
+    dt = float("inf")
+    for _ in range(2):
+        t0 = time.time()
+        for batch in engine.prefetch_loader(batches(steps)):
+            loss = engine.train_batch(batch)
+        loss = float(loss)
+        dt = min(dt, (time.time() - t0) / steps)
+    return dt
+
+
 def bench_model(cfg, micro_bs, gas, seq, steps, zero_stage, label):
     import jax
 
@@ -69,24 +87,7 @@ def bench_model(cfg, micro_bs, gas, seq, steps, zero_stage, label):
         for _ in range(n):
             yield {"input_ids": rng.integers(0, cfg.vocab_size, (global_bs, seq), dtype=np.int32)}
 
-    # warmup / compile (input pipeline = threaded device prefetch,
-    # standard practice; batch transfer overlaps the compiled step)
-    t0 = time.time()
-    for batch in engine.prefetch_loader(batches(2)):
-        loss = engine.train_batch(batch)
-    log(f"[{label}] compile+2 steps: {time.time()-t0:.1f}s loss={float(loss):.3f}")
-
-    # best-of-2 timing windows: remote/tunneled TPU paths occasionally
-    # hiccup for seconds — one bad window must not poison the record
-    dt = float("inf")
-    for _ in range(2):
-        t0 = time.time()
-        for batch in engine.prefetch_loader(batches(steps)):
-            loss = engine.train_batch(batch)
-        # a true sync: pull the scalar to host (block_until_ready is not
-        # a reliable barrier on remote/tunneled backends)
-        loss = float(loss)
-        dt = min(dt, (time.time() - t0) / steps)
+    dt = _timed_steps(engine, batches, steps, label)
 
     tokens_per_sec_chip = global_bs * seq / dt / n_dev
     # Training FLOPs/token ≈ 6*N + 12*L*D*seq (attention term)
@@ -104,6 +105,60 @@ def bench_model(cfg, micro_bs, gas, seq, steps, zero_stage, label):
         "vs_baseline": round(mfu / 0.35, 4),
         "mfu_pct": round(mfu * 100, 2),
         "step_ms": round(dt * 1000, 1),
+    }
+
+
+def bench_bert(seq: int, micro_bs: int, gas: int, steps: int):
+    """BERT-Large MLM+NSP pretraining samples/s — a BASELINE.json metric
+    (reference: 64 TFLOPS / 272 samples/s @seq128, 53 TFLOPS / 52
+    samples/s @seq512 on 1x V100-32GB, fastest-bert blog :15-16)."""
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import bert
+
+    n_dev = jax.device_count()
+    cfg = dataclasses.replace(
+        bert.BERT_LARGE, remat=False, scan_unroll=bert.BERT_LARGE.num_hidden_layers
+    )
+    model_fn, init_fn, tp_fn = bert.make_model(cfg)
+    config = {
+        "train_micro_batch_size_per_gpu": micro_bs,
+        "gradient_accumulation_steps": gas,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 0},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "steps_per_print": 10_000,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model_fn, model_parameters=init_fn(), config=config, tp_spec_fn=tp_fn
+    )
+    global_bs = micro_bs * gas * engine.mesh_info.dp_world_size
+    rng = np.random.default_rng(0)
+
+    def batches(n):
+        for _ in range(n):
+            ids = rng.integers(0, cfg.vocab_size, (global_bs, seq), dtype=np.int32)
+            yield {
+                "input_ids": ids,
+                "masked_lm_labels": np.where(rng.random((global_bs, seq)) < 0.15, ids, -100).astype(np.int32),
+                "next_sentence_label": rng.integers(0, 2, (global_bs,), dtype=np.int32),
+            }
+
+    dt = _timed_steps(engine, batches, steps, f"bert-large-s{seq}")
+    samples_s = global_bs / dt / n_dev
+    n_params = cfg.num_params()
+    flops_per_token = 6 * n_params + 12 * cfg.num_hidden_layers * cfg.hidden_size * seq
+    tflops = samples_s * seq * flops_per_token / 1e12
+    log(
+        f"[bert-large-s{seq}] step={dt*1000:.1f}ms samples/s/chip={samples_s:,.1f} "
+        f"achieved={tflops:.1f} TFLOP/s (ref V100: {'272 samples/s / 64 TF' if seq == 128 else '52 samples/s / 53 TF'})"
+    )
+    return {
+        "metric": f"bert_large_seq{seq}_train_samples_per_sec_per_chip",
+        "value": round(samples_s, 1),
+        "unit": "samples/s",
+        "achieved_tflops": round(tflops, 1),
     }
 
 
@@ -197,6 +252,10 @@ def main():
             lambda: bench_model(big, micro_bs=4, gas=2, seq=1024, steps=4, zero_stage=3, label="774M-zero3"),
             "774M-zero3",
         )
+        # BERT-Large samples/s (BASELINE.json metric; ref V100 numbers in
+        # the fastest-bert blog)
+        try_point(lambda: bench_bert(seq=128, micro_bs=32, gas=1, steps=6), "bert-large-s128")
+        try_point(lambda: bench_bert(seq=512, micro_bs=8, gas=1, steps=6), "bert-large-s512")
         # Inference rungs: GPT-2 XL-class KV-cache decode, bf16 and int8
         try_point(lambda: bench_inference("gpt2-xl", 0, "bf16"), "infer-bf16")
         try_point(lambda: bench_inference("gpt2-xl", 8, "int8"), "infer-int8")

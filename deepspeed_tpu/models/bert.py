@@ -37,6 +37,8 @@ class BertConfig:
     pre_layer_norm: bool = True
     use_flash_attention: bool = True
     remat: bool = True
+    # lax.scan unroll factor for the layer loop (see gpt2.GPT2Config)
+    scan_unroll: int = 1
 
     @property
     def head_dim(self) -> int:
@@ -179,7 +181,7 @@ def encode(params, input_ids, cfg: BertConfig, token_type_ids=None, attention_ma
 
     if cfg.remat:
         scan_body = jax.checkpoint(scan_body, prevent_cse=False)
-    x, _ = jax.lax.scan(scan_body, x, (params["blocks"], layer_rngs))
+    x, _ = jax.lax.scan(scan_body, x, (params["blocks"], layer_rngs), unroll=max(1, cfg.scan_unroll))
     return x
 
 
