@@ -41,7 +41,19 @@
 
 namespace {
 
-constexpr int64_t kSector = 512;
+// O_DIRECT transfer granularity.  512 covers most devices; NVMe
+// formatted with 4096-byte logical blocks accepts the open but returns
+// EINVAL at io_submit — that case demotes to the thread pool at wait()
+// (and the engine is marked dead so later requests skip it), or set
+// DS_AIO_SECTOR=4096 to keep kernel AIO on such devices.
+static int64_t sector_size() {
+    static int64_t s = [] {
+        const char* e = getenv("DS_AIO_SECTOR");
+        long v = e ? atol(e) : 0;
+        return (v >= 512 && (v & (v - 1)) == 0) ? v : 512;
+    }();
+    return s;
+}
 
 static long sys_io_setup(unsigned nr, aio_context_t* ctx) { return syscall(SYS_io_setup, nr, ctx); }
 static long sys_io_destroy(aio_context_t ctx) { return syscall(SYS_io_destroy, ctx); }
@@ -202,7 +214,7 @@ struct AioChunk {
 class KernelAioEngine {
   public:
     KernelAioEngine(int64_t block_size, int queue_depth)
-        : block_size_(round_up(block_size, kSector)), queue_depth_(queue_depth) {
+        : block_size_(round_up(block_size, sector_size())), queue_depth_(queue_depth) {
         ok_ = sys_io_setup(queue_depth_, &ctx_) == 0;
     }
 
@@ -211,7 +223,7 @@ class KernelAioEngine {
         for (auto* r : inflight_) free_request(r);
     }
 
-    bool available() const { return ok_; }
+    bool available() const { return ok_ && !submit_failed_; }
 
     // Writes must arrive sector-aligned in length (the handle routes any
     // unaligned tail through the buffered engine — zero-padding a write
@@ -222,7 +234,7 @@ class KernelAioEngine {
         req->fd = fd;
         req->user_buf = buf;
         req->nbytes = nbytes;
-        req->padded = is_read ? round_up(std::max<int64_t>(nbytes, 1), kSector) : nbytes;
+        req->padded = is_read ? round_up(std::max<int64_t>(nbytes, 1), sector_size()) : nbytes;
         req->file_offset = file_offset;
         req->is_read = is_read;
         if (posix_memalign(reinterpret_cast<void**>(&req->bounce), 4096, req->padded) != 0) {
@@ -253,7 +265,9 @@ class KernelAioEngine {
         return true;
     }
 
-    int64_t wait() {
+    // ``failed_out`` (optional): one flag per request in submit order, so
+    // the handle can re-run exactly the failed ones through the pool.
+    int64_t wait(std::vector<char>* failed_out = nullptr) {
         while (!pending_.empty() || in_kernel_ > 0) {
             pump();
             if (in_kernel_ > 0 && !reap(/*min_nr=*/1)) {
@@ -273,10 +287,13 @@ class KernelAioEngine {
         }
         bool ok = true;
         int64_t n = 0;
+        if (failed_out) failed_out->clear();
         for (auto* r : inflight_) {
             // a read that could not deliver its full payload is a
             // failure, matching the thread-pool engine's semantics
-            ok = ok && !r->failed && (!r->is_read || r->copied >= r->nbytes);
+            bool req_ok = !r->failed && (!r->is_read || r->copied >= r->nbytes);
+            ok = ok && req_ok;
+            if (failed_out) failed_out->push_back(req_ok ? 0 : 1);
             ::close(r->fd);
             free_request(r);
             ++n;
@@ -301,7 +318,13 @@ class KernelAioEngine {
             long r = sys_io_submit(ctx_, batch.size(), batch.data());
             if (r <= 0) {
                 if (in_kernel_ > 0 && reap(1)) continue;  // drain and retry
-                // nothing in flight and the kernel refuses: fail all
+                // Nothing in flight and the kernel refuses (e.g. EINVAL:
+                // 4096-byte-logical-block NVMe rejecting 512-granular
+                // iocbs): fail the pending requests and mark the engine
+                // unhealthy — the handle re-runs the failed requests
+                // through the thread pool at wait() and stops routing
+                // here (ADVICE r2: no permanent-failure mode).
+                submit_failed_ = true;
                 for (auto* ch : pending_) {
                     ch->req->failed = true;
                     delete ch;
@@ -346,6 +369,7 @@ class KernelAioEngine {
     long queue_depth_;
     aio_context_t ctx_ = 0;
     bool ok_ = false;
+    bool submit_failed_ = false;
     long in_kernel_ = 0;
     std::deque<AioChunk*> pending_;
     std::vector<AioRequest*> inflight_;
@@ -369,8 +393,8 @@ class AioHandle {
         // (<512B) tail rides the buffered pool so no byte past the
         // payload is ever touched.  reads: O_DIRECT end to end (the
         // bounce copy-back clips to the payload).
-        int64_t body = is_read ? nbytes : (nbytes / kSector) * kSector;
-        if (kaio_enabled_ && file_offset % kSector == 0 && body > 0) {
+        int64_t body = is_read ? nbytes : (nbytes / sector_size()) * sector_size();
+        if (kaio_enabled_ && file_offset % sector_size() == 0 && body > 0) {
             int flags = (is_read ? O_RDONLY : (O_WRONLY | O_CREAT)) | O_DIRECT;
             int fd = ::open(path, flags, 0644);
             if (fd >= 0) {
@@ -379,6 +403,9 @@ class AioHandle {
                     ::close(fd);
                     return -1;
                 }
+                // record for re-run through the pool if the kernel path
+                // fails at io_submit/io_getevents time (wait() below)
+                kaio_recs_.push_back(KaioRec{path, buf, body, is_read, file_offset});
                 kaio_inflight_ = true;
                 if (body == nbytes) {
                     ++user_requests_;
@@ -408,9 +435,31 @@ class AioHandle {
     int64_t wait() {
         bool ok = true;
         if (kaio_inflight_) {
-            ok = ok && kaio_.wait() >= 0;
+            std::vector<char> failed;
+            bool kaio_ok = kaio_.wait(&failed) >= 0;
             kaio_inflight_ = false;
-            if (!kaio_.available()) kaio_enabled_ = false;  // engine died
+            if (!kaio_.available()) kaio_enabled_ = false;  // engine unhealthy
+            if (!kaio_ok) {
+                // Re-run exactly the failed requests through the thread
+                // pool (fresh buffered fds).  Safe for both directions:
+                // a repeated read refills the same caller buffer, a
+                // repeated write rewrites the same payload bytes.
+                bool requeued_all = true;
+                for (size_t i = 0; i < kaio_recs_.size() && i < failed.size(); ++i) {
+                    if (!failed[i]) continue;
+                    const KaioRec& rec = kaio_recs_[i];
+                    int fd = ::open(rec.path.c_str(),
+                                    rec.is_read ? O_RDONLY : (O_WRONLY | O_CREAT), 0644);
+                    if (fd < 0 || !pool_.submit(fd, rec.buf, rec.nbytes, rec.is_read, rec.off)) {
+                        if (fd >= 0) ::close(fd);
+                        requeued_all = false;
+                        continue;
+                    }
+                    pool_inflight_ = true;
+                }
+                ok = ok && requeued_all;
+            }
+            kaio_recs_.clear();
         }
         if (pool_inflight_) {
             ok = ok && pool_.wait() >= 0;
@@ -444,9 +493,18 @@ class AioHandle {
         int64_t off;
     };
 
+    struct KaioRec {  // enough to replay a request through the pool
+        std::string path;
+        char* buf;
+        int64_t nbytes;
+        bool is_read;
+        int64_t off;
+    };
+
     ThreadPoolEngine pool_;
     KernelAioEngine kaio_;
     std::vector<PendingTail> tails_;
+    std::vector<KaioRec> kaio_recs_;
     bool kaio_enabled_ = false;
     bool kaio_inflight_ = false;
     bool pool_inflight_ = false;

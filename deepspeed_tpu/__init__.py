@@ -87,7 +87,33 @@ def initialize(
     info = MeshInfo.from_mesh(mesh)
     ds_config = DeepSpeedConfig(config, world_size=info.dp_world_size)
 
-    if is_pipe:
+    stream_reason = "pipeline module" if is_pipe else None
+    if not is_pipe and ds_config.zero_config.offload_param.enabled:
+        from deepspeed_tpu.runtime.zero.param_offload import ZeroInfinityEngine
+
+        stream_reason = ZeroInfinityEngine.streamable(model, ds_config, info, optimizer)
+        if stream_reason is not None and getattr(model, "stream_spec", None) is not None:
+            from deepspeed_tpu.utils.logging import logger as _logger
+
+            _logger.warning(
+                f"offload_param: falling back to the in-HBM engine — {stream_reason}"
+            )
+    if not is_pipe and ds_config.zero_config.offload_param.enabled and stream_reason is None:
+        # ZeRO-Infinity param offload: params exceed HBM — stream layer
+        # groups through the device (reference
+        # partitioned_param_swapper.py:36 / features.md:116 "13B on one
+        # 32GB device"); models advertise streamability via
+        # model.stream_spec (models/gpt2.py)
+        from deepspeed_tpu.runtime.zero.param_offload import ZeroInfinityEngine
+
+        engine = ZeroInfinityEngine(
+            model=model,
+            params=model_parameters,
+            config=ds_config,
+            mesh=mesh,
+            lr_scheduler=lr_scheduler,
+        )
+    elif is_pipe:
         # reference: PipelineEngine iff model is a PipelineModule
         # (deepspeed/__init__.py:125-149)
         from deepspeed_tpu.runtime.pipe.engine import PipelineEngine

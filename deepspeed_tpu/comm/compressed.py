@@ -75,7 +75,7 @@ def _body(x, worker_error, server_error, *, axis_name: str):
     return out[None], new_werr[None], new_serr[None]
 
 
-def _exchange(x_per_rank, worker_error, server_error, mesh, axis_name: str, replicated_out: bool):
+def _exchange(x_per_rank, worker_error, server_error, mesh, axis_name, replicated_out: bool):
     from jax.sharding import PartitionSpec as P
 
     n, m = x_per_rank.shape
@@ -96,18 +96,25 @@ def _exchange(x_per_rank, worker_error, server_error, mesh, axis_name: str, repl
     return mapped(x_per_rank, worker_error, server_error)
 
 
-def compressed_allreduce(x_per_rank, worker_error, server_error, mesh, axis_name: str = "data"):
+def compressed_allreduce(x_per_rank, worker_error, server_error, mesh, axis_name="data"):
     """1-bit error-feedback averaged allreduce.
 
     ``x_per_rank``: (n, M) — row i is rank i's local tensor (M divisible
     by n).  ``worker_error``: (n, M).  ``server_error``: (n, M // n).
     Returns (avg (n, M) — every row identical, new_worker_error,
     new_server_error), all sharded over ``axis_name``.
+
+    ``axis_name`` may be one mesh axis name or a TUPLE of axis names —
+    e.g. ``("data", "fsdp")`` runs the exchange flat across the whole
+    data-parallel grid, the ZeRO-composed form (n = product of the axis
+    sizes; rank order is mesh-major).  The reference's 1-bit Adam never
+    composes with ZeRO (onebit/adam.py:110 under FP16_UnfusedOptimizer
+    only); here it is just a bigger ring.
     """
     return _exchange(x_per_rank, worker_error, server_error, mesh, axis_name, replicated_out=False)
 
 
-def compressed_allreduce_replicated(x_per_rank, worker_error, server_error, mesh, axis_name: str = "data"):
+def compressed_allreduce_replicated(x_per_rank, worker_error, server_error, mesh, axis_name="data"):
     """Like :func:`compressed_allreduce` but returns the averaged vector
     as a single replicated ``(M,)`` array — free, because phase 3's
     all-gather already leaves the full result on every rank; declaring

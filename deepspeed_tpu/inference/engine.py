@@ -216,6 +216,13 @@ class InferenceEngine:
     # ----------------------------------------------------------------------
     # forward
     # ----------------------------------------------------------------------
+    def _scoped(self, fn):
+        """This engine's mesh becomes ambient for the trace (see
+        parallel.sequence.scoped_to)."""
+        from deepspeed_tpu.parallel.sequence import scoped_to
+
+        return scoped_to(self.mesh, fn)
+
     def forward(self, input_ids, **kw):
         """Full-sequence forward: GPT → logits (B,T,V); BERT → encoder
         hidden states (BERT accepts token_type_ids/attention_mask
@@ -254,7 +261,7 @@ class InferenceEngine:
                 fn = lambda p, ids: self._family.apply(p, ids, cfg, deterministic=True)
             else:
                 fn = lambda p, ids, **k: self._family.encode(p, ids, cfg, deterministic=True, **k)
-            self._compiled[key] = jax.jit(fn)
+            self._compiled[key] = jax.jit(self._scoped(fn))
         return self._compiled[key](self.params, input_ids, **{k: jnp.asarray(v) for k, v in kw.items()})
 
     __call__ = forward
@@ -334,7 +341,7 @@ class InferenceEngine:
             )
             return jnp.concatenate([tokens, first[:, None], rest.T], axis=1)
 
-        return jax.jit(gen)
+        return jax.jit(self._scoped(gen))
 
     def generate(
         self,

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from deepspeed_tpu.ops.registry import register_op
+from deepspeed_tpu.utils.logging import logger
 
 DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 
@@ -498,7 +499,44 @@ def _flash_fwd_rule(q, k, v, bias, mask, causal, sm_scale, block_q, block_k, int
         q, k, v, causal, sm_scale, block_q, block_k, interpret,
         bias=bias, mask=mask, keep_prob=keep_prob,
     )
+    # Names for selective activation checkpointing: a remat policy that
+    # saves "attn_o"/"attn_lse" keeps the kernel's residuals, so the
+    # backward pass does NOT re-run the forward kernel to rebuild the
+    # logsumexp (the policy-driven analog of the reference's fused
+    # kernels persisting their softmax stats between fwd and bwd,
+    # csrc/transformer/softmax_kernels.cu)
+    from jax.ad_checkpoint import checkpoint_name
+
+    out = checkpoint_name(out, "attn_o")
+    lse = checkpoint_name(lse, "attn_lse")
     return out, (q, k, v, out, lse, bias, mask)
+
+
+def _bias_cotangent(q, k, v, out, lse, g, bias, mask, causal, sm_scale, keep_prob):
+    """Exact dL/dbias = dS (pre-scale scores' cotangent) reduced over the
+    bias' broadcast dims.  Deliberately a SEPARATE computation from the
+    Pallas backward: when the caller's bias is a constant (padding mask —
+    the common case) the returned cotangent is unused and XLA's DCE
+    removes this entire block; a trainable bias (learned relative
+    position / ALiBi) pays O(Tq·Tk) here, the same order as the bias
+    tensor it owns."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * sm_scale
+    s = s + jnp.broadcast_to(bias, (b, h, sq, sk)).astype(jnp.float32)
+    if causal:
+        qp = jnp.arange(sq)[:, None] + (sk - sq)
+        s = jnp.where(qp >= jnp.arange(sk)[None, :], s, DEFAULT_MASK_VALUE)
+    p = jnp.exp(s - lse[..., None])
+    dp = jnp.einsum("bhqd,bhkd->bhqk", g.astype(jnp.float32), v.astype(jnp.float32))
+    if mask is not None:
+        dp = dp * (mask.reshape(b, h, sq, sk).astype(jnp.float32) / keep_prob)
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    ds = p * (dp - delta[..., None])  # no sm_scale: bias enters post-scale
+    # reduce over the dims the bias broadcast along
+    reduce_axes = tuple(i for i in range(4) if bias.shape[i] == 1)
+    db = jnp.sum(ds, axis=reduce_axes, keepdims=True) if reduce_axes else ds
+    return db.astype(bias.dtype)
 
 
 def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, keep_prob, res, g):
@@ -507,10 +545,9 @@ def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, keep_prob, re
         q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret,
         bias=bias, mask=mask, keep_prob=keep_prob,
     )
-    # bias is a mask/additive-offset input here, not a trained weight:
-    # its cotangent is declared zero (use mha_reference for a
-    # differentiable bias)
-    dbias = None if bias is None else jnp.zeros_like(bias)
+    dbias = None if bias is None else _bias_cotangent(
+        q, k, v, out, lse, g, bias, mask, causal, sm_scale, keep_prob
+    )
     dmask = None if mask is None else jnp.zeros_like(mask)
     return dq, dk, dv, dbias, dmask
 
@@ -527,7 +564,10 @@ def flash_attention(
     bias: Optional[jnp.ndarray] = None,
     dropout_rate: float = 0.0,
     dropout_rng: Optional[jax.Array] = None,
-    block_q: int = 1024,
+    # (512, 512) measured fastest for fwd+bwd at GPT-2 shapes on v5e
+    # (tools/bench_flash_blocks.py: 1.36ms vs 1.61ms for 1024/512 at
+    # B=4 H=20 T=1024 d=64); pick() clamps to sequence divisors
+    block_q: int = 512,
     block_k: int = 512,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
@@ -540,13 +580,16 @@ def flash_attention(
     defaults to True off-TPU.
 
     ``bias``: additive score bias broadcastable to (B, H, Tq, Tk) — e.g.
-    a (B, 1, 1, Tk) padding mask.  Treated as non-differentiable through
-    the kernel path (zero cotangent).  ``dropout_rate`` applies
-    attention-probability dropout (softmax-then-dropout, the reference's
-    stochastic-transformer mode, csrc/transformer/dropout_kernels.cu):
-    the keep-mask is drawn host-graph-side from ``dropout_rng`` and fed
-    to both kernels, so it costs O(Tq·Tk) bytes — intended for the
-    BERT-era sequence lengths that use it; keep it 0 for long-context.
+    a (B, 1, 1, Tk) padding mask.  Fully differentiable: a trainable
+    bias (learned relative position) gets its exact cotangent from a
+    separable O(Tq·Tk) recompute that XLA dead-code-eliminates when the
+    gradient is unused (constant masks — the common case).
+    ``dropout_rate`` applies attention-probability dropout
+    (softmax-then-dropout, the reference's stochastic-transformer mode,
+    csrc/transformer/dropout_kernels.cu): the keep-mask is drawn
+    host-graph-side from ``dropout_rng`` and fed to both kernels, so it
+    costs O(Tq·Tk) bytes — intended for the BERT-era sequence lengths
+    that use it; keep it 0 for long-context (warned above 4k).
     """
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -559,6 +602,12 @@ def flash_attention(
     if dropout_rate > 0.0:
         if dropout_rng is None:
             raise ValueError("dropout_rate > 0 requires dropout_rng")
+        if sq * sk > 4096 * 4096:
+            logger.warning(
+                f"attention dropout at seq {sq}x{sk} materializes a "
+                f"{b*h*sq*sk/2**30:.1f}GiB keep-mask in HBM (forfeits flash "
+                "attention's O(T) memory); prefer dropout_rate=0 at long context"
+            )
         mask3 = jax.random.bernoulli(dropout_rng, keep_prob, (b * h, sq, sk)).astype(jnp.uint8)
 
     def reference():

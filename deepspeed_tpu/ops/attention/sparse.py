@@ -375,11 +375,16 @@ def block_sparse_attention(
     numpy (static).  ``backend``:
 
     * ``"splash"`` — the streamed Pallas kernel (O(nnz) compute AND HBM
-      traffic, one K/V block DMA per active pair);
+      traffic, one K/V block DMA per active pair); rows with no active
+      block produce zeros (the kernel's l==0 guard);
     * ``"gather"`` — the XLA gather formulation below (O(nnz) compute,
       differentiable end-to-end; also the splash backward's recompute);
-    * ``None`` — auto: splash when eligible (no key-padding mask, MXU-
-      worthy blocks, every row active), else gather.
+    * ``None`` — auto: splash when eligible (no key-padding mask,
+      MXU-worthy ``block >= 64``, ``T % block == 0``, running on TPU),
+      else gather.  NOTE the numerics difference: splash runs its score/
+      value dots in the input dtype (bf16 on the MXU) with fp32
+      accumulation, while gather runs fp32 dots — auto therefore changes
+      dot precision when it switches backends on TPU.
 
     ``causal=True`` additionally applies the elementwise causal mask
     inside diagonal blocks (the layout itself should already be

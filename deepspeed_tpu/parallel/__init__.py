@@ -8,6 +8,7 @@ from deepspeed_tpu.parallel.sequence import (
     ulysses_attention,
     set_global_mesh,
     get_global_mesh,
+    ambient_mesh,
 )
 
-__all__ = ["ring_attention", "ulysses_attention", "set_global_mesh", "get_global_mesh"]
+__all__ = ["ring_attention", "ulysses_attention", "set_global_mesh", "get_global_mesh", "ambient_mesh"]

@@ -179,38 +179,73 @@ def _seq_parallel_call(body_fn, q, k, v, causal, sm_scale, mesh, axis_name, **bo
 
 
 # ---------------------------------------------------------------------------
-# Ambient mesh registry — models are built before the engine/mesh exists,
-# so sequence-parallel attention resolves the mesh lazily at trace time.
+# Ambient mesh — models are built before the engine/mesh exists, so
+# sequence-parallel attention resolves the mesh lazily at trace time.
+# Each engine activates its own mesh (``ambient_mesh``) around every
+# trace, so multiple engines with different meshes co-exist in one
+# process (train + eval, train + inference) with no global cross-talk;
+# ``set_global_mesh`` remains as a *process default* for code running
+# outside any engine (tests, notebooks) and sits below the ambient mesh
+# in the resolution order: explicit arg > ambient (tracing engine) >
+# process default.
 # ---------------------------------------------------------------------------
 
-_GLOBAL_MESH = None
+import contextlib
+from contextvars import ContextVar
+
+_AMBIENT_MESH: ContextVar = ContextVar("ds_tpu_ambient_mesh", default=None)
+_DEFAULT_MESH = None
+
+
+@contextlib.contextmanager
+def ambient_mesh(mesh):
+    """Activate ``mesh`` for the duration of a trace (engine-scoped)."""
+    token = _AMBIENT_MESH.set(mesh)
+    try:
+        yield mesh
+    finally:
+        _AMBIENT_MESH.reset(token)
+
+
+def scoped_to(mesh, fn):
+    """Wrap a to-be-traced function so lazily-resolved parallel ops
+    (ring/ulysses attention, MoE expert sharding) see ``mesh`` at trace
+    time.  Engine-scoped (contextvar), so engines over different meshes
+    co-exist in one process — no global singleton."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        with ambient_mesh(mesh):
+            return fn(*args, **kwargs)
+
+    return wrapped
 
 
 def set_global_mesh(mesh) -> None:
-    global _GLOBAL_MESH
-    if _GLOBAL_MESH is not None and _GLOBAL_MESH is not mesh:
-        from deepspeed_tpu.utils.logging import logger
-
-        logger.warning(
-            "global mesh replaced (last engine wins); models built against "
-            "the previous mesh must pass mesh= explicitly on retrace"
-        )
-    _GLOBAL_MESH = mesh
+    """Set the process-default mesh (fallback for code outside engines)."""
+    global _DEFAULT_MESH
+    _DEFAULT_MESH = mesh
 
 
 def get_global_mesh():
-    return _GLOBAL_MESH
+    """The mesh lazily-resolved ops would use here: the tracing engine's
+    ambient mesh if inside one, else the process default."""
+    amb = _AMBIENT_MESH.get()
+    return amb if amb is not None else _DEFAULT_MESH
 
 
 def _resolve_mesh(mesh):
     if mesh is not None:
         return mesh
-    if _GLOBAL_MESH is None:
+    resolved = get_global_mesh()
+    if resolved is None:
         raise ValueError(
-            "sequence-parallel attention needs a mesh: pass mesh=... or "
-            "initialize an engine first (it registers the global mesh)"
+            "sequence-parallel attention needs a mesh: pass mesh=..., run "
+            "under an engine (it scopes its mesh around every trace), or "
+            "set_global_mesh(...) for standalone use"
         )
-    return _GLOBAL_MESH
+    return resolved
 
 
 @register_op("ring_attention", "xla+shard_map", "Exact ring attention over the seq axis (ppermute K/V rotation)")

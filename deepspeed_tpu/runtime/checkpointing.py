@@ -20,6 +20,7 @@ import os
 from typing import Any, Dict, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.utils.logging import log_dist, logger
@@ -72,6 +73,10 @@ def save_checkpoint(
         "dp_world_size": engine.mesh_info.dp_world_size,
         "mp_world_size": engine.mesh_info.model_parallel_world_size,
         "zero_stage": engine.zero_stage,
+        # whether the tag contains an allocated grad accumulator (gas==1
+        # engines skip the persistent buffer; a restoring job with a
+        # different gas must know to partial-restore)
+        "has_grad_acc": bool(engine.state.get("grad_acc")),
         "client_state": client_state or {},
         "ds_tpu_version": _version(),
     }
@@ -126,32 +131,66 @@ def load_checkpoint(
     # (Flat-padded ZeRO leaves are stored in natural shapes; the engine
     # re-pads them for its own mesh below.)
     target = engine._portable_target()
+
+    def _partial_restore(skip_keys):
+        import orbax.checkpoint as ocp
+
+        partial_target = {k: v for k, v in target.items() if k not in skip_keys}
+        out = dict(
+            ocp.PyTreeCheckpointer().restore(
+                os.path.join(path, "state"),
+                args=ocp.args.PyTreeRestore(
+                    item=jax.tree.map(lambda a: np.zeros(a.shape, a.dtype), partial_target),
+                    partial_restore=True,
+                ),
+            )
+        )
+        for k in skip_keys:
+            out[k] = {}
+        return out
+
+    # grad_acc layout mismatch across gas settings (a gas==1 engine never
+    # allocates the persistent accumulator): skip it in the restore and
+    # keep this engine's own — at any saved step boundary it is zeros, so
+    # no information is lost.  Tags from before the meta key existed were
+    # written by engines that always allocated the accumulator, so a
+    # missing key means "the tag has one".
+    disk_has_acc = meta.get("has_grad_acc", True)
+    skip = set()
+    if disk_has_acc != bool(target.get("grad_acc")) and getattr(engine, "_use_grad_acc", True):
+        skip.add("grad_acc")
+
     from_partial = False
     try:
-        restored = ckptr.restore(os.path.join(path, "state"), target)
-    except ValueError:
+        if skip:
+            restored = _partial_restore(skip)
+            from_partial = True
+        else:
+            restored = ckptr.restore(os.path.join(path, "state"), target)
+    except (ValueError, TypeError):
         if getattr(engine, "_host_opt", None) is None:
             raise
         # offload engine restoring a non-offload checkpoint: the saved
         # tree has real opt_state arrays while our target has {} — restore
         # everything except opt_state and keep the host masters path below
-        import orbax.checkpoint as ocp
-
-        partial_target = {k: v for k, v in target.items() if k != "opt_state"}
-        partial = ocp.PyTreeCheckpointer().restore(
-            os.path.join(path, "state"),
-            args=ocp.args.PyTreeRestore(
-                item=jax.tree.map(lambda a: np.zeros(a.shape, a.dtype), partial_target),
-                partial_restore=True,
-            ),
-        )
-        restored = dict(partial)
-        restored["opt_state"] = {}
+        restored = _partial_restore(skip | {"opt_state"})
         from_partial = True
 
     # checkpoint layout -> this engine's state layout (re-pad flat
     # leaves for the current mesh), then pin the state shardings
     restored = engine._from_portable_state(restored)
+    if "grad_acc" in skip:
+        # keep this engine's accumulator SHAPE but force it to zeros —
+        # a restore mid-accumulation must not mix pending grads from the
+        # pre-restore params into the restored run
+        restored["grad_acc"] = (
+            jax.jit(
+                lambda t: jax.tree.map(jnp.zeros_like, t),
+                out_shardings=engine._state_shardings["grad_acc"],
+            )(engine.state["grad_acc"])
+            if engine.state["grad_acc"]
+            else {}
+        )
     if engine._flat_plan:
         restored = jax.device_put(restored, engine._state_shardings)
     elif from_partial:

@@ -131,7 +131,12 @@ class OnebitAdam:
             scale = jnp.mean(jnp.abs(corrected))
             m_comp = jnp.where(corrected >= 0, scale, -scale)
             werr_new = corrected - m_comp
-            m_eff = jnp.where(frozen, m_comp, m_new)
+            # 1-bit compression cannot represent exact zero (the
+            # reference requires a user momentum mask for always-zero
+            # coordinates, onebit/adam.py:221-226); gate on v > 0
+            # instead: a coordinate that never saw a gradient has no
+            # variance, and ±scale/(√0+eps) would be a huge noise update
+            m_eff = jnp.where(frozen, m_comp * (v_new > 0), m_new)
             werr_out = jnp.where(frozen, werr_new, werr)
 
             denom = jnp.sqrt(v_new / c2) + self.eps
@@ -148,7 +153,9 @@ class OnebitAdam:
     # train executable (reference onebit/adam.py:110-220 + nccl.py:47)
     # ------------------------------------------------------------------
     def make_frozen_state(self, state: OnebitAdamState, n_ranks: int) -> FrozenOnebitAdamState:
-        """One-time warmup→frozen layout conversion at the freeze step."""
+        """One-time warmup→frozen layout conversion at the freeze step.
+        ``n_ranks``: number of exchange rows — the full data-parallel
+        world (data × fsdp when ZeRO-composed)."""
         m_flat = pack_flat(state.exp_avg, n_ranks)
         v_flat = pack_flat(state.exp_avg_sq, n_ranks)
         mp = m_flat.shape[0]
@@ -167,12 +174,14 @@ class OnebitAdam:
         p_flat: jnp.ndarray,  # (Mp,) fp32 packed params
         lr,
         mesh,
-        axis_name: str = "data",
+        axis_name="data",
     ):
         """One compressed-momentum step: every rank folds its LOCAL
         gradient into the synced momentum, the momenta are exchanged
         1-bit with error feedback, and the update uses the frozen
-        variance (reference onebit/adam.py:148-205)."""
+        variance (reference onebit/adam.py:148-205).  ``axis_name`` may
+        be a tuple of mesh axes (the ZeRO-composed flat exchange over
+        the whole dp grid, comm/compressed.py)."""
         from deepspeed_tpu.comm.compressed import compressed_allreduce_replicated
 
         step = fstate.step + 1
@@ -182,7 +191,11 @@ class OnebitAdam:
         )
         c2 = 1.0 - self.b2 ** jnp.float32(self.freeze_step)
         denom = jnp.sqrt(fstate.v_flat / c2) + self.eps
-        upd = -lr * m_synced / denom
+        # v == 0 ⇒ the coordinate never received a gradient (incl. the
+        # pack_flat padding): the ±scale sign noise must not become a
+        # (scale/eps)-sized update — the reference's momentum-mask
+        # requirement (onebit/adam.py:221-226), made automatic
+        upd = -lr * (m_synced * (fstate.v_flat > 0)) / denom
         if self.weight_decay > 0.0:
             upd = upd - lr * self.weight_decay * p_flat
         new_state = FrozenOnebitAdamState(

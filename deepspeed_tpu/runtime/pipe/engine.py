@@ -490,7 +490,7 @@ class PipelineEngine(DeepSpeedEngine):
                 state, info = self._apply_update(state, grads)
                 return state, loss, info
 
-            self._compiled["pipe_train"] = jax.jit(full_step, donate_argnums=(0,))
+            self._compiled["pipe_train"] = jax.jit(self._scoped(full_step), donate_argnums=(0,))
 
         self.state, loss, info = self._compiled["pipe_train"](self.state, full)
         if self.loss_scaler.dynamic:
@@ -521,7 +521,7 @@ class PipelineEngine(DeepSpeedEngine):
                 _, loss = self._compute_loss(state["params"], b, None, state["loss_scale"])
                 return loss
 
-            self._compiled["pipe_eval"] = jax.jit(eval_fn)
+            self._compiled["pipe_eval"] = jax.jit(self._scoped(eval_fn))
         return self._compiled["pipe_eval"](self.state, full)
 
     # The reference disables the unfused API on pipeline engines

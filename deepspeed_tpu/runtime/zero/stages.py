@@ -237,3 +237,31 @@ def opt_state_specs(opt_state: Any, params: Any, rules: ZeroShardingRules) -> An
     return map_param_shaped_subtrees(
         opt_state, params, lambda node: opt_spec_tree, default=lambda leaf: P()
     )
+
+
+def zero_step_comm_model(
+    n_params: int,
+    fsdp: int,
+    stage: int,
+    gas: int = 1,
+    param_bytes: int = 2,
+    grad_bytes: int = 4,
+) -> dict:
+    """First-order per-train-step collective-byte model for a ZeRO step
+    over the ``fsdp`` axis (the reference's perf-critical allgather tail,
+    stage2.py:1489; its bucket knobs tune exactly this traffic).
+
+    Ring-traffic convention matches utils/hlo.py: an all-gather of a
+    full-size result counts its result bytes once; a reduce-scatter
+    counts its (sharded) result bytes once.  Stage 3 gathers the bf16
+    params once in forward and once in the (remat) backward per micro
+    batch; grads reduce-scatter once per micro batch at stage >= 2,
+    all-reduce (2x) at stage <= 1.  Validated against compiled-HLO byte
+    counts in tests/test_zero_comm.py.
+    """
+    if fsdp <= 1:
+        return {"all-gather": 0, "reduce-scatter": 0, "all-reduce": 0, "total": 0}
+    ag = 2 * n_params * param_bytes * gas if stage >= 3 else 0
+    rs = n_params // fsdp * grad_bytes * gas if stage >= 2 else 0
+    ar = 2 * n_params * grad_bytes * gas if stage < 2 else 0
+    return {"all-gather": ag, "reduce-scatter": rs, "all-reduce": ar, "total": ag + rs + ar}

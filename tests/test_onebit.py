@@ -88,48 +88,11 @@ def test_onebit_optimizers_train(opt_name, freeze, lr):
 # compressed-exchange training path (engine frozen phase)
 # ---------------------------------------------------------------------------
 
-_COLLECTIVES = ("all-reduce", "all-gather", "all-to-all", "collective-permute", "reduce-scatter")
-_DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s64": 8, "u64": 8,
-                "s32": 4, "u32": 4, "s16": 2, "u16": 2, "s8": 1, "u8": 1, "pred": 1}
+from deepspeed_tpu.utils.hlo import collective_bytes as _collective_bytes  # noqa: E402
 
 
-def _collective_bytes(hlo_text: str, dtype_filter=None) -> int:
-    """Estimated wire bytes of the collectives in an HLO dump: a ring
-    all-reduce moves ~2x its payload (reduce-scatter + all-gather
-    phases); all-gather / all-to-all / reduce-scatter / permute move ~1x.
-    ``dtype_filter`` restricts the count to one dtype (e.g. "f32")."""
-    import re
-
-    total = 0
-    for line in hlo_text.splitlines():
-        parts = line.split(" = ", 1)
-        if len(parts) != 2:
-            continue
-        rhs = parts[1]
-        # shapes sit between '=' and the op name: "(f32[64]{0}, ...) all-reduce(..."
-        cut = -1
-        weight = 1
-        for c in _COLLECTIVES:
-            for op in (f" {c}(", f" {c}-start("):
-                i = rhs.find(op)
-                if i >= 0 and (cut < 0 or i < cut):
-                    cut = i
-                    weight = 2 if c == "all-reduce" else 1
-        if cut < 0:
-            continue
-        for dt, dims in re.findall(r"(\w+)\[([\d,]*)\]", rhs[:cut]):
-            if dt not in _DTYPE_BYTES or (dtype_filter and dt != dtype_filter):
-                continue
-            n = 1
-            for d in dims.split(","):
-                if d:
-                    n *= int(d)
-            total += n * _DTYPE_BYTES[dt] * weight
-    return total
-
-
-def _train_engine(opt_cfg, steps, gas=2):
-    cfg = base_config(stage=0, mesh={"data": 8}, gas=gas)
+def _train_engine(opt_cfg, steps, gas=2, mesh=None, stage=0, **extra):
+    cfg = base_config(stage=stage, mesh=mesh or {"data": 8}, gas=gas, **extra)
     cfg["optimizer"] = opt_cfg
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=simple_model_loss, model_parameters=simple_model_init(HIDDEN), config=cfg
@@ -184,6 +147,43 @@ def test_onebit_frozen_collective_bytes_drop_4x():
     assert compressed * 3.8 <= plain, (compressed, plain)
     # fp32 traffic: the grads no longer cross the wire at all
     assert _collective_bytes(frozen_txt, "f32") * 20 <= _collective_bytes(plain_txt, "f32")
+
+
+def test_onebit_frozen_with_clipping_and_fsdp_zero2():
+    """Round-3 envelope (VERDICT r2 #6): 1-bit + gradient clipping +
+    fsdp=2 (ZeRO-2) all compose — the exchange runs flat over the
+    (data × fsdp) grid, clipping uses per-rank local norms before the
+    exchange (the reference's unfused_optimizer.py:187-226 semantics),
+    and the compressed step still moves ≥3.8× fewer wire bytes than
+    plain Adam on the SAME mesh/stage."""
+    adam_engine, _ = _train_engine(
+        {"type": "Adam", "params": {"lr": 1e-2}},
+        steps=1, mesh={"data": 4, "fsdp": 2}, stage=2, gradient_clipping=1.0,
+    )
+    engine, losses = _train_engine(
+        {"type": "OneBitAdam", "params": {"lr": 1e-2, "freeze_step": 2}},
+        steps=8, mesh={"data": 4, "fsdp": 2}, stage=2, gradient_clipping=1.0,
+    )
+    assert engine._onebit_exchange_ok and engine._onebit_frozen
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    # exchange state spans the full dp grid (4×2 = 8 rows)
+    assert engine.state["opt_state"].worker_error.shape[0] == 8
+    # grad_norm is REAL in the frozen phase (ADVICE r2: was constant 0.0)
+    batch = random_batches(1, 8 * 2 * 8, HIDDEN)[0]
+    engine.train_batch(batch)
+    assert float(engine._last_info["grad_norm"]) > 0.0
+
+    def tb_text(e, frozen):
+        key = next(
+            k for k in e._compiled
+            if isinstance(k, tuple) and k[0] == "train_batch" and k[1] == frozen
+        )
+        return e._compiled[key].as_text()
+
+    plain = _collective_bytes(tb_text(adam_engine, False))
+    compressed = _collective_bytes(tb_text(engine, True))
+    assert plain > 0 and compressed > 0
+    assert compressed * 3.8 <= plain, (compressed, plain)
 
 
 def test_onebit_frozen_checkpoint_roundtrip(tmp_path):

@@ -1,0 +1,175 @@
+"""ZeRO-Infinity param offload: the >HBM-per-chip training path
+(VERDICT r2 #5; reference partitioned_param_swapper.py:36 — "13B on one
+32GB device", features.md:116).
+
+The streaming executor must (a) match the normal engine's numerics,
+(b) bound device-resident param bytes by ONE layer group instead of the
+full model (asserted from the compiled programs' argument shapes), and
+(c) run the bf16 group params through the kernel-AIO NVMe stage when
+offload_param.device == "nvme"."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import gpt2
+
+CFG = dataclasses.replace(
+    gpt2.GPT2_TINY, n_layer=4, vocab_size=256, n_positions=64, remat=True,
+    use_flash_attention=False,
+)
+
+
+def _offload_config(device="cpu", buffer_count=1, gas=1, nvme_path=None):
+    zero = {
+        "stage": 3,
+        "offload_param": {"device": device, "buffer_count": buffer_count,
+                          **({"nvme_path": nvme_path} if nvme_path else {})},
+    }
+    return {
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": gas,
+        "bf16": {"enabled": True},
+        "zero_optimization": zero,
+        "mesh": {"data": 8},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "steps_per_print": 10_000,
+    }
+
+
+def _normal_config(gas=1):
+    return {
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": gas,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 0},
+        "mesh": {"data": 8},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "steps_per_print": 10_000,
+    }
+
+
+def _batches(n, bs=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        {"input_ids": rng.integers(0, CFG.vocab_size, (bs, 48), dtype=np.int32)}
+        for _ in range(n)
+    ]
+
+
+def _build(config):
+    model_fn, init_fn, tp_fn = gpt2.make_model(CFG)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model_fn, model_parameters=init_fn(), config=config, tp_spec_fn=tp_fn
+    )
+    return engine
+
+
+def test_streaming_engine_selected_and_trains():
+    e = _build(_offload_config())
+    from deepspeed_tpu.runtime.zero.param_offload import ZeroInfinityEngine
+
+    assert isinstance(e, ZeroInfinityEngine)
+    assert e.n_groups == CFG.n_layer  # buffer_count=1 -> one layer per group
+    batches = _batches(6)
+    losses = [float(e.train_batch(b)) for b in batches]
+    assert np.isfinite(losses).all()
+    fixed = _batches(1)[0]
+    l0 = float(e.eval_batch(fixed))
+    for _ in range(4):
+        e.train_batch(fixed)
+    assert float(e.eval_batch(fixed)) < l0  # learns
+
+
+def test_streaming_matches_normal_engine_losses():
+    """Same model/seed/data: the streamed fwd/bwd + host Adam must track
+    the in-HBM engine's loss curve closely (same math, different
+    residency; bf16 rounding + host-fp32 update ordering allow small
+    drift)."""
+    e_off = _build(_offload_config(buffer_count=2))
+    e_norm = _build(_normal_config())
+    batches = _batches(5, seed=3)
+    lo = [float(e_off.train_batch(b)) for b in batches]
+    ln = [float(e_norm.train_batch(b)) for b in batches]
+    np.testing.assert_allclose(lo, ln, rtol=2e-2, atol=2e-2)
+
+
+def test_device_param_bytes_bounded_by_group():
+    """The point of the feature: the largest compiled program's device
+    argument footprint holds ONE layer group's params, not the model —
+    i.e. a simulated HBM budget of (group + activations) suffices where
+    the full stacked blocks would not fit (VERDICT r2 #5 'Done'
+    criterion)."""
+    e = _build(_offload_config(buffer_count=1))
+    b = _batches(1)[0]
+    e.train_batch(b)
+
+    total_block_bf16 = sum(np.asarray(a).size * 2 for a in jax.tree.leaves(e._blocks_host))
+    group_bf16 = total_block_bf16 // e.n_groups
+    assert e.n_groups >= 4  # the bound below is only meaningful if streaming splits
+
+    gdev = e._upload_group(0)
+    res = e._upload_resident()
+    tokens = jax.device_put(np.asarray(b["input_ids"]))
+    x = e._compiled["embed"](res, tokens)
+    rngs = e._layer_rngs(0, 0)[0]
+    compiled = (
+        jax.jit(lambda gp, x_, r_: e.spec.group(gp, x_, r_, True)).lower(gdev, x, rngs).compile()
+    )
+    mem = compiled.memory_analysis()
+    arg_bytes = getattr(mem, "argument_size_in_bytes", None)
+    act_bytes = x.size * x.dtype.itemsize
+    if arg_bytes is None:
+        pytest.skip("backend exposes no memory_analysis argument sizes")
+    # one group's params + the boundary activation + rng keys, NOT the model
+    assert arg_bytes < total_block_bf16, (arg_bytes, total_block_bf16)
+    assert arg_bytes <= group_bf16 + act_bytes + rngs.size * 4 + (1 << 20), (
+        arg_bytes, group_bf16, act_bytes,
+    )
+
+
+def test_nvme_param_staging_roundtrip(tmp_path):
+    """device='nvme': group params stage through the kernel-AIO swapper
+    and training still converges (bytes really go through disk)."""
+    import os
+
+    e = _build(_offload_config(device="nvme", buffer_count=2, nvme_path=str(tmp_path)))
+    assert e._param_swapper is not None
+    files = os.listdir(str(tmp_path / "params"))
+    assert len(files) >= e.n_groups  # one staged file per group
+    fixed = _batches(1, seed=5)[0]
+    l0 = float(e.eval_batch(fixed))
+    for _ in range(4):
+        e.train_batch(fixed)
+    assert float(e.eval_batch(fixed)) < l0
+
+
+def test_streaming_checkpoint_roundtrip(tmp_path):
+    e = _build(_offload_config())
+    batches = _batches(3, seed=9)
+    for b in batches:
+        e.train_batch(b)
+    e.save_checkpoint(str(tmp_path), client_state={"k": 1})
+    probe = _batches(1, seed=11)[0]
+    ref = float(e.eval_batch(probe))
+
+    e2 = _build(_offload_config())
+    path, cs = e2.load_checkpoint(str(tmp_path))
+    assert path is not None and cs == {"k": 1} and e2.global_steps == 3
+    got = float(e2.eval_batch(probe))
+    np.testing.assert_allclose(ref, got, rtol=1e-5, atol=1e-6)
+
+
+def test_gas_accumulation_trains():
+    """gas=2: two streamed micros accumulate on host fp32 and still
+    learn (per-micro stream loop + host accumulation path)."""
+    e2 = _build(_offload_config(gas=2))
+    rng = np.random.default_rng(0)
+    big = {"input_ids": rng.integers(0, CFG.vocab_size, (16, 48), dtype=np.int32)}
+    l0 = float(e2.eval_batch({"input_ids": big["input_ids"][:8]}))
+    for _ in range(3):
+        e2.train_batch(big)
+    assert float(e2.eval_batch({"input_ids": big["input_ids"][:8]})) < l0

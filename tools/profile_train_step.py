@@ -32,14 +32,22 @@ def main():
     seq = int(sys.argv[4]) if len(sys.argv) > 4 else 1024
     steps = 3
 
-    cfg = dataclasses.replace(gpt2.PRESETS[preset], remat=False)
+    if preset.startswith("sweep:"):
+        # profile one of the 774M sweep configurations by name
+        from tools.sweep_774m import CONFIGS
+
+        c = CONFIGS[preset.split(":", 1)[1]]
+        cfg = dataclasses.replace(gpt2.GPT2_LARGE, **c["model"])
+        mb, gas = c["mb"], c["gas"]
+    else:
+        cfg = dataclasses.replace(gpt2.PRESETS[preset], remat=False)
     seq = min(seq, cfg.n_positions)
     model_fn, init_fn, tp_fn = gpt2.make_model(cfg)
     config = {
         "train_micro_batch_size_per_gpu": mb,
         "gradient_accumulation_steps": gas,
         "bf16": {"enabled": True},
-        "zero_optimization": {"stage": 0},
+        "zero_optimization": {"stage": 3 if preset.startswith("sweep:") else 0},
         "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
         "steps_per_print": 10_000,
     }
@@ -81,6 +89,27 @@ def main():
     for s, t in src_t.most_common(20):
         tf = src_f[s] / (t * 1e-6) / 1e12 if t else 0
         print(f"{s[-68:]:68s} {t/1e3/steps:8.1f} {tf:8.1f}")
+
+    # HLO-category view (dot vs fusion vs copy/convert traffic) and the
+    # top individual ops — separates "matmuls running slow" from
+    # "non-matmul time attributed to the same source line"
+    cat_t = collections.Counter()
+    cat_f = collections.Counter()
+    op_t = collections.Counter()
+    for e in ev:
+        c = e["args"]["hlo_category"]
+        if c in ("while", "conditional", "call"):
+            continue
+        cat_t[c] += e["dur"]
+        cat_f[c] += int(e["args"].get("model_flops", 0) or 0)
+        op_t[e.get("name", "?")[:70]] += e["dur"]
+    print(f"\n{'hlo category':30s} {'ms/step':>8s} {'TFLOP/s':>8s}")
+    for c, t in cat_t.most_common(12):
+        tf = cat_f[c] / (t * 1e-6) / 1e12 if t else 0
+        print(f"{c:30s} {t/1e3/steps:8.1f} {tf:8.1f}")
+    print(f"\n{'top ops':70s} {'ms/step':>8s}")
+    for o, t in op_t.most_common(15):
+        print(f"{o:70s} {t/1e3/steps:8.1f}")
 
 
 if __name__ == "__main__":
