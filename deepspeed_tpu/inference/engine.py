@@ -319,6 +319,15 @@ class InferenceEngine:
             first = sample_token(logits[:, -1].astype(jnp.float32), r0)
             finished = first == eos
 
+            # prefill ran with the STACKED cache (layer scan amortizes);
+            # the token loop carries PER-LAYER cache tuples instead —
+            # each unrolled layer then owns its buffer and the stacked
+            # cache's per-token slice/reassembly copies (profiled at
+            # ~7ms/token at XL) disappear
+            n_layer = k_cache.shape[0]
+            k_tup = tuple(k_cache[i] for i in range(n_layer))
+            v_tup = tuple(v_cache[i] for i in range(n_layer))
+
             def body(carry, xs):
                 tok, kc, vc, pos, fin = carry
                 r, step = xs
@@ -336,7 +345,7 @@ class InferenceEngine:
 
             (_, _, _, _, _), rest = jax.lax.scan(
                 body,
-                (first, k_cache, v_cache, jnp.int32(T), finished),
+                (first, k_tup, v_tup, jnp.int32(T), finished),
                 (jax.random.split(rng, N - 1), jnp.arange(1, N, dtype=jnp.int32)),
             )
             return jnp.concatenate([tokens, first[:, None], rest.T], axis=1)

@@ -493,6 +493,8 @@ def make_model(cfg: GPT2Config):
         group=functools.partial(_stream_group, cfg),
         head_loss=functools.partial(_stream_head_loss, cfg),
         deterministic=cfg.dropout == 0.0,
-        supported=cfg.n_experts == 0,
+        # MoE experts need the expert mesh axis; ring/ulysses need the
+        # seq axis — both incompatible with the data-only streaming mesh
+        supported=cfg.n_experts == 0 and cfg.attention_mode == "flash",
     )
     return model_fn, functools.partial(init_params, cfg), tp_spec_fn

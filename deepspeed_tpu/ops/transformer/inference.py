@@ -59,6 +59,13 @@ class DeepSpeedInferenceConfig:
     # MoEConfig.eval_capacity_factor default) or decode diverges
     moe_top_k: int = 2
     moe_eval_capacity_factor: float = 2.0
+    # layer-loop unroll for SINGLE-TOKEN decode steps: the scanned form
+    # pays per-iteration bookkeeping (dynamic slices of the stacked
+    # cache/params) that dominates when each layer's math is one token —
+    # the same fix that closed the training-side scan overhead.  0 =
+    # full unroll.  Prefill (T>1) always scans: its per-layer compute
+    # amortizes the loop and full unroll would bloat compile time.
+    decode_unroll: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -212,12 +219,37 @@ def forward_with_cache(
     x = jnp.take(params["wte"], tokens, axis=0) + pos_emb
     x = x.astype(cfg.dtype)
 
-    def body(carry, xs):
-        lp, ck, cv = xs
-        y, ck, cv = inference_block(cfg, lp, carry, ck, cv, pos, key_padding_mask=key_padding_mask)
-        return y, (ck, cv)
+    if isinstance(k_cache, (tuple, list)):
+        # PER-LAYER cache buffers (decode fast path): each of the L
+        # python-unrolled layers reads/writes ITS OWN (B,H,S,d) array —
+        # no slicing/reassembly of a stacked (L,...) buffer, which the
+        # profiler showed materializing ~GBs of slice/bitcast copies per
+        # token when the stacked cache flowed through an unrolled scan.
+        # Weight slices a[i] are static reads that fuse into the matmuls.
+        n_layer = len(k_cache)
+        new_k, new_v = [], []
+        for i in range(n_layer):
+            lp = jax.tree.map(lambda a: a[i], params["blocks"])
+            x, ck, cv = inference_block(
+                cfg, lp, x, k_cache[i], v_cache[i], pos, key_padding_mask=key_padding_mask
+            )
+            new_k.append(ck)
+            new_v.append(cv)
+        new_k, new_v = tuple(new_k), tuple(new_v)
+    else:
 
-    x, (new_k, new_v) = jax.lax.scan(body, x, (params["blocks"], k_cache, v_cache))
+        def body(carry, xs):
+            lp, ck, cv = xs
+            y, ck, cv = inference_block(cfg, lp, carry, ck, cv, pos, key_padding_mask=key_padding_mask)
+            return y, (ck, cv)
+
+        n_layer = k_cache.shape[0]
+        unroll = 1
+        if T == 1:  # decode: kill the per-layer scan bookkeeping
+            unroll = n_layer if cfg.decode_unroll in (0, None) else max(1, cfg.decode_unroll)
+        x, (new_k, new_v) = jax.lax.scan(
+            body, x, (params["blocks"], k_cache, v_cache), unroll=unroll
+        )
     x = _ln(x, params["lnf_g"], params["lnf_b"], cfg.layer_norm_eps)
     logits = x @ params["wte"].T.astype(x.dtype)
     return logits.astype(jnp.float32), new_k, new_v

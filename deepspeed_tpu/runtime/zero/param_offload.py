@@ -197,30 +197,38 @@ class ZeroInfinityEngine:
     def _group_key(self, g: int) -> str:
         return f"group{g:04d}"
 
-    def _swap_out_all_groups(self) -> None:
-        """Write every group's bf16 params to NVMe (init and post-step)."""
+    @property
+    def _stage_np_dtype(self):
+        """NVMe staging dtype — the COMPUTE dtype, so a pure-fp32 config
+        stages fp32 (no silent truncation to bf16)."""
         import ml_dtypes
 
+        return ml_dtypes.bfloat16 if self.compute_dtype == jnp.bfloat16 else np.float32
+
+    def _swap_out_all_groups(self) -> None:
+        """Write every group's compute-dtype params to NVMe (init and
+        post-step)."""
+        dt = self._stage_np_dtype
         for g in range(self.n_groups):
             flat = np.concatenate([
-                np.asarray(l, ml_dtypes.bfloat16).view(np.uint8).reshape(-1)
+                np.asarray(l, dt).view(np.uint8).reshape(-1)
                 for l in jax.tree.leaves(self._group_slice_host(g))
             ])
             self._param_swapper.swap_out(self._group_key(g), flat, async_op=True)
         self._param_swapper.synchronize()
 
     def _upload_group(self, g: int) -> Any:
-        """bf16 group params → device (from NVMe when staged there)."""
-        import ml_dtypes
-
+        """compute-dtype group params → device (from NVMe when staged)."""
         host = self._group_slice_host(g)
         if self._param_swapper is not None:
+            dt = self._stage_np_dtype
+            itemsize = np.dtype(dt).itemsize
             flat = self._param_swapper.swap_in(self._group_key(g), async_op=False)
             leaves, treedef = jax.tree.flatten(host)
             out, off = [], 0
             for l in leaves:
-                nb = l.size * 2
-                out.append(flat[off : off + nb].view(ml_dtypes.bfloat16).reshape(l.shape))
+                nb = l.size * itemsize
+                out.append(flat[off : off + nb].view(dt).reshape(l.shape))
                 off += nb
             return jax.device_put(jax.tree.unflatten(treedef, out))
         return jax.device_put(jax.tree.map(lambda a: jnp.asarray(a, self.compute_dtype), host))
@@ -277,14 +285,17 @@ class ZeroInfinityEngine:
         def head_eval(res, x, batch):
             return spec.head_loss(res, x, batch)
 
+        from deepspeed_tpu.parallel.sequence import scoped_to
+
+        sc = lambda fn: scoped_to(self.mesh, fn)  # ambient mesh for traces
         self._compiled = {
-            "embed": jax.jit(embed),
-            "group_fwd": jax.jit(group_fwd),
-            "head": jax.jit(head),
-            "group_bwd": jax.jit(group_bwd, donate_argnums=(3,)),
-            "embed_bwd": jax.jit(embed_bwd, donate_argnums=(2,)),
-            "group_eval": jax.jit(group_eval),
-            "head_eval": jax.jit(head_eval),
+            "embed": jax.jit(sc(embed)),
+            "group_fwd": jax.jit(sc(group_fwd)),
+            "head": jax.jit(sc(head)),
+            "group_bwd": jax.jit(sc(group_bwd), donate_argnums=(3,)),
+            "embed_bwd": jax.jit(sc(embed_bwd), donate_argnums=(2,)),
+            "group_eval": jax.jit(sc(group_eval)),
+            "head_eval": jax.jit(sc(head_eval)),
         }
         return self._compiled
 

@@ -1,0 +1,65 @@
+"""Worker script for the REAL multi-process distributed test
+(tests/test_distributed.py).  Launched through the full stack:
+
+    launcher/runner.py -> launcher/launch.py (RANK/WORLD_SIZE/MASTER_*)
+      -> this script -> deepspeed_tpu.initialize()
+          -> comm/distributed.init_distributed -> jax.distributed.initialize
+
+Each process owns ``--local_devices`` virtual CPU devices; the engine's
+mesh spans all processes.  Every rank feeds the SAME global batch (the
+engine slices local shards) and writes its loss curve to
+``--out/rank<i>.json`` for the test to compare against a single-process
+run — mirroring the reference's fork-per-rank harness
+(tests/unit/common.py:16-104) with real collectives, no mocks.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--mode", default="dp", choices=["dp", "offload"])
+    ap.add_argument("--local_devices", type=int, default=4)
+    ap.add_argument("--steps", type=int, default=3)
+    a = ap.parse_args()
+
+    # device count must be pinned before the CPU backend initializes
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + f" --xla_force_host_platform_device_count={a.local_devices}"
+    ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import deepspeed_tpu
+    from tests.simple_model import base_config, random_batches, simple_model_init, simple_model_loss
+
+    total = a.local_devices * int(os.environ.get("WORLD_SIZE", "1"))
+    cfg = base_config(stage=2 if a.mode == "offload" else 0, mesh={"data": total}, gas=1)
+    if a.mode == "offload":
+        cfg["zero_optimization"]["offload_optimizer"] = {"device": "cpu"}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_model_loss, model_parameters=simple_model_init(64), config=cfg
+    )
+    assert jax.device_count() == total, (jax.device_count(), total)
+
+    bs = engine.train_micro_batch_size_per_gpu * engine.mesh_info.dp_world_size
+    batches = random_batches(a.steps, bs, 64, seed=0)  # identical on every rank
+    losses = [float(engine.train_batch(b)) for b in batches]
+
+    rank = jax.process_index()
+    os.makedirs(a.out, exist_ok=True)
+    with open(os.path.join(a.out, f"rank{rank}.json"), "w") as f:
+        json.dump({"rank": rank, "process_count": jax.process_count(), "losses": losses}, f)
+    print(f"worker rank {rank}: {losses}")
+
+
+if __name__ == "__main__":
+    main()

@@ -173,3 +173,19 @@ def test_gas_accumulation_trains():
     for _ in range(3):
         e2.train_batch(big)
     assert float(e2.eval_batch({"input_ids": big["input_ids"][:8]})) < l0
+
+
+def test_nvme_staging_fp32_config(tmp_path):
+    """Pure-fp32 config + NVMe staging: params must stage in fp32 (no
+    silent bf16 truncation — the staging dtype follows compute dtype)."""
+    cfg = _offload_config(device="nvme", buffer_count=2, nvme_path=str(tmp_path))
+    del cfg["bf16"]
+    e = _build(cfg)
+    assert e.compute_dtype == jnp.float32
+    g = e._upload_group(0)
+    assert all(l.dtype == jnp.float32 for l in jax.tree.leaves(g))
+    fixed = _batches(1, seed=7)[0]
+    l0 = float(e.eval_batch(fixed))
+    for _ in range(3):
+        e.train_batch(fixed)
+    assert float(e.eval_batch(fixed)) < l0

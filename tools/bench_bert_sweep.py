@@ -1,0 +1,25 @@
+"""BERT-Large seq128 micro-batch sweep (VERDICT r2 #8: close or explain
+the 258.7 vs 272 samples/s gap on the reference's seq128 rung).
+
+Run: python tools/bench_bert_sweep.py [seq]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import bench
+
+    seq = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    for mb in (16, 32, 48, 64, 96):
+        try:
+            r = bench.bench_bert(seq=seq, micro_bs=mb, gas=1, steps=6)
+            print({"micro_bs": mb, **r}, flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(f"mb={mb} FAILED: {str(e)[:200]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

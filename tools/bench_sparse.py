@@ -18,9 +18,11 @@ from deepspeed_tpu.ops.attention.flash_attention import flash_attention
 from deepspeed_tpu.ops.attention.sparse import BigBirdSparsityConfig, block_sparse_attention
 
 
-def timed_chain(fn, q, k, v, iters=8):
+def timed_chain(fn, q, k, v, iters=48):
     """Dependency-chained timing (block_until_ready is unreliable on
-    tunneled backends): q is perturbed by a reduction of the output."""
+    tunneled backends): q is perturbed by a reduction of the output.
+    ``iters`` amortizes the tunnel's ~100ms fixed dispatch RTT — at 8
+    iters the floor is ~12ms/call and masks sub-10ms kernels."""
 
     @jax.jit
     def chain(q, k, v):
@@ -43,11 +45,28 @@ def timed_chain(fn, q, k, v, iters=8):
     return best
 
 
+def grad_of(fn):
+    """Full training backward: differentiate ALL of q/k/v and fold every
+    grad into the result, or XLA dead-code-eliminates the dk/dv kernel
+    of whichever backend splits its backward into separate programs and
+    the comparison is asymmetric."""
+
+    def loss(q, k, v):
+        return jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
+
+    def g(q, k, v):
+        dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        return dq + (jnp.sum(dk) + jnp.sum(dv)).astype(dq.dtype)
+
+    return g
+
+
 def main():
     H, hd, block = 12, 64, 128
     B = 1
+    mode = sys.argv[1] if len(sys.argv) > 1 else "both"
     r = np.random.default_rng(0)
-    print(f"{'seq':>6s} {'dense flash':>12s} {'splash':>12s} {'speedup':>8s} {'density':>8s}")
+    print(f"{'seq':>6s} {'pass':>8s} {'dense flash':>12s} {'splash':>12s} {'speedup':>8s} {'density':>8s}")
     for T in (4096, 8192, 16384):
         sc = BigBirdSparsityConfig(
             num_heads=H, block=block, num_random_blocks=1,
@@ -59,16 +78,24 @@ def main():
         k = jnp.asarray(r.standard_normal((B, H, T, hd)) * 0.3, jnp.bfloat16)
         v = jnp.asarray(r.standard_normal((B, H, T, hd)) * 0.3, jnp.bfloat16)
 
-        t_dense = timed_chain(lambda q, k, v: flash_attention(q, k, v, causal=True), q, k, v)
-        t_splash = timed_chain(
-            lambda q, k, v: block_sparse_attention(q, k, v, layout, block, causal=True, backend="splash"),
-            q, k, v,
+        dense = lambda q, k, v: flash_attention(q, k, v, causal=True)
+        splash = lambda q, k, v: block_sparse_attention(
+            q, k, v, layout, block, causal=True, backend="splash"
         )
-        print(
-            f"{T:6d} {t_dense*1e3:10.2f}ms {t_splash*1e3:10.2f}ms "
-            f"{t_dense/t_splash:7.2f}x {density*100:7.1f}%",
-            flush=True,
-        )
+        passes = []
+        if mode in ("fwd", "both"):
+            passes.append(("fwd", dense, splash))
+        if mode in ("bwd", "both"):
+            # training path: fwd + dedicated Pallas backward
+            passes.append(("fwd+bwd", grad_of(dense), grad_of(splash)))
+        for name, fd, fs in passes:
+            t_dense = timed_chain(fd, q, k, v)
+            t_splash = timed_chain(fs, q, k, v)
+            print(
+                f"{T:6d} {name:>8s} {t_dense*1e3:10.2f}ms {t_splash*1e3:10.2f}ms "
+                f"{t_dense/t_splash:7.2f}x {density*100:7.1f}%",
+                flush=True,
+            )
 
 
 if __name__ == "__main__":

@@ -1,0 +1,67 @@
+"""Profile the XL decode loop on the chip: per-HLO-category device time
+for the steady-state token scan (the instrument behind the decode
+dispatch work — run after any decode-path change).
+
+Run: python tools/profile_decode.py [model] [B] [new_tokens]
+"""
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import deepspeed_tpu
+
+    model = sys.argv[1] if len(sys.argv) > 1 else "gpt2-xl"
+    B = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    N = int(sys.argv[3]) if len(sys.argv) > 3 else 64
+
+    engine = deepspeed_tpu.init_inference(model=model, max_out_tokens=512)
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, engine.model_config.vocab_size, (B, 128), dtype=np.int32)
+    out = engine.generate(prompt, max_new_tokens=N, do_sample=False)
+    _ = int(np.asarray(out)[0, -1])  # warm + compile
+
+    trace_dir = tempfile.mkdtemp(prefix="decode_trace_")
+    with jax.profiler.trace(trace_dir):
+        out = engine.generate(prompt, max_new_tokens=N, do_sample=False)
+        _ = int(np.asarray(out)[0, -1])
+
+    f = sorted(glob.glob(os.path.join(trace_dir, "plugins/profile/*/*.trace.json.gz")))[-1]
+    with gzip.open(f) as fh:
+        data = json.load(fh)
+    ev = [
+        e for e in data["traceEvents"]
+        if e.get("ph") == "X" and e.get("args") and e["args"].get("hlo_category")
+    ]
+    cat_t = collections.Counter()
+    op_t = collections.Counter()
+    total = 0
+    for e in ev:
+        c = e["args"]["hlo_category"]
+        if c in ("while", "conditional", "call"):
+            continue
+        cat_t[c] += e["dur"]
+        op_t[e.get("name", "?")[:70]] += e["dur"]
+        total += e["dur"]
+    print(f"total device time: {total/1e3:.1f} ms for {N} tokens -> {total/1e3/N:.2f} ms/token")
+    print(f"\n{'hlo category':30s} {'ms/token':>9s}")
+    for c, t in cat_t.most_common(12):
+        print(f"{c:30s} {t/1e3/N:9.3f}")
+    print(f"\n{'top ops':70s} {'ms/token':>9s}")
+    for o, t in op_t.most_common(15):
+        print(f"{o:70s} {t/1e3/N:9.3f}")
+
+
+if __name__ == "__main__":
+    main()
