@@ -299,8 +299,10 @@ def main():
         try_point(big_rung, "774M-zero3")
         # BERT-Large samples/s (BASELINE.json metric; ref V100 numbers in
         # the fastest-bert blog)
-        try_point(lambda: bench_bert(seq=128, micro_bs=32, gas=1, steps=6), "bert-large-s128")
-        try_point(lambda: bench_bert(seq=512, micro_bs=8, gas=1, steps=6), "bert-large-s512")
+        # micro-batches from the r3 sweep: seq128 mb64 (390.6 samples/s
+        # with the short-seq dense attention path), seq512 mb16 (76.7)
+        try_point(lambda: bench_bert(seq=128, micro_bs=64, gas=1, steps=6), "bert-large-s128")
+        try_point(lambda: bench_bert(seq=512, micro_bs=16, gas=1, steps=6), "bert-large-s512")
         # Inference rungs: GPT-2 XL-class KV-cache decode, bf16 and int8
         try_point(lambda: bench_inference("gpt2-xl", 0, "bf16"), "infer-bf16")
         try_point(lambda: bench_inference("gpt2-xl", 8, "int8"), "infer-int8")

@@ -121,3 +121,69 @@ def compressed_allreduce_replicated(x_per_rank, worker_error, server_error, mesh
     the output replicated avoids a redundant broadcast at the engine
     boundary (this is the training-path entry point)."""
     return _exchange(x_per_rank, worker_error, server_error, mesh, axis_name, replicated_out=True)
+
+
+def compressed_allreduce_compressed_out(
+    x_per_rank, worker_error, server_error, mesh, axis_name="data"
+):
+    """Like :func:`compressed_allreduce_replicated` but returns the
+    averaged vector in its COMPRESSED form — ``(signs (M,) int8,
+    scales (n,) fp32)`` with ``out = decompress_chunks(signs, scales)``
+    — instead of the decompressed fp32 vector.  Phase 3's all-gather
+    already moves exactly these bytes; exposing them lets the caller
+    STORE the synced momentum at 1 byte/param (it is exactly
+    sign×chunk-scale by construction) and decompress transiently."""
+    from jax.sharding import PartitionSpec as P
+
+    n, m = x_per_rank.shape
+    if m % n:
+        raise ValueError(f"tensor length {m} not divisible by axis size {n}")
+
+    def body(x, werr, serr):
+        n_ = jax.lax.psum(1, axis_name)
+        xv, we, se = x[0], werr[0], serr[0]
+        chunk = xv.shape[0] // n_
+
+        corrected = xv + we
+        signs, scale = _sign_compress(corrected)
+        new_werr = corrected - _decompress(signs, scale)
+
+        served = jax.lax.all_to_all(
+            signs.reshape(n_, chunk), axis_name, split_axis=0, concat_axis=0, tiled=False
+        )
+        scales = jax.lax.all_gather(scale, axis_name)
+        avg = jnp.mean(served.astype(jnp.float32) * scales[:, None], axis=0)
+
+        corrected_srv = avg + se
+        srv_signs, srv_scale = _sign_compress(corrected_srv)
+        new_serr = corrected_srv - _decompress(srv_signs, srv_scale)
+
+        all_signs = jax.lax.all_gather(srv_signs, axis_name).reshape(-1)  # (M,)
+        all_scales = jax.lax.all_gather(srv_scale, axis_name)  # (n,)
+        return all_signs, all_scales, new_werr[None], new_serr[None]
+
+    mapped = _shard_map()(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis_name), P(axis_name), P(axis_name)),
+        out_specs=(P(), P(), P(axis_name), P(axis_name)),
+        check_vma=False,
+    )
+    return mapped(x_per_rank, worker_error, server_error)
+
+
+def decompress_chunks(signs: jnp.ndarray, scales: jnp.ndarray) -> jnp.ndarray:
+    """Rebuild the fp32 vector from per-chunk sign compression:
+    ``signs`` (M,) int8, ``scales`` (n,) — chunk i spans
+    ``[i*M/n, (i+1)*M/n)`` (the all-to-all chunking)."""
+    n = scales.shape[0]
+    return (signs.reshape(n, -1).astype(jnp.float32) * scales[:, None]).reshape(-1)
+
+
+def compress_chunks(x: jnp.ndarray, n: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-chunk sign compression of a flat vector (the server-side
+    granularity): returns (signs (M,) int8, scales (n,))."""
+    xc = x.reshape(n, -1)
+    scales = jnp.mean(jnp.abs(xc), axis=1)
+    signs = jnp.where(xc >= 0, jnp.int8(1), jnp.int8(-1)).reshape(-1)
+    return signs, scales

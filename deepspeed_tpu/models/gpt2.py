@@ -494,7 +494,9 @@ def make_model(cfg: GPT2Config):
         head_loss=functools.partial(_stream_head_loss, cfg),
         deterministic=cfg.dropout == 0.0,
         # MoE experts need the expert mesh axis; ring/ulysses need the
-        # seq axis — both incompatible with the data-only streaming mesh
-        supported=cfg.n_experts == 0 and cfg.attention_mode == "flash",
+        # seq axis — both incompatible with the data-only streaming
+        # mesh.  flash and sparse are fine: both are single-device
+        # kernels with host-side (numpy) layout prep only.
+        supported=cfg.n_experts == 0 and cfg.attention_mode in ("flash", "sparse"),
     )
     return model_fn, functools.partial(init_params, cfg), tp_spec_fn

@@ -72,6 +72,43 @@ def mha_reference(
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
+# Crossover measured on v5e (fwd+bwd, d=64, tokens held constant):
+# T=128 dense 2.31ms vs kernel 2.82ms; T=256 dense 2.97ms vs kernel
+# 2.64ms — below ~128x128 scores the kernel's grid overhead dominates
+# and a materializing bf16 path is faster (BERT seq128 shapes).
+SMALL_SEQ_DENSE_SCORES = 128 * 128
+
+
+def mha_dense(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    bias: Optional[jnp.ndarray] = None,
+    dropout_mask: Optional[jnp.ndarray] = None,
+    keep_prob: float = 1.0,
+) -> jnp.ndarray:
+    """Materializing attention with input-dtype (MXU-rate) dots and fp32
+    softmax — the fast path at short sequence, where the Pallas grid's
+    per-program overhead exceeds the O(T^2) memory cost it avoids.  Same
+    numerics class as the kernel (bf16 dots, fp32 accumulate/softmax);
+    fp32 inputs stay fp32 end-to-end."""
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * sm_scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if causal:
+        qlen, klen = s.shape[-2], s.shape[-1]
+        qp = jnp.arange(qlen)[:, None] + (klen - qlen)
+        s = jnp.where(qp >= jnp.arange(klen)[None, :], s, DEFAULT_MASK_VALUE)
+    p = jax.nn.softmax(s, axis=-1)
+    if dropout_mask is not None:
+        p = p * (dropout_mask.astype(jnp.float32) / keep_prob)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v, preferred_element_type=jnp.float32).astype(q.dtype)
+
+
 # ---------------------------------------------------------------------------
 # Pallas forward kernel
 # ---------------------------------------------------------------------------
@@ -117,7 +154,7 @@ def _flash_fwd_kernel(
         v = v_ref[0, pl.dslice(i * block_k, block_k), :]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale  # (block_q, block_k) fp32
         if kbias:
-            s = s + bias_ref[0, pl.dslice(i * block_k, block_k)].astype(jnp.float32)[None, :]
+            s = s + bias_ref[0, 0, pl.dslice(i * block_k, block_k)].astype(jnp.float32)[None, :]
         elif fbias:
             s = s + bias_ref[0, :, pl.dslice(i * block_k, block_k)].astype(jnp.float32)
         if causal:
@@ -163,7 +200,10 @@ def _bias_mode(bias, b, h, sq, sk):
     if bias.ndim != 4:
         raise ValueError(f"bias must be 4-D broadcastable to (B,H,Tq,Tk), got {bias.shape}")
     if bias.shape[1] == 1 and bias.shape[2] == 1 and bias.shape[3] == sk:
-        return "kbias", bias.reshape(bias.shape[0], sk)
+        # (B, 1, Tk): the middle singleton keeps the block's trailing two
+        # dims equal to the array dims, which Mosaic requires when the
+        # row count (B) isn't a multiple of 8
+        return "kbias", bias.reshape(bias.shape[0], 1, sk)
     full = jnp.broadcast_to(bias, (b, h, sq, sk)).reshape(b * h, sq, sk)
     return "fbias", full
 
@@ -173,7 +213,7 @@ def _fwd_extra_specs(mode, bias2, mask, b, h, sq, sk, block_q):
     kernels (block over the q dim; the kv dim is sliced in-kernel)."""
     specs, args = [], []
     if mode == "kbias":
-        specs.append(pl.BlockSpec((1, sk), lambda bh_, qi, h=h: (bh_ // h, 0)))
+        specs.append(pl.BlockSpec((1, 1, sk), lambda bh_, qi, h=h: (bh_ // h, 0, 0)))
         args.append(bias2)
     elif mode == "fbias":
         specs.append(pl.BlockSpec((1, block_q, sk), lambda bh_, qi: (bh_, qi, 0)))
@@ -329,7 +369,7 @@ def _flash_bwd_dq_kernel(
         v = v_ref[0, pl.dslice(i * block_k, block_k), :]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
         if kbias:
-            s = s + bias_ref[0, pl.dslice(i * block_k, block_k)].astype(jnp.float32)[None, :]
+            s = s + bias_ref[0, 0, pl.dslice(i * block_k, block_k)].astype(jnp.float32)[None, :]
         elif fbias:
             s = s + bias_ref[0, :, pl.dslice(i * block_k, block_k)].astype(jnp.float32)
         if causal:
@@ -382,7 +422,7 @@ def _flash_bwd_dkv_kernel(
         delta = delta_ref[0, 0, pl.dslice(i * block_q, block_q)][:, None]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
         if kbias:
-            s = s + bias_ref[0].astype(jnp.float32)[None, :]
+            s = s + bias_ref[0, 0].astype(jnp.float32)[None, :]
         elif fbias:
             s = s + bias_ref[0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
         if causal:
@@ -448,7 +488,7 @@ def _flash_bwd_pallas(
     # kv-blocked layouts for the dk/dv pass
     kv_extra_specs, kv_extra_args = [], []
     if mode == "kbias":
-        kv_extra_specs.append(pl.BlockSpec((1, block_k), lambda bh_, ki, h=h: (bh_ // h, ki)))
+        kv_extra_specs.append(pl.BlockSpec((1, 1, block_k), lambda bh_, ki, h=h: (bh_ // h, 0, ki)))
         kv_extra_args.append(bias2)
     elif mode == "fbias":
         kv_extra_specs.append(pl.BlockSpec((1, sq, block_k), lambda bh_, ki: (bh_, 0, ki)))
@@ -593,6 +633,10 @@ def flash_attention(
     """
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    # An explicitly-passed ``interpret`` signals "exercise the kernel"
+    # (the parity tests) — only the default dispatch may take the
+    # short-sequence dense shortcut below.
+    explicit_interpret = interpret is not None
     if interpret is None:
         interpret = not _on_tpu()
     b, h, sq, d = q.shape
@@ -609,6 +653,13 @@ def flash_attention(
                 "attention's O(T) memory); prefer dropout_rate=0 at long context"
             )
         mask3 = jax.random.bernoulli(dropout_rng, keep_prob, (b * h, sq, sk)).astype(jnp.uint8)
+
+    if not explicit_interpret and sq * sk <= SMALL_SEQ_DENSE_SCORES:
+        m4 = None if mask3 is None else mask3.reshape(b, h, sq, sk)
+        return mha_dense(
+            q, k, v, causal=causal, sm_scale=sm_scale, bias=bias,
+            dropout_mask=m4, keep_prob=keep_prob,
+        )
 
     def reference():
         m4 = None if mask3 is None else mask3.reshape(b, h, sq, sk)

@@ -59,13 +59,6 @@ class DeepSpeedInferenceConfig:
     # MoEConfig.eval_capacity_factor default) or decode diverges
     moe_top_k: int = 2
     moe_eval_capacity_factor: float = 2.0
-    # layer-loop unroll for SINGLE-TOKEN decode steps: the scanned form
-    # pays per-iteration bookkeeping (dynamic slices of the stacked
-    # cache/params) that dominates when each layer's math is one token —
-    # the same fix that closed the training-side scan overhead.  0 =
-    # full unroll.  Prefill (T>1) always scans: its per-layer compute
-    # amortizes the loop and full unroll would bloat compile time.
-    decode_unroll: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -244,9 +237,14 @@ def forward_with_cache(
             return y, (ck, cv)
 
         n_layer = k_cache.shape[0]
-        unroll = 1
-        if T == 1:  # decode: kill the per-layer scan bookkeeping
-            unroll = n_layer if cfg.decode_unroll in (0, None) else max(1, cfg.decode_unroll)
+        # Single-token decode fully unrolls the layer loop (the scanned
+        # form's per-iteration bookkeeping — dynamic slices of the
+        # stacked cache/params — dominates when each layer's math is one
+        # token; same fix as the training-side scan overhead).  The
+        # engine's decode path goes further and uses the per-layer tuple
+        # caches above.  Prefill (T>1) always scans: its per-layer
+        # compute amortizes the loop and unrolling bloats compile time.
+        unroll = n_layer if T == 1 else 1
         x, (new_k, new_v) = jax.lax.scan(
             body, x, (params["blocks"], k_cache, v_cache), unroll=unroll
         )

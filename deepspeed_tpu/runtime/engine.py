@@ -303,14 +303,17 @@ class DeepSpeedEngine:
             and self.mesh_info.fsdp_world_size > 1
             and self.zero_stage >= 1
         ):
-            # the frozen layout replicates flat fp32 m/v + packed params
-            # (~12 bytes/param/chip) — models that only fit BECAUSE of
-            # ZeRO sharding will OOM at the freeze step, not at init
+            # the frozen layout replicates int8 momentum signs + the flat
+            # fp32 variance + packed params (~9 bytes/param/chip; m is
+            # stored in its compressed exchange form) — models that only
+            # fit BECAUSE of ZeRO sharding will OOM at the freeze step,
+            # not at init
             n_p = sum(int(np.prod(np.shape(p))) for p in jax.tree.leaves(params))
             logger.warning(
                 "1-bit Adam + ZeRO(fsdp>1): the compressed phase replicates "
-                f"the flat fp32 momentum/variance/params (~{12 * n_p / 2**30:.1f}"
-                "GiB per chip) — ZeRO's state sharding does not apply after "
+                "the momentum signs (int8) + flat fp32 variance/params "
+                f"(~{9 * n_p / 2**30:.1f}GiB per chip) — ZeRO's state "
+                "sharding does not apply after "
                 f"freeze_step; ensure HBM headroom or keep fsdp=1"
             )
         if isinstance(self.optimizer, OnebitAdam) and not self._onebit_exchange_ok:
@@ -814,12 +817,15 @@ class DeepSpeedEngine:
 
         n = self.mesh_info.dp_world_size  # exchange rows = full dp grid
         row_spec = P(self._onebit_exchange_axes())
-        # NOTE: the frozen layout replicates m/v (the exchange needs the
-        # full momentum on every rank to compress it) — ZeRO-1's moment
-        # sharding is traded for the 1-bit wire in this phase
+        # NOTE: the frozen layout replicates the momentum (in its int8
+        # compressed exchange form — 1 byte/param) and the fp32 variance
+        # (the exchange needs the full momentum on every rank to
+        # compress it) — ZeRO-1's moment sharding is traded for the
+        # 1-bit wire in this phase
         sh = FrozenOnebitAdamState(
             step=self._sh(P()),
-            m_flat=self._sh(P()),
+            m_signs=self._sh(P()),
+            m_scales=self._sh(P()),
             v_flat=self._sh(P()),
             worker_error=self._sh(row_spec),
             server_error=self._sh(row_spec),
@@ -829,7 +835,8 @@ class DeepSpeedEngine:
         )(self.state["opt_state"])
         self._state_shardings["opt_state"] = sh
         self._opt_specs = FrozenOnebitAdamState(
-            step=P(), m_flat=P(), v_flat=P(), worker_error=row_spec, server_error=row_spec
+            step=P(), m_signs=P(), m_scales=P(), v_flat=P(),
+            worker_error=row_spec, server_error=row_spec,
         )
         # the frozen path accumulates into its own (n, Mp) rows buffer —
         # free the params-sized fp32 accumulator
@@ -881,7 +888,7 @@ class DeepSpeedEngine:
         n = self.mesh_info.dp_world_size  # exchange rows = full dp grid
         axes = self._onebit_exchange_axes()
         gas = self.gradient_accumulation_steps
-        mp = state["opt_state"].m_flat.shape[0]
+        mp = state["opt_state"].m_signs.shape[0]
         row_sh = self._sh(P(axes))
         acc0 = jax.lax.with_sharding_constraint(jnp.zeros((n, mp), jnp.float32), row_sh)
 

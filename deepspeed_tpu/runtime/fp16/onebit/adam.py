@@ -37,14 +37,27 @@ class OnebitAdamState(NamedTuple):
 class FrozenOnebitAdamState(NamedTuple):
     """Compressed-exchange phase state (engine's frozen train path).
 
-    The momentum and frozen variance live as one fused flat fp32 vector
-    (padded to a multiple of the data-axis size), matching the
-    reference's flattened fused buffer (onebit/adam.py:141); the
-    error-feedback residuals are PER-RANK rows sharded over ``data``
-    (reference worker_error/server_error, comm/nccl.py:47-186)."""
+    The synced momentum is stored in its COMPRESSED exchange form —
+    int8 signs + per-chunk scales.  This is exact, not an
+    approximation: after every exchange the momentum every rank holds
+    IS ``sign × chunk-scale`` by construction (phase 3 all-gathers
+    exactly these bytes, comm/compressed.py), so storing the
+    decompressed fp32 vector was a 4× memory redundancy.  The one
+    boundary case — the warm-phase momentum at the freeze step is NOT
+    sign-representable — is handled by folding ``β1·(m_warm − m_stored)``
+    into every worker-error row, which makes each rank's first
+    corrected/exchanged tensor bit-identical to the reference's
+    (see :meth:`OnebitAdam.make_frozen_state`).
+
+    The frozen variance is one flat fp32 vector (padded to a multiple
+    of the data-axis size), matching the reference's flattened fused
+    buffer (onebit/adam.py:141); the error-feedback residuals are
+    PER-RANK rows sharded over ``data`` (reference
+    worker_error/server_error, comm/nccl.py:47-186)."""
 
     step: jnp.ndarray
-    m_flat: jnp.ndarray  # (Mp,) replicated — synced momentum
+    m_signs: jnp.ndarray  # (Mp,) int8 replicated — synced momentum signs
+    m_scales: jnp.ndarray  # (n,) fp32 replicated — per-chunk scales
     v_flat: jnp.ndarray  # (Mp,) replicated — frozen variance
     worker_error: jnp.ndarray  # (n, Mp) sharded over data
     server_error: jnp.ndarray  # (n, Mp // n) sharded over data
@@ -156,14 +169,23 @@ class OnebitAdam:
         """One-time warmup→frozen layout conversion at the freeze step.
         ``n_ranks``: number of exchange rows — the full data-parallel
         world (data × fsdp when ZeRO-composed)."""
+        from deepspeed_tpu.comm.compressed import compress_chunks, decompress_chunks
+
         m_flat = pack_flat(state.exp_avg, n_ranks)
         v_flat = pack_flat(state.exp_avg_sq, n_ranks)
         mp = m_flat.shape[0]
+        # Store m compressed (1 byte/param); the representation error of
+        # the warm momentum rides into every worker-error row scaled by
+        # β1, so each rank's first frozen-phase corrected tensor equals
+        # β1·m_warm + (1−β1)·g + werr — the reference's value exactly.
+        m_signs, m_scales = compress_chunks(m_flat, n_ranks)
+        delta = self.b1 * (m_flat - decompress_chunks(m_signs, m_scales))
         return FrozenOnebitAdamState(
             step=state.step,
-            m_flat=m_flat,
+            m_signs=m_signs,
+            m_scales=m_scales,
             v_flat=v_flat,
-            worker_error=jnp.zeros((n_ranks, mp), jnp.float32),
+            worker_error=jnp.broadcast_to(delta[None, :], (n_ranks, mp)),
             server_error=jnp.zeros((n_ranks, mp // n_ranks), jnp.float32),
         )
 
@@ -181,14 +203,22 @@ class OnebitAdam:
         1-bit with error feedback, and the update uses the frozen
         variance (reference onebit/adam.py:148-205).  ``axis_name`` may
         be a tuple of mesh axes (the ZeRO-composed flat exchange over
-        the whole dp grid, comm/compressed.py)."""
-        from deepspeed_tpu.comm.compressed import compressed_allreduce_replicated
+        the whole dp grid, comm/compressed.py).  The synced momentum is
+        stored/loaded in its compressed exchange form (see
+        :class:`FrozenOnebitAdamState`); it is decompressed transiently
+        here (fp32 HBM only for the step's lifetime)."""
+        from deepspeed_tpu.comm.compressed import (
+            compressed_allreduce_compressed_out,
+            decompress_chunks,
+        )
 
         step = fstate.step + 1
-        m_rows = self.b1 * fstate.m_flat[None, :] + (1.0 - self.b1) * g_rows
-        m_synced, werr, serr = compressed_allreduce_replicated(
+        m_flat = decompress_chunks(fstate.m_signs, fstate.m_scales)
+        m_rows = self.b1 * m_flat[None, :] + (1.0 - self.b1) * g_rows
+        m_signs, m_scales, werr, serr = compressed_allreduce_compressed_out(
             m_rows, fstate.worker_error, fstate.server_error, mesh, axis_name
         )
+        m_synced = decompress_chunks(m_signs, m_scales)
         c2 = 1.0 - self.b2 ** jnp.float32(self.freeze_step)
         denom = jnp.sqrt(fstate.v_flat / c2) + self.eps
         # v == 0 ⇒ the coordinate never received a gradient (incl. the
@@ -199,6 +229,7 @@ class OnebitAdam:
         if self.weight_decay > 0.0:
             upd = upd - lr * self.weight_decay * p_flat
         new_state = FrozenOnebitAdamState(
-            step=step, m_flat=m_synced, v_flat=fstate.v_flat, worker_error=werr, server_error=serr
+            step=step, m_signs=m_signs, m_scales=m_scales, v_flat=fstate.v_flat,
+            worker_error=werr, server_error=serr,
         )
         return upd, new_state

@@ -156,7 +156,8 @@ class ZeroInfinityEngine:
             )
             self._swap_out_all_groups()
             log_dist(
-                f"ZeRO-Infinity param offload: {self.n_groups} bf16 layer-group files on NVMe "
+                f"ZeRO-Infinity param offload: {self.n_groups} "
+                f"{np.dtype(self._stage_np_dtype).name} layer-group files on NVMe "
                 f"at {nvme_dir} (kernel AIO), one group resident in HBM at a time"
             )
         else:
@@ -219,19 +220,52 @@ class ZeroInfinityEngine:
 
     def _upload_group(self, g: int) -> Any:
         """compute-dtype group params → device (from NVMe when staged)."""
+        return self._finish_upload(g, self._issue_swap_in(g))
+
+    def _issue_swap_in(self, g) -> Optional[np.ndarray]:
+        """Start the async NVMe read of group ``g``'s staged bytes.
+        Returns the in-flight host buffer (valid after the next
+        ``_finish_upload``), or None when params live in host memory
+        (no disk hop to hide — device_put happens at finish time).
+
+        One read is kept in flight at a time: ``synchronize()`` waits on
+        ALL pending aio ops, so issuing deeper would make finishing
+        group g also wait for g+2's bytes."""
+        if g is None or not (0 <= g < self.n_groups) or self._param_swapper is None:
+            return None
+        return self._param_swapper.swap_in(self._group_key(g), async_op=True)
+
+    def _finish_upload(self, g: int, flat: Optional[np.ndarray]) -> Any:
+        """Complete group ``g``'s upload: wait for its NVMe bytes (if
+        staged) and hand them to the device (device_put is async — the
+        H2D copy itself overlaps with whatever compute is in flight)."""
         host = self._group_slice_host(g)
-        if self._param_swapper is not None:
-            dt = self._stage_np_dtype
-            itemsize = np.dtype(dt).itemsize
-            flat = self._param_swapper.swap_in(self._group_key(g), async_op=False)
-            leaves, treedef = jax.tree.flatten(host)
-            out, off = [], 0
-            for l in leaves:
-                nb = l.size * itemsize
-                out.append(flat[off : off + nb].view(dt).reshape(l.shape))
-                off += nb
-            return jax.device_put(jax.tree.unflatten(treedef, out))
-        return jax.device_put(jax.tree.map(lambda a: jnp.asarray(a, self.compute_dtype), host))
+        if self._param_swapper is None:
+            return jax.device_put(
+                jax.tree.map(lambda a: jnp.asarray(a, self.compute_dtype), host)
+            )
+        if flat is None:
+            flat = self._param_swapper.swap_in(self._group_key(g), async_op=True)
+        self._param_swapper.synchronize()
+        dt = self._stage_np_dtype
+        itemsize = np.dtype(dt).itemsize
+        leaves, treedef = jax.tree.flatten(host)
+        out, off = [], 0
+        for l in leaves:
+            nb = l.size * itemsize
+            out.append(flat[off : off + nb].view(dt).reshape(l.shape))
+            off += nb
+        return jax.device_put(jax.tree.unflatten(treedef, out))
+
+    @staticmethod
+    def _start_host_copy(tree) -> None:
+        """Kick off the D2H transfer of every leaf (best effort — some
+        backends/tunnels don't expose copy_to_host_async)."""
+        for leaf in jax.tree.leaves(tree):
+            try:
+                leaf.copy_to_host_async()
+            except Exception:
+                return
 
     def _upload_resident(self) -> Any:
         return jax.device_put(
@@ -326,25 +360,42 @@ class ZeroInfinityEngine:
             rngs = self._layer_rngs(self.global_steps, micro)
             tokens = mbatch["input_ids"]
 
-            # ---- forward sweep: keep only the group BOUNDARY activations
+            # ---- forward sweep: keep only the group BOUNDARY activations.
+            # Pipeline: finish group g's upload, immediately issue the
+            # NVMe read for g+1, then dispatch g's compute — the next
+            # read and H2D ride under the current group's compute.
             xs = [progs["embed"](res_dev, tokens)]
-            g_dev = self._upload_group(0)
+            inflight = self._issue_swap_in(0)
             for g in range(self.n_groups):
-                x_out = progs["group_fwd"](g_dev, xs[-1], rngs[g])
-                # prefetch next group's params while this (async) runs
-                g_dev = self._upload_group(g + 1) if g + 1 < self.n_groups else None
-                xs.append(x_out)
+                g_dev = self._finish_upload(g, inflight)
+                inflight = self._issue_swap_in(g + 1) if g + 1 < self.n_groups else None
+                xs.append(progs["group_fwd"](g_dev, xs[-1], rngs[g]))
 
             loss, d_res, dx = progs["head"](res_dev, xs[-1], mbatch)
             losses.append(loss)
 
-            # ---- backward sweep: re-upload groups in reverse, vjp each
+            # ---- backward sweep: re-upload groups in reverse, vjp each.
+            # Group grads drain to host one group behind compute (async
+            # D2H started at dispatch, converted next iteration), so HBM
+            # holds at most TWO groups' grads — never the model's.
             micro_grads: List[Any] = [None] * self.n_groups
-            g_dev = self._upload_group(self.n_groups - 1)
+            inflight = self._issue_swap_in(self.n_groups - 1)
+            pend_g, pend_dgp = None, None
             for g in range(self.n_groups - 1, -1, -1):
+                g_dev = self._finish_upload(g, inflight)
+                inflight = self._issue_swap_in(g - 1) if g > 0 else None
                 dgp, dx = progs["group_bwd"](g_dev, xs[g], rngs[g], dx)
-                g_dev = self._upload_group(g - 1) if g > 0 else None
-                micro_grads[g] = dgp
+                self._start_host_copy(dgp)
+                if pend_g is not None:
+                    micro_grads[pend_g] = jax.tree.map(
+                        lambda a: np.asarray(a, np.float32), pend_dgp
+                    )
+                pend_g, pend_dgp = g, dgp
+            if pend_g is not None:
+                micro_grads[pend_g] = jax.tree.map(
+                    lambda a: np.asarray(a, np.float32), pend_dgp
+                )
+            pend_dgp = None
             d_res_embed = progs["embed_bwd"](res_dev, tokens, dx)
 
             # ---- host grad accumulation (resident grads sum embed+head)
@@ -392,8 +443,11 @@ class ZeroInfinityEngine:
         res_dev = self._upload_resident()
         x = progs["embed"](res_dev, batch["input_ids"])
         rngs = self._layer_rngs(0, 0)
+        inflight = self._issue_swap_in(0)
         for g in range(self.n_groups):
-            x = progs["group_eval"](self._upload_group(g), x, rngs[g])
+            g_dev = self._finish_upload(g, inflight)
+            inflight = self._issue_swap_in(g + 1) if g + 1 < self.n_groups else None
+            x = progs["group_eval"](g_dev, x, rngs[g])
         return progs["head_eval"](res_dev, x, batch)
 
     # ------------------------------------------------------------------

@@ -1,0 +1,110 @@
+"""Profile one compiled BERT-Large train step on the real chip (same
+per-source / per-HLO-category attribution as profile_train_step.py, for
+the seq128 samples/s rung — VERDICT r2 #8).
+
+Run: python tools/profile_bert_step.py [seq] [micro_bs]
+"""
+import collections
+import dataclasses
+import glob
+import gzip
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import bert
+    from deepspeed_tpu.runtime.engine import _PlacedBatch
+
+    seq = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    mb = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+    steps = 3
+
+    cfg = dataclasses.replace(
+        bert.BERT_LARGE, remat=False, scan_unroll=bert.BERT_LARGE.num_hidden_layers
+    )
+    model_fn, init_fn, tp_fn = bert.make_model(cfg)
+    config = {
+        "train_micro_batch_size_per_gpu": mb,
+        "gradient_accumulation_steps": 1,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 0},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "steps_per_print": 10_000,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model_fn, model_parameters=init_fn(), config=config, tp_spec_fn=tp_fn
+    )
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (mb, seq), dtype=np.int32)
+    placed = _PlacedBatch(
+        engine._stack_and_place(
+            {
+                "input_ids": ids,
+                "masked_lm_labels": np.where(
+                    rng.random((mb, seq)) < 0.15, ids, -100
+                ).astype(np.int32),
+                "next_sentence_label": rng.integers(0, 2, (mb,), dtype=np.int32),
+            }
+        )
+    )
+    loss = engine.train_batch(placed)
+    float(loss)
+
+    trace_dir = tempfile.mkdtemp(prefix="tpu_trace_")
+    with jax.profiler.trace(trace_dir):
+        for _ in range(steps):
+            loss = engine.train_batch(placed)
+        float(loss)
+
+    f = sorted(glob.glob(os.path.join(trace_dir, "plugins/profile/*/*.trace.json.gz")))[-1]
+    with gzip.open(f) as fh:
+        data = json.load(fh)
+    ev = [
+        e
+        for e in data["traceEvents"]
+        if e.get("ph") == "X" and e.get("args") and e["args"].get("hlo_category")
+    ]
+    src_t = collections.Counter()
+    src_f = collections.Counter()
+    for e in ev:
+        if e["args"]["hlo_category"] in ("while", "conditional", "call"):
+            continue
+        s = e["args"].get("source", "?")
+        src_t[s] += e["dur"]
+        src_f[s] += int(e["args"].get("model_flops", 0) or 0)
+    print(f"{'source':68s} {'ms/step':>8s} {'TFLOP/s':>8s}")
+    for s, t in src_t.most_common(20):
+        tf = src_f[s] / (t * 1e-6) / 1e12 if t else 0
+        print(f"{s[-68:]:68s} {t/1e3/steps:8.1f} {tf:8.1f}")
+
+    cat_t = collections.Counter()
+    cat_f = collections.Counter()
+    op_t = collections.Counter()
+    for e in ev:
+        c = e["args"]["hlo_category"]
+        if c in ("while", "conditional", "call"):
+            continue
+        cat_t[c] += e["dur"]
+        cat_f[c] += int(e["args"].get("model_flops", 0) or 0)
+        op_t[e.get("name", "?")[:70]] += e["dur"]
+    print(f"\n{'hlo category':30s} {'ms/step':>8s} {'TFLOP/s':>8s}")
+    for c, t in cat_t.most_common(12):
+        tf = cat_f[c] / (t * 1e-6) / 1e12 if t else 0
+        print(f"{c:30s} {t/1e3/steps:8.1f} {tf:8.1f}")
+    print(f"\n{'top ops':70s} {'ms/step':>8s}")
+    for o, t in op_t.most_common(15):
+        print(f"{o:70s} {t/1e3/steps:8.1f}")
+
+
+if __name__ == "__main__":
+    main()
