@@ -204,6 +204,20 @@ def main():
     on_tpu = backend in ("tpu", "axon")
     log(f"backend={backend} devices={jax.device_count()}")
 
+    if on_tpu:
+        # Persistent compilation cache (TPU only): the big rungs' graphs
+        # (unrolled 124M step, 48-layer XL decode) cost minutes of
+        # compile; a warm cache turns repeat runs into pure execution.
+        # NOT enabled on CPU — XLA:CPU AOT artifacts are machine-feature
+        # sensitive on these VMs (see tests/conftest.py note).
+        cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache_tpu")
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+            log(f"compilation cache: {cache_dir}")
+        except Exception as e:  # noqa: BLE001
+            log(f"compilation cache unavailable: {e}")
+
     # Headline: 124M fits without activation recompute at this batch —
     # remat would burn 1/3 extra flops for memory we don't need
     if on_tpu:

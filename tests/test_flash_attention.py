@@ -77,6 +77,29 @@ def test_tiny_shapes_fallback():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+def test_short_seq_dense_dispatch_matches_kernel():
+    """The default dispatch routes sq*sk <= 128^2 to the materializing
+    dense path (TPU crossover, mha_dense); an explicitly-passed
+    ``interpret`` keeps the Pallas kernel.  Both must agree — forward
+    AND grads — so the dispatch seam can't drift."""
+    q, k, v = qkv(b=3, h=2, sq=128, sk=128, d=32)
+    bias = jnp.where(
+        jax.random.uniform(jax.random.PRNGKey(7), (3, 1, 1, 128)) < 0.2, -1e9, 0.0
+    ).astype(jnp.float32)
+    dense = flash_attention(q, k, v, causal=True, bias=bias)  # dense shortcut
+    kern = flash_attention(q, k, v, causal=True, bias=bias, interpret=True)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(kern), atol=2e-5, rtol=2e-5)
+
+    def loss(fn_kwargs):
+        def f(q):
+            return jnp.sum(flash_attention(q, k, v, causal=True, bias=bias, **fn_kwargs) ** 2)
+        return jax.grad(f)(q)
+
+    g_dense = loss({})
+    g_kern = loss({"interpret": True})
+    np.testing.assert_allclose(np.asarray(g_dense), np.asarray(g_kern), atol=3e-4, rtol=3e-4)
+
+
 def test_backward_rectangular_causal():
     """sq < sk with end-aligned causal (chunked-prefill shape): the
     Pallas backward's causal offsets must match the reference."""
