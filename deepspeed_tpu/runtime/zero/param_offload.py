@@ -391,12 +391,15 @@ class ZeroInfinityEngine:
                         lambda a: np.asarray(a, np.float32), pend_dgp
                     )
                 pend_g, pend_dgp = g, dgp
+            # dispatch the embed backward BEFORE draining the last
+            # group's grads — the host-side conversion below blocks on
+            # D2H and would otherwise idle the device
+            d_res_embed = progs["embed_bwd"](res_dev, tokens, dx)
             if pend_g is not None:
                 micro_grads[pend_g] = jax.tree.map(
                     lambda a: np.asarray(a, np.float32), pend_dgp
                 )
             pend_dgp = None
-            d_res_embed = progs["embed_bwd"](res_dev, tokens, dx)
 
             # ---- host grad accumulation (resident grads sum embed+head)
             d_res_total = jax.tree.map(

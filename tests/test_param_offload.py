@@ -97,6 +97,30 @@ def test_streaming_matches_normal_engine_losses():
     np.testing.assert_allclose(lo, ln, rtol=2e-2, atol=2e-2)
 
 
+def test_sparse_attention_config_streams():
+    """attention_mode='sparse' is streamable (static numpy layouts, no
+    extra mesh axis) — it must route to the streaming engine and match
+    the in-HBM engine's losses, same as flash/dense."""
+    from deepspeed_tpu.runtime.zero.param_offload import ZeroInfinityEngine
+
+    sparse_cfg = dataclasses.replace(CFG, attention_mode="sparse")  # default BigBird layout
+    model_fn, init_fn, tp_fn = gpt2.make_model(sparse_cfg)
+
+    def build(config):
+        e, _, _, _ = deepspeed_tpu.initialize(
+            model=model_fn, model_parameters=init_fn(), config=config, tp_spec_fn=tp_fn
+        )
+        return e
+
+    e_off = build(_offload_config(buffer_count=2))
+    assert isinstance(e_off, ZeroInfinityEngine)
+    e_norm = build(_normal_config())
+    batches = _batches(3, seed=5)
+    lo = [float(e_off.train_batch(b)) for b in batches]
+    ln = [float(e_norm.train_batch(b)) for b in batches]
+    np.testing.assert_allclose(lo, ln, rtol=2e-2, atol=2e-2)
+
+
 def test_device_param_bytes_bounded_by_group():
     """The point of the feature: the largest compiled program's device
     argument footprint holds ONE layer group's params, not the model —
