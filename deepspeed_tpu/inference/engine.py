@@ -162,9 +162,7 @@ class InferenceEngine:
         # int8 payloads must stay int8; scales stay f32.  Cast on HOST
         # (ml_dtypes handles bf16) so no full-precision staging copy
         # ever lands in HBM — device_put of fp32 then casting on-device
-        # doubles transfer and OOMs XL-class models.  The upload is ONE
-        # batched device_put: per-leaf calls pay a tunnel round trip
-        # each (~1200 leaves on an int8-packed XL ≈ minutes of pure RTT).
+        # doubles transfer and OOMs XL-class models.
         flat, treedef = jax.tree_util.tree_flatten_with_path(params)
         arrays, shardings = [], []
         for path, leaf in flat:
@@ -173,8 +171,36 @@ class InferenceEngine:
             dtype = arr.dtype if arr.dtype == np.int8 else (jnp.float32 if pstr.endswith("/s") else self.dtype)
             arrays.append(arr.astype(dtype, copy=False))
             shardings.append(NamedSharding(self.mesh, self._tp_spec(pstr, np.shape(leaf))))
-        placed = jax.device_put(arrays, shardings)
-        return jax.tree_util.tree_unflatten(treedef, [p for p in placed])
+        if self.mp_world_size > 1:
+            # TP: leaves carry different shardings — batched device_put
+            placed = jax.device_put(arrays, shardings)
+            return jax.tree_util.tree_unflatten(treedef, list(placed))
+        # mp=1: every transfer pays a tunnel/PCIe round trip, and an
+        # XL-class tree has ~600-1200 leaves (minutes of pure RTT).
+        # Upload ONE flat buffer per dtype and split on device (the
+        # split program is trivial and persists in the compile cache).
+        placed = [None] * len(arrays)
+        by_dtype = {}
+        for i, a in enumerate(arrays):
+            by_dtype.setdefault(a.dtype, []).append(i)
+        rep = NamedSharding(self.mesh, P())
+        for dt, idxs in by_dtype.items():
+            buf = np.concatenate([arrays[i].reshape(-1) for i in idxs])
+            dev = jax.device_put(buf, rep)
+            shapes = [arrays[i].shape for i in idxs]
+
+            @jax.jit
+            def split(b, shapes=tuple(shapes)):
+                outs, off = [], 0
+                for shp in shapes:
+                    n = int(np.prod(shp)) if shp else 1
+                    outs.append(jax.lax.dynamic_slice(b, (off,), (n,)).reshape(shp))
+                    off += n
+                return outs
+
+            for i, part in zip(idxs, split(dev)):
+                placed[i] = part
+        return jax.tree_util.tree_unflatten(treedef, placed)
 
     def _load_checkpoint_params(self, checkpoint: str, tag: Optional[str], params):
         """Load params from a training checkpoint dir (orbax sharded
