@@ -22,6 +22,7 @@ checkpoints (:150), converts dtype (:175), applies the injection policy
 from __future__ import annotations
 
 import dataclasses
+import functools
 import os
 from typing import Any, Callable, Dict, Optional
 
@@ -33,6 +34,26 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from deepspeed_tpu.comm.mesh import MESH_AXES, MeshInfo
 from deepspeed_tpu.config.config import MeshConfig
 from deepspeed_tpu.utils.logging import log_dist, logger
+
+# Host→device staging is chunked so the transient flat buffer never adds
+# more than this many bytes of HBM on top of the parameters themselves
+# (an XL-class model staged as ONE flat buffer peaks at ~2x its size).
+_STAGE_CHUNK_BYTES = 256 << 20
+
+
+@functools.partial(jax.jit, static_argnums=1)
+def _split_flat(buf, shapes):
+    """Split one flat staging buffer into per-leaf arrays on device.
+    Module-level (static ``shapes``) so jit's in-process trace cache hits
+    across engines.  No donation: XLA cannot alias one flat buffer into
+    many reshaped outputs (it would just warn per call) — the HBM peak
+    is bounded by _STAGE_CHUNK_BYTES chunking, not aliasing."""
+    outs, off = [], 0
+    for shp in shapes:
+        n = int(np.prod(shp)) if shp else 1
+        outs.append(jax.lax.dynamic_slice(buf, (off,), (n,)).reshape(shp))
+        off += n
+    return outs
 
 
 class InferenceEngine:
@@ -52,6 +73,7 @@ class InferenceEngine:
         quantize_bits: int = 0,
         quantize_groups: int = 1,
         seed: int = 0,
+        init_on_device: bool = False,
         **kwargs,
     ):
         """``model`` may be:
@@ -112,9 +134,18 @@ class InferenceEngine:
             # a random init would only serve as a shape template here, so
             # skip it — the restore target comes from checkpoint metadata
             params = self._load_checkpoint_params(checkpoint, checkpoint_tag, params)
+        owns_params = False  # only engine-created trees may be donated
         if params is None:
-            init = gpt2_mod.init_params if self._is_gpt else bert_mod.init_params
-            params = init(self.model_config, seed=seed)
+            if init_on_device and getattr(self.model_config, "n_experts", 0) == 0:
+                # generate the random init ON the chip: host generation +
+                # upload of an XL-class model costs minutes over a
+                # tunnel/PCIe link, on-chip generation costs seconds
+                init_dev = gpt2_mod.init_params_device if self._is_gpt else bert_mod.init_params_device
+                params = init_dev(self.model_config, seed=seed, dtype=self.dtype)
+            else:
+                init = gpt2_mod.init_params if self._is_gpt else bert_mod.init_params
+                params = init(self.model_config, seed=seed)
+            owns_params = True
         self._packed_int8 = False
         if quantize_bits:
             if quantize_bits == 8 and self._is_gpt:
@@ -122,13 +153,14 @@ class InferenceEngine:
                 # run as (x @ q) * s in the fused decode path
                 from deepspeed_tpu.runtime.weight_quantizer import pack_int8_tree
 
-                params = pack_int8_tree(params)
+                params = pack_int8_tree(params, donate=owns_params)
+                owns_params = True  # pack outputs are fresh arrays
                 self._packed_int8 = True
             else:
                 from deepspeed_tpu.runtime.weight_quantizer import WeightQuantization
 
                 params = WeightQuantization(bits=quantize_bits, groups=quantize_groups).quantize_dequantize_tree(params)
-        self.params = self._shard_params(params)
+        self.params = self._shard_params(params, owned=owns_params)
         n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(self.params))
         log_dist(
             f"inference engine: {type(self.model_config).__name__} params={n_params/1e6:.1f}M "
@@ -158,48 +190,72 @@ class InferenceEngine:
             spec = P(*(dims[:-2] + (dims[-1],))) if len(dims) >= 2 else P()
         return spec
 
-    def _shard_params(self, params):
+    def _shard_params(self, params, owned: bool = False):
         # int8 payloads must stay int8; scales stay f32.  Cast on HOST
         # (ml_dtypes handles bf16) so no full-precision staging copy
         # ever lands in HBM — device_put of fp32 then casting on-device
         # doubles transfer and OOMs XL-class models.
         flat, treedef = jax.tree_util.tree_flatten_with_path(params)
-        arrays, shardings = [], []
-        for path, leaf in flat:
-            pstr = "/".join(str(getattr(k, "key", k)) for k in path)
-            arr = np.asarray(leaf)
-            dtype = arr.dtype if arr.dtype == np.int8 else (jnp.float32 if pstr.endswith("/s") else self.dtype)
-            arrays.append(arr.astype(dtype, copy=False))
-            shardings.append(NamedSharding(self.mesh, self._tp_spec(pstr, np.shape(leaf))))
+        pstrs = ["/".join(str(getattr(k, "key", k)) for k in path) for path, _ in flat]
+        def _target_dtype(pstr, leaf):
+            if np.dtype(getattr(leaf, "dtype", np.float32)) == np.int8:
+                return np.int8
+            return np.float32 if pstr.endswith("/s") else self.dtype
+
+        tgt_dtypes = [_target_dtype(pstr, leaf) for pstr, (_, leaf) in zip(pstrs, flat)]
+        shardings = [
+            NamedSharding(self.mesh, self._tp_spec(pstr, np.shape(leaf)))
+            for pstr, (_, leaf) in zip(pstrs, flat)
+        ]
+
+        if all(isinstance(leaf, jax.Array) for _, leaf in flat):
+            # params already device-resident (init_params_device /
+            # pack_int8_tree on device): no host staging at all — one
+            # jitted cast, resharded by out_shardings.  Donation only
+            # when the engine created the tree — a CALLER-provided tree
+            # must stay valid after init.
+            dtypes = tuple(jnp.dtype(d) for d in tgt_dtypes)
+
+            def cast_all(leaves):
+                return [l.astype(d) for l, d in zip(leaves, dtypes)]
+
+            placed = jax.jit(
+                cast_all, donate_argnums=0 if owned else (), out_shardings=shardings
+            )([leaf for _, leaf in flat])
+            return jax.tree_util.tree_unflatten(treedef, list(placed))
+
+        arrays = [np.asarray(leaf).astype(dt, copy=False) for (_, leaf), dt in zip(flat, tgt_dtypes)]
         if self.mp_world_size > 1:
             # TP: leaves carry different shardings — batched device_put
             placed = jax.device_put(arrays, shardings)
             return jax.tree_util.tree_unflatten(treedef, list(placed))
         # mp=1: every transfer pays a tunnel/PCIe round trip, and an
         # XL-class tree has ~600-1200 leaves (minutes of pure RTT).
-        # Upload ONE flat buffer per dtype and split on device (the
-        # split program is trivial and persists in the compile cache).
+        # Upload flat staging buffers (grouped by dtype, capped at
+        # _STAGE_CHUNK_BYTES so peak HBM overhead stays bounded) and
+        # split on device; _split_flat donates the staging buffer.
         placed = [None] * len(arrays)
         by_dtype = {}
         for i, a in enumerate(arrays):
             by_dtype.setdefault(a.dtype, []).append(i)
         rep = NamedSharding(self.mesh, P())
         for dt, idxs in by_dtype.items():
-            buf = np.concatenate([arrays[i].reshape(-1) for i in idxs])
-            dev = jax.device_put(buf, rep)
-            shapes = [arrays[i].shape for i in idxs]
-
-            @jax.jit
-            def split(b, shapes=tuple(shapes)):
-                outs, off = [], 0
-                for shp in shapes:
-                    n = int(np.prod(shp)) if shp else 1
-                    outs.append(jax.lax.dynamic_slice(b, (off,), (n,)).reshape(shp))
-                    off += n
-                return outs
-
-            for i, part in zip(idxs, split(dev)):
-                placed[i] = part
+            chunk, chunk_bytes = [], 0
+            chunks = [chunk]
+            for i in idxs:
+                chunk.append(i)
+                chunk_bytes += arrays[i].nbytes
+                if chunk_bytes >= _STAGE_CHUNK_BYTES:
+                    chunk, chunk_bytes = [], 0
+                    chunks.append(chunk)
+            for idx_chunk in chunks:
+                if not idx_chunk:
+                    continue
+                buf = np.concatenate([arrays[i].reshape(-1) for i in idx_chunk])
+                dev = jax.device_put(buf, rep)
+                shapes = tuple(arrays[i].shape for i in idx_chunk)
+                for i, part in zip(idx_chunk, _split_flat(dev, shapes)):
+                    placed[i] = part
         return jax.tree_util.tree_unflatten(treedef, placed)
 
     def _load_checkpoint_params(self, checkpoint: str, tag: Optional[str], params):

@@ -98,6 +98,48 @@ def init_params(cfg: BertConfig, seed: int = 0) -> Dict[str, Any]:
     }
 
 
+def init_params_device(cfg: BertConfig, seed: int = 0, dtype=jnp.float32):
+    """Random init generated ON DEVICE (same tree structure/shapes as
+    ``init_params``, independent random stream) — see
+    ``models/gpt2.init_params_device`` for when to use which."""
+    d, l, i = cfg.hidden_size, cfg.num_hidden_layers, cfg.intermediate_size
+
+    def build(key):
+        ks = iter(jax.random.split(key, 16))
+
+        def n(shape, s=0.02):
+            return (jax.random.normal(next(ks), shape, jnp.float32) * s).astype(dtype)
+
+        z = lambda *shape: jnp.zeros(shape, dtype)
+        o = lambda *shape: jnp.ones(shape, dtype)
+        return {
+            "tok_emb": n((cfg.vocab_size, d)),
+            "pos_emb": n((cfg.max_position_embeddings, d)),
+            "type_emb": n((cfg.type_vocab_size, d)),
+            "emb_ln_g": o(d),
+            "emb_ln_b": z(d),
+            "blocks": {
+                "ln1_g": o(l, d), "ln1_b": z(l, d),
+                "qkv_w": n((l, d, 3 * d)), "qkv_b": z(l, 3 * d),
+                "proj_w": n((l, d, d)), "proj_b": z(l, d),
+                "ln2_g": o(l, d), "ln2_b": z(l, d),
+                "fc_w": n((l, d, i)), "fc_b": z(l, i),
+                "fc_proj_w": n((l, i, d)), "fc_proj_b": z(l, d),
+            },
+            "pooler_w": n((d, d)),
+            "pooler_b": z(d),
+            "mlm_dense_w": n((d, d)),
+            "mlm_dense_b": z(d),
+            "mlm_ln_g": o(d),
+            "mlm_ln_b": z(d),
+            "mlm_bias": z(cfg.vocab_size),
+            "nsp_w": n((d, 2)),
+            "nsp_b": z(2),
+        }
+
+    return jax.jit(build)(jax.random.PRNGKey(seed))
+
+
 def tp_spec_fn(path: str, shape) -> Optional[P]:
     name = path.split("/")[-1]
     col = {"qkv_w": P(None, None, "model"), "qkv_b": P(None, "model"),

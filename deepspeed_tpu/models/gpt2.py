@@ -163,6 +163,45 @@ def init_params(cfg: GPT2Config, seed: int = 0) -> Dict[str, Any]:
     }
 
 
+def init_params_device(cfg: GPT2Config, seed: int = 0, dtype=jnp.float32):
+    """Random init generated ON DEVICE (same tree structure/shapes as
+    ``init_params``, independent random stream).
+
+    For benchmark/serving paths where host generation + upload of an
+    XL-class model costs minutes over PCIe/tunnel while on-chip
+    generation costs seconds.  Not bitwise-equal to ``init_params`` —
+    use the host init when pinned numerics matter."""
+    if cfg.n_experts > 0:
+        raise NotImplementedError("device init does not cover MoE; use init_params")
+    d, l = cfg.n_embd, cfg.n_layer
+    std, proj_std = 0.02, 0.02 / np.sqrt(2 * l)
+
+    def build(key):
+        ks = iter(jax.random.split(key, 8))
+
+        def n(shape, s=std):
+            return (jax.random.normal(next(ks), shape, jnp.float32) * s).astype(dtype)
+
+        z = lambda *shape: jnp.zeros(shape, dtype)
+        o = lambda *shape: jnp.ones(shape, dtype)
+        return {
+            "wte": n((cfg.vocab_size, d)),
+            "wpe": n((cfg.n_positions, d), s=0.01),
+            "blocks": {
+                "ln1_g": o(l, d), "ln1_b": z(l, d),
+                "qkv_w": n((l, d, 3 * d)), "qkv_b": z(l, 3 * d),
+                "proj_w": n((l, d, d), s=proj_std), "proj_b": z(l, d),
+                "ln2_g": o(l, d), "ln2_b": z(l, d),
+                "fc_w": n((l, d, 4 * d)), "fc_b": z(l, 4 * d),
+                "fc_proj_w": n((l, 4 * d, d), s=proj_std), "fc_proj_b": z(l, d),
+            },
+            "lnf_g": o(d),
+            "lnf_b": z(d),
+        }
+
+    return jax.jit(build)(jax.random.PRNGKey(seed))
+
+
 def tp_spec_fn(path: str, shape) -> Optional[P]:
     """Megatron-style tensor-parallel specs over the ``model`` axis
     (reference delegates TP to Megatron mpu; inference-side slicing in

@@ -303,18 +303,24 @@ class DeepSpeedEngine:
             and self.mesh_info.fsdp_world_size > 1
             and self.zero_stage >= 1
         ):
-            # the frozen layout replicates int8 momentum signs + the flat
-            # fp32 variance + packed params (~9 bytes/param/chip; m is
-            # stored in its compressed exchange form) — models that only
-            # fit BECAUSE of ZeRO sharding will OOM at the freeze step,
-            # not at init
+            # the frozen layout replicates int8 momentum signs (1 B) +
+            # flat fp32 variance (4 B) + packed params (4 B) and keeps a
+            # per-chip fp32 worker-error row (1/n of an (n, Mp) grid ≈
+            # 4 B/param/chip) — ~13 bytes/param/chip STATIC, plus
+            # step-transient decompressed fp32 momentum and grad rows.
+            # Models that only fit BECAUSE of ZeRO sharding will OOM at
+            # the freeze step, not at init.
             n_p = sum(int(np.prod(np.shape(p))) for p in jax.tree.leaves(params))
             logger.warning(
                 "1-bit Adam + ZeRO(fsdp>1): the compressed phase replicates "
-                "the momentum signs (int8) + flat fp32 variance/params "
-                f"(~{9 * n_p / 2**30:.1f}GiB per chip) — ZeRO's state "
-                "sharding does not apply after "
-                f"freeze_step; ensure HBM headroom or keep fsdp=1"
+                "the momentum signs (int8) + flat fp32 variance/params and "
+                "keeps a per-chip fp32 worker-error row "
+                f"(~{13 * n_p / 2**30:.1f}GiB static per chip, plus fp32 "
+                "momentum/grad transients during the step) — ZeRO's state "
+                "sharding does not apply after freeze_step; ensure HBM "
+                "headroom or keep fsdp=1 "
+                "(layout trade-off measured in tests/test_onebit.py::"
+                "test_frozen_variance_layout_wire_bytes)"
             )
         if isinstance(self.optimizer, OnebitAdam) and not self._onebit_exchange_ok:
             failed = [k for k, ok in onebit_blockers.items() if not ok]

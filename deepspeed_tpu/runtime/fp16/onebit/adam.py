@@ -233,3 +233,62 @@ class OnebitAdam:
             worker_error=werr, server_error=serr,
         )
         return upd, new_state
+
+    def frozen_apply_vsharded(
+        self,
+        g_rows: jnp.ndarray,   # (n, Mp) per-rank unreduced averaged grads
+        m_signs: jnp.ndarray,  # (Mp,) int8 replicated
+        m_scales: jnp.ndarray, # (n,) fp32 replicated
+        v_rows: jnp.ndarray,   # (n, Mp//n) fp32 SHARDED over the grid
+        p_rows: jnp.ndarray,   # (n, Mp//n) fp32 SHARDED over the grid
+        werr: jnp.ndarray,
+        serr: jnp.ndarray,
+        lr,
+        mesh,
+        axis_name="data",
+    ):
+        """ALTERNATIVE frozen layout: variance + params sharded 1/n over
+        the exchange grid, each rank updating only its chunk, with the
+        updated params all-gathered for the next forward.
+
+        Implemented to MEASURE the r3 trade-off question (VERDICT weak
+        #6), not as the default: the next step's momentum fold-in
+        ``b1·m + (1−b1)·g`` needs the FULL synced momentum on every
+        rank, so phase 3's 1 B/param allgather can never be dropped —
+        sharding v/p therefore strictly ADDS the fp32 param-chunk
+        allgather (4 B/param/step) on top of the ~2 B/param the 1-bit
+        exchange already moves, i.e. it TRIPLES the wire volume that
+        the 1-bit machinery exists to minimize, in exchange for ~8
+        B/param/chip less HBM (v 4 B + p 4 B).  The HLO-pinned
+        comparison at fsdp ∈ {2,4} lives in
+        ``tests/test_onebit.py::test_frozen_variance_layout_wire_bytes``;
+        the engine keeps the replicated layout and warns about the HBM
+        floor at init (runtime/engine.py).
+        """
+        from deepspeed_tpu.comm.compressed import (
+            compressed_allreduce_compressed_out,
+            decompress_chunks,
+        )
+
+        m_flat = decompress_chunks(m_signs, m_scales)
+        m_rows = self.b1 * m_flat[None, :] + (1.0 - self.b1) * g_rows
+        new_signs, new_scales, werr, serr = compressed_allreduce_compressed_out(
+            m_rows, werr, serr, mesh, axis_name
+        )
+        n = m_scales.shape[0]
+        # each rank's served chunk of the synced momentum
+        m_chunks = (new_signs.reshape(n, -1).astype(jnp.float32) * new_scales[:, None])
+        c2 = 1.0 - self.b2 ** jnp.float32(self.freeze_step)
+        denom = jnp.sqrt(v_rows / c2) + self.eps
+        upd_rows = -lr * (m_chunks * (v_rows > 0)) / denom
+        if self.weight_decay > 0.0:
+            upd_rows = upd_rows - lr * self.weight_decay * p_rows
+        p_rows = p_rows + upd_rows
+        # the extra wire this layout costs: every rank needs the full
+        # updated params for its next forward
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        p_full = jax.lax.with_sharding_constraint(
+            p_rows.reshape(-1), NamedSharding(mesh, P())
+        )
+        return p_full, p_rows, new_signs, new_scales, werr, serr

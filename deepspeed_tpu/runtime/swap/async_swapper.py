@@ -6,7 +6,13 @@ the aio engine, overlapping I/O with compute.
 
 Here tensors are host numpy arrays (the engine's host-offload path owns
 device<->host movement); each logical tensor maps to one file in the
-swap folder and swaps ride the native aio handle.
+swap folder.  Reads ride one aio handle; writes ride a PER-KEY handle,
+so a ``swap_in`` of a key whose write is still in flight waits for that
+key's write ONLY — other keys' writes keep overlapping the caller's
+compute (the reference's double-buffered pattern,
+``pipelined_optimizer_swapper.py:60``).  An injected ``aio_handle``
+serves every op (the injection contract: tuned settings / test fakes
+observe all I/O) at the cost of bulk-granularity waits.
 """
 from __future__ import annotations
 
@@ -23,66 +29,126 @@ class AsyncTensorSwapper:
     def __init__(self, swap_dir: str, aio_handle: Optional[AioHandle] = None, aio_config=None):
         os.makedirs(swap_dir, exist_ok=True)
         self.swap_dir = swap_dir
-        if aio_handle is None:
-            kw = {}
-            if aio_config is not None:
-                kw = dict(
-                    block_size=aio_config.block_size,
-                    queue_depth=aio_config.queue_depth,
-                    single_submit=aio_config.single_submit,
-                    overlap_events=aio_config.overlap_events,
-                    thread_count=max(1, aio_config.thread_count),
-                )
-            aio_handle = AioHandle(**kw)
-        self.aio = aio_handle
+        kw = {}
+        if aio_config is not None:
+            kw = dict(
+                block_size=aio_config.block_size,
+                queue_depth=aio_config.queue_depth,
+                single_submit=aio_config.single_submit,
+                overlap_events=aio_config.overlap_events,
+                thread_count=max(1, aio_config.thread_count),
+            )
+        self._handle_kw = kw
+        self._injected = aio_handle is not None
+        self.aio = aio_handle if aio_handle is not None else AioHandle(**kw)
+        # writes ride a small FIXED pool of handles (keys hash to slots):
+        # per-slot wait granularity keeps unrelated writes airborne while
+        # bounding native aio contexts/threads regardless of key count
+        self._write_handles: Dict[int, AioHandle] = {}
         # key -> (path, shape, dtype) for swapped-out tensors
         self._index: Dict[str, tuple] = {}
-        self._pending = 0
-        # buffers owned by in-flight async writes — the native engine
-        # reads them from worker threads, so they must stay alive until
-        # the next synchronize() (dropping the ref frees the memory mid-
-        # write and corrupts the file)
-        self._inflight_bufs: list = []
+        self._pending_reads = 0
+        # key -> buffer owned by that key's in-flight async write — the
+        # native engine reads it from worker threads, so it must stay
+        # alive until the write completes (dropping the ref frees the
+        # memory mid-write and corrupts the file)
+        self._inflight_writes: Dict[str, np.ndarray] = {}
 
     def _path(self, key: str) -> str:
         safe = key.replace("/", "__")
         return os.path.join(self.swap_dir, f"{safe}.swp")
 
+    _WRITE_POOL = 4
+
+    def _slot(self, key: str) -> int:
+        import zlib
+
+        return zlib.crc32(key.encode()) % self._WRITE_POOL
+
+    def _write_handle(self, key: str) -> AioHandle:
+        if self._injected:
+            return self.aio
+        s = self._slot(key)
+        h = self._write_handles.get(s)
+        if h is None:
+            h = self._write_handles[s] = AioHandle(**self._handle_kw)
+        return h
+
     def swap_out(self, key: str, array: np.ndarray, async_op: bool = True) -> None:
         """Write ``array`` to the swap file for ``key``.  With
-        ``async_op`` the caller must not mutate ``array`` until
-        ``synchronize()`` (aio reads the buffer in worker threads)."""
+        ``async_op`` the swapper owns ``array`` until the write lands."""
+        if key in self._inflight_writes:
+            # never two in-flight writes against one file
+            self.synchronize_writes(key)
         arr = np.ascontiguousarray(array)
         path = self._path(key)
         self._index[key] = (path, arr.shape, arr.dtype)
-        self._inflight_bufs.append(arr)
-        self.aio.async_pwrite(arr, path)
-        self._pending += 1
+        self._inflight_writes[key] = arr
+        self._write_handle(key).async_pwrite(arr, path)
         if not async_op:
-            self.synchronize()
+            self.synchronize_writes(key)
 
     def swap_in(self, key: str, out: Optional[np.ndarray] = None, async_op: bool = True) -> np.ndarray:
         """Read ``key`` into ``out`` (allocated if None).  With
         ``async_op`` the data is valid only after ``synchronize()``."""
         if key not in self._index:
             raise KeyError(f"tensor '{key}' was never swapped out")
+        if key in self._inflight_writes:
+            # read-after-write: THIS key's bytes are still in flight;
+            # other keys' writes stay airborne
+            self.synchronize_writes(key)
         path, shape, dtype = self._index[key]
         if out is None:
             out = np.empty(shape, dtype)
         assert out.nbytes == int(np.prod(shape)) * np.dtype(dtype).itemsize
         self.aio.async_pread(out, path)
-        self._pending += 1
+        self._pending_reads += 1
         if not async_op:
             self.synchronize()
         return out
 
-    def synchronize(self) -> int:
+    def synchronize_writes(self, key: Optional[str] = None) -> int:
+        """Complete the in-flight write for ``key`` (all writes when
+        None).  Waiting a key's pool slot completes every write on that
+        slot — all such keys are cleared together."""
+        if key is None:
+            n = 0
+            for k in list(self._inflight_writes):
+                n += self.synchronize_writes(k)
+            return n
+        if key not in self._inflight_writes:
+            return 0
+        n = self._write_handle(key).wait()
+        if self._injected:
+            # a shared handle completes every op it carries
+            self._inflight_writes.clear()
+            self._pending_reads = 0
+        else:
+            s = self._slot(key)
+            for k in [k for k in self._inflight_writes if self._slot(k) == s]:
+                self._inflight_writes.pop(k, None)
+        return n
+
+    def synchronize_reads(self) -> int:
+        """Complete all in-flight reads (writes stay airborne — an
+        injected shared handle completes its writes too, tracked)."""
+        if not self._pending_reads:
+            return 0
         n = self.aio.wait()
-        self._pending = 0
-        self._inflight_bufs.clear()
+        self._pending_reads = 0
+        if self._injected:
+            self._inflight_writes.clear()
+        return n
+
+    def synchronize(self) -> int:
+        """Complete all in-flight reads and writes."""
+        n = self.synchronize_reads()
+        n += self.synchronize_writes()
         return n
 
     def release(self, key: str) -> None:
+        if key in self._inflight_writes:
+            self.synchronize_writes(key)
         info = self._index.pop(key, None)
         if info and os.path.exists(info[0]):
             os.unlink(info[0])

@@ -100,7 +100,7 @@ def quantize_transformer_layer(params: Any, bits: int = 8, groups: int = 1) -> A
     return WeightQuantization(bits=bits, groups=groups).quantize_dequantize_tree(params)
 
 
-def pack_int8_tree(params: Any) -> Any:
+def pack_int8_tree(params: Any, donate: bool = False) -> Any:
     """True-int8 packing for the serving path: every matmul weight
     (``*_w``, ndim>=2, non-embedding) becomes ``{"q": int8, "s": f32}``
     with per-output-channel scales (``ops/quantizer.quantize_per_channel``);
@@ -110,12 +110,20 @@ def pack_int8_tree(params: Any) -> Any:
 
     def visit(path, leaf):
         name = str(getattr(path[-1], "key", path[-1])) if path else ""
-        arr = np.asarray(leaf)
-        if arr.ndim >= 2 and name.endswith("_w") and "emb" not in name:
-            q, s = quantize_per_channel(arr)
-            return {"q": np.asarray(q), "s": np.asarray(s)}
+        if np.ndim(leaf) >= 2 and name.endswith("_w") and "emb" not in name:
+            q, s = quantize_per_channel(leaf)
+            return {"q": q, "s": s}
         return leaf
 
-    return jax.tree_util.tree_map_with_path(
-        visit, params, is_leaf=lambda x: not isinstance(x, dict)
-    )
+    def pack(tree):
+        return jax.tree_util.tree_map_with_path(
+            visit, tree, is_leaf=lambda x: not isinstance(x, dict)
+        )
+
+    if any(isinstance(l, jax.Array) for l in jax.tree.leaves(params)):
+        # device-resident params: one jitted pack over the whole tree
+        # (per-leaf eager ops would pay a dispatch round trip each);
+        # donate=True frees the full-precision originals as it goes —
+        # only safe when the caller owns the tree (engine-created init)
+        return jax.jit(pack, donate_argnums=0 if donate else ())(params)
+    return jax.tree.map(np.asarray, pack(params))

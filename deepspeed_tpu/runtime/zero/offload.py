@@ -85,15 +85,41 @@ class HostOffloadOptimizer:
     def uses_native_kernel(self) -> bool:
         return self.opt.uses_native
 
-    def step(self, grads: Any, lr: float, step_count: int) -> Any:
+    def step(
+        self,
+        grads: Any,
+        lr: float,
+        step_count: int,
+        row_groups=None,
+        row_group_prefix: str = "",
+        on_group=None,
+    ) -> Any:
         """``grads``: pytree of host fp32 arrays matching the params
-        structure.  Updates masters in place; returns the masters tree."""
+        structure.  Updates masters in place; returns the masters tree.
+
+        ``row_groups``: optional list of ``(lo, hi)`` leading-dim row
+        ranges over the leaves whose key starts with
+        ``row_group_prefix`` (the streaming engine's stacked blocks).
+        When given, those leaves step group-major and ``on_group(g)``
+        fires the moment range ``g``'s rows are updated across ALL
+        selected leaves — letting the caller overlap per-group NVMe
+        write-back with the remainder of the optimizer step (the
+        reference's pipelined swap pattern,
+        ``pipelined_optimizer_swapper.py:60``).  Ignored when moments
+        are themselves NVMe-swapped (group-major order would re-read
+        every leaf's moments once per group)."""
         import jax
 
         gflat = [np.asarray(g, np.float32) for _, g in _flatten_with_paths(grads)]
         assert len(gflat) == len(self.masters)
         n = len(self.masters)
-        for i in range(n):
+        grouped = row_groups is not None and self.swapper is None
+        sel = (
+            [i for i in range(n) if self.keys[i].startswith(row_group_prefix)]
+            if grouped else []
+        )
+        rest = [i for i in range(n) if i not in set(sel)] if grouped else range(n)
+        for i in rest:
             if self.swapper is not None:
                 if i + 1 < n:
                     self.swapper.prefetch(i + 1)  # overlap next group's read
@@ -104,6 +130,20 @@ class HostOffloadOptimizer:
             self.opt.step(self.masters[i], gflat[i], m, v, step_count, lr=lr)
             if self.swapper is not None:
                 self.swapper.put(i)  # async write-back while next group steps
+        if grouped:
+            for g, (lo, hi) in enumerate(row_groups):
+                for i in sel:
+                    # leading-dim slices of contiguous arrays stay
+                    # contiguous — the native kernel steps them in place
+                    self.opt.step(
+                        self.masters[i][lo:hi], gflat[i][lo:hi],
+                        self._m[i][lo:hi], self._v[i][lo:hi], step_count, lr=lr,
+                    )
+                if on_group is not None:
+                    on_group(g)
+        elif row_groups is not None and on_group is not None:
+            for g in range(len(row_groups)):
+                on_group(g)
         if self.swapper is not None:
             self.swapper.flush()
         return jax.tree.unflatten(self._treedef, self.masters)

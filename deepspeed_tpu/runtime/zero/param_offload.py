@@ -81,8 +81,10 @@ class ZeroInfinityEngine:
             return "model config is not streamable (MoE blocks)"
         if config.fp16.enabled:
             return "requires bf16 (no dynamic loss scale on the host path)"
-        if mesh_info.fsdp_world_size > 1 or mesh_info.model_parallel_world_size > 1:
-            return "needs data-axis DP only (no fsdp/model sharding of streamed params)"
+        if mesh_info.model_parallel_world_size > 1:
+            return "model (TP) sharding of streamed params is not implemented"
+        if mesh_info.fsdp_world_size > 1 and jax.process_count() > 1:
+            return "fsdp streaming is single-process (multi-host 1/P master sharding not implemented)"
         if optimizer is not None:
             return "client optimizer objects are unsupported (host Adam owns the update)"
         name = (config.optimizer.name or "adamw").lower()
@@ -102,10 +104,15 @@ class ZeroInfinityEngine:
         self.spec = spec
         self.mesh = mesh
         self.mesh_info = MeshInfo.from_mesh(mesh)
-        if self.mesh_info.fsdp_world_size > 1 or self.mesh_info.model_parallel_world_size > 1:
+        if self.mesh_info.model_parallel_world_size > 1:
             raise NotImplementedError(
-                "offload_param streams full layer groups; use data-axis DP only "
-                "(fsdp/model sharding of host-resident params is not implemented)"
+                "offload_param streams layer groups over data/fsdp axes only "
+                "(model-axis TP sharding of streamed params is not implemented)"
+            )
+        if self.mesh_info.fsdp_world_size > 1 and jax.process_count() > 1:
+            raise NotImplementedError(
+                "offload_param with fsdp>1 is single-process (multi-host 1/P "
+                "master sharding is not implemented)"
             )
         self.compute_dtype = jnp.bfloat16 if config.bf16.enabled else jnp.float32
 
@@ -120,8 +127,6 @@ class ZeroInfinityEngine:
 
         # -- host-resident state ------------------------------------------
         params = jax.tree.map(lambda p: np.asarray(p, np.float32), params)
-        self._blocks_host = params[spec.blocks_key]
-        self._resident_host = {k: v for k, v in params.items() if k != spec.blocks_key}
         opt_cfg = dict(config.optimizer.params or {})
         opt_name = (config.optimizer.name or "adamw").lower()
         if opt_name not in ("adam", "adamw"):
@@ -144,7 +149,17 @@ class ZeroInfinityEngine:
             aio_config=config.aio,
         )
         self._treedef = jax.tree.structure(params)
-        self._params_host = params  # masters view (updated by host_opt.step)
+        # Host param views alias the optimizer's MASTER arrays by
+        # construction (masters_tree() unflattens the very ndarrays
+        # opt.step mutates in place) — the per-group write-back hook
+        # fires mid-step and must see each group's freshly-updated rows,
+        # so the aliasing is load-bearing, not an accident of
+        # ascontiguousarray happening to return its input.
+        self._params_host = self._host_opt.masters_tree()
+        self._blocks_host = self._params_host[spec.blocks_key]
+        self._resident_host = {
+            k: v for k, v in self._params_host.items() if k != spec.blocks_key
+        }
 
         # -- NVMe param staging (ZeRO-Infinity proper) ---------------------
         self._param_swapper = None
@@ -181,7 +196,19 @@ class ZeroInfinityEngine:
         self.global_steps = 0
         self.skipped_steps = 0
         self._compiled: Dict[str, Any] = {}
-        self._batch_sh = NamedSharding(mesh, P(("data",)))
+        # batch rows shard over the whole DP world (data × fsdp), the
+        # same convention as the in-HBM engine (comm/mesh.batch_pspec)
+        self._batch_sh = NamedSharding(mesh, P(("data", "fsdp")))
+        # ZeRO-3 × ZeRO-Infinity composition (reference stage3.py:2633-2686
+        # + partitioned_param_swapper.py:36 swap per-rank *partitions*):
+        # each uploaded group is SHARDED over the fsdp axis — per-device
+        # HBM holds group/fsdp param bytes; GSPMD all-gathers shards
+        # inside the group programs and reduce-scatters group grads back
+        # to the same 1/P layout (out_shardings below).
+        self._group_shardings = jax.tree.map(
+            lambda a: NamedSharding(mesh, self._fsdp_leaf_spec(np.shape(a))),
+            self._group_slice_host(0),
+        )
         log_dist(
             f"ZeRO-Infinity engine: {spec.n_layer} layers in {self.n_groups} groups, "
             f"micro_bs={config.train_micro_batch_size_per_gpu} gas={config.gradient_accumulation_steps} "
@@ -191,6 +218,27 @@ class ZeroInfinityEngine:
     # ------------------------------------------------------------------
     # host <-> device staging
     # ------------------------------------------------------------------
+    def _fsdp_leaf_spec(self, shape):
+        """fsdp PartitionSpec for one stacked-block leaf ``(gl, ...)``:
+        shard the largest trailing dim divisible by the fsdp size (the
+        leading stacked-layer dim stays whole — group_layers may be
+        smaller than the axis); replicate when nothing divides."""
+        from jax.sharding import PartitionSpec as P
+
+        n = self.mesh_info.fsdp_world_size
+        dims = list(shape)
+        if n <= 1 or len(dims) < 2:
+            return P()
+        best = None
+        for i in range(len(dims) - 1, 0, -1):
+            if dims[i] % n == 0 and (best is None or dims[i] > dims[best]):
+                best = i
+        if best is None:
+            return P()
+        spec = [None] * len(dims)
+        spec[best] = "fsdp"
+        return P(*spec)
+
     def _group_slice_host(self, g: int) -> Any:
         lo = g * self.group_layers
         return jax.tree.map(lambda a: a[lo : lo + self.group_layers], self._blocks_host)
@@ -206,17 +254,26 @@ class ZeroInfinityEngine:
 
         return ml_dtypes.bfloat16 if self.compute_dtype == jnp.bfloat16 else np.float32
 
-    def _swap_out_all_groups(self) -> None:
-        """Write every group's compute-dtype params to NVMe (init and
-        post-step)."""
+    def _issue_group_swap_out(self, g: int) -> None:
+        """Start the async NVMe write of group ``g``'s compute-dtype
+        params (sourced from the just-updated master rows).  The write
+        rides the swapper's dedicated write handle; a next-step read of
+        the same group synchronizes it first (read-after-write hazard
+        handled inside AsyncTensorSwapper)."""
         dt = self._stage_np_dtype
+        flat = np.concatenate([
+            np.asarray(l, dt).view(np.uint8).reshape(-1)
+            for l in jax.tree.leaves(self._group_slice_host(g))
+        ])
+        self._param_swapper.swap_out(self._group_key(g), flat, async_op=True)
+
+    def _swap_out_all_groups(self) -> None:
+        """Write every group's compute-dtype params to NVMe and wait
+        (init and checkpoint-load; the per-step path issues groups
+        incrementally from the optimizer-step hook instead)."""
         for g in range(self.n_groups):
-            flat = np.concatenate([
-                np.asarray(l, dt).view(np.uint8).reshape(-1)
-                for l in jax.tree.leaves(self._group_slice_host(g))
-            ])
-            self._param_swapper.swap_out(self._group_key(g), flat, async_op=True)
-        self._param_swapper.synchronize()
+            self._issue_group_swap_out(g)
+        self._param_swapper.synchronize_writes()
 
     def _upload_group(self, g: int) -> Any:
         """compute-dtype group params → device (from NVMe when staged)."""
@@ -241,12 +298,20 @@ class ZeroInfinityEngine:
         H2D copy itself overlaps with whatever compute is in flight)."""
         host = self._group_slice_host(g)
         if self._param_swapper is None:
+            # cast on HOST (ml_dtypes) and device_put with the shard
+            # specs: each device receives only its 1/P slice — staging
+            # the full group on one device first would transiently break
+            # the per-device HBM bound the fsdp composition provides
+            dt = self._stage_np_dtype
             return jax.device_put(
-                jax.tree.map(lambda a: jnp.asarray(a, self.compute_dtype), host)
+                jax.tree.map(lambda a: np.asarray(a, dt), host),
+                self._group_shardings,
             )
         if flat is None:
             flat = self._param_swapper.swap_in(self._group_key(g), async_op=True)
-        self._param_swapper.synchronize()
+        # wait for THIS read only — other groups' write-backs keep
+        # overlapping this group's upload + compute
+        self._param_swapper.synchronize_reads()
         dt = self._stage_np_dtype
         itemsize = np.dtype(dt).itemsize
         leaves, treedef = jax.tree.flatten(host)
@@ -255,7 +320,7 @@ class ZeroInfinityEngine:
             nb = l.size * itemsize
             out.append(flat[off : off + nb].view(dt).reshape(l.shape))
             off += nb
-        return jax.device_put(jax.tree.unflatten(treedef, out))
+        return jax.device_put(jax.tree.unflatten(treedef, out), self._group_shardings)
 
     @staticmethod
     def _start_host_copy(tree) -> None:
@@ -326,7 +391,13 @@ class ZeroInfinityEngine:
             "embed": jax.jit(sc(embed)),
             "group_fwd": jax.jit(sc(group_fwd)),
             "head": jax.jit(sc(head)),
-            "group_bwd": jax.jit(sc(group_bwd), donate_argnums=(3,)),
+            # group grads leave in the groups' own 1/P fsdp layout —
+            # GSPMD lowers the grad reduction to a reduce-scatter over
+            # fsdp (+ psum over data) instead of a full allreduce
+            "group_bwd": jax.jit(
+                sc(group_bwd), donate_argnums=(3,),
+                out_shardings=(self._group_shardings, self._batch_sh),
+            ),
             "embed_bwd": jax.jit(sc(embed_bwd), donate_argnums=(2,)),
             "group_eval": jax.jit(sc(group_eval)),
             "head_eval": jax.jit(sc(head_eval)),
@@ -427,12 +498,23 @@ class ZeroInfinityEngine:
         lr = float(self.lr_schedule(self.global_steps))
         if not overflow:
             grads_tree = jax.tree.unflatten(self._treedef, grad_acc)
-            masters = self._host_opt.step(grads_tree, lr, self.global_steps + 1)
+            # NVMe path: step the stacked blocks group-major and start
+            # each group's write-back the moment its master rows land —
+            # the writes overlap the remaining groups' CPU Adam and the
+            # next step's forward uploads instead of serializing at the
+            # step boundary (was: _swap_out_all_groups + global wait,
+            # ~model-size synchronous writes per step)
+            swap = self._param_swapper is not None
+            gl = self.group_layers
+            masters = self._host_opt.step(
+                grads_tree, lr, self.global_steps + 1,
+                row_groups=[(g * gl, (g + 1) * gl) for g in range(self.n_groups)] if swap else None,
+                row_group_prefix=f"{self.spec.blocks_key}/" if swap else "",
+                on_group=self._issue_group_swap_out if swap else None,
+            )
             self._params_host = masters
             self._blocks_host = masters[self.spec.blocks_key]
             self._resident_host = {k: v for k, v in masters.items() if k != self.spec.blocks_key}
-            if self._param_swapper is not None:
-                self._swap_out_all_groups()
             self.global_steps += 1
         else:
             self.skipped_steps += 1

@@ -338,3 +338,66 @@ def test_int8_tp_serving():
     np.testing.assert_allclose(
         np.asarray(q1.forward(toks)), np.asarray(q4.forward(toks)), rtol=3e-4, atol=3e-4
     )
+
+
+def test_param_staging_paths_numerically_equal(monkeypatch):
+    """r4 engine-build paths must all yield the SAME sharded params:
+    (a) host init via chunked flat staging (tiny chunk cap forces many
+    chunks, pinning the chunk-boundary reassembly), (b) the same host
+    init passed as caller params, (c) device-resident caller params
+    (jitted cast path — and the caller's tree must SURVIVE init,
+    no donation of non-owned arrays)."""
+    import deepspeed_tpu.inference.engine as eng_mod
+    from deepspeed_tpu.models import gpt2
+
+    monkeypatch.setattr(eng_mod, "_STAGE_CHUNK_BYTES", 4096)
+    host = gpt2.init_params(gpt2.GPT2_TINY, seed=3)
+    e_host = deepspeed_tpu.init_inference(model="tiny", seed=3, max_out_tokens=32)
+    e_caller = deepspeed_tpu.init_inference(model=None, model_config=gpt2.GPT2_TINY,
+                                            params=host, max_out_tokens=32)
+    dev = jax.tree.map(jnp.asarray, host)
+    e_dev = deepspeed_tpu.init_inference(model=None, model_config=gpt2.GPT2_TINY,
+                                         params=dev, max_out_tokens=32)
+    for a, b, c in zip(jax.tree.leaves(e_host.params), jax.tree.leaves(e_caller.params),
+                       jax.tree.leaves(e_dev.params)):
+        np.testing.assert_array_equal(np.asarray(a, np.float32), np.asarray(b, np.float32))
+        np.testing.assert_array_equal(np.asarray(a, np.float32), np.asarray(c, np.float32))
+    # caller trees survive engine init (no donation of non-owned arrays)
+    _ = [np.asarray(l) for l in jax.tree.leaves(host)]
+    _ = [np.asarray(l) for l in jax.tree.leaves(dev)]
+
+
+def test_int8_pack_device_equals_host():
+    """pack_int8_tree must produce identical quantization whether the
+    tree is host numpy (per-leaf) or device-resident (single jitted
+    pack with donation)."""
+    from deepspeed_tpu.models import gpt2
+    from deepspeed_tpu.runtime.weight_quantizer import pack_int8_tree
+
+    host = gpt2.init_params(gpt2.GPT2_TINY, seed=5)
+    p_host = pack_int8_tree(host)
+    dev = jax.tree.map(jnp.asarray, host)
+    p_dev = pack_int8_tree(dev, donate=True)
+    assert jax.tree_util.tree_structure(p_host) == jax.tree_util.tree_structure(p_dev)
+    for a, b in zip(jax.tree.leaves(p_host), jax.tree.leaves(p_dev)):
+        a, b = np.asarray(a), np.asarray(b)
+        if a.dtype == np.int8:
+            # quantized payloads must match exactly...
+            np.testing.assert_array_equal(a, b)
+        else:
+            # ...scales may differ at fp32 ulp level (eager vs jitted
+            # reduction fusion order)
+            np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_init_on_device_generates():
+    """init_on_device engines must build and generate (structure/shape
+    parity with host init is pinned in tests/test_models.py-style
+    checks; values are an independent random stream)."""
+    e = deepspeed_tpu.init_inference(model="tiny", max_out_tokens=32, init_on_device=True)
+    out = e.generate(np.zeros((2, 4), np.int32), max_new_tokens=4)
+    assert np.asarray(out).shape == (2, 8)
+    e8 = deepspeed_tpu.init_inference(model="tiny", max_out_tokens=32,
+                                      init_on_device=True, quantize_bits=8)
+    out8 = e8.generate(np.zeros((2, 4), np.int32), max_new_tokens=4)
+    assert np.asarray(out8).shape == (2, 8)

@@ -360,3 +360,34 @@ def test_multihost_shaped_offload_checkpoint(tmp_path, monkeypatch):
     assert path is not None
     got = [float(eng2.train_batch(b)) for b in batches[3:]]
     np.testing.assert_allclose(ref, got, rtol=2e-5, atol=2e-6)
+
+
+def test_async_swapper_read_after_write_hazard(tmp_path):
+    """r4 incremental write-back: reads and writes ride separate aio
+    handles, and a swap_in of a key whose write is still in flight must
+    see the NEW bytes (the swapper serializes that key's write first) —
+    the ordering guarantee the streaming engine's per-group overlapped
+    write-back depends on."""
+    from deepspeed_tpu.runtime.swap.async_swapper import AsyncTensorSwapper
+
+    sw = AsyncTensorSwapper(str(tmp_path))
+    rng = np.random.default_rng(1)
+    v1 = rng.standard_normal((256, 256)).astype(np.float32)
+    v2 = rng.standard_normal((256, 256)).astype(np.float32)
+    sw.swap_out("g0", v1, async_op=True)
+    # immediately overwrite while the first write may still be in flight
+    sw.swap_out("g0", v2, async_op=True)
+    # and immediately read back — must be v2, not v1 or torn bytes
+    out = sw.swap_in("g0", async_op=True)
+    sw.synchronize()
+    np.testing.assert_array_equal(out, v2)
+    # interleave a different key's read with a pending write: the read
+    # must not force the unrelated write to have completed first, but
+    # both must land by synchronize()
+    sw.swap_out("g1", v1, async_op=True)
+    sw.swap_out("g0", v1, async_op=True)
+    out1 = sw.swap_in("g1", async_op=True)
+    sw.synchronize()
+    np.testing.assert_array_equal(out1, v1)
+    out0 = sw.swap_in("g0", async_op=False)
+    np.testing.assert_array_equal(out0, v1)

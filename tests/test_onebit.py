@@ -233,3 +233,73 @@ def test_onebit_checkpoint_at_freeze_boundary_and_rollback(tmp_path):
     l1 = float(engine.train_batch(batch))
     l2 = float(engine2.train_batch(batch))
     np.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+
+@pytest.mark.parametrize("fsdp", [2, 4])
+def test_frozen_variance_layout_wire_bytes(fsdp):
+    """VERDICT r3 #8: measure the frozen-phase layout trade-off.
+
+    Replicated layout (engine default): v/p replicated, wire = the 1-bit
+    exchange only (~2 B/param: int8 all-to-all + int8 all-gather).
+    v-sharded layout (``frozen_apply_vsharded``): v/p sharded 1/n, but
+    the momentum fold-in still needs the full synced m on every rank, so
+    phase 3 survives AND the updated fp32 param chunks must be
+    all-gathered — strictly MORE wire.  Pin both HLO byte counts and the
+    conclusion: sharding saves ~8 B/param HBM at ~3x the wire, so the
+    engine keeps replication and warns about the HBM floor instead
+    (runtime/engine.py init warning points here)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deepspeed_tpu.runtime.fp16.onebit.adam import OnebitAdam
+    from deepspeed_tpu.utils.hlo import collective_bytes
+
+    n = fsdp * (8 // fsdp)  # exchange over the whole 8-device grid
+    mesh = make_mesh(MeshConfig(data=8 // fsdp, fsdp=fsdp))
+    axes = ("data", "fsdp")
+    M = n * 1024
+    opt = OnebitAdam(lr=1e-3, freeze_step=1)
+    row_sh = NamedSharding(mesh, P(axes))
+    rep = NamedSharding(mesh, P())
+    rng = np.random.default_rng(0)
+    g_rows = jax.device_put(rng.standard_normal((n, M)).astype(np.float32), row_sh)
+    werr = jax.device_put(np.zeros((n, M), np.float32), row_sh)
+    serr = jax.device_put(np.zeros((n, M // n), np.float32), row_sh)
+    m_signs = jax.device_put(np.ones((M,), np.int8), rep)
+    m_scales = jax.device_put(np.full((n,), 0.1, np.float32), rep)
+    v_flat = jax.device_put(rng.random(M).astype(np.float32), rep)
+    p_flat = jax.device_put(rng.standard_normal(M).astype(np.float32), rep)
+    v_rows = jax.device_put(np.asarray(v_flat).reshape(n, -1), row_sh)
+    p_rows = jax.device_put(np.asarray(p_flat).reshape(n, -1), row_sh)
+    lr = jnp.float32(1e-3)
+
+    from deepspeed_tpu.runtime.fp16.onebit.adam import FrozenOnebitAdamState
+
+    fstate = FrozenOnebitAdamState(
+        step=jnp.int32(1), m_signs=m_signs, m_scales=m_scales, v_flat=v_flat,
+        worker_error=werr, server_error=serr,
+    )
+
+    rep_fn = jax.jit(lambda g, fs, p: opt.frozen_apply(g, fs, p, lr, mesh, axes))
+    rep_txt = rep_fn.lower(g_rows, fstate, p_flat).compile().as_text()
+    sh_fn = jax.jit(
+        lambda g, ms, sc, v, p, we, se: opt.frozen_apply_vsharded(
+            g, ms, sc, v, p, we, se, lr, mesh, axes
+        )
+    )
+    sh_txt = sh_fn.lower(g_rows, m_signs, m_scales, v_rows, p_rows, werr, serr).compile().as_text()
+
+    b_rep = collective_bytes(rep_txt)
+    b_sh = collective_bytes(sh_txt)
+    assert b_rep > 0 and b_sh > 0
+    # the sharded layout must contain the extra fp32 param all-gather:
+    # >= replicated bytes + ~4*M*(ring weight 1)
+    assert b_sh >= b_rep + 3 * M, (b_sh, b_rep, M)
+    # and the replicated layout's wire is dominated by int8 (the point
+    # of 1-bit): fp32 traffic is scales/epsilon only
+    assert collective_bytes(rep_txt, "f32") < M, collective_bytes(rep_txt, "f32")
+    # numerics: both layouts produce the same updated params
+    p_rep = np.asarray(p_flat) + np.asarray(
+        rep_fn(g_rows, fstate, p_flat)[0], np.float32
+    )
+    p_shd = np.asarray(sh_fn(g_rows, m_signs, m_scales, v_rows, p_rows, werr, serr)[0])
+    np.testing.assert_allclose(p_rep, p_shd, rtol=1e-5, atol=1e-6)
