@@ -287,7 +287,8 @@ def bench_inference(model_name: str, quantize_bits: int, label: str):
         init_on_device=on_tpu,
     )
     log(f"[{label}] engine ready in {time.time()-t0:.1f}s")
-    B, T = 8, 128
+    # dev (CPU/tiny) runs shrink the windows to fit the model's n_positions
+    B, T, short, long_ = (8, 128, 16, 128) if on_tpu else (4, 32, 8, 64)
     rng = np.random.default_rng(0)
     prompt = rng.integers(0, engine.model_config.vocab_size, (B, T), dtype=np.int32)
 
@@ -297,15 +298,15 @@ def bench_inference(model_name: str, quantize_bits: int, label: str):
         _ = int(np.asarray(out)[0, -1])  # true sync
         return time.time() - t0
 
-    run(16)  # compile short
+    run(short)  # compile short
     log(f"[{label}] short generate compiled")
-    run(128)  # compile long
+    run(long_)  # compile long
     log(f"[{label}] long generate compiled")
-    t16 = min(run(16) for _ in range(2))
-    t128 = min(run(128) for _ in range(2))
-    # marginal decode rate: the (t128 - t16) window is pure decode
-    tok_s = B * (128 - 16) / (t128 - t16)
-    log(f"[{label}] decode tokens/s={tok_s:,.0f} (B={B}, prompt={T}; t16={t16:.2f}s t128={t128:.2f}s)")
+    t_s = min(run(short) for _ in range(2))
+    t_l = min(run(long_) for _ in range(2))
+    # marginal decode rate: the (t_l - t_s) window is pure decode
+    tok_s = B * (long_ - short) / (t_l - t_s)
+    log(f"[{label}] decode tokens/s={tok_s:,.0f} (B={B}, prompt={T}; t_short={t_s:.2f}s t_long={t_l:.2f}s)")
     return {
         "metric": f"{model_name.replace('-', '_')}_{label}_decode_tokens_per_sec",
         "value": round(tok_s, 1),
@@ -401,10 +402,13 @@ def main():
     for name, est, cap in RUNGS:
         if name != "headline" and skip_big:
             continue
-        if remaining() < est:
-            log(f"[{name}] SKIPPED: {remaining():.0f}s left < {est}s estimate")
+        # the rung must fit inside its own kill cap: launching when
+        # remaining()-45 < est would start a rung predicted to be
+        # killed, burning the budget of every rung behind it
+        if remaining() - 45 < est:
+            log(f"[{name}] SKIPPED: {remaining():.0f}s left < {est}s estimate + 45s teardown")
             extra.append({"metric": name, "skipped": True,
-                          "reason": f"{remaining():.0f}s budget left < {est}s estimate"})
+                          "reason": f"{remaining():.0f}s budget left < {est}s estimate + 45s teardown"})
             flush_extra()
             continue
         budget = min(cap, remaining() - 45)
@@ -416,10 +420,13 @@ def main():
             )
         except subprocess.TimeoutExpired as e:
             log(f"[{name}] TIMED OUT at {budget:.0f}s — killed")
-            extra.append({"metric": name, "skipped": True, "reason": f"timed out at {budget:.0f}s"})
-            flush_extra()
-            # salvage any records the child printed before the cap
+            # salvage any records the child printed before the cap; the
+            # skip marker is recorded only if nothing was salvaged (a
+            # rung must not appear both skipped and measured)
             out = (e.stdout or b"").decode(errors="replace")
+            if not any(l.strip().startswith("{") for l in out.splitlines()):
+                extra.append({"metric": name, "skipped": True, "reason": f"timed out at {budget:.0f}s"})
+                flush_extra()
             proc = None
         else:
             out = proc.stdout.decode(errors="replace")

@@ -77,6 +77,9 @@ class GPT2Config:
     # lax.scan unroll factor for the layer loop: >1 trades compile time
     # for fewer loop-carried copies / less per-iteration bookkeeping
     scan_unroll: int = 1
+    # flash kernel block override: (block_q, block_k[, bwd_block_q,
+    # bwd_block_k]); empty ⇒ the op's measured defaults
+    flash_blocks: tuple = ()
     dtype: Any = jnp.float32  # activation dtype is set by the engine cast
 
     @property
@@ -290,7 +293,11 @@ def _block(cfg: GPT2Config, x, lp, rng, deterministic: bool, token_mask=None):
     elif cfg.attention_mode != "flash":
         raise ValueError(f"unknown attention_mode {cfg.attention_mode!r} (flash|ring|ulysses|sparse)")
     elif cfg.use_flash_attention and T >= 128:
-        attn = flash_attention(q, k, v, causal=True)
+        fb = cfg.flash_blocks
+        fb_kw = (
+            dict(zip(("block_q", "block_k", "bwd_block_q", "bwd_block_k"), fb)) if fb else {}
+        )
+        attn = flash_attention(q, k, v, causal=True, **fb_kw)
     else:
         attn = mha_reference(q, k, v, causal=True)
     attn = attn.transpose(0, 2, 1, 3).reshape(B, T, D)

@@ -110,14 +110,107 @@ def mha_dense(
 
 
 # ---------------------------------------------------------------------------
+# In-kernel counter-based dropout PRNG
+#
+# Threefry-2x32 (20 rounds, the Random123 / jax.random schedule) written
+# in pure uint32 add/xor/rotate — it lowers identically through Mosaic
+# and the Pallas interpreter (pltpu.prng_random_bits is a TPU-only
+# primitive and stubs to zeros in interpret mode), and the same pure
+# function run host-side reproduces the exact keep-mask for the oracle
+# and for the non-kernel fallback paths.  The counter is the score
+# element's absolute (row·Tk + col, batch·head) position, so any block
+# decomposition (fwd q-blocks, dkv kv-blocks) regenerates identical
+# bits — the FA-2 backward never needs a stored mask.  Cost: ~80 VPU
+# ops per score on the dropout path only — the same threefry work
+# jax.random.bernoulli would do in XLA, minus the O(Tq·Tk) HBM
+# round-trip the materialized mask paid.
+# ---------------------------------------------------------------------------
+
+
+def _rotl32(x, r):
+    return (x << jnp.uint32(r)) | (x >> jnp.uint32(32 - r))
+
+
+def _threefry2x32_bits(k0, k1, x0, x1):
+    """First output word of 20-round Threefry-2x32 on counter (x0, x1)
+    under key (k0, k1).  All inputs uint32 arrays/scalars."""
+    ks0, ks1 = k0, k1
+    ks2 = jnp.uint32(0x1BD11BDA) ^ k0 ^ k1
+    x0 = x0 + ks0
+    x1 = x1 + ks1
+    rot_a = (13, 15, 26, 6)
+    rot_b = (17, 29, 16, 24)
+
+    def rounds4(x0, x1, rots):
+        for r in rots:
+            x0 = x0 + x1
+            x1 = _rotl32(x1, r)
+            x1 = x1 ^ x0
+        return x0, x1
+
+    for i, (rots, ka, kb) in enumerate((
+        (rot_a, ks1, ks2), (rot_b, ks2, ks0), (rot_a, ks0, ks1),
+        (rot_b, ks1, ks2), (rot_a, ks2, ks0),
+    )):
+        x0, x1 = rounds4(x0, x1, rots)
+        x0 = x0 + ka
+        x1 = x1 + kb + jnp.uint32(i + 1)
+    return x0
+
+
+def _drop_threshold(keep_prob: float) -> int:
+    """keep iff bits < threshold (uint32 compare) ⇒ P(keep) = keep_prob."""
+    return min(int(keep_prob * 4294967296.0), 4294967295)
+
+
+def _drop_keep_tile(k0, k1, bh, row0, col0, bq, bk, sk, keep_prob):
+    """(bq, bk) bool keep-tile for score rows [row0, row0+bq) × cols
+    [col0, col0+bk) of batch·head ``bh`` — pure function of the absolute
+    element position, identical across fwd/dq/dkv block decompositions."""
+    rows = jnp.uint32(row0) + jax.lax.broadcasted_iota(jnp.uint32, (bq, bk), 0)
+    cols = jnp.uint32(col0) + jax.lax.broadcasted_iota(jnp.uint32, (bq, bk), 1)
+    x0 = rows * jnp.uint32(sk) + cols
+    x1 = jnp.full((bq, bk), 1, jnp.uint32) * jnp.uint32(bh)
+    bits = _threefry2x32_bits(jnp.uint32(k0), jnp.uint32(k1), x0, x1)
+    return bits < jnp.uint32(_drop_threshold(keep_prob))
+
+
+def dropout_keep_mask_host(seed_pair, b, h, sq, sk, keep_prob):
+    """The full (b·h, sq, sk) uint8 keep-mask the kernels generate —
+    host-graph-side twin of ``_drop_keep_tile`` for the oracle and the
+    materializing fallback paths (dense short-seq / reference)."""
+    k0 = seed_pair[0].astype(jnp.uint32)
+    k1 = seed_pair[1].astype(jnp.uint32)
+    rows = jax.lax.broadcasted_iota(jnp.uint32, (sq, sk), 0)
+    cols = jax.lax.broadcasted_iota(jnp.uint32, (sq, sk), 1)
+    x0 = rows * jnp.uint32(sk) + cols
+    bhs = jnp.arange(b * h, dtype=jnp.uint32)
+    bits = jax.vmap(lambda bh: _threefry2x32_bits(k0, k1, x0, jnp.full((sq, sk), 1, jnp.uint32) * bh))(bhs)
+    return (bits < jnp.uint32(_drop_threshold(keep_prob))).astype(jnp.uint8)
+
+
+def _seed_pair(rng) -> jnp.ndarray:
+    """(2,) uint32 key words from either a new-style typed PRNG key or a
+    raw uint32[2] key."""
+    try:
+        if jnp.issubdtype(rng.dtype, jax.dtypes.prng_key):
+            rng = jax.random.key_data(rng)
+    except (TypeError, AttributeError):
+        pass
+    return jnp.asarray(rng).reshape(-1)[:2].astype(jnp.uint32)
+
+
+# ---------------------------------------------------------------------------
 # Pallas forward kernel
 # ---------------------------------------------------------------------------
 
 def _flash_fwd_kernel(
     q_ref, k_ref, v_ref, *rest, sm_scale: float, causal: bool, block_k: int,
-    kbias: bool, fbias: bool, keep_prob: float,
+    kbias: bool, fbias: bool, keep_prob: float, kdrop: bool = False,
 ):
-    # optional trailing inputs: [bias], [drop-mask]; outputs: o, [lse]
+    # optional trailing inputs: [bias], [drop-mask | prng-seed]; outputs:
+    # o, [lse].  ``kdrop``: the dropout input is a (2,) uint32 SMEM seed
+    # and the keep-mask is generated in-kernel (no O(Tq·Tk) HBM buffer).
     refs = list(rest)
     bias_ref = refs.pop(0) if (kbias or fbias) else None
     mask_ref = refs.pop(0) if keep_prob < 1.0 else None
@@ -128,6 +221,7 @@ def _flash_fwd_kernel(
     seq_k = k_ref.shape[1]
     seq_q_total = pl.num_programs(1) * block_q
     q_idx = pl.program_id(1)
+    bh_idx = pl.program_id(0)
     # End-aligned causal offset (queries are the LAST seq_q positions of
     # the kv sequence — decode convention, matches mha_reference's
     # tril(k=klen-qlen)).
@@ -170,7 +264,13 @@ def _flash_fwd_kernel(
         # csrc/transformer/dropout_kernels.cu)
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
         if keep_prob < 1.0:
-            keep = mask_ref[0, :, pl.dslice(i * block_k, block_k)]
+            if kdrop:
+                keep = _drop_keep_tile(
+                    mask_ref[0], mask_ref[1], bh_idx,
+                    q_idx * block_q, i * block_k, block_q, block_k, seq_k, keep_prob,
+                )
+            else:
+                keep = mask_ref[0, :, pl.dslice(i * block_k, block_k)]
             p = p * (keep.astype(jnp.float32) / keep_prob)
         acc = acc * alpha + jnp.dot(p.astype(v.dtype), v, preferred_element_type=jnp.float32)
         return acc, m_new, l_new
@@ -208,9 +308,13 @@ def _bias_mode(bias, b, h, sq, sk):
     return "fbias", full
 
 
-def _fwd_extra_specs(mode, bias2, mask, b, h, sq, sk, block_q):
-    """in_specs + arrays for the optional bias/mask inputs of the fwd/dq
-    kernels (block over the q dim; the kv dim is sliced in-kernel)."""
+def _fwd_extra_specs(mode, bias2, mask, b, h, sq, sk, block_q, drop_seed=None):
+    """in_specs + arrays for the optional bias/mask/seed inputs of the
+    fwd/dq kernels (block over the q dim; the kv dim is sliced
+    in-kernel).  ``drop_seed``: (2,) uint32 for in-kernel dropout —
+    rides SMEM, mutually exclusive with ``mask``."""
+    from jax.experimental.pallas import tpu as pltpu
+
     specs, args = [], []
     if mode == "kbias":
         specs.append(pl.BlockSpec((1, 1, sk), lambda bh_, qi, h=h: (bh_ // h, 0, 0)))
@@ -221,12 +325,15 @@ def _fwd_extra_specs(mode, bias2, mask, b, h, sq, sk, block_q):
     if mask is not None:
         specs.append(pl.BlockSpec((1, block_q, sk), lambda bh_, qi: (bh_, qi, 0)))
         args.append(mask)
+    elif drop_seed is not None:
+        specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(drop_seed)
     return specs, args
 
 
 def _flash_fwd_pallas(
     q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: int, interpret: bool,
-    want_lse: bool = True, bias=None, mask=None, keep_prob: float = 1.0,
+    want_lse: bool = True, bias=None, mask=None, keep_prob: float = 1.0, drop_seed=None,
 ):
     b, h, sq, d = q.shape
     sk = k.shape[2]
@@ -245,13 +352,14 @@ def _flash_fwd_pallas(
         pl.BlockSpec((1, sk, d), lambda bh_, qi: (bh_, 0, 0)),
         pl.BlockSpec((1, sk, d), lambda bh_, qi: (bh_, 0, 0)),
     ]
-    extra_specs, extra_args = _fwd_extra_specs(mode, bias2, mask, b, h, sq, sk, block_q)
+    extra_specs, extra_args = _fwd_extra_specs(mode, bias2, mask, b, h, sq, sk, block_q, drop_seed)
     in_specs += extra_specs
     o_spec = pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0))
     o_shape = jax.ShapeDtypeStruct((bh, sq, d), q.dtype)
     kern = functools.partial(
         _flash_fwd_kernel, sm_scale=sm_scale, causal=causal, block_k=block_k,
         kbias=(mode == "kbias"), fbias=(mode == "fbias"), keep_prob=keep_prob,
+        kdrop=(drop_seed is not None),
     )
     if not want_lse:
         # inference/eval path: skip the logsumexp output entirely
@@ -339,7 +447,7 @@ def _blockwise_xla(q, k, v, causal: bool, sm_scale: float, block_k: int):
 
 def _flash_bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-    sm_scale, causal, block_k, kbias, fbias, keep_prob,
+    sm_scale, causal, block_k, kbias, fbias, keep_prob, kdrop=False,
 ):
     refs = list(rest)
     bias_ref = refs.pop(0) if (kbias or fbias) else None
@@ -350,6 +458,7 @@ def _flash_bwd_dq_kernel(
     seq_k = k_ref.shape[1]
     seq_q_total = pl.num_programs(1) * block_q
     q_idx = pl.program_id(1)
+    bh_idx = pl.program_id(0)
     causal_offset = seq_k - seq_q_total
 
     q = q_ref[0]
@@ -379,7 +488,13 @@ def _flash_bwd_dq_kernel(
         p = jnp.exp(s - lse)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         if keep_prob < 1.0:
-            keep = mask_ref[0, :, pl.dslice(i * block_k, block_k)]
+            if kdrop:
+                keep = _drop_keep_tile(
+                    mask_ref[0], mask_ref[1], bh_idx,
+                    q_idx * block_q, i * block_k, block_q, block_k, seq_k, keep_prob,
+                )
+            else:
+                keep = mask_ref[0, :, pl.dslice(i * block_k, block_k)]
             dp = dp * (keep.astype(jnp.float32) / keep_prob)
         ds = (p * (dp - delta) * sm_scale).astype(k.dtype)
         return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
@@ -390,7 +505,7 @@ def _flash_bwd_dq_kernel(
 
 def _flash_bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-    sm_scale, causal, block_q, kbias, fbias, keep_prob,
+    sm_scale, causal, block_q, kbias, fbias, keep_prob, kdrop=False,
 ):
     refs = list(rest)
     bias_ref = refs.pop(0) if (kbias or fbias) else None
@@ -401,6 +516,7 @@ def _flash_bwd_dkv_kernel(
     seq_q = q_ref.shape[1]
     seq_k_total = pl.num_programs(1) * block_k
     kv_idx = pl.program_id(1)
+    bh_idx = pl.program_id(0)
     causal_offset = seq_k_total - seq_q
 
     k = k_ref[0]
@@ -431,7 +547,14 @@ def _flash_bwd_dkv_kernel(
             s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
         p = jnp.exp(s - lse)
         if keep_prob < 1.0:
-            scaled_keep = mask_ref[0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32) / keep_prob
+            if kdrop:
+                keep = _drop_keep_tile(
+                    mask_ref[0], mask_ref[1], bh_idx,
+                    i * block_q, kv_idx * block_k, block_q, block_k, seq_k_total, keep_prob,
+                )
+            else:
+                keep = mask_ref[0, pl.dslice(i * block_q, block_q), :]
+            scaled_keep = keep.astype(jnp.float32) / keep_prob
             d_mat = p * scaled_keep  # post-dropout probabilities
         else:
             d_mat = p
@@ -449,9 +572,176 @@ def _flash_bwd_dkv_kernel(
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
+def _flash_bwd_fused_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    sm_scale, causal, block_q, kbias, fbias, keep_prob, kdrop=False,
+):
+    """Single-pass backward: one kernel computes dq, dk, dv together.
+
+    Grid is (bh, kv-blocks) with the kv axis SEQUENTIAL ("arbitrary"
+    semantics): every program loops the q blocks for its kv block,
+    computing P = exp(S − lse) ONCE per score and feeding all three
+    cotangents — the two-pass design (dq pass + dkv pass) pays that
+    exp twice, and at d=64 the kernel is VPU-softmax-bound
+    (ROUND3_NOTES "Known limits"), so the second exp is pure waste.
+    dq accumulates across kv blocks by revisiting its (full-seq) output
+    block, which stays resident in VMEM between sequential grid steps —
+    this bounds the fused kernel to seqs where sq·d fp32 fits VMEM
+    (~8k at d=64; longer seqs keep the two-pass path)."""
+    refs = list(rest)
+    bias_ref = refs.pop(0) if (kbias or fbias) else None
+    mask_ref = refs.pop(0) if keep_prob < 1.0 else None
+    dq_ref, dk_ref, dv_ref = refs
+
+    block_k, d = k_ref.shape[1], k_ref.shape[2]
+    seq_q = q_ref.shape[1]
+    seq_k_total = pl.num_programs(1) * block_k
+    kv_idx = pl.program_id(1)
+    bh_idx = pl.program_id(0)
+    causal_offset = seq_k_total - seq_q
+
+    @pl.when(kv_idx == 0)
+    def _zero_dq():
+        dq_ref[0] = jnp.zeros((seq_q, d), dq_ref.dtype)
+
+    k = k_ref[0]
+    v = v_ref[0]
+
+    num_q = seq_q // block_q
+    if causal:
+        k_start = kv_idx * block_k
+        lo = jnp.clip(jax.lax.div(k_start - causal_offset, block_q), 0, num_q)
+    else:
+        lo = 0
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.dslice(i * block_q, block_q), :]
+        do = do_ref[0, pl.dslice(i * block_q, block_q), :]
+        lse = lse_ref[0, 0, pl.dslice(i * block_q, block_q)][:, None]
+        delta = delta_ref[0, 0, pl.dslice(i * block_q, block_q)][:, None]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        if kbias:
+            s = s + bias_ref[0, 0].astype(jnp.float32)[None, :]
+        elif fbias:
+            s = s + bias_ref[0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
+        if causal:
+            q_pos = causal_offset + i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = kv_idx * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
+        p = jnp.exp(s - lse)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        if keep_prob < 1.0:
+            if kdrop:
+                keep = _drop_keep_tile(
+                    mask_ref[0], mask_ref[1], bh_idx,
+                    i * block_q, kv_idx * block_k, block_q, block_k, seq_k_total, keep_prob,
+                )
+            else:
+                keep = mask_ref[0, pl.dslice(i * block_q, block_q), :]
+            scaled_keep = keep.astype(jnp.float32) / keep_prob
+            d_mat = p * scaled_keep
+            dp = dp * scaled_keep
+        else:
+            d_mat = p
+        dv = dv + jnp.dot(d_mat.astype(do.dtype).T, do, preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * sm_scale).astype(q.dtype)
+        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        # dq accumulation: read-modify-write the resident dq block
+        cur = dq_ref[0, pl.dslice(i * block_q, block_q), :]
+        dq_ref[0, pl.dslice(i * block_q, block_q), :] = (
+            cur + jnp.dot(ds, k, preferred_element_type=jnp.float32).astype(dq_ref.dtype)
+        )
+        return dk, dv
+
+    init = (jnp.zeros((block_k, d), jnp.float32), jnp.zeros((block_k, d), jnp.float32))
+    dk, dv = jax.lax.fori_loop(lo, num_q, body, init)
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_fused_pallas(
+    q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret,
+    bias=None, mask=None, keep_prob: float = 1.0, drop_seed=None,
+):
+    """Single-kernel backward (see ``_flash_bwd_fused_kernel``).  dq is
+    accumulated in fp32 and cast at the end."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bh = b * h
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    qr, kr, vr = (t.reshape(bh, t.shape[2], d) for t in (q, k, v))
+    dor = g.reshape(bh, sq, d)
+    lser = jnp.broadcast_to(lse.reshape(bh, 1, sq), (bh, 8, sq))
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta.reshape(bh, 1, sq), (bh, 8, sq))
+    mode, bias2 = _bias_mode(bias, b, h, sq, sk)
+    flags = dict(
+        kbias=(mode == "kbias"), fbias=(mode == "fbias"), keep_prob=keep_prob,
+        kdrop=(drop_seed is not None),
+    )
+
+    extra_specs, extra_args = [], []
+    if mode == "kbias":
+        extra_specs.append(pl.BlockSpec((1, 1, block_k), lambda bh_, ki, h=h: (bh_ // h, 0, ki)))
+        extra_args.append(bias2)
+    elif mode == "fbias":
+        extra_specs.append(pl.BlockSpec((1, sq, block_k), lambda bh_, ki: (bh_, 0, ki)))
+        extra_args.append(bias2)
+    if mask is not None:
+        extra_specs.append(pl.BlockSpec((1, sq, block_k), lambda bh_, ki: (bh_, 0, ki)))
+        extra_args.append(mask)
+    elif drop_seed is not None:
+        extra_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        extra_args.append(drop_seed)
+
+    dq32, dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_fused_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q, **flags),
+        grid=(bh, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, sq, d), lambda bh_, ki: (bh_, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, ki: (bh_, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, ki: (bh_, ki, 0)),
+            pl.BlockSpec((1, sq, d), lambda bh_, ki: (bh_, 0, 0)),
+            pl.BlockSpec((1, 8, sq), lambda bh_, ki: (bh_, 0, 0)),
+            pl.BlockSpec((1, 8, sq), lambda bh_, ki: (bh_, 0, 0)),
+        ] + extra_specs,
+        out_specs=[
+            # dq: full-seq block revisited every kv step (accumulator)
+            pl.BlockSpec((1, sq, d), lambda bh_, ki: (bh_, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, ki: (bh_, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, ki: (bh_, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, delta, *extra_args)
+
+    return (
+        dq32.astype(q.dtype).reshape(q.shape),
+        dk.reshape(k.shape),
+        dv.reshape(v.shape),
+    )
+
+
+# VMEM bound for the fused backward's resident per-program state:
+# q + do + dq(fp32) + k/v blocks, double-buffered — beyond this the
+# two-pass kernels take over.
+_FUSED_BWD_MAX_SQ_BYTES = 1 << 21  # sq * d * 4 (fp32 dq) per program
+
+
 def _flash_bwd_pallas(
     q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret,
-    bias=None, mask=None, keep_prob: float = 1.0,
+    bias=None, mask=None, keep_prob: float = 1.0, drop_seed=None,
 ):
     b, h, sq, d = q.shape
     sk = k.shape[2]
@@ -466,9 +756,12 @@ def _flash_bwd_pallas(
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta.reshape(bh, 1, sq), (bh, 8, sq))
     mode, bias2 = _bias_mode(bias, b, h, sq, sk)
-    flags = dict(kbias=(mode == "kbias"), fbias=(mode == "fbias"), keep_prob=keep_prob)
+    flags = dict(
+        kbias=(mode == "kbias"), fbias=(mode == "fbias"), keep_prob=keep_prob,
+        kdrop=(drop_seed is not None),
+    )
 
-    dq_extra_specs, dq_extra_args = _fwd_extra_specs(mode, bias2, mask, b, h, sq, sk, block_q)
+    dq_extra_specs, dq_extra_args = _fwd_extra_specs(mode, bias2, mask, b, h, sq, sk, block_q, drop_seed)
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, sm_scale=sm_scale, causal=causal, block_k=block_k, **flags),
         grid=(bh, sq // block_q),
@@ -496,6 +789,11 @@ def _flash_bwd_pallas(
     if mask is not None:
         kv_extra_specs.append(pl.BlockSpec((1, sq, block_k), lambda bh_, ki: (bh_, 0, ki)))
         kv_extra_args.append(mask)
+    elif drop_seed is not None:
+        from jax.experimental.pallas import tpu as pltpu
+
+        kv_extra_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        kv_extra_args.append(drop_seed)
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q, **flags),
         grid=(bh, sk // block_k),
@@ -525,19 +823,19 @@ def _flash_bwd_pallas(
 # Public API with custom VJP
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
-def _flash_attention(q, k, v, bias, mask, causal, sm_scale, block_q, block_k, interpret, keep_prob):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11, 12, 13))
+def _flash_attention(q, k, v, bias, mask, drop_seed, causal, sm_scale, block_q, block_k, interpret, keep_prob, bwd_block_q=None, bwd_block_k=None):
     # non-differentiated primal (inference/eval): no lse buffer
     return _flash_fwd_pallas(
         q, k, v, causal, sm_scale, block_q, block_k, interpret,
-        want_lse=False, bias=bias, mask=mask, keep_prob=keep_prob,
+        want_lse=False, bias=bias, mask=mask, keep_prob=keep_prob, drop_seed=drop_seed,
     )[0]
 
 
-def _flash_fwd_rule(q, k, v, bias, mask, causal, sm_scale, block_q, block_k, interpret, keep_prob):
+def _flash_fwd_rule(q, k, v, bias, mask, drop_seed, causal, sm_scale, block_q, block_k, interpret, keep_prob, bwd_block_q=None, bwd_block_k=None):
     out, lse = _flash_fwd_pallas(
         q, k, v, causal, sm_scale, block_q, block_k, interpret,
-        bias=bias, mask=mask, keep_prob=keep_prob,
+        bias=bias, mask=mask, keep_prob=keep_prob, drop_seed=drop_seed,
     )
     # Names for selective activation checkpointing: a remat policy that
     # saves "attn_o"/"attn_lse" keeps the kernel's residuals, so the
@@ -549,10 +847,10 @@ def _flash_fwd_rule(q, k, v, bias, mask, causal, sm_scale, block_q, block_k, int
 
     out = checkpoint_name(out, "attn_o")
     lse = checkpoint_name(lse, "attn_lse")
-    return out, (q, k, v, out, lse, bias, mask)
+    return out, (q, k, v, out, lse, bias, mask, drop_seed)
 
 
-def _bias_cotangent(q, k, v, out, lse, g, bias, mask, causal, sm_scale, keep_prob):
+def _bias_cotangent(q, k, v, out, lse, g, bias, mask, causal, sm_scale, keep_prob, drop_seed=None):
     """Exact dL/dbias = dS (pre-scale scores' cotangent) reduced over the
     bias' broadcast dims.  Deliberately a SEPARATE computation from the
     Pallas backward: when the caller's bias is a constant (padding mask —
@@ -569,6 +867,10 @@ def _bias_cotangent(q, k, v, out, lse, g, bias, mask, causal, sm_scale, keep_pro
         s = jnp.where(qp >= jnp.arange(sk)[None, :], s, DEFAULT_MASK_VALUE)
     p = jnp.exp(s - lse[..., None])
     dp = jnp.einsum("bhqd,bhkd->bhqk", g.astype(jnp.float32), v.astype(jnp.float32))
+    if mask is None and drop_seed is not None:
+        # regenerate the kernels' keep-mask (host twin of the in-kernel
+        # counter PRNG); only reached for a TRAINABLE bias under dropout
+        mask = dropout_keep_mask_host(drop_seed, b, h, sq, sk, keep_prob)
     if mask is not None:
         dp = dp * (mask.reshape(b, h, sq, sk).astype(jnp.float32) / keep_prob)
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
@@ -579,17 +881,29 @@ def _bias_cotangent(q, k, v, out, lse, g, bias, mask, causal, sm_scale, keep_pro
     return db.astype(bias.dtype)
 
 
-def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, keep_prob, res, g):
-    q, k, v, out, lse, bias, mask = res
-    dq, dk, dv = _flash_bwd_pallas(
-        q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret,
-        bias=bias, mask=mask, keep_prob=keep_prob,
+def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, keep_prob, bwd_block_q, bwd_block_k, res, g):
+    q, k, v, out, lse, bias, mask, drop_seed = res
+    # single-pass backward when the full-seq fp32 dq accumulator fits a
+    # program's VMEM share — one exp per score instead of two (the d=64
+    # kernel is VPU-softmax-bound; measured ~20% faster bwd at GPT-2
+    # shapes); longer sequences fall back to the two-pass FA-2 kernels
+    bwd = (
+        _flash_bwd_fused_pallas
+        if q.shape[2] * q.shape[3] * 4 <= _FUSED_BWD_MAX_SQ_BYTES
+        else _flash_bwd_pallas
+    )
+    dq, dk, dv = bwd(
+        q, k, v, out, lse, g, causal, sm_scale,
+        bwd_block_q or block_q, bwd_block_k or block_k, interpret,
+        bias=bias, mask=mask, keep_prob=keep_prob, drop_seed=drop_seed,
     )
     dbias = None if bias is None else _bias_cotangent(
-        q, k, v, out, lse, g, bias, mask, causal, sm_scale, keep_prob
+        q, k, v, out, lse, g, bias, mask, causal, sm_scale, keep_prob,
+        drop_seed=drop_seed,
     )
     dmask = None if mask is None else jnp.zeros_like(mask)
-    return dq, dk, dv, dbias, dmask
+    dseed = None if drop_seed is None else jnp.zeros_like(drop_seed)
+    return dq, dk, dv, dbias, dmask, dseed
 
 
 _flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -604,11 +918,17 @@ def flash_attention(
     bias: Optional[jnp.ndarray] = None,
     dropout_rate: float = 0.0,
     dropout_rng: Optional[jax.Array] = None,
-    # (512, 512) measured fastest for fwd+bwd at GPT-2 shapes on v5e
-    # (tools/bench_flash_blocks.py: 1.36ms vs 1.61ms for 1024/512 at
-    # B=4 H=20 T=1024 d=64); pick() clamps to sequence divisors
+    # (512, 512) measured fastest for the FULL 774M train step on v5e
+    # (47.6% MFU vs 44.4% at the isolated-microbench winner (1024, 256)
+    # — the micro sweep's 4.18ms/layer did not survive composition with
+    # remat + the rest of the step's VMEM pressure); pick() clamps to
+    # sequence divisors
     block_q: int = 512,
     block_k: int = 512,
+    # backward-pass blocks (None ⇒ same as forward); the fused bwd and
+    # the fwd kernel prefer different shapes at some sizes
+    bwd_block_q: Optional[int] = None,
+    bwd_block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Flash attention over ``(batch, heads, seq, head_dim)`` inputs.
@@ -626,10 +946,12 @@ def flash_attention(
     gradient is unused (constant masks — the common case).
     ``dropout_rate`` applies attention-probability dropout
     (softmax-then-dropout, the reference's stochastic-transformer mode,
-    csrc/transformer/dropout_kernels.cu): the keep-mask is drawn
-    host-graph-side from ``dropout_rng`` and fed to both kernels, so it
-    costs O(Tq·Tk) bytes — intended for the BERT-era sequence lengths
-    that use it; keep it 0 for long-context (warned above 4k).
+    csrc/transformer/dropout_kernels.cu).  On the kernel path the
+    keep-mask is generated IN-KERNEL by a counter-based Threefry-2x32
+    keyed on ``dropout_rng`` and the score element's absolute position
+    — no O(Tq·Tk) HBM buffer, so long-context training keeps flash
+    attention's O(T) memory with dropout on.  Non-kernel fallback paths
+    materialize the identical mask host-graph-side (warned above 4k²).
     """
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -642,30 +964,37 @@ def flash_attention(
     b, h, sq, d = q.shape
     sk = k.shape[2]
     keep_prob = 1.0 - float(dropout_rate)
-    mask3 = None  # (B*H, Tq, Tk) uint8 for the kernels
+    drop_seed = None  # (2,) uint32 — the kernels generate keep-bits in-kernel
     if dropout_rate > 0.0:
         if dropout_rng is None:
             raise ValueError("dropout_rate > 0 requires dropout_rng")
+        drop_seed = _seed_pair(dropout_rng)
+
+    def host_mask4():
+        """Materialized keep-mask for the non-kernel paths — the SAME
+        bits the kernels would generate (one dropout stream per seed,
+        whatever the dispatch)."""
+        if drop_seed is None:
+            return None
         if sq * sk > 4096 * 4096:
             logger.warning(
-                f"attention dropout at seq {sq}x{sk} materializes a "
-                f"{b*h*sq*sk/2**30:.1f}GiB keep-mask in HBM (forfeits flash "
-                "attention's O(T) memory); prefer dropout_rate=0 at long context"
+                f"attention dropout at seq {sq}x{sk} fell off the Pallas "
+                f"kernel path and materializes a {b*h*sq*sk/2**30:.1f}GiB "
+                "keep-mask in HBM (the kernel path generates it in-kernel "
+                "at O(T) memory)"
             )
-        mask3 = jax.random.bernoulli(dropout_rng, keep_prob, (b * h, sq, sk)).astype(jnp.uint8)
+        return dropout_keep_mask_host(drop_seed, b, h, sq, sk, keep_prob).reshape(b, h, sq, sk)
 
     if not explicit_interpret and sq * sk <= SMALL_SEQ_DENSE_SCORES:
-        m4 = None if mask3 is None else mask3.reshape(b, h, sq, sk)
         return mha_dense(
             q, k, v, causal=causal, sm_scale=sm_scale, bias=bias,
-            dropout_mask=m4, keep_prob=keep_prob,
+            dropout_mask=host_mask4(), keep_prob=keep_prob,
         )
 
     def reference():
-        m4 = None if mask3 is None else mask3.reshape(b, h, sq, sk)
         return mha_reference(
             q, k, v, causal=causal, sm_scale=sm_scale, bias=bias,
-            dropout_mask=m4, keep_prob=keep_prob,
+            dropout_mask=host_mask4(), keep_prob=keep_prob,
         )
 
     # Caller-supplied blocks are honored when they divide the sequence;
@@ -683,18 +1012,19 @@ def flash_attention(
         return None
 
     bq, bk = pick(sq, block_q), pick(sk, block_k)
-    if bq is not None and bk is not None and (bias is not None or mask3 is not None):
-        # the full-bias/mask BlockSpecs are (1, block_q, sk) fwd and
+    if bq is not None and bk is not None and bias is not None:
+        # the full-bias BlockSpecs are (1, block_q, sk) fwd and
         # (1, sq, block_k) in the dkv pass — clamp the block sizes so
         # those auxiliary buffers stay ~2MB (VMEM is ~16MB/core and the
-        # pipeline double-buffers)
-        aux_bytes = 4 if bias is not None else 1
+        # pipeline double-buffers); in-kernel dropout carries only a
+        # (2,) SMEM seed, no clamp needed
+        aux_bytes = 4
         while bq > 128 and bq * sk * aux_bytes > 2**21:
             bq = pick(sq, bq // 2) or 128
         while bk > 128 and bk * sq * aux_bytes > 2**21:
             bk = pick(sk, bk // 2) or 128
     if bq is None or bk is None or sq < 8 or sk < 8:
-        if sq >= 8 and sk >= 8 and b * h * sq * sk * 4 > 2**28 and bias is None and mask3 is None:
+        if sq >= 8 and sk >= 8 and b * h * sq * sk * 4 > 2**28 and bias is None and drop_seed is None:
             # No kernel-compatible blocking but the (b,h,sq,sk) fp32
             # score tensor would exceed ~256MB: blockwise-rematerialized
             # XLA path (handles ragged sk by pad+mask).
@@ -709,11 +1039,16 @@ def flash_attention(
     # against the ~16MB/core limit.
     itemsize = jnp.dtype(q.dtype).itemsize
     if max(sq, sk) * d * itemsize * 4 >= 2**23:
-        if bias is not None or mask3 is not None:
-            # the O(T^2) mask already dominates memory at these sizes
+        if bias is not None or drop_seed is not None:
+            # scores must materialize beyond the kernel's VMEM envelope
             return reference()
         return _blockwise_xla(q, k, v, causal=causal, sm_scale=sm_scale, block_k=bk)
-    return _flash_attention(q, k, v, bias, mask3, causal, float(sm_scale), bq, bk, interpret, keep_prob)
+    bbq = pick(sq, bwd_block_q) if bwd_block_q else None
+    bbk = pick(sk, bwd_block_k) if bwd_block_k else None
+    return _flash_attention(
+        q, k, v, bias, None, drop_seed, causal, float(sm_scale), bq, bk,
+        interpret, keep_prob, bbq, bbk,
+    )
 
 
 @register_op("flash_attention", "pallas", "Online-softmax fused attention, Pallas fwd + FA-2 dq/dkv bwd, bias + attention dropout")

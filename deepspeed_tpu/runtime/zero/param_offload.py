@@ -411,7 +411,17 @@ class ZeroInfinityEngine:
         key = jax.random.fold_in(jax.random.PRNGKey(self.config.seed), step * 1000 + micro)
         return jax.random.split(key, self.spec.n_layer).reshape(self.n_groups, self.group_layers, 2)
 
-    def train_batch(self, batch: Any) -> jnp.ndarray:
+    def train_batch(self, batch: Any, timing: Optional[dict] = None) -> jnp.ndarray:
+        """One training step.  ``timing``: pass a dict to run this step
+        SERIALIZED (block_until_ready after every phase) and receive a
+        wall-clock decomposition — upload_s (host→device incl. NVMe
+        read waits), fwd_s / bwd_s (chip compute), drain_s (device→host
+        grad pulls), opt_s (host Adam + NVMe write issuance).  The
+        serialized step is slower than a normal pipelined step (the
+        overlaps are deliberately removed so each phase is attributable);
+        use normal steps for throughput numbers."""
+        import time as _time
+
         progs = self._programs()
         gas = self.config.gradient_accumulation_steps
         mb = self.config.train_micro_batch_size_per_gpu * self.mesh_info.dp_world_size
@@ -420,7 +430,19 @@ class ZeroInfinityEngine:
         if n_rows != mb * gas:
             raise ValueError(f"batch rows {n_rows} != micro_bs*dp*gas {mb * gas}")
 
-        res_dev = self._upload_resident()
+        if timing is not None:
+            timing.update({k: 0.0 for k in ("upload_s", "fwd_s", "bwd_s", "drain_s", "opt_s")})
+
+        def _phase(key, fn, *a, **kw):
+            if timing is None:
+                return fn(*a, **kw)
+            t0 = _time.time()
+            out = fn(*a, **kw)
+            jax.block_until_ready(out)
+            timing[key] += _time.time() - t0
+            return out
+
+        res_dev = _phase("upload_s", self._upload_resident)
         grad_acc: Optional[List[np.ndarray]] = None
         losses = []
         for micro in range(gas):
@@ -435,14 +457,14 @@ class ZeroInfinityEngine:
             # Pipeline: finish group g's upload, immediately issue the
             # NVMe read for g+1, then dispatch g's compute — the next
             # read and H2D ride under the current group's compute.
-            xs = [progs["embed"](res_dev, tokens)]
+            xs = [_phase("fwd_s", progs["embed"], res_dev, tokens)]
             inflight = self._issue_swap_in(0)
             for g in range(self.n_groups):
-                g_dev = self._finish_upload(g, inflight)
+                g_dev = _phase("upload_s", self._finish_upload, g, inflight)
                 inflight = self._issue_swap_in(g + 1) if g + 1 < self.n_groups else None
-                xs.append(progs["group_fwd"](g_dev, xs[-1], rngs[g]))
+                xs.append(_phase("fwd_s", progs["group_fwd"], g_dev, xs[-1], rngs[g]))
 
-            loss, d_res, dx = progs["head"](res_dev, xs[-1], mbatch)
+            loss, d_res, dx = _phase("fwd_s", progs["head"], res_dev, xs[-1], mbatch)
             losses.append(loss)
 
             # ---- backward sweep: re-upload groups in reverse, vjp each.
@@ -452,30 +474,32 @@ class ZeroInfinityEngine:
             micro_grads: List[Any] = [None] * self.n_groups
             inflight = self._issue_swap_in(self.n_groups - 1)
             pend_g, pend_dgp = None, None
+            def _drain(tree):
+                return jax.tree.map(lambda a: np.asarray(a, np.float32), tree)
+
             for g in range(self.n_groups - 1, -1, -1):
-                g_dev = self._finish_upload(g, inflight)
+                g_dev = _phase("upload_s", self._finish_upload, g, inflight)
                 inflight = self._issue_swap_in(g - 1) if g > 0 else None
-                dgp, dx = progs["group_bwd"](g_dev, xs[g], rngs[g], dx)
+                dgp, dx = _phase("bwd_s", progs["group_bwd"], g_dev, xs[g], rngs[g], dx)
                 self._start_host_copy(dgp)
                 if pend_g is not None:
-                    micro_grads[pend_g] = jax.tree.map(
-                        lambda a: np.asarray(a, np.float32), pend_dgp
-                    )
+                    micro_grads[pend_g] = _phase("drain_s", _drain, pend_dgp)
                 pend_g, pend_dgp = g, dgp
             # dispatch the embed backward BEFORE draining the last
             # group's grads — the host-side conversion below blocks on
             # D2H and would otherwise idle the device
-            d_res_embed = progs["embed_bwd"](res_dev, tokens, dx)
+            d_res_embed = _phase("bwd_s", progs["embed_bwd"], res_dev, tokens, dx)
             if pend_g is not None:
-                micro_grads[pend_g] = jax.tree.map(
-                    lambda a: np.asarray(a, np.float32), pend_dgp
-                )
+                micro_grads[pend_g] = _phase("drain_s", _drain, pend_dgp)
             pend_dgp = None
 
             # ---- host grad accumulation (resident grads sum embed+head)
-            d_res_total = jax.tree.map(
-                lambda a, b: np.asarray(a, np.float32) + np.asarray(b, np.float32),
-                jax.device_get(d_res), jax.device_get(d_res_embed),
+            d_res_total = _phase(
+                "drain_s",
+                lambda: jax.tree.map(
+                    lambda a, b: np.asarray(a, np.float32) + np.asarray(b, np.float32),
+                    jax.device_get(d_res), jax.device_get(d_res_embed),
+                ),
             )
             blocks_grads = jax.tree.map(
                 lambda *gs: np.concatenate([np.asarray(g, np.float32) for g in gs], axis=0),
@@ -506,11 +530,14 @@ class ZeroInfinityEngine:
             # ~model-size synchronous writes per step)
             swap = self._param_swapper is not None
             gl = self.group_layers
-            masters = self._host_opt.step(
-                grads_tree, lr, self.global_steps + 1,
-                row_groups=[(g * gl, (g + 1) * gl) for g in range(self.n_groups)] if swap else None,
-                row_group_prefix=f"{self.spec.blocks_key}/" if swap else "",
-                on_group=self._issue_group_swap_out if swap else None,
+            masters = _phase(
+                "opt_s",
+                lambda: self._host_opt.step(
+                    grads_tree, lr, self.global_steps + 1,
+                    row_groups=[(g * gl, (g + 1) * gl) for g in range(self.n_groups)] if swap else None,
+                    row_group_prefix=f"{self.spec.blocks_key}/" if swap else "",
+                    on_group=self._issue_group_swap_out if swap else None,
+                ),
             )
             self._params_host = masters
             self._blocks_host = masters[self.spec.blocks_key]

@@ -195,12 +195,12 @@ def test_dropout_matches_reference_with_same_mask():
     mask3 = jnp.asarray((r.random((b * h, sq, sk)) < keep_prob).astype(np.uint8))
     m4 = mask3.reshape(b, h, sq, sk)
 
-    out = _flash_attention(q, k, v, None, mask3, False, d ** -0.5, 128, 128, True, keep_prob)
+    out = _flash_attention(q, k, v, None, mask3, None, False, d ** -0.5, 128, 128, True, keep_prob)
     ref = mha_reference(q, k, v, dropout_mask=m4, keep_prob=keep_prob)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
     def f_flash(q, k, v):
-        return jnp.sum(_flash_attention(q, k, v, None, mask3, False, d ** -0.5, 128, 128, True, keep_prob) ** 2)
+        return jnp.sum(_flash_attention(q, k, v, None, mask3, None, False, d ** -0.5, 128, 128, True, keep_prob) ** 2)
 
     def f_ref(q, k, v):
         return jnp.sum(mha_reference(q, k, v, dropout_mask=m4, keep_prob=keep_prob) ** 2)
@@ -235,6 +235,90 @@ def test_bias_dropout_causal_combined():
     bias = jnp.asarray(np.where(r.random((b, 1, 1, t)) < 0.2, -1e9, 0.0), jnp.float32)
     keep = 0.9
     mask3 = jnp.asarray((r.random((b * h, t, t)) < keep).astype(np.uint8))
-    out = _flash_attention(q, k, v, bias, mask3, True, d ** -0.5, 128, 128, True, keep)
+    out = _flash_attention(q, k, v, bias, mask3, None, True, d ** -0.5, 128, 128, True, keep)
     ref = mha_reference(q, k, v, causal=True, bias=bias, dropout_mask=mask3.reshape(b, h, t, t), keep_prob=keep)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_inkernel_dropout_matches_host_twin_mask():
+    """r4 in-kernel dropout PRNG (VERDICT r3 #7): the kernels generate
+    the keep-mask from a counter-based Threefry inside the kernel; the
+    host twin (dropout_keep_mask_host) must reproduce it exactly, so
+    kernel fwd+grads equal the oracle fed the host-generated mask."""
+    from deepspeed_tpu.ops.attention.flash_attention import (
+        _flash_attention, _seed_pair, dropout_keep_mask_host,
+    )
+
+    r = np.random.default_rng(7)
+    b, h, sq, sk, d = 2, 2, 256, 256, 64
+    q, k, v = _rand_qkv(r, b, h, sq, sk, d)
+    keep_prob = 0.8
+    seed = _seed_pair(jax.random.PRNGKey(123))
+    m4 = dropout_keep_mask_host(seed, b, h, sq, sk, keep_prob).reshape(b, h, sq, sk)
+    # keep statistics: the threshold rule must hit keep_prob closely
+    frac = float(np.asarray(m4, np.float32).mean())
+    assert abs(frac - keep_prob) < 0.01, frac
+
+    out = _flash_attention(q, k, v, None, None, seed, False, d ** -0.5, 128, 128, True, keep_prob)
+    ref = mha_reference(q, k, v, dropout_mask=m4, keep_prob=keep_prob)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    def f_flash(q, k, v):
+        return jnp.sum(_flash_attention(q, k, v, None, None, seed, False, d ** -0.5, 128, 128, True, keep_prob) ** 2)
+
+    def f_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, dropout_mask=m4, keep_prob=keep_prob) ** 2)
+
+    g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=2e-4, atol=2e-5)
+
+
+def test_inkernel_dropout_causal_and_blocking_invariance():
+    """The mask is a pure function of absolute element position: kernel
+    results must be identical across block decompositions (the dkv pass
+    re-derives tiles under a different grid), and compose with causal."""
+    from deepspeed_tpu.ops.attention.flash_attention import _flash_attention, _seed_pair
+
+    r = np.random.default_rng(8)
+    b, h, t, d = 1, 2, 256, 64
+    q, k, v = _rand_qkv(r, b, h, t, t, d)
+    seed = _seed_pair(jax.random.PRNGKey(5))
+    keep = 0.9
+    o128 = _flash_attention(q, k, v, None, None, seed, True, d ** -0.5, 128, 128, True, keep)
+    o64 = _flash_attention(q, k, v, None, None, seed, True, d ** -0.5, 64, 64, True, keep)
+    np.testing.assert_allclose(np.asarray(o128), np.asarray(o64), rtol=2e-5, atol=2e-5)
+
+    def g(fn_blocks):
+        bq, bk = fn_blocks
+        return jax.grad(lambda q_: jnp.sum(
+            _flash_attention(q_, k, v, None, None, seed, True, d ** -0.5, bq, bk, True, keep) ** 2
+        ))(q)
+
+    np.testing.assert_allclose(np.asarray(g((128, 128))), np.asarray(g((64, 64))), rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.slow
+def test_long_seq_dropout_compiled_memory_bound():
+    """The point of in-kernel dropout: training with attention dropout
+    at 8k seq must NOT materialize the (B,H,Tq,Tk) keep-mask — compiled
+    temp memory stays far below the 64MB/head the mask would cost
+    (VERDICT r3 #7 'Done' criterion)."""
+    b, h, t, d = 1, 2, 8192, 64
+    rng = jax.random.PRNGKey(0)
+
+    def loss(q, k, v):
+        o = flash_attention(q, k, v, causal=True, dropout_rate=0.1, dropout_rng=rng)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    # bf16: the kernel's VMEM envelope admits 8k×64 bf16 (fp32 tops out
+    # just below 8k and would fall back to the materializing path)
+    q = jnp.zeros((b, h, t, d), jnp.bfloat16)
+    compiled = jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(q, q, q).compile()
+    mem = compiled.memory_analysis()
+    temp = getattr(mem, "temp_size_in_bytes", None)
+    if temp is None:
+        pytest.skip("backend exposes no memory_analysis temp sizes")
+    mask_bytes = b * h * t * t  # uint8 keep-mask the old path materialized
+    assert temp < mask_bytes // 2, (temp, mask_bytes)

@@ -44,6 +44,31 @@ CONFIGS = {
     # round 3: save flash residuals (no kernel re-run in bwd) + tuned blocks
     "self": dict(model=dict(remat=True, xent_chunk_size=512, remat_save_names=SAVE_FLASH), mb=4, gas=1),
     "selfa": dict(model=dict(remat=True, xent_chunk_size=512, remat_save_names=SAVE_FLASH + ("attn_ctx",)), mb=4, gas=1),
+    # round 4: amortize the fixed ~43ms optimizer/elementwise cost over
+    # more tokens per step (saved-activation memory scales with mb; the
+    # gas==1 fused step freed the 3.1GB accumulator that pays for it)
+    "mb6": dict(model=dict(remat=True, xent_chunk_size=512, remat_save_names=SAVE_FLASH), mb=6, gas=1),
+    "mb8": dict(model=dict(remat=True, xent_chunk_size=512, remat_save_names=SAVE_FLASH), mb=8, gas=1),
+    "x1024": dict(model=dict(remat=True, xent_chunk_size=1024, remat_save_names=SAVE_FLASH), mb=4, gas=1),
+    "mb6x1024": dict(model=dict(remat=True, xent_chunk_size=1024, remat_save_names=SAVE_FLASH), mb=6, gas=1),
+    "mb8small": dict(model=dict(remat=True, xent_chunk_size=512, remat_save_names=("qkv", "attn_o", "attn_lse")), mb=8, gas=1),
+    "mb6small": dict(model=dict(remat=True, xent_chunk_size=512, remat_save_names=("qkv", "attn_o", "attn_lse")), mb=6, gas=1),
+    # round 4 cont.: scan unroll on the SAVE_FLASH set with the fused
+    # single-pass attention backward (the DUS scan bookkeeping was
+    # ~30ms of the r4 profile's top ops)
+    "selfu6": dict(model=dict(remat=True, xent_chunk_size=512, remat_save_names=SAVE_FLASH, scan_unroll=6), mb=4, gas=1),
+    "selfu12": dict(model=dict(remat=True, xent_chunk_size=512, remat_save_names=SAVE_FLASH, scan_unroll=12), mb=4, gas=1),
+    # split fwd/bwd flash blocks (fwd prefers (1024,256), fused bwd (512,512))
+    "fb_split": dict(model=dict(remat=True, xent_chunk_size=512, remat_save_names=SAVE_FLASH, flash_blocks=(1024, 256, 512, 512)), mb=4, gas=1),
+    "fb_1024_512": dict(model=dict(remat=True, xent_chunk_size=512, remat_save_names=SAVE_FLASH, flash_blocks=(1024, 512, 512, 512)), mb=4, gas=1),
+    # gas=2 with the fused bwd: the ~27ms fp32 Adam HBM pass amortizes
+    # over 2 micros (r3's gas2 lost to the fp32 accumulator's memory
+    # pressure under nothing_saveable; SAVE_FLASH changes the balance)
+    "selfg2": dict(model=dict(remat=True, xent_chunk_size=512, remat_save_names=SAVE_FLASH), mb=4, gas=2),
+    "selfg4": dict(model=dict(remat=True, xent_chunk_size=512, remat_save_names=SAVE_FLASH), mb=4, gas=4),
+    "x256": dict(model=dict(remat=True, xent_chunk_size=256, remat_save_names=SAVE_FLASH), mb=4, gas=1),
+    "x768": dict(model=dict(remat=True, xent_chunk_size=768, remat_save_names=SAVE_FLASH), mb=4, gas=1),
+    "x2048": dict(model=dict(remat=True, xent_chunk_size=2048, remat_save_names=SAVE_FLASH), mb=4, gas=1),
 }
 
 
