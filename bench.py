@@ -302,10 +302,19 @@ def bench_inference(model_name: str, quantize_bits: int, label: str):
     log(f"[{label}] short generate compiled")
     run(long_)  # compile long
     log(f"[{label}] long generate compiled")
-    t_s = min(run(short) for _ in range(2))
-    t_l = min(run(long_) for _ in range(2))
-    # marginal decode rate: the (t_l - t_s) window is pure decode
-    tok_s = B * (long_ - short) / (t_l - t_s)
+    t_s = min(run(short) for _ in range(3))
+    t_l = min(run(long_) for _ in range(3))
+    # marginal decode rate: the (t_l - t_s) window is pure decode.
+    # Tunnel/dispatch noise can exceed the window on a bad run and
+    # produce a negative or absurd rate — fail the rung rather than
+    # record garbage (the parent then marks it skipped with rc=1).
+    delta = t_l - t_s
+    if delta <= max(0.05 * t_l, 1e-3):
+        raise RuntimeError(
+            f"decode timing windows not separable: t_short={t_s:.2f}s "
+            f"t_long={t_l:.2f}s (noise >= decode delta)"
+        )
+    tok_s = B * (long_ - short) / delta
     log(f"[{label}] decode tokens/s={tok_s:,.0f} (B={B}, prompt={T}; t_short={t_s:.2f}s t_long={t_l:.2f}s)")
     return {
         "metric": f"{model_name.replace('-', '_')}_{label}_decode_tokens_per_sec",
@@ -424,7 +433,21 @@ def main():
             # skip marker is recorded only if nothing was salvaged (a
             # rung must not appear both skipped and measured)
             out = (e.stdout or b"").decode(errors="replace")
-            if not any(l.strip().startswith("{") for l in out.splitlines()):
+
+            def _is_record(l):
+                l = l.strip()
+                if not (l.startswith("{") and l.endswith("}")):
+                    return False
+                try:
+                    json.loads(l)
+                    return True
+                except json.JSONDecodeError:
+                    return False
+
+            # the salvage test must match the record-parse condition
+            # below — a child killed mid-print must still get its skip
+            # marker (a truncated line is not a salvaged record)
+            if not any(_is_record(l) for l in out.splitlines()):
                 extra.append({"metric": name, "skipped": True, "reason": f"timed out at {budget:.0f}s"})
                 flush_extra()
             proc = None

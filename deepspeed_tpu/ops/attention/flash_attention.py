@@ -72,10 +72,19 @@ def mha_reference(
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
-# Crossover measured on v5e (fwd+bwd, d=64, tokens held constant):
-# T=128 dense 2.31ms vs kernel 2.82ms; T=256 dense 2.97ms vs kernel
-# 2.64ms — below ~128x128 scores the kernel's grid overhead dominates
-# and a materializing bf16 path is faster (BERT seq128 shapes).
+# Crossover measured on v5e (fwd+bwd, d=64, tokens held constant).
+# r3 (two-pass bwd): T=128 dense 2.31ms vs kernel 2.82ms; T=256 dense
+# 2.97ms vs kernel 2.64ms.  r4 re-measured with the fused single-pass
+# backward: T=128 dense 2.13ms vs kernel 3.69ms (1.73x), T=256 ~parity.
+# The bound is structural, not a missing optimization: at T=128 the
+# grid runs one program per (batch·head) — B=64·H=16 ⇒ 1024 programs of
+# a single 128-row block, so the per-program fixed cost (DMA prologue,
+# pipeline fill) dominates a compute body that the dense path executes
+# as a handful of large fused MXU launches with identical exp counts;
+# shrinking blocks can't help (128 is the minimum useful q-block) and
+# the O(T²) memory the kernel exists to avoid is only ~64MB here.
+# Below ~128x128 scores the materializing bf16 path is simply the
+# right program shape (BERT seq128 — the reference's own record shape).
 SMALL_SEQ_DENSE_SCORES = 128 * 128
 
 
@@ -668,35 +677,11 @@ def _flash_bwd_fused_pallas(
     accumulated in fp32 and cast at the end."""
     from jax.experimental.pallas import tpu as pltpu
 
-    b, h, sq, d = q.shape
-    sk = k.shape[2]
-    bh = b * h
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
-    qr, kr, vr = (t.reshape(bh, t.shape[2], d) for t in (q, k, v))
-    dor = g.reshape(bh, sq, d)
-    lser = jnp.broadcast_to(lse.reshape(bh, 1, sq), (bh, 8, sq))
-    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
-    delta = jnp.broadcast_to(delta.reshape(bh, 1, sq), (bh, 8, sq))
-    mode, bias2 = _bias_mode(bias, b, h, sq, sk)
-    flags = dict(
-        kbias=(mode == "kbias"), fbias=(mode == "fbias"), keep_prob=keep_prob,
-        kdrop=(drop_seed is not None),
+    b, h, sq, sk, bh, block_q, block_k, qr, kr, vr, dor, lser, delta, mode, bias2, flags = (
+        _bwd_prologue(q, k, v, out, lse, g, bias, block_q, block_k, keep_prob, drop_seed)
     )
-
-    extra_specs, extra_args = [], []
-    if mode == "kbias":
-        extra_specs.append(pl.BlockSpec((1, 1, block_k), lambda bh_, ki, h=h: (bh_ // h, 0, ki)))
-        extra_args.append(bias2)
-    elif mode == "fbias":
-        extra_specs.append(pl.BlockSpec((1, sq, block_k), lambda bh_, ki: (bh_, 0, ki)))
-        extra_args.append(bias2)
-    if mask is not None:
-        extra_specs.append(pl.BlockSpec((1, sq, block_k), lambda bh_, ki: (bh_, 0, ki)))
-        extra_args.append(mask)
-    elif drop_seed is not None:
-        extra_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
-        extra_args.append(drop_seed)
+    d = q.shape[3]
+    extra_specs, extra_args = _kv_grid_extra_specs(mode, bias2, mask, h, sq, block_k, drop_seed)
 
     dq32, dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_fused_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q, **flags),
@@ -739,10 +724,10 @@ def _flash_bwd_fused_pallas(
 _FUSED_BWD_MAX_SQ_BYTES = 1 << 21  # sq * d * 4 (fp32 dq) per program
 
 
-def _flash_bwd_pallas(
-    q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret,
-    bias=None, mask=None, keep_prob: float = 1.0, drop_seed=None,
-):
+def _bwd_prologue(q, k, v, out, lse, g, bias, block_q, block_k, keep_prob, drop_seed):
+    """Shared backward-pass setup: (bh, seq, d) reshapes, 8-sublane
+    lse/delta broadcasts (TPU block constraint: last two dims must be
+    8/128-aligned or full), bias classification, kernel flags."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bh = b * h
@@ -750,8 +735,6 @@ def _flash_bwd_pallas(
     block_k = min(block_k, sk)
     qr, kr, vr = (t.reshape(bh, t.shape[2], d) for t in (q, k, v))
     dor = g.reshape(bh, sq, d)
-    # 8-sublane broadcast layout (TPU block constraint: last two dims
-    # must be 8/128-aligned or full)
     lser = jnp.broadcast_to(lse.reshape(bh, 1, sq), (bh, 8, sq))
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta.reshape(bh, 1, sq), (bh, 8, sq))
@@ -760,6 +743,38 @@ def _flash_bwd_pallas(
         kbias=(mode == "kbias"), fbias=(mode == "fbias"), keep_prob=keep_prob,
         kdrop=(drop_seed is not None),
     )
+    return b, h, sq, sk, bh, block_q, block_k, qr, kr, vr, dor, lser, delta, mode, bias2, flags
+
+
+def _kv_grid_extra_specs(mode, bias2, mask, h, sq, block_k, drop_seed):
+    """in_specs + arrays for the optional bias/mask/seed inputs of the
+    kv-gridded backward kernels (dkv pass + fused single-pass)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    specs, args = [], []
+    if mode == "kbias":
+        specs.append(pl.BlockSpec((1, 1, block_k), lambda bh_, ki, h=h: (bh_ // h, 0, ki)))
+        args.append(bias2)
+    elif mode == "fbias":
+        specs.append(pl.BlockSpec((1, sq, block_k), lambda bh_, ki: (bh_, 0, ki)))
+        args.append(bias2)
+    if mask is not None:
+        specs.append(pl.BlockSpec((1, sq, block_k), lambda bh_, ki: (bh_, 0, ki)))
+        args.append(mask)
+    elif drop_seed is not None:
+        specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(drop_seed)
+    return specs, args
+
+
+def _flash_bwd_pallas(
+    q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret,
+    bias=None, mask=None, keep_prob: float = 1.0, drop_seed=None,
+):
+    b, h, sq, sk, bh, block_q, block_k, qr, kr, vr, dor, lser, delta, mode, bias2, flags = (
+        _bwd_prologue(q, k, v, out, lse, g, bias, block_q, block_k, keep_prob, drop_seed)
+    )
+    d = q.shape[3]
 
     dq_extra_specs, dq_extra_args = _fwd_extra_specs(mode, bias2, mask, b, h, sq, sk, block_q, drop_seed)
     dq = pl.pallas_call(
@@ -779,21 +794,7 @@ def _flash_bwd_pallas(
     )(qr, kr, vr, dor, lser, delta, *dq_extra_args)
 
     # kv-blocked layouts for the dk/dv pass
-    kv_extra_specs, kv_extra_args = [], []
-    if mode == "kbias":
-        kv_extra_specs.append(pl.BlockSpec((1, 1, block_k), lambda bh_, ki, h=h: (bh_ // h, 0, ki)))
-        kv_extra_args.append(bias2)
-    elif mode == "fbias":
-        kv_extra_specs.append(pl.BlockSpec((1, sq, block_k), lambda bh_, ki: (bh_, 0, ki)))
-        kv_extra_args.append(bias2)
-    if mask is not None:
-        kv_extra_specs.append(pl.BlockSpec((1, sq, block_k), lambda bh_, ki: (bh_, 0, ki)))
-        kv_extra_args.append(mask)
-    elif drop_seed is not None:
-        from jax.experimental.pallas import tpu as pltpu
-
-        kv_extra_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
-        kv_extra_args.append(drop_seed)
+    kv_extra_specs, kv_extra_args = _kv_grid_extra_specs(mode, bias2, mask, h, sq, block_k, drop_seed)
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q, **flags),
         grid=(bh, sk // block_k),

@@ -333,8 +333,15 @@ class ZeroInfinityEngine:
                 return
 
     def _upload_resident(self) -> Any:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        # explicit replicated sharding: under multi-process execution
+        # every host holds identical resident params and device_put
+        # places each process's addressable shards (a bare device_put
+        # would commit to one local device and break the global mesh)
         return jax.device_put(
-            jax.tree.map(lambda a: jnp.asarray(a, self.compute_dtype), self._resident_host)
+            jax.tree.map(lambda a: jnp.asarray(a, self.compute_dtype), self._resident_host),
+            NamedSharding(self.mesh, P()),
         )
 
     # ------------------------------------------------------------------
@@ -569,7 +576,26 @@ class ZeroInfinityEngine:
         tag = tag or f"global_step{self.global_steps}"
         path = os.path.join(os.path.abspath(save_dir), str(tag))
         os.makedirs(path, exist_ok=True)
-        self._host_opt.save(os.path.join(path, "host_optimizer_rank0.npz"))
+        # every process holds identical masters (grads are psum'd
+        # replicated before the host step); each writes its OWN file —
+        # works on per-host local disks (no shared-FS assumption) and
+        # never races on one filename.  A barrier keeps rank 0's
+        # latest-tag write from outrunning slower writers.
+        self._host_opt.save(
+            os.path.join(path, f"host_optimizer_rank{jax.process_index()}.npz")
+        )
+        def _barrier(name):
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+
+                multihost_utils.sync_global_devices(name)
+
+        _barrier("zero_infinity_ckpt_opt_files")
+        if jax.process_index() != 0:
+            # rank 0 writes meta + the latest tag after all opt files
+            # are durable; everyone leaves only once those exist
+            _barrier("zero_infinity_ckpt_meta")
+            return path
         meta = {
             "tag": str(tag), "global_step": self.global_steps,
             "skipped_steps": self.skipped_steps, "client_state": client_state or {},
@@ -580,6 +606,7 @@ class ZeroInfinityEngine:
         if save_latest:
             with open(os.path.join(os.path.abspath(save_dir), "latest"), "w") as f:
                 f.write(str(tag))
+        _barrier("zero_infinity_ckpt_meta")
         log_dist(f"saved ZeRO-Infinity checkpoint {path}")
         return path
 
@@ -592,7 +619,11 @@ class ZeroInfinityEngine:
             with open(latest) as f:
                 tag = f.read().strip()
         path = os.path.join(load_dir, str(tag))
-        opt_path = os.path.join(path, "host_optimizer_rank0.npz")
+        # prefer this process's own file (per-host local disks); the
+        # rank-0 file is equivalent on a shared filesystem
+        opt_path = os.path.join(path, f"host_optimizer_rank{jax.process_index()}.npz")
+        if not os.path.exists(opt_path):
+            opt_path = os.path.join(path, "host_optimizer_rank0.npz")
         if not os.path.exists(opt_path):
             logger.warning(f"ZeRO-Infinity checkpoint {path} not found")
             return None, {}

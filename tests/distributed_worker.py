@@ -23,7 +23,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", required=True)
-    ap.add_argument("--mode", default="dp", choices=["dp", "offload"])
+    ap.add_argument("--mode", default="dp", choices=["dp", "offload", "streaming"])
     ap.add_argument("--local_devices", type=int, default=4)
     ap.add_argument("--steps", type=int, default=3)
     a = ap.parse_args()
@@ -42,17 +42,54 @@ def main():
     from tests.simple_model import base_config, random_batches, simple_model_init, simple_model_loss
 
     total = a.local_devices * int(os.environ.get("WORLD_SIZE", "1"))
-    cfg = base_config(stage=2 if a.mode == "offload" else 0, mesh={"data": total}, gas=1)
-    if a.mode == "offload":
-        cfg["zero_optimization"]["offload_optimizer"] = {"device": "cpu"}
-    engine, _, _, _ = deepspeed_tpu.initialize(
-        model=simple_model_loss, model_parameters=simple_model_init(64), config=cfg
-    )
-    assert jax.device_count() == total, (jax.device_count(), total)
+    if a.mode == "streaming":
+        # ZeRO-Infinity streaming executor across REAL processes:
+        # every rank feeds the same global batch, group programs psum
+        # grads over the global data axis, every host steps identical
+        # masters (reference multi-node ZeRO-Offload semantics)
+        import dataclasses
 
-    bs = engine.train_micro_batch_size_per_gpu * engine.mesh_info.dp_world_size
-    batches = random_batches(a.steps, bs, 64, seed=0)  # identical on every rank
-    losses = [float(engine.train_batch(b)) for b in batches]
+        from deepspeed_tpu.models import gpt2
+        from deepspeed_tpu.runtime.zero.param_offload import ZeroInfinityEngine
+
+        mcfg = dataclasses.replace(
+            gpt2.GPT2_TINY, n_layer=4, vocab_size=256, n_positions=64,
+            remat=True, use_flash_attention=False,
+        )
+        model_fn, init_fn, tp_fn = gpt2.make_model(mcfg)
+        cfg = {
+            "train_micro_batch_size_per_gpu": 1,
+            "gradient_accumulation_steps": 1,
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 3, "offload_param": {"device": "cpu", "buffer_count": 2}},
+            "mesh": {"data": total},
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "steps_per_print": 10_000,
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model_fn, model_parameters=init_fn(seed=0), config=cfg, tp_spec_fn=tp_fn
+        )
+        assert isinstance(engine, ZeroInfinityEngine), type(engine)
+        assert jax.device_count() == total, (jax.device_count(), total)
+        rng = np.random.default_rng(0)
+        losses = [
+            float(engine.train_batch(
+                {"input_ids": rng.integers(0, mcfg.vocab_size, (total, 48), dtype=np.int32)}
+            ))
+            for _ in range(a.steps)
+        ]
+    else:
+        cfg = base_config(stage=2 if a.mode == "offload" else 0, mesh={"data": total}, gas=1)
+        if a.mode == "offload":
+            cfg["zero_optimization"]["offload_optimizer"] = {"device": "cpu"}
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=simple_model_loss, model_parameters=simple_model_init(64), config=cfg
+        )
+        assert jax.device_count() == total, (jax.device_count(), total)
+
+        bs = engine.train_micro_batch_size_per_gpu * engine.mesh_info.dp_world_size
+        batches = random_batches(a.steps, bs, 64, seed=0)  # identical on every rank
+        losses = [float(engine.train_batch(b)) for b in batches]
 
     rank = jax.process_index()
     os.makedirs(a.out, exist_ok=True)
