@@ -49,6 +49,21 @@ def remaining() -> float:
     return DEADLINE_S - (time.time() - START)
 
 
+def append_capability_record(rec: dict) -> None:
+    """Dedup-append one record (by metric name) to BENCH_CAPABILITY.json
+    — the shared writer for capability tools (train_xl_onchip,
+    bench_neo27_decode); bench.py's own rungs use BENCH_EXTRA.json,
+    which every run clears."""
+    cap_path = os.path.join(HERE, "BENCH_CAPABILITY.json")
+    recs = []
+    if os.path.exists(cap_path):
+        with open(cap_path) as f:
+            recs = [r for r in json.load(f) if r.get("metric") != rec["metric"]]
+    recs.append(rec)
+    with open(cap_path, "w") as f:
+        json.dump(recs, f, indent=1)
+
+
 def peak_flops_per_chip(backend: str) -> float:
     """bf16 peak. v5e: 197 TFLOP/s. CPU fallback: nominal 1e12 so the
     script still reports a number in dev environments."""

@@ -106,6 +106,11 @@ GPT2_MEDIUM = GPT2Config(n_embd=1024, n_layer=24, n_head=16)  # 350M
 GPT2_LARGE = GPT2Config(n_embd=1280, n_layer=36, n_head=20)  # 774M
 GPT2_XL = GPT2Config(n_embd=1600, n_layer=48, n_head=25)  # 1.5B
 
+# GPT-Neo-2.7B dims (BASELINE ladder's inference rung; HF weights map
+# through HFGPTNEOLayerPolicy — this preset serves the random-init
+# serving/throughput path at the same scale)
+GPT_NEO_27B = GPT2Config(n_positions=2048, n_embd=2560, n_layer=32, n_head=20)
+
 PRESETS = {
     "tiny": GPT2_TINY,
     "gpt2": GPT2_SMALL,
@@ -114,6 +119,8 @@ PRESETS = {
     "gpt2-large": GPT2_LARGE,
     "gpt2-xl": GPT2_XL,
     "gpt2-1.5b": GPT2_XL,
+    "gpt-neo-2.7b": GPT_NEO_27B,
+    "gpt-neo": GPT_NEO_27B,
 }
 
 

@@ -89,14 +89,9 @@ def main():
     print("RESULT " + json.dumps(rec), flush=True)
     # capability records live in their own file — bench.py clears
     # BENCH_EXTRA.json at the start of every run
-    cap_path = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "BENCH_CAPABILITY.json")
-    recs = []
-    if os.path.exists(cap_path):
-        with open(cap_path) as f:
-            recs = [r for r in json.load(f) if r.get("metric") != rec["metric"]]
-    recs.append(rec)
-    with open(cap_path, "w") as f:
-        json.dump(recs, f, indent=1)
+    import bench
+
+    bench.append_capability_record(rec)
 
 
 if __name__ == "__main__":
