@@ -352,7 +352,13 @@ def run_rung(name: str):
     on_tpu = backend in ("tpu", "axon")
     log(f"rung={name} backend={backend} devices={jax.device_count()}")
 
-    records = []
+    def emit(rec):
+        """Print the record the moment it is measured — the parent's
+        timeout salvage reads partial child stdout, so buffering until
+        rung end would lose completed measurements on a cap kill."""
+        rec.setdefault("backend", backend)
+        print(json.dumps(rec), flush=True)
+
     if name == "headline":
         if on_tpu:
             # 124M fits without activation recompute at this batch — remat
@@ -360,13 +366,17 @@ def run_rung(name: str):
             # layer-loop unroll kills the scan's dynamic-slice/copy
             # bookkeeping (~50ms/step) at the cost of a longer compile
             cfg = dataclasses.replace(gpt2.GPT2_SMALL, remat=False, scan_unroll=gpt2.GPT2_SMALL.n_layer)
-            records.append(bench_model(cfg, micro_bs=8, gas=4, seq=1024, steps=8, zero_stage=0, label="124M"))
+            emit(bench_model(cfg, micro_bs=8, gas=4, seq=1024, steps=8, zero_stage=0, label="124M"))
         else:
-            records.append(bench_model(gpt2.GPT2_TINY, micro_bs=2, gas=1, seq=128, steps=3, zero_stage=0, label="tiny"))
+            emit(bench_model(gpt2.GPT2_TINY, micro_bs=2, gas=1, seq=128, steps=3, zero_stage=0, label="tiny"))
     elif name == "decode-bf16":
-        records.append(bench_inference("gpt2-xl" if on_tpu else "tiny", 0, "bf16"))
+        emit(bench_inference("gpt2-xl" if on_tpu else "tiny", 0, "bf16"))
     elif name == "decode-int8":
-        records.append(bench_inference("gpt2-xl" if on_tpu else "tiny", 8, "int8"))
+        emit(bench_inference("gpt2-xl" if on_tpu else "tiny", 8, "int8"))
+    elif name == "neo-bf16":
+        emit(bench_inference("gpt-neo-2.7b" if on_tpu else "tiny", 0, "bf16"))
+    elif name == "neo-int8":
+        emit(bench_inference("gpt-neo-2.7b" if on_tpu else "tiny", 8, "int8"))
     elif name == "774M-zero3":
         # Big-model rung: 774M with full on-device fp32 Adam state
         # (params 3.1G + m/v 6.2G ≈ 9.3G at gas==1), round-4 MFU
@@ -377,21 +387,18 @@ def run_rung(name: str):
         )
         mb, sq, st = (4, 1024, 6) if on_tpu else (2, 128, 3)
         r = bench_model(big, micro_bs=mb, gas=1, seq=sq, steps=st, zero_stage=3, label="774M-zero3")
-        records.append(r)
+        emit(r)
         try:
             # derived metric must never cost the measured primary rung
-            records.append(zero3_comm_record(big, r, gas=1))
+            emit(zero3_comm_record(big, r, gas=1))
         except Exception as e:  # noqa: BLE001
             log(f"[zero3-comm] FAILED: {str(e)[:200]}")
     elif name == "bert-s128":
-        records.append(bench_bert(seq=128, micro_bs=64 if on_tpu else 2, gas=1, steps=6 if on_tpu else 3))
+        emit(bench_bert(seq=128, micro_bs=64 if on_tpu else 2, gas=1, steps=6 if on_tpu else 3))
     elif name == "bert-s512":
-        records.append(bench_bert(seq=512, micro_bs=16 if on_tpu else 2, gas=1, steps=6 if on_tpu else 3))
+        emit(bench_bert(seq=512, micro_bs=16 if on_tpu else 2, gas=1, steps=6 if on_tpu else 3))
     else:
         raise SystemExit(f"unknown rung '{name}'")
-
-    for rec in records:
-        print(json.dumps(rec), flush=True)
 
 
 # ---------------------------------------------------------------------------
@@ -408,7 +415,62 @@ RUNGS = [
     ("774M-zero3", 300, 540),
     ("bert-s128", 180, 360),
     ("bert-s512", 240, 420),
+    # 2.7B-class serving (BASELINE ladder's final rung) — runs last so
+    # the core rungs can never be starved by it; warm-cache cost ~100s
+    # each (measured r4: full 7-rung suite finished in 338s of 1620)
+    ("neo-bf16", 150, 360),
+    ("neo-int8", 150, 360),
 ]
+
+# Plausibility floors for each rung's PRIMARY record on REAL TPU —
+# 2-5x below the measured r4 values, so they only trip on catastrophic
+# stalls (the shared dev tunnel was observed delivering a ~20x-slow
+# rung while neighboring rungs ran at full speed).  A sub-floor rung
+# is retried ONCE if the budget allows and the better run is kept.
+# CPU dev runs (BENCH_FORCE_CPU=1) skip the floors.
+RUNG_FLOORS = {
+    "headline": 40_000,      # tokens/s/chip (normal ~120k)
+    "decode-bf16": 200,      # tokens/s (normal ~1000)
+    "decode-int8": 200,      # tokens/s (normal ~1400)
+    "774M-zero3": 6_000,     # tokens/s/chip (normal ~17.7k)
+    "bert-s128": 100,        # samples/s (normal ~390)
+    "bert-s512": 20,         # samples/s (normal ~78)
+    "neo-bf16": 200,         # tokens/s (normal ~930)
+    "neo-int8": 200,         # tokens/s (normal ~1450)
+}
+
+
+def _parse_records(out: str):
+    recs = []
+    for line in out.splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and line.endswith("}")):
+            continue
+        try:
+            recs.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue
+    return recs
+
+
+def _run_child(name: str, budget: float):
+    """Run one rung child; returns (records, failure_reason|None)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--rung", name],
+            stdout=subprocess.PIPE, timeout=budget, cwd=HERE,
+        )
+    except subprocess.TimeoutExpired as e:
+        log(f"[{name}] TIMED OUT at {budget:.0f}s — killed")
+        # salvage complete records the child printed before the cap
+        recs = _parse_records((e.stdout or b"").decode(errors="replace"))
+        return recs, None if recs else f"timed out at {budget:.0f}s"
+    out = proc.stdout.decode(errors="replace")
+    recs = _parse_records(out)
+    if proc.returncode != 0:
+        log(f"[{name}] FAILED rc={proc.returncode}")
+        return recs, None if recs else f"child rc={proc.returncode}"
+    return recs, None
 
 
 def main():
@@ -422,8 +484,10 @@ def main():
 
     headline_printed = False
     skip_big = os.environ.get("BENCH_SKIP_BIG") == "1"
+    retries_used = 0
 
-    for name, est, cap in RUNGS:
+    for i, (name, est, cap) in enumerate(RUNGS):
+        rest_est = sum(e for _, e, _ in RUNGS[i + 1:])
         if name != "headline" and skip_big:
             continue
         # the rung must fit inside its own kill cap: launching when
@@ -437,49 +501,32 @@ def main():
             continue
         budget = min(cap, remaining() - 45)
         log(f"[{name}] launching (cap {budget:.0f}s, {remaining():.0f}s left)")
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--rung", name],
-                stdout=subprocess.PIPE, timeout=budget, cwd=HERE,
-            )
-        except subprocess.TimeoutExpired as e:
-            log(f"[{name}] TIMED OUT at {budget:.0f}s — killed")
-            # salvage any records the child printed before the cap; the
-            # skip marker is recorded only if nothing was salvaged (a
-            # rung must not appear both skipped and measured)
-            out = (e.stdout or b"").decode(errors="replace")
+        records, fail_reason = _run_child(name, budget)
 
-            def _is_record(l):
-                l = l.strip()
-                if not (l.startswith("{") and l.endswith("}")):
-                    return False
-                try:
-                    json.loads(l)
-                    return True
-                except json.JSONDecodeError:
-                    return False
+        # floors apply only to REAL TPU measurements — the child stamps
+        # every record with the backend it actually ran on (a dev box
+        # without the tunnel falls back to tiny CPU models whose values
+        # sit far below the TPU floors)
+        on_real_tpu = bool(records) and records[0].get("backend") in ("tpu", "axon")
+        floor = RUNG_FLOORS.get(name) if on_real_tpu else None
+        primary = records[0].get("value") if records else None
+        if (
+            floor is not None and primary is not None and primary < floor
+            and retries_used < 2  # a persistent stall must not turn every rung into two
+            and remaining() - 45 - est >= rest_est  # never starve the ladder behind
+        ):
+            # implausibly slow (shared-tunnel stall) — retry, keep the
+            # better run
+            retries_used += 1
+            log(f"[{name}] value {primary} below plausibility floor {floor} — retrying once")
+            records2, _ = _run_child(name, min(cap, remaining() - 45 - rest_est))
+            if records2 and records2[0].get("value", 0) > primary:
+                records = records2
 
-            # the salvage test must match the record-parse condition
-            # below — a child killed mid-print must still get its skip
-            # marker (a truncated line is not a salvaged record)
-            if not any(_is_record(l) for l in out.splitlines()):
-                extra.append({"metric": name, "skipped": True, "reason": f"timed out at {budget:.0f}s"})
-                flush_extra()
-            proc = None
-        else:
-            out = proc.stdout.decode(errors="replace")
-            if proc.returncode != 0:
-                log(f"[{name}] FAILED rc={proc.returncode}")
-                extra.append({"metric": name, "skipped": True, "reason": f"child rc={proc.returncode}"})
-                flush_extra()
-        for line in out.splitlines():
-            line = line.strip()
-            if not (line.startswith("{") and line.endswith("}")):
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                continue
+        if fail_reason is not None and not records:
+            extra.append({"metric": name, "skipped": True, "reason": fail_reason})
+            flush_extra()
+        for rec in records:
             if name == "headline" and not headline_printed and "vs_baseline" in rec:
                 # the driver records this line — print it the moment the
                 # headline rung lands so nothing later can lose it
