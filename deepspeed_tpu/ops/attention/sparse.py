@@ -503,11 +503,15 @@ def _masked_softmax(s):
 
 
 def _splash_kernel(
-    idx_ref, valid_ref, q_ref, kv_ref, vv_ref, o_ref,
-    *, sm_scale: float, causal: bool, block: int, deg: int, heads: int, group: int,
+    idx_ref, valid_ref, q_ref, kv_ref, vv_ref, o_ref, *rest,
+    sm_scale: float, causal: bool, block: int, deg: int, heads: int, group: int,
 ):
     # each program handles `group` consecutive q-rows — grid-step launch
-    # overhead dominates at long sequences, so amortize it
+    # overhead dominates at long sequences, so amortize it.
+    # Optional trailing output: per-row logsumexp (8-sublane broadcast
+    # layout) saved for the backward, which then never recomputes the
+    # online-softmax stats (the flash kernels' attn_lse pattern).
+    lse_ref = rest[0] if rest else None
     h = pl.program_id(0) % heads
     g0 = pl.program_id(1)
     hd = q_ref.shape[-1]
@@ -549,6 +553,14 @@ def _splash_kernel(
         acc, m, l = jax.lax.fori_loop(0, deg, body, init)
         safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, pl.dslice(gi * block, block), :] = (acc / safe).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # +inf for zero-degree rows ⇒ bwd's exp(s − lse) is exactly 0
+            lse = jnp.where(
+                l[:, 0] == 0.0, jnp.inf, m[:, 0] + jnp.log(jnp.maximum(l[:, 0], 1e-37))
+            )
+            lse_ref[0, :, pl.dslice(gi * block, block)] = jnp.broadcast_to(
+                lse[None, :], (8, block)
+            )
         return 0
 
     jax.lax.fori_loop(0, group, one_row, 0)
@@ -591,45 +603,54 @@ def _splash_prep(q, k, v, layout: np.ndarray, block: int, vmem_bufs: int = 2):
     return qr, kg, vg, idx, idx2, valid2, deg, group, nb, drows_np, dvalid_np
 
 
-def _splash_fwd(q, k, v, layout: np.ndarray, block: int, causal: bool, sm_scale: float, interpret: bool):
+def _splash_fwd(q, k, v, layout: np.ndarray, block: int, causal: bool, sm_scale: float, interpret: bool, want_lse: bool = False):
     from jax.experimental.pallas import tpu as pltpu
 
     B, H, T, hd = q.shape
     qr, kg, vg, _idx, idx2, valid2, deg, group, nb, _dr, _dv = _splash_prep(q, k, v, layout, block)
 
     strip_spec = pl.BlockSpec((1, 1, group * deg * block, hd), lambda b, r, idx, valid: (b, r, 0, 0))
+    row_spec = pl.BlockSpec((1, group * block, hd), lambda b, r, idx, valid: (b, r, 0))
+    out_specs = [row_spec]
+    out_shape = [jax.ShapeDtypeStruct((B * H, T, hd), q.dtype)]
+    if want_lse:
+        out_specs.append(pl.BlockSpec((1, 8, group * block), lambda b, r, idx, valid: (b, 0, r)))
+        out_shape.append(jax.ShapeDtypeStruct((B * H, 8, T), jnp.float32))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B * H, nb // group),
-        in_specs=[
-            pl.BlockSpec((1, group * block, hd), lambda b, r, idx, valid: (b, r, 0)),
-            strip_spec,
-            strip_spec,
-        ],
-        out_specs=pl.BlockSpec((1, group * block, hd), lambda b, r, idx, valid: (b, r, 0)),
+        in_specs=[row_spec, strip_spec, strip_spec],
+        out_specs=out_specs,
         scratch_shapes=[],
     )
     kern = functools.partial(
         _splash_kernel, sm_scale=sm_scale, causal=causal, block=block, deg=deg, heads=H, group=group
     )
-    out = pl.pallas_call(
+    outs = pl.pallas_call(
         kern,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B * H, T, hd), q.dtype),
+        out_shape=out_shape,
         interpret=interpret,
     )(idx2, valid2, qr, kg, vg)
-    return out.reshape(B, H, T, hd)
+    if want_lse:
+        out, lse = outs
+        return out.reshape(B, H, T, hd), lse[:, 0, :].reshape(B, H, T)
+    return outs[0].reshape(B, H, T, hd)
 
 
 def _splash_bwd_kernel(
-    idx_ref, valid_ref, q_ref, kv_ref, vv_ref, o_ref, g_ref, dq_ref, dk_ref, dv_ref,
+    idx_ref, valid_ref, q_ref, kv_ref, vv_ref, lse_ref, g_ref, dq_ref, dk_ref, dv_ref,
     *, sm_scale: float, causal: bool, block: int, deg: int, heads: int, group: int,
 ):
-    """FA-2-style backward over the gathered strips, one program per
-    (batch·head, q-row-group).  Two passes per row: (1) online m/l from
-    the qk dots alone; (2) exact p → dp → ds, accumulating dq and
-    writing per-edge dk/dv into STRIP-layout outputs (scattered back to
-    blocks with a segment-sum outside the kernel)."""
+    """Single-pass backward over the gathered strips, one program per
+    (batch·head, q-row-group): P = exp(S − lse) rebuilds from the
+    forward's SAVED logsumexp (the r3 version recomputed the online
+    m/l stats in a first pass — one extra qk dot + exp per score, pure
+    waste once the fwd emits lse; same design as the flash fused
+    backward), then p → dp → ds accumulates dq and writes per-edge
+    dk/dv into STRIP-layout outputs (scattered back to blocks with a
+    segment-sum outside the kernel).  ``delta`` comes in precomputed
+    through the lse row buffer's sibling (see _splash_bwd)."""
     h = pl.program_id(0) % heads
     g0 = pl.program_id(1)
     hd = q_ref.shape[-1]
@@ -637,12 +658,13 @@ def _splash_bwd_kernel(
     def one_row(gi, _):
         row_idx = g0 * group + gi
         q = q_ref[0, pl.dslice(gi * block, block), :]
-        o = o_ref[0, pl.dslice(gi * block, block), :]
         g = g_ref[0, pl.dslice(gi * block, block), :]
-        delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=1, keepdims=True)
+        lse = lse_ref[0, 0, pl.dslice(gi * block, block)][:, None]
+        delta = lse_ref[0, 1, pl.dslice(gi * block, block)][:, None]
 
-        def masked_scores(e):
+        def body(e, dq):
             k = kv_ref[0, 0, pl.dslice(gi * deg * block + e * block, block), :]
+            v = vv_ref[0, 0, pl.dslice(gi * deg * block + e * block, block), :]
             s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
             ki = idx_ref[h, row_idx * deg + e]
             ok = valid_ref[h, row_idx * deg + e] == 1
@@ -652,28 +674,9 @@ def _splash_bwd_kernel(
                 keep = jnp.logical_and(ok, q_pos >= k_pos)
             else:
                 keep = jnp.broadcast_to(ok, (block, block))
-            return jnp.where(keep, s, DEFAULT_MASK_VALUE), keep, k
-
-        def pass1(e, carry):
-            m_prev, l_prev = carry
-            s, keep, _ = masked_scores(e)
-            m_cur = jnp.max(s, axis=1, keepdims=True)
-            m_new = jnp.maximum(m_prev, m_cur)
-            p = jnp.exp(s - m_new) * keep.astype(jnp.float32)
-            l_new = jnp.exp(m_prev - m_new) * l_prev + jnp.sum(p, axis=1, keepdims=True)
-            return m_new, l_new
-
-        m, l = jax.lax.fori_loop(
-            0, deg, pass1,
-            (jnp.full((block, 1), -jnp.inf, jnp.float32), jnp.zeros((block, 1), jnp.float32)),
-        )
-        # zero-degree rows: p must be exactly 0 (out was 0, grads are 0)
-        inv_l = jnp.where(l > 0.0, 1.0 / jnp.maximum(l, 1e-30), 0.0)
-
-        def pass2(e, dq):
-            s, keep, k = masked_scores(e)
-            v = vv_ref[0, 0, pl.dslice(gi * deg * block + e * block, block), :]
-            p = jnp.exp(s - m) * keep.astype(jnp.float32) * inv_l  # (block, block)
+            s = jnp.where(keep, s, DEFAULT_MASK_VALUE)
+            # saved lse is +inf for zero-degree rows ⇒ p exactly 0
+            p = jnp.exp(s - lse) * keep.astype(jnp.float32)
             dp = jnp.dot(g, v.T, preferred_element_type=jnp.float32)
             ds = p * (dp - delta) * sm_scale
             dq = dq + jnp.dot(ds.astype(k.dtype), k, preferred_element_type=jnp.float32)
@@ -686,29 +689,37 @@ def _splash_bwd_kernel(
             ).astype(dv_ref.dtype)
             return dq
 
-        dq = jax.lax.fori_loop(0, deg, pass2, jnp.zeros((block, hd), jnp.float32))
+        dq = jax.lax.fori_loop(0, deg, body, jnp.zeros((block, hd), jnp.float32))
         dq_ref[0, pl.dslice(gi * block, block), :] = dq.astype(dq_ref.dtype)
         return 0
 
     jax.lax.fori_loop(0, group, one_row, 0)
 
 
-def _splash_bwd(q, k, v, out, g, layout: np.ndarray, block: int, causal: bool, sm_scale: float, interpret: bool):
+def _splash_bwd(q, k, v, out, lse, g, layout: np.ndarray, block: int, causal: bool, sm_scale: float, interpret: bool):
     from jax.experimental.pallas import tpu as pltpu
 
     B, H, T, hd = q.shape
     qr, kg, vg, idx, idx2, valid2, deg, group, nb, _dr, _dv = _splash_prep(
         q, k, v, layout, block, vmem_bufs=4
     )
-    orr = out.reshape(B * H, T, hd)
     gr = g.reshape(B * H, T, hd)
+    # per-row scalars ride ONE (bh, 8, T) buffer: sublane 0 = the fwd's
+    # saved lse, sublane 1 = delta = rowsum(dO ∘ O) (computed here in
+    # XLA — one fused elementwise pass)
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1).reshape(B * H, T)
+    rows = jnp.concatenate(
+        [lse.reshape(B * H, 1, T), delta[:, None, :], jnp.zeros((B * H, 6, T), jnp.float32)],
+        axis=1,
+    )
 
     strip_spec = pl.BlockSpec((1, 1, group * deg * block, hd), lambda b, r, idx, valid: (b, r, 0, 0))
     row_spec = pl.BlockSpec((1, group * block, hd), lambda b, r, idx, valid: (b, r, 0))
+    lse_spec = pl.BlockSpec((1, 8, group * block), lambda b, r, idx, valid: (b, 0, r))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B * H, nb // group),
-        in_specs=[row_spec, strip_spec, strip_spec, row_spec, row_spec],
+        in_specs=[row_spec, strip_spec, strip_spec, lse_spec, row_spec],
         out_specs=[row_spec, strip_spec, strip_spec],
         scratch_shapes=[],
     )
@@ -724,7 +735,7 @@ def _splash_bwd(q, k, v, out, g, layout: np.ndarray, block: int, causal: bool, s
             jax.ShapeDtypeStruct((B * H, nb // group, group * deg * block, hd), v.dtype),
         ],
         interpret=interpret,
-    )(idx2, valid2, qr, kg, vg, orr, gr)
+    )(idx2, valid2, qr, kg, vg, rows, gr)
 
     # scatter-add the strip grads back to K/V blocks: segment-sum over
     # each head's (row, edge) -> k-block index map (the transpose of the
@@ -780,17 +791,26 @@ def _splash_attention(q, k, v, layout_key, block, causal, sm_scale, interpret):
 
 
 def _splash_fwd_rule(q, k, v, layout_key, block, causal, sm_scale, interpret):
-    out = _splash_attention(q, k, v, layout_key, block, causal, sm_scale, interpret)
-    return out, (q, k, v, out)
+    from jax.ad_checkpoint import checkpoint_name
+
+    out, lse = _splash_fwd(
+        q, k, v, layout_key.layout, block, causal, sm_scale, interpret, want_lse=True
+    )
+    # same residual names as the flash kernels: a remat policy saving
+    # attn_o/attn_lse keeps these, so the backward never re-runs the
+    # forward kernel under selective checkpointing either
+    out = checkpoint_name(out, "attn_o")
+    lse = checkpoint_name(lse, "attn_lse")
+    return out, (q, k, v, out, lse)
 
 
 def _splash_bwd_rule(layout_key, block, causal, sm_scale, interpret, res, g):
-    # dedicated Pallas backward (VERDICT r2 #7 — the round-2 version
-    # recomputed through the XLA gather formulation): same O(nnz)
-    # streaming as the forward, dq + strip-local dk/dv in one kernel,
-    # block scatter via segment-sum
-    q, k, v, out = res
-    return _splash_bwd(q, k, v, out, g, layout_key.layout, block, causal, sm_scale, interpret)
+    # dedicated Pallas backward (VERDICT r2 #7; r4: single pass from the
+    # forward's saved lse — the first pass that recomputed online m/l
+    # stats is gone): same O(nnz) streaming as the forward, dq +
+    # strip-local dk/dv in one kernel, block scatter via segment-sum
+    q, k, v, out, lse = res
+    return _splash_bwd(q, k, v, out, lse, g, layout_key.layout, block, causal, sm_scale, interpret)
 
 
 _splash_attention.defvjp(_splash_fwd_rule, _splash_bwd_rule)
