@@ -554,13 +554,14 @@ def _splash_kernel(
         safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, pl.dslice(gi * block, block), :] = (acc / safe).astype(o_ref.dtype)
         if lse_ref is not None:
-            # +inf for zero-degree rows ⇒ bwd's exp(s − lse) is exactly 0
+            # +inf for zero-degree rows ⇒ bwd's exp(s − lse) is exactly 0.
+            # Layout (group, 8, block): the store covers the FULL lane
+            # dim — a (8, group·block) row buffer sliced at gi·block
+            # fails Mosaic's 128-alignment rule for block < 128
             lse = jnp.where(
                 l[:, 0] == 0.0, jnp.inf, m[:, 0] + jnp.log(jnp.maximum(l[:, 0], 1e-37))
             )
-            lse_ref[0, :, pl.dslice(gi * block, block)] = jnp.broadcast_to(
-                lse[None, :], (8, block)
-            )
+            lse_ref[0, gi] = jnp.broadcast_to(lse[None, :], (8, block))
         return 0
 
     jax.lax.fori_loop(0, group, one_row, 0)
@@ -614,8 +615,10 @@ def _splash_fwd(q, k, v, layout: np.ndarray, block: int, causal: bool, sm_scale:
     out_specs = [row_spec]
     out_shape = [jax.ShapeDtypeStruct((B * H, T, hd), q.dtype)]
     if want_lse:
-        out_specs.append(pl.BlockSpec((1, 8, group * block), lambda b, r, idx, valid: (b, 0, r)))
-        out_shape.append(jax.ShapeDtypeStruct((B * H, 8, T), jnp.float32))
+        out_specs.append(
+            pl.BlockSpec((1, group, 8, block), lambda b, r, idx, valid: (b, r, 0, 0))
+        )
+        out_shape.append(jax.ShapeDtypeStruct((B * H, nb, 8, block), jnp.float32))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B * H, nb // group),
@@ -634,7 +637,7 @@ def _splash_fwd(q, k, v, layout: np.ndarray, block: int, causal: bool, sm_scale:
     )(idx2, valid2, qr, kg, vg)
     if want_lse:
         out, lse = outs
-        return out.reshape(B, H, T, hd), lse[:, 0, :].reshape(B, H, T)
+        return out.reshape(B, H, T, hd), lse[:, :, 0, :].reshape(B, H, T)
     return outs[0].reshape(B, H, T, hd)
 
 
@@ -659,8 +662,9 @@ def _splash_bwd_kernel(
         row_idx = g0 * group + gi
         q = q_ref[0, pl.dslice(gi * block, block), :]
         g = g_ref[0, pl.dslice(gi * block, block), :]
-        lse = lse_ref[0, 0, pl.dslice(gi * block, block)][:, None]
-        delta = lse_ref[0, 1, pl.dslice(gi * block, block)][:, None]
+        # (group, 8, block) layout: full-lane-dim reads (see fwd comment)
+        lse = lse_ref[0, gi, 0, :][:, None]
+        delta = lse_ref[0, gi, 1, :][:, None]
 
         def body(e, dq):
             k = kv_ref[0, 0, pl.dslice(gi * deg * block + e * block, block), :]
@@ -704,18 +708,19 @@ def _splash_bwd(q, k, v, out, lse, g, layout: np.ndarray, block: int, causal: bo
         q, k, v, layout, block, vmem_bufs=4
     )
     gr = g.reshape(B * H, T, hd)
-    # per-row scalars ride ONE (bh, 8, T) buffer: sublane 0 = the fwd's
-    # saved lse, sublane 1 = delta = rowsum(dO ∘ O) (computed here in
-    # XLA — one fused elementwise pass)
-    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1).reshape(B * H, T)
+    # per-row scalars ride ONE (bh, nb, 8, block) buffer: sublane 0 =
+    # the fwd's saved lse, sublane 1 = delta = rowsum(dO ∘ O) (computed
+    # here in XLA — one fused elementwise pass); the per-q-block trailing
+    # dim keeps every in-kernel read full-lane (Mosaic 128-alignment)
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1).reshape(B * H, nb, 1, block)
     rows = jnp.concatenate(
-        [lse.reshape(B * H, 1, T), delta[:, None, :], jnp.zeros((B * H, 6, T), jnp.float32)],
-        axis=1,
+        [lse.reshape(B * H, nb, 1, block), delta, jnp.zeros((B * H, nb, 6, block), jnp.float32)],
+        axis=2,
     )
 
     strip_spec = pl.BlockSpec((1, 1, group * deg * block, hd), lambda b, r, idx, valid: (b, r, 0, 0))
     row_spec = pl.BlockSpec((1, group * block, hd), lambda b, r, idx, valid: (b, r, 0))
-    lse_spec = pl.BlockSpec((1, 8, group * block), lambda b, r, idx, valid: (b, 0, r))
+    lse_spec = pl.BlockSpec((1, group, 8, block), lambda b, r, idx, valid: (b, r, 0, 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B * H, nb // group),
