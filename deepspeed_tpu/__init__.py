@@ -92,7 +92,12 @@ def initialize(
         from deepspeed_tpu.runtime.zero.param_offload import ZeroInfinityEngine
 
         stream_reason = ZeroInfinityEngine.streamable(model, ds_config, info, optimizer)
-        if stream_reason is not None and getattr(model, "stream_spec", None) is not None:
+        if stream_reason is not None:
+            # refuse (not warn-then-OOM) when the model the user asked to
+            # STREAM would not fit the in-HBM fallback engine
+            ZeroInfinityEngine.check_fallback_fits(
+                model_parameters, ds_config, info, stream_reason
+            )
             from deepspeed_tpu.utils.logging import logger as _logger
 
             _logger.warning(

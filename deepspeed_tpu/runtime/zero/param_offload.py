@@ -37,7 +37,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.comm.mesh import MeshInfo, batch_pspec
-from deepspeed_tpu.runtime.zero.offload import HostOffloadOptimizer, host_unscale_clip_and_check
+from deepspeed_tpu.runtime.zero.offload import (
+    HostOffloadOptimizer,
+    _flatten_with_paths,
+    host_unscale_clip_and_check,
+)
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 
@@ -83,14 +87,47 @@ class ZeroInfinityEngine:
             return "requires bf16 (no dynamic loss scale on the host path)"
         if mesh_info.model_parallel_world_size > 1:
             return "model (TP) sharding of streamed params is not implemented"
-        if mesh_info.fsdp_world_size > 1 and jax.process_count() > 1:
-            return "fsdp streaming is single-process (multi-host 1/P master sharding not implemented)"
         if optimizer is not None:
             return "client optimizer objects are unsupported (host Adam owns the update)"
         name = (config.optimizer.name or "adamw").lower()
         if name not in ("adam", "adamw"):
             return f"host step supports Adam/AdamW, got '{config.optimizer.name}'"
         return None
+
+    @staticmethod
+    def check_fallback_fits(params, config, mesh_info, reason: str) -> None:
+        """``offload_param`` was requested but this combo can't stream
+        (``reason``).  The fallback to the in-HBM engine is only safe if
+        the model actually FITS per device — for a >HBM model it would
+        OOM at step time with no mention of why streaming refused.
+        Estimate the fallback engine's resident bytes and refuse early,
+        carrying the streamable-reason.  HBM budget: real device
+        ``memory_stats()['bytes_limit']`` (override with
+        ``DS_TPU_HBM_BYTES``); unknown budget (CPU backend) skips the
+        check."""
+        hbm = os.environ.get("DS_TPU_HBM_BYTES")
+        if hbm is None:
+            try:
+                stats = jax.local_devices()[0].memory_stats() or {}
+                hbm = stats.get("bytes_limit")
+            except Exception:  # noqa: BLE001 — stats are backend-optional
+                hbm = None
+        if hbm is None:
+            return
+        n = sum(int(np.size(l)) for l in jax.tree.leaves(params))
+        dt = 2 if (config.bf16.enabled or config.fp16.enabled) else 4
+        zc = config.zero_config
+        pg_shards = max(1, mesh_info.fsdp_world_size) if zc.stage >= 3 else 1
+        opt_dev = 0 if zc.offload_optimizer.enabled else 12  # fp32 master+m+v
+        opt_shards = max(1, mesh_info.fsdp_world_size) if zc.stage >= 1 else 1
+        per_dev = n * (dt * 2 / pg_shards + opt_dev / opt_shards)  # params+grads, opt
+        if per_dev > 0.9 * float(hbm):
+            raise RuntimeError(
+                f"offload_param requested but this combination cannot stream "
+                f"({reason}); the in-HBM fallback would keep "
+                f"~{per_dev / 1e9:.1f} GB/device resident of {float(hbm) / 1e9:.1f} GB "
+                "HBM and OOM at step time. Fix the streaming blocker instead."
+            )
 
     def __init__(self, model, params, config, mesh, lr_scheduler=None):
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -109,11 +146,6 @@ class ZeroInfinityEngine:
                 "offload_param streams layer groups over data/fsdp axes only "
                 "(model-axis TP sharding of streamed params is not implemented)"
             )
-        if self.mesh_info.fsdp_world_size > 1 and jax.process_count() > 1:
-            raise NotImplementedError(
-                "offload_param with fsdp>1 is single-process (multi-host 1/P "
-                "master sharding is not implemented)"
-            )
         self.compute_dtype = jnp.bfloat16 if config.bf16.enabled else jnp.float32
 
         zc = config.zero_config
@@ -127,6 +159,37 @@ class ZeroInfinityEngine:
 
         # -- host-resident state ------------------------------------------
         params = jax.tree.map(lambda p: np.asarray(p, np.float32), params)
+        # Multi-host master sharding (reference ``stage3.py:2633-2686`` +
+        # ``partitioned_param_swapper.py:36`` — ZeRO-Infinity swaps each
+        # DP rank's PARTITION, never the whole model): the stacked-blocks
+        # fp32 masters + Adam moments live 1/H per HOST along the fsdp
+        # axis.  Each process keeps only the master rows covering its
+        # local devices' fsdp shards; group uploads assemble the global
+        # array from the process-local slices and group grads drain back
+        # shard-local, so host RAM and NVMe bytes both scale 1/H.  When
+        # fsdp sits inside one host (or fsdp == 1) the local range is the
+        # whole axis and behavior is the replicated-masters path.
+        blocks_full = params[spec.blocks_key]
+        bflat = _flatten_with_paths(blocks_full)
+        self._blocks_gshapes = [tuple(np.shape(v)) for _, v in bflat]
+        self._blocks_tdef = jax.tree.structure(blocks_full)
+        self._setup_host_partition(mesh)
+        params = dict(params)
+        params[spec.blocks_key] = jax.tree.unflatten(
+            self._blocks_tdef,
+            [self._leaf_to_local(v, gs) for (_, v), gs in zip(bflat, self._blocks_gshapes)],
+        )
+        # flat-leaf classification for the distributed grad norm: each
+        # block leaf carries its fsdp-sharded dim (None = replicated)
+        bdims = {
+            k: self._sharded_dim((gl,) + gs[1:])
+            for (k, _), gs in zip(bflat, self._blocks_gshapes)
+        }
+        _prefix = f"{spec.blocks_key}/"
+        self._flat_leaf_kinds = [
+            ("block", bdims[k[len(_prefix):]]) if k.startswith(_prefix) else ("resident", None)
+            for k, _ in _flatten_with_paths(params)
+        ]
         opt_cfg = dict(config.optimizer.params or {})
         opt_name = (config.optimizer.name or "adamw").lower()
         if opt_name not in ("adam", "adamw"):
@@ -136,6 +199,11 @@ class ZeroInfinityEngine:
             zc.offload_param.enabled and zc.offload_param.device == "nvme"
         ):
             nvme_dir = zc.offload_param.nvme_path or zc.offload_optimizer.nvme_path or "/tmp/ds_tpu_nvme"
+            if jax.process_count() > 1:
+                # on a real multi-host job the same path names each
+                # host's LOCAL disk; the rank suffix additionally keeps
+                # co-located test processes from clobbering each other
+                nvme_dir = os.path.join(nvme_dir, f"rank{jax.process_index()}")
         self._host_opt = HostOffloadOptimizer(
             params,
             lr=opt_cfg.get("lr", 1e-3),
@@ -204,10 +272,12 @@ class ZeroInfinityEngine:
         # each uploaded group is SHARDED over the fsdp axis — per-device
         # HBM holds group/fsdp param bytes; GSPMD all-gathers shards
         # inside the group programs and reduce-scatters group grads back
-        # to the same 1/P layout (out_shardings below).
-        self._group_shardings = jax.tree.map(
-            lambda a: NamedSharding(mesh, self._fsdp_leaf_spec(np.shape(a))),
-            self._group_slice_host(0),
+        # to the same 1/P layout (out_shardings below).  Shardings are
+        # built from GLOBAL group shapes — the host slices are 1/H.
+        self._group_gshapes = [(gl,) + gs[1:] for gs in self._blocks_gshapes]
+        self._group_shardings = jax.tree.unflatten(
+            self._blocks_tdef,
+            [NamedSharding(mesh, self._fsdp_leaf_spec(gs)) for gs in self._group_gshapes],
         )
         log_dist(
             f"ZeRO-Infinity engine: {spec.n_layer} layers in {self.n_groups} groups, "
@@ -238,6 +308,135 @@ class ZeroInfinityEngine:
         spec = [None] * len(dims)
         spec[best] = "fsdp"
         return P(*spec)
+
+    def _sharded_dim(self, group_shape) -> Optional[int]:
+        """Index of the fsdp-sharded dim of one group leaf, or None."""
+        for i, s in enumerate(self._fsdp_leaf_spec(group_shape)):
+            if s == "fsdp":
+                return i
+        return None
+
+    def _setup_host_partition(self, mesh) -> None:
+        """Locate this host on the fsdp axis: the contiguous range of
+        fsdp parts its local devices cover (masters / moments / NVMe
+        bytes are kept ONLY for that range), and the sub-range it OWNS
+        for grad-norm accounting (a part is owned by the lowest process
+        index holding it, so every part is counted exactly once
+        globally)."""
+        me = jax.process_index()
+        P = self.mesh_info.fsdp_world_size
+        axis_i = list(mesh.axis_names).index("fsdp")
+        owner: Dict[int, int] = {}
+        local = set()
+        for coord, dev in np.ndenumerate(mesh.devices):
+            f = int(coord[axis_i])
+            pi = int(dev.process_index)
+            owner[f] = min(owner.get(f, pi), pi)
+            if pi == me:
+                local.add(f)
+        parts = sorted(local)
+        if parts != list(range(parts[0], parts[-1] + 1)):
+            raise NotImplementedError(
+                "offload_param: this host's fsdp shards are non-contiguous "
+                f"on the mesh ({parts}); arrange the mesh so each host "
+                "covers a contiguous fsdp range"
+            )
+        owned = sorted(f for f in parts if owner[f] == me)
+        if owned and owned != list(range(owned[0], owned[-1] + 1)):
+            raise NotImplementedError(
+                f"offload_param: non-contiguous owned fsdp range {owned}"
+            )
+        self._part_local = (parts[0], parts[-1] + 1)
+        self._part_owned = (owned[0], owned[-1] + 1) if owned else (0, 0)
+        self._masters_sharded = (self._part_local[1] - self._part_local[0]) < P
+        if self._masters_sharded:
+            log_dist(
+                f"ZeRO-Infinity multi-host: masters sharded 1/{P} per fsdp "
+                f"part, this host keeps parts [{parts[0]}, {parts[-1] + 1})"
+            )
+
+    def _leaf_to_local(self, arr: np.ndarray, gshape) -> np.ndarray:
+        """This host's slice of one full stacked-blocks leaf (the whole
+        leaf when masters are not sharded across hosts)."""
+        d = self._sharded_dim((self.group_layers,) + tuple(gshape[1:]))
+        if d is None or not self._masters_sharded:
+            return arr
+        plo, phi = self._part_local
+        per = gshape[d] // self.mesh_info.fsdp_world_size
+        sl = [slice(None)] * len(gshape)
+        sl[d] = slice(plo * per, phi * per)
+        return np.ascontiguousarray(arr[tuple(sl)])
+
+    @staticmethod
+    def _to_local_np(garr, dtype=np.float32) -> np.ndarray:
+        """Host copy of the process-local region of a (possibly
+        multi-host) device array: the bounding box of this process's
+        addressable shards — the full array single-process, this host's
+        fsdp slice for sharded group grads."""
+        if jax.process_count() == 1:
+            return np.asarray(garr, dtype)
+        shape = garr.shape
+        boxes, lo, hi = [], list(shape), [0] * len(shape)
+        for sh in garr.addressable_shards:
+            b = []
+            for i, sl in enumerate(sh.index):
+                start = 0 if sl.start is None else int(sl.start)
+                stop = shape[i] if sl.stop is None else int(sl.stop)
+                b.append((start, stop))
+                lo[i] = min(lo[i], start)
+                hi[i] = max(hi[i], stop)
+            boxes.append(b)
+        out = np.empty([h - l for l, h in zip(lo, hi)], dtype)
+        for sh, b in zip(garr.addressable_shards, boxes):
+            dest = tuple(slice(s - l, e - l) for (s, e), l in zip(b, lo))
+            out[dest] = np.asarray(sh.data, dtype)
+        return out
+
+    def _drain_group(self, tree) -> Any:
+        """Group grads device→host, keeping only this host's local
+        region of each leaf (matches the 1/H master slices)."""
+        leaves = [self._to_local_np(l) for l in jax.tree.leaves(tree)]
+        return jax.tree.unflatten(self._blocks_tdef, leaves)
+
+    def _clip_and_check_global(self, grad_flat: List[np.ndarray]):
+        """Global grad-norm clip + overflow check over host-sharded
+        grads.  Each fsdp part is counted by exactly one process (its
+        lowest-indexed holder) and the replicated resident leaves by
+        process 0; the per-host partial sums meet in one tiny
+        process_allgather.  Single-process: the numpy fast path."""
+        clip = self.config.gradient_clipping
+        if jax.process_count() == 1:
+            _, norm, overflow = host_unscale_clip_and_check(grad_flat, 1.0, clip)
+            return norm, overflow
+        me = jax.process_index()
+        plo, phi = self._part_local
+        olo, ohi = self._part_owned
+        sq, overflow = 0.0, False
+        for (kind, d), g in zip(self._flat_leaf_kinds, grad_flat):
+            if not np.all(np.isfinite(g)):
+                overflow = True
+            if kind == "resident" or d is None:
+                if me == 0:
+                    sq += float(np.sum(np.square(g, dtype=np.float64)))
+            elif ohi > olo:
+                per = g.shape[d] // (phi - plo)
+                sl = [slice(None)] * g.ndim
+                sl[d] = slice((olo - plo) * per, (ohi - plo) * per)
+                sq += float(np.sum(np.square(g[tuple(sl)], dtype=np.float64)))
+        from jax.experimental import multihost_utils
+
+        vec = np.asarray(
+            multihost_utils.process_allgather(
+                np.asarray([sq, 1.0 if overflow else 0.0], np.float32)
+            )
+        ).reshape(jax.process_count(), 2)
+        norm = float(np.sqrt(vec[:, 0].sum()))
+        overflow = bool(vec[:, 1].max() > 0)
+        if clip > 0.0 and np.isfinite(norm) and norm > clip:
+            factor = clip / (norm + 1e-6)
+            for g in grad_flat:
+                g *= factor
+        return norm, overflow
 
     def _group_slice_host(self, g: int) -> Any:
         lo = g * self.group_layers
@@ -298,15 +497,7 @@ class ZeroInfinityEngine:
         H2D copy itself overlaps with whatever compute is in flight)."""
         host = self._group_slice_host(g)
         if self._param_swapper is None:
-            # cast on HOST (ml_dtypes) and device_put with the shard
-            # specs: each device receives only its 1/P slice — staging
-            # the full group on one device first would transiently break
-            # the per-device HBM bound the fsdp composition provides
-            dt = self._stage_np_dtype
-            return jax.device_put(
-                jax.tree.map(lambda a: np.asarray(a, dt), host),
-                self._group_shardings,
-            )
+            return self._put_group(host)
         if flat is None:
             flat = self._param_swapper.swap_in(self._group_key(g), async_op=True)
         # wait for THIS read only — other groups' write-backs keep
@@ -320,7 +511,31 @@ class ZeroInfinityEngine:
             nb = l.size * itemsize
             out.append(flat[off : off + nb].view(dt).reshape(l.shape))
             off += nb
-        return jax.device_put(jax.tree.unflatten(treedef, out), self._group_shardings)
+        return self._put_group(jax.tree.unflatten(treedef, out))
+
+    def _put_group(self, host_tree) -> Any:
+        """One group's compute-dtype params → device, each device
+        receiving only its 1/P fsdp slice.  Multi-host, the global array
+        is assembled from each process's LOCAL 1/H master slice
+        (``make_array_from_process_local_data``) — no host ever
+        materializes a full group.  Casting happens on HOST (ml_dtypes);
+        staging a full group on one device first would transiently break
+        the per-device HBM bound the fsdp composition provides."""
+        dt = self._stage_np_dtype
+        if jax.process_count() == 1:
+            return jax.device_put(
+                jax.tree.map(lambda a: np.asarray(a, dt), host_tree),
+                self._group_shardings,
+            )
+        out = [
+            jax.make_array_from_process_local_data(sh, np.asarray(a, dt), tuple(gs))
+            for a, sh, gs in zip(
+                jax.tree.leaves(host_tree),
+                jax.tree.leaves(self._group_shardings),
+                self._group_gshapes,
+            )
+        ]
+        return jax.tree.unflatten(self._blocks_tdef, out)
 
     @staticmethod
     def _start_host_copy(tree) -> None:
@@ -481,8 +696,7 @@ class ZeroInfinityEngine:
             micro_grads: List[Any] = [None] * self.n_groups
             inflight = self._issue_swap_in(self.n_groups - 1)
             pend_g, pend_dgp = None, None
-            def _drain(tree):
-                return jax.tree.map(lambda a: np.asarray(a, np.float32), tree)
+            _drain = self._drain_group
 
             for g in range(self.n_groups - 1, -1, -1):
                 g_dev = _phase("upload_s", self._finish_upload, g, inflight)
@@ -523,9 +737,7 @@ class ZeroInfinityEngine:
 
         for a in grad_acc:
             a /= gas
-        _, grad_norm, overflow = host_unscale_clip_and_check(
-            grad_acc, 1.0, self.config.gradient_clipping
-        )
+        grad_norm, overflow = self._clip_and_check_global(grad_acc)
         lr = float(self.lr_schedule(self.global_steps))
         if not overflow:
             grads_tree = jax.tree.unflatten(self._treedef, grad_acc)
@@ -576,37 +788,56 @@ class ZeroInfinityEngine:
         tag = tag or f"global_step{self.global_steps}"
         path = os.path.join(os.path.abspath(save_dir), str(tag))
         os.makedirs(path, exist_ok=True)
-        # every process holds identical masters (grads are psum'd
-        # replicated before the host step); each writes its OWN file —
-        # works on per-host local disks (no shared-FS assumption) and
-        # never races on one filename.  A barrier keeps rank 0's
-        # latest-tag write from outrunning slower writers.
-        self._host_opt.save(
-            os.path.join(path, f"host_optimizer_rank{jax.process_index()}.npz")
-        )
-        def _barrier(name):
+        # Each process writes its OWN file — its full masters when
+        # replicated, its 1/H fsdp slice when multi-host-sharded — so
+        # per-host local disks work (no shared-FS assumption) and ranks
+        # never race on one filename.  The barrier between phases is a
+        # flag ALLGATHER, not sync_global_devices: every rank reaches it
+        # even after a local write failure, so a failing rank surfaces
+        # as a raised error on ALL ranks instead of a deadlock.
+        def _sync_ok(ok: bool, what: str, cause=None) -> None:
             if jax.process_count() > 1:
                 from jax.experimental import multihost_utils
 
-                multihost_utils.sync_global_devices(name)
+                flags = np.asarray(
+                    multihost_utils.process_allgather(np.float32(0.0 if ok else 1.0))
+                ).reshape(-1)
+                if flags.max() > 0:
+                    raise RuntimeError(
+                        f"checkpoint {what} write failed on rank(s) "
+                        f"{np.nonzero(flags)[0].tolist()}"
+                    ) from cause
+            elif not ok:
+                raise RuntimeError(f"checkpoint {what} write failed") from cause
 
-        _barrier("zero_infinity_ckpt_opt_files")
-        if jax.process_index() != 0:
-            # rank 0 writes meta + the latest tag after all opt files
-            # are durable; everyone leaves only once those exist
-            _barrier("zero_infinity_ckpt_meta")
-            return path
-        meta = {
-            "tag": str(tag), "global_step": self.global_steps,
-            "skipped_steps": self.skipped_steps, "client_state": client_state or {},
-            "engine": "zero_infinity_param_offload",
-        }
-        with open(os.path.join(path, "meta.json"), "w") as f:
-            json.dump(meta, f, indent=2)
-        if save_latest:
-            with open(os.path.join(os.path.abspath(save_dir), "latest"), "w") as f:
-                f.write(str(tag))
-        _barrier("zero_infinity_ckpt_meta")
+        err = None
+        try:
+            self._host_opt.save(
+                os.path.join(path, f"host_optimizer_rank{jax.process_index()}.npz")
+            )
+        except Exception as e:  # noqa: BLE001 — must still reach the barrier
+            err = e
+        _sync_ok(err is None, "optimizer-state", err)
+        meta_err = None
+        if jax.process_index() == 0:
+            # rank 0 writes meta + the latest tag only after all opt
+            # files are durable; everyone leaves only once those exist
+            try:
+                meta = {
+                    "tag": str(tag), "global_step": self.global_steps,
+                    "skipped_steps": self.skipped_steps, "client_state": client_state or {},
+                    "engine": "zero_infinity_param_offload",
+                    "process_count": jax.process_count(),
+                    "masters_sharded": self._masters_sharded,
+                }
+                with open(os.path.join(path, "meta.json"), "w") as f:
+                    json.dump(meta, f, indent=2)
+                if save_latest:
+                    with open(os.path.join(os.path.abspath(save_dir), "latest"), "w") as f:
+                        f.write(str(tag))
+            except Exception as e:  # noqa: BLE001
+                meta_err = e
+        _sync_ok(meta_err is None, "meta/latest", meta_err)
         log_dist(f"saved ZeRO-Infinity checkpoint {path}")
         return path
 
@@ -620,9 +851,18 @@ class ZeroInfinityEngine:
                 tag = f.read().strip()
         path = os.path.join(load_dir, str(tag))
         # prefer this process's own file (per-host local disks); the
-        # rank-0 file is equivalent on a shared filesystem
+        # rank-0 file is equivalent on a shared filesystem ONLY when
+        # masters are replicated — a sharded-master checkpoint holds a
+        # different 1/H slice per rank
         opt_path = os.path.join(path, f"host_optimizer_rank{jax.process_index()}.npz")
         if not os.path.exists(opt_path):
+            if self._masters_sharded:
+                raise FileNotFoundError(
+                    f"ZeRO-Infinity checkpoint {path} has no file for rank "
+                    f"{jax.process_index()} and masters are host-sharded "
+                    "(each rank's slice differs; the rank-0 file is not a "
+                    "substitute). Restore with the same process topology."
+                )
             opt_path = os.path.join(path, "host_optimizer_rank0.npz")
         if not os.path.exists(opt_path):
             logger.warning(f"ZeRO-Infinity checkpoint {path} not found")
@@ -639,6 +879,18 @@ class ZeroInfinityEngine:
         if os.path.exists(meta_path):
             with open(meta_path) as f:
                 meta = json.load(f)
+        if "masters_sharded" in meta and (
+            bool(meta["masters_sharded"]) != self._masters_sharded
+            or (self._masters_sharded and int(meta.get("process_count", 1)) != jax.process_count())
+        ):
+            raise ValueError(
+                f"ZeRO-Infinity checkpoint {path} was saved with "
+                f"masters_sharded={meta['masters_sharded']} over "
+                f"{meta.get('process_count', 1)} processes; this engine has "
+                f"masters_sharded={self._masters_sharded} over "
+                f"{jax.process_count()} — the per-rank master files would "
+                "mis-slice the fsdp axis. Restore with a matching topology."
+            )
         self.global_steps = int(meta.get("global_step", 0))
         self.skipped_steps = int(meta.get("skipped_steps", 0))
         log_dist(f"loaded ZeRO-Infinity checkpoint {path} (global_step={self.global_steps})")

@@ -290,3 +290,41 @@ def test_fsdp_streaming_nvme(tmp_path):
     for _ in range(3):
         e.train_batch(fixed)
     assert float(e.eval_batch(fixed)) < l0
+
+
+# -- fail-fast when a >HBM model can't stream (VERDICT r4 weak #7) -----
+
+def _unstreamable_variants():
+    """(name, config mutation) per guarded streamable() combo."""
+    fp16 = _offload_config()
+    fp16.pop("bf16")
+    fp16["fp16"] = {"enabled": True}
+    tp = _offload_config()
+    tp["mesh"] = {"data": 4, "model": 2}
+    badopt = _offload_config()
+    badopt["optimizer"] = {"type": "Lamb", "params": {"lr": 1e-2}}
+    return [("fp16", fp16), ("tp", tp), ("non_adam", badopt)]
+
+
+@pytest.mark.parametrize("name,cfg", _unstreamable_variants())
+def test_unstreamable_combo_refuses_when_model_exceeds_hbm(name, cfg, monkeypatch):
+    """offload_param requested + combo can't stream + model won't fit the
+    in-HBM fallback => refuse AT INIT with the streamable reason, instead
+    of warn-then-OOM at step N (param_offload.check_fallback_fits)."""
+    monkeypatch.setenv("DS_TPU_HBM_BYTES", "1000")  # everything is >HBM
+    with pytest.raises(RuntimeError, match="cannot stream"):
+        _build(cfg)
+
+
+def test_unstreamable_combo_falls_back_when_model_fits(monkeypatch):
+    """Same blocked combo, but the model fits: the documented
+    warn-and-fall-back behavior is preserved."""
+    monkeypatch.setenv("DS_TPU_HBM_BYTES", str(10**12))
+    cfg = _offload_config()
+    cfg.pop("bf16")
+    cfg["fp16"] = {"enabled": True}
+    e = _build(cfg)
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+    from deepspeed_tpu.runtime.zero.param_offload import ZeroInfinityEngine
+
+    assert isinstance(e, DeepSpeedEngine) and not isinstance(e, ZeroInfinityEngine)
