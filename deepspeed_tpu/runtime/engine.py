@@ -696,7 +696,15 @@ class DeepSpeedEngine:
         if self.config.gradient_clipping > 0.0:
             grads, grad_norm = _clip_by_global_norm(grads, self.config.gradient_clipping)
         lr = jnp.asarray(self.lr_schedule(state["global_step"]), jnp.float32)
-        updates, new_opt = self.optimizer.update(grads, state["opt_state"], state["params"], lr=lr)
+        upd_kw = {}
+        if getattr(self.optimizer, "state_precision", "fp32") == "8bit":
+            # stochastic rounding of the 8-bit Adam state needs fresh
+            # bits each step — without them v falls back to nearest
+            # rounding and sub-LSB EMA increments are systematically lost
+            upd_kw["rng"] = jax.random.fold_in(state["rng"], state["global_step"] + 997_001)
+        updates, new_opt = self.optimizer.update(
+            grads, state["opt_state"], state["params"], lr=lr, **upd_kw
+        )
 
         def apply_or_skip(p, u):
             return jnp.where(overflow, p, (p.astype(jnp.float32) + u).astype(p.dtype))

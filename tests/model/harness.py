@@ -207,6 +207,32 @@ def _pipe_3d_recipe():
     return [float(engine.train_batch((xb, yb))) for _ in range(8)]
 
 
+def _gpt2_adam8bit_recipe():
+    """Reduced-precision Adam state (m bf16, v uint8-of-sqrt blocks with
+    stochastic rounding): the convergence curve is pinned so 8-bit state
+    drift vs the fp32 path shows here (VERDICT r4 next #2)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt2
+
+    cfg = gpt2.GPT2_TINY
+    model_fn, init_fn, tp_fn = gpt2.make_model(cfg)
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 2,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 0},
+        "mesh": {"data": 8},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3, "state_precision": "8bit"}},
+        "steps_per_print": 10_000,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model_fn, model_parameters=init_fn(seed=0), config=config, tp_spec_fn=tp_fn
+    )
+    r = np.random.default_rng(0)
+    batch = {"input_ids": r.integers(0, cfg.vocab_size, (32, 64), dtype=np.int32)}
+    return [float(engine.train_batch(batch)) for _ in range(8)]
+
+
 RECIPES = {
     "cifar_tiny_dp8_adam": _cifar_recipe,
     "gpt2_tiny_zero3_tp_bf16": _gpt2_zero3_recipe,
@@ -214,6 +240,7 @@ RECIPES = {
     "gpt2_tiny_streaming_offload_fsdp2": _gpt2_streaming_offload_recipe,
     "gpt2_tiny_onebit_frozen": _gpt2_onebit_frozen_recipe,
     "pipe_3d_zero1": _pipe_3d_recipe,
+    "gpt2_tiny_adam8bit": _gpt2_adam8bit_recipe,
 }
 
 
