@@ -100,17 +100,36 @@ def _setup_jax_cache():
 
 def _timed_steps(engine, batches, steps, label):
     """Compile+warm, then best-of-2 timing windows with a true host sync
-    (one bad window must not poison the record)."""
+    (one bad window must not poison the record).
+
+    The window drives ``engine.train_batches`` (N steps in ONE compiled
+    lax.scan) when available: per-program dispatch overhead through a
+    remote runtime (~10-30 ms/step over the dev tunnel) amortizes over
+    the run, the way production TPU loops (t5x/pax) are driven.  The
+    per-step semantics are identical (pinned by
+    tests/test_engine.py::test_train_batches_matches_per_step_loop)."""
+    use_run = hasattr(engine, "train_batches") and not getattr(engine, "_offload", False)
+    use_run = use_run and os.environ.get("DS_BENCH_RUN_API", "1") != "0"
+    tb_unroll = os.environ.get("DS_TB_UNROLL") == "1"
     t0 = time.time()
-    for batch in engine.prefetch_loader(batches(2)):
-        loss = engine.train_batch(batch)
-    log(f"[{label}] compile+2 steps: {time.time()-t0:.1f}s loss={float(loss):.3f}")
+    if use_run:
+        losses = engine.train_batches(list(batches(2)), unroll=tb_unroll)
+        loss = float(losses[-1])
+    else:
+        for batch in engine.prefetch_loader(batches(2)):
+            loss = engine.train_batch(batch)
+        loss = float(loss)
+    log(f"[{label}] compile+2 steps: {time.time()-t0:.1f}s loss={loss:.3f}")
     dt = float("inf")
     for _ in range(2):
         t0 = time.time()
-        for batch in engine.prefetch_loader(batches(steps)):
-            loss = engine.train_batch(batch)
-        loss = float(loss)
+        if use_run:
+            losses = engine.train_batches(list(batches(steps)), unroll=tb_unroll)
+            loss = float(losses[-1])
+        else:
+            for batch in engine.prefetch_loader(batches(steps)):
+                loss = engine.train_batch(batch)
+            loss = float(loss)
         dt = min(dt, (time.time() - t0) / steps)
     log(f"[{label}] timing windows done")
     return dt
@@ -130,7 +149,7 @@ def _device_or_host_init(family_mod, cfg, on_tpu):
     return family_mod.init_params(cfg)
 
 
-def bench_model(cfg, micro_bs, gas, seq, steps, zero_stage, label):
+def bench_model(cfg, micro_bs, gas, seq, steps, zero_stage, label, opt_params=None):
     import jax
 
     import deepspeed_tpu
@@ -146,7 +165,7 @@ def bench_model(cfg, micro_bs, gas, seq, steps, zero_stage, label):
         "bf16": {"enabled": True},
         "zero_optimization": {"stage": zero_stage},
         "mesh": {"fsdp": n_dev, "data": 1} if n_dev > 1 else None,
-        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4, **(opt_params or {})}},
         "steps_per_print": 10_000,
     }
     config = {k: v for k, v in config.items() if v is not None}
@@ -522,10 +541,27 @@ def main():
             and remaining() - 45 - est >= rest_est  # never starve the ladder behind
         ):
             retries_used += 1
-            log(f"[{name}] suspect result ({fail_reason or f'value {primary} < floor {floor}'}) — retrying once")
+            reason = fail_reason or f"value {primary} < floor {floor}"
+            log(f"[{name}] suspect result ({reason}) — retrying once")
             records2, fail2 = _run_child(name, min(cap, remaining() - 45 - rest_est))
-            if records2 and (primary is None or records2[0].get("value", 0) > primary):
+            kept_retry = bool(records2) and (
+                primary is None or records2[0].get("value", 0) > primary
+            )
+            if kept_retry:
                 records, fail_reason = records2, fail2
+            # the selection is asymmetric (only sub-floor runs retry, and
+            # max wins) — record BOTH attempts so the bias is visible in
+            # BENCH_EXTRA.json rather than silently folded into the value
+            if records:
+                records[0] = dict(
+                    records[0],
+                    retry={
+                        "reason": reason,
+                        "kept": "retry" if kept_retry else "first",
+                        "first_value": primary,
+                        "retry_value": records2[0].get("value") if records2 else None,
+                    },
+                )
 
         if fail_reason is not None and not records:
             extra.append({"metric": name, "skipped": True, "reason": fail_reason})

@@ -233,7 +233,9 @@ class InferenceEngine:
         # XL-class tree has ~600-1200 leaves (minutes of pure RTT).
         # Upload flat staging buffers (grouped by dtype, capped at
         # _STAGE_CHUNK_BYTES so peak HBM overhead stays bounded) and
-        # split on device; _split_flat donates the staging buffer.
+        # split on device (_split_flat deliberately does NOT donate the
+        # staging buffer — peak HBM is bounded by the chunk cap instead;
+        # see its docstring).
         placed = [None] * len(arrays)
         by_dtype = {}
         for i, a in enumerate(arrays):

@@ -172,10 +172,28 @@ def _drop_threshold(keep_prob: float) -> int:
     return min(int(keep_prob * 4294967296.0), 4294967295)
 
 
+def _check_dropout_counter_bound(sq: int, sk: int) -> None:
+    """The position-keyed Threefry counter packs ``row*sk + col`` into
+    one uint32 word; beyond 2**32 score positions the stream would
+    repeat.  64k × 64k scores is far outside any supported score-matrix
+    size (long-context runs route through sparse/ring attention), so
+    refuse loudly rather than degrade silently."""
+    if sq * sk >= 2**32:
+        raise ValueError(
+            f"attention dropout PRNG counter would wrap: sq*sk = {sq}*{sk} "
+            ">= 2**32; use sparse or ring attention for scores this large"
+        )
+
+
 def _drop_keep_tile(k0, k1, bh, row0, col0, bq, bk, sk, keep_prob):
     """(bq, bk) bool keep-tile for score rows [row0, row0+bq) × cols
     [col0, col0+bk) of batch·head ``bh`` — pure function of the absolute
-    element position, identical across fwd/dq/dkv block decompositions."""
+    element position, identical across fwd/dq/dkv block decompositions.
+
+    Counter bound: the x0 word is ``row*sk + col`` in uint32, so score
+    grids with sq*sk >= 2**32 (64k × 64k) would silently repeat
+    keep-bits across distant positions — entry points assert the bound
+    (``_check_dropout_counter_bound``) before handing a seed down."""
     rows = jnp.uint32(row0) + jax.lax.broadcasted_iota(jnp.uint32, (bq, bk), 0)
     cols = jnp.uint32(col0) + jax.lax.broadcasted_iota(jnp.uint32, (bq, bk), 1)
     x0 = rows * jnp.uint32(sk) + cols
@@ -188,6 +206,7 @@ def dropout_keep_mask_host(seed_pair, b, h, sq, sk, keep_prob):
     """The full (b·h, sq, sk) uint8 keep-mask the kernels generate —
     host-graph-side twin of ``_drop_keep_tile`` for the oracle and the
     materializing fallback paths (dense short-seq / reference)."""
+    _check_dropout_counter_bound(sq, sk)
     k0 = seed_pair[0].astype(jnp.uint32)
     k1 = seed_pair[1].astype(jnp.uint32)
     rows = jax.lax.broadcasted_iota(jnp.uint32, (sq, sk), 0)
@@ -322,6 +341,8 @@ def _fwd_extra_specs(mode, bias2, mask, b, h, sq, sk, block_q, drop_seed=None):
     fwd/dq kernels (block over the q dim; the kv dim is sliced
     in-kernel).  ``drop_seed``: (2,) uint32 for in-kernel dropout —
     rides SMEM, mutually exclusive with ``mask``."""
+    if drop_seed is not None:
+        _check_dropout_counter_bound(sq, sk)
     from jax.experimental.pallas import tpu as pltpu
 
     specs, args = [], []

@@ -43,7 +43,12 @@ class AsyncTensorSwapper:
         self.aio = aio_handle if aio_handle is not None else AioHandle(**kw)
         # writes ride a small FIXED pool of handles (keys hash to slots):
         # per-slot wait granularity keeps unrelated writes airborne while
-        # bounding native aio contexts/threads regardless of key count
+        # bounding native aio contexts/threads regardless of key count.
+        # NOTE the granularity is per-SLOT, not per-key: with more than
+        # _WRITE_POOL concurrent writers a swap_in can wait on an
+        # unrelated key's in-flight write that hashed to the same slot —
+        # correctness is unaffected, overlap just degrades for
+        # n_groups > _WRITE_POOL
         self._write_handles: Dict[int, AioHandle] = {}
         # key -> (path, shape, dtype) for swapped-out tensors
         self._index: Dict[str, tuple] = {}

@@ -273,3 +273,78 @@ def test_1f1b_activation_memory_bounded_in_micro_batches():
     growth_1f1b = temp_bytes("1f1b", 16) - temp_bytes("1f1b", 4)
     growth_gpipe = temp_bytes("gpipe", 16) - temp_bytes("gpipe", 4)
     assert growth_1f1b < 0.5 * growth_gpipe, (growth_1f1b, growth_gpipe)
+
+
+# ---------------------------------------------------------------------------
+# 3D: pipe × model(TP) × data — reference topology.py:246-249 (Megatron
+# mpu supplies the model axis inside each pipeline stage)
+# ---------------------------------------------------------------------------
+class MLP:
+    """Column→row parallel MLP block (Megatron layout): w1 shards its
+    OUTPUT dim over `model`, w2 its INPUT dim, so the block needs one
+    psum at the end — the tp_spec below expresses exactly that."""
+
+    def __init__(self, dim, mult=4):
+        self.dim, self.mult = dim, mult
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        h = self.dim * self.mult
+        return {
+            "w1": jax.random.normal(k1, (self.dim, h), jnp.float32) / np.sqrt(self.dim),
+            "b1": jnp.zeros((h,), jnp.float32),
+            "w2": jax.random.normal(k2, (h, self.dim), jnp.float32) / np.sqrt(h),
+            "b2": jnp.zeros((self.dim,), jnp.float32),
+        }
+
+    def apply(self, params, x, rng=None):
+        h = jax.nn.gelu(x @ params["w1"] + params["b1"])
+        return x + h @ params["w2"] + params["b2"]
+
+
+def mlp_tp_spec(path, shape):
+    """Client tp_spec over the PER-BLOCK paths (the pipe engine prepends
+    the stacked dim itself)."""
+    from jax.sharding import PartitionSpec as P
+
+    if path.endswith("w1"):
+        return P(None, "model")
+    if path.endswith("b1"):
+        return P("model")
+    if path.endswith("w2"):
+        return P("model", None)
+    return None
+
+
+def _make_3d_engine(mesh, tp):
+    module = PipelineModule(
+        layers=[LayerSpec(MLP, 16) for _ in range(4)], loss_fn=mse_loss
+    )
+    cfg = base_config(stage=1, micro_bs=1, gas=4, dtype="fp32", mesh=mesh)
+    engine, _, _, _ = ds.initialize(
+        model=module, config=cfg, tp_spec_fn=mlp_tp_spec if tp else None
+    )
+    return engine
+
+
+def test_pipeline_3d_tp_parity():
+    """pipe×model×data (2×2×2) with a REAL tp_spec through _pipe_tp_spec
+    must match the sequential single-axis run step for step — the 3D row
+    of SURVEY §2.5 executed, not just plumbed (VERDICT r4 missing #2)."""
+    batch = pipe_batch(8, 16, seed=5)
+    e3d = _make_3d_engine({"pipe": 2, "model": 2, "data": 2}, tp=True)
+    eref = _make_3d_engine({"data": -1}, tp=False)
+
+    # the body leaves really carry ('pipe', <model specs>) shardings
+    w1 = e3d.state["params"]["blocks"]["w1"]
+    spec = w1.sharding.spec
+    assert tuple(spec)[:1] == ("pipe",) and "model" in tuple(spec), spec
+    assert len({s.index for s in w1.addressable_shards}) >= 4  # pipe×model shards
+
+    l3, lr_ = [], []
+    for i in range(4):
+        b = pipe_batch(8, 16, seed=10 + i)
+        l3.append(float(e3d.train_batch(b)))
+        lr_.append(float(eref.train_batch(b)))
+    np.testing.assert_allclose(l3, lr_, rtol=2e-4, atol=2e-5)
+    assert l3[-1] < l3[0]  # and it actually trains

@@ -43,12 +43,17 @@ def main():
         cfg = dataclasses.replace(gpt2.PRESETS[preset], remat=False)
     seq = min(seq, cfg.n_positions)
     model_fn, init_fn, tp_fn = gpt2.make_model(cfg)
+    opt_extra = {}
+    if preset.startswith("sweep:"):
+        from tools.sweep_774m import CONFIGS as _C
+
+        opt_extra = _C[preset.split(":", 1)[1]].get("opt") or {}
     config = {
         "train_micro_batch_size_per_gpu": mb,
         "gradient_accumulation_steps": gas,
         "bf16": {"enabled": True},
         "zero_optimization": {"stage": 3 if preset.startswith("sweep:") else 0},
-        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4, **opt_extra}},
         "steps_per_print": 10_000,
     }
     engine, _, _, _ = deepspeed_tpu.initialize(

@@ -69,6 +69,19 @@ CONFIGS = {
     "x256": dict(model=dict(remat=True, xent_chunk_size=256, remat_save_names=SAVE_FLASH), mb=4, gas=1),
     "x768": dict(model=dict(remat=True, xent_chunk_size=768, remat_save_names=SAVE_FLASH), mb=4, gas=1),
     "x2048": dict(model=dict(remat=True, xent_chunk_size=2048, remat_save_names=SAVE_FLASH), mb=4, gas=1),
+    # round 5: 8-bit Adam state (m bf16, v uint8 sqrt-codes) — the fp32
+    # m/v HBM pass was the r4-attributed ~27ms dominant loss; 8-bit cuts
+    # state traffic 16 B/param -> ~5 (r+w) and frees ~3.9 GB of HBM,
+    # which may also re-open mb=6/gas=2 (OOM at fp32 state in r4)
+    "q8": dict(model=dict(remat=True, xent_chunk_size=512, remat_save_names=SAVE_FLASH), mb=4, gas=1, opt=dict(state_precision="8bit")),
+    "q8g2": dict(model=dict(remat=True, xent_chunk_size=512, remat_save_names=SAVE_FLASH), mb=4, gas=2, opt=dict(state_precision="8bit")),
+    "q8mb6": dict(model=dict(remat=True, xent_chunk_size=512, remat_save_names=SAVE_FLASH), mb=6, gas=1, opt=dict(state_precision="8bit")),
+    "q8mb8": dict(model=dict(remat=True, xent_chunk_size=512, remat_save_names=SAVE_FLASH), mb=8, gas=1, opt=dict(state_precision="8bit")),
+    "q8u6": dict(model=dict(remat=True, xent_chunk_size=512, remat_save_names=SAVE_FLASH, scan_unroll=6), mb=4, gas=1, opt=dict(state_precision="8bit")),
+    # bf16 state (native dtype, SR on the v store): no uint8 relayout
+    "qb16": dict(model=dict(remat=True, xent_chunk_size=512, remat_save_names=SAVE_FLASH), mb=4, gas=1, opt=dict(state_precision="bf16")),
+    "qb16g2": dict(model=dict(remat=True, xent_chunk_size=512, remat_save_names=SAVE_FLASH), mb=4, gas=2, opt=dict(state_precision="bf16")),
+    "qb16mb6": dict(model=dict(remat=True, xent_chunk_size=512, remat_save_names=SAVE_FLASH), mb=6, gas=1, opt=dict(state_precision="bf16")),
 }
 
 
@@ -80,7 +93,8 @@ def main():
 
     cfg = dataclasses.replace(gpt2.GPT2_LARGE, **c["model"])
     out = bench.bench_model(
-        cfg, micro_bs=c["mb"], gas=c["gas"], seq=1024, steps=4, zero_stage=3, label=f"774M-{name}"
+        cfg, micro_bs=c["mb"], gas=c["gas"], seq=1024, steps=4, zero_stage=3,
+        label=f"774M-{name}", opt_params=c.get("opt"),
     )
     print(json.dumps({"name": name, **out}), flush=True)
 

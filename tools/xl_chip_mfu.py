@@ -1,0 +1,188 @@
+"""Chip-implied MFU of the GPT-2 XL (1.5B) streaming train step.
+
+The steady-state XL streaming record (tools/train_xl_onchip.py) is
+bound by the dev tunnel's ~10 MB/s host link — its wall time says
+nothing about the CHIP.  This tool measures what the chip itself does:
+each compiled stage program of the ZeRO-Infinity executor (group fwd,
+group vjp, embed, head, embed bwd) is timed ON DEVICE by chaining N
+iterations inside one jitted ``lax.scan`` (single dispatch + single
+sync, so the tunnel's ~100 ms RTT amortizes to nothing), then
+
+    chip_step_s = G * (t_group_fwd + t_group_bwd) + t_embed + t_head + t_embed_bwd
+    chip_mfu    = step_flops / (chip_step_s * peak_flops)
+
+This is the number a real deployment (PCIe-class host link, or fsdp
+over multiple hosts) converges to as the upload pipeline stops being
+the bottleneck — the VERDICT r4 "missing #3" evidence row.
+
+Run: python tools/xl_chip_mfu.py [seq] [micro_bs] [buffer_count] [iters]
+"""
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import bench
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt2
+
+    seq = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    mb = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+    lpg = int(sys.argv[3]) if len(sys.argv) > 3 else 8
+    iters = int(sys.argv[4]) if len(sys.argv) > 4 else 8
+
+    cfg = gpt2.GPT2_XL
+    model_fn, init_fn, _ = gpt2.make_model(cfg)
+    config = {
+        "train_micro_batch_size_per_gpu": mb,
+        "gradient_accumulation_steps": 1,
+        "bf16": {"enabled": True},
+        "zero_optimization": {
+            "stage": 3,
+            "offload_param": {"device": "cpu", "buffer_count": lpg},
+            "offload_optimizer": {"device": "cpu"},
+        },
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "steps_per_print": 10_000,
+    }
+    t0 = time.time()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model_fn, model_parameters=init_fn(), config=config
+    )
+    print(f"init {time.time() - t0:.0f}s  groups={engine.n_groups}", flush=True)
+    spec = engine.spec
+    G = engine.n_groups
+
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (mb, seq), dtype=np.int32)}
+    res = engine._upload_resident()
+    g0 = engine._upload_group(0)
+    mbatch = {k: jax.device_put(v, engine._batch_sh) for k, v in batch.items()}
+    tokens = mbatch["input_ids"]
+    rngs = engine._layer_rngs(0, 0)[0]
+
+    def sync(x):
+        # block_until_ready is unreliable through the tunnel; pull bytes
+        np.asarray(jax.device_get(jax.tree.leaves(x)[0]))
+
+    def timed(fn, *args, warm=True):
+        if warm:
+            sync(fn(*args))  # compile + warm
+        t0 = time.time()
+        out = fn(*args)
+        sync(out)
+        return (time.time() - t0) / iters
+
+    n = iters
+
+    @jax.jit
+    def chain_group_fwd(gp, x, r):
+        def body(x_, _):
+            return spec.group(gp, x_, r, spec.deterministic), None
+
+        y, _ = jax.lax.scan(body, x, None, length=n)
+        return y
+
+    @jax.jit
+    def chain_group_bwd(gp, x, r, dy):
+        def body(dy_, _):
+            _, vjp = jax.vjp(lambda g_, x_: spec.group(g_, x_, r, spec.deterministic), gp, x)
+            dgp, dx = vjp(dy_)
+            return dx, None
+
+        out, _ = jax.lax.scan(body, dy, None, length=n)
+        return out
+
+    @jax.jit
+    def chain_embed(r_, t_):
+        def body(c, _):
+            return spec.embed(r_, t_) + 0.0 * c, None
+
+        y, _ = jax.lax.scan(body, spec.embed(r_, t_), None, length=n)
+        return y
+
+    @jax.jit
+    def chain_head(r_, x_):
+        def body(c, _):
+            def f(rr, xx):
+                return spec.head_loss(rr, xx, mbatch)
+
+            loss, vjp = jax.vjp(f, r_, x_)
+            d_res, dx = vjp(jnp.float32(1.0).astype(loss.dtype))
+            return c + loss.astype(jnp.float32), None
+
+        y, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=n)
+        return y
+
+    @jax.jit
+    def chain_embed_bwd(r_, t_, dx0):
+        def body(c, _):
+            _, vjp = jax.vjp(lambda rr: spec.embed(rr, t_), r_)
+            (d_res,) = vjp(dx0 + 0.0 * c)
+            return c + 1.0, None
+
+        y, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=n)
+        return y
+
+    x0 = jax.jit(lambda r_, t_: spec.embed(r_, t_))(res, tokens)
+    dy = jnp.ones_like(x0)
+    t_gf = timed(chain_group_fwd, g0, x0, rngs)
+    t_gb = timed(chain_group_bwd, g0, x0, rngs, dy)
+    t_em = timed(chain_embed, res, tokens)
+    t_hd = timed(chain_head, res, x0)
+    t_eb = timed(chain_embed_bwd, res, tokens, dy)
+    print(
+        f"per-program chip times: group_fwd={t_gf * 1000:.1f}ms "
+        f"group_bwd={t_gb * 1000:.1f}ms embed={t_em * 1000:.1f}ms "
+        f"head(+vjp)={t_hd * 1000:.1f}ms embed_bwd={t_eb * 1000:.1f}ms",
+        flush=True,
+    )
+
+    chip_step = G * (t_gf + t_gb) + t_em + t_hd + t_eb
+    n_params = cfg.num_params()
+    tokens_per_step = mb * seq
+    flops_per_token = 6 * n_params + 12 * cfg.n_layer * cfg.n_embd * seq
+    peak = bench.peak_flops_per_chip(jax.default_backend())
+    chip_mfu = tokens_per_step * flops_per_token / chip_step / peak
+
+    rec = {
+        "metric": "gpt2_xl_1p5b_streaming_chip_mfu",
+        "value": round(chip_mfu * 100, 2),
+        "unit": "percent_of_peak",
+        "chip_seconds_per_step": round(chip_step, 4),
+        "per_program_ms": {
+            "group_fwd": round(t_gf * 1e3, 1),
+            "group_bwd": round(t_gb * 1e3, 1),
+            "embed": round(t_em * 1e3, 1),
+            "head_vjp": round(t_hd * 1e3, 1),
+            "embed_bwd": round(t_eb * 1e3, 1),
+            "n_groups": G,
+        },
+        "seq": seq,
+        "micro_bs": mb,
+        "method": (
+            "each streaming stage program timed on-chip via a jitted "
+            f"lax.scan of {iters} chained iterations (one dispatch+sync, "
+            "tunnel RTT amortized); chip_step = G*(fwd+vjp) + embed + "
+            "head + embed_bwd; MFU = step_flops/(chip_step*peak). "
+            "Tunnel-bound phases (group upload over the ~10MB/s dev "
+            "link, grad drain) are excluded by construction — they "
+            "pipeline under compute on a PCIe-class host link."
+        ),
+    }
+    print("RESULT " + json.dumps(rec), flush=True)
+    bench.append_capability_record(rec)
+
+
+if __name__ == "__main__":
+    main()
