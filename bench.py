@@ -306,7 +306,8 @@ def bench_bert(seq: int, micro_bs: int, gas: int, steps: int):
     }
 
 
-def bench_inference(model_name: str, quantize_bits: int, label: str):
+def bench_inference(model_name: str, quantize_bits: int, label: str,
+                    kv_cache_dtype: str = "model", prompt_len: int = 128):
     """Decode throughput: tokens/s in the steady KV-cache decode loop
     (reference inference kernels claim 2-4x fp16 / 3-5x int8,
     docs/_posts/2021-05-05-inference-kernel-optimization.md:55)."""
@@ -318,11 +319,11 @@ def bench_inference(model_name: str, quantize_bits: int, label: str):
     t0 = time.time()
     engine = deepspeed_tpu.init_inference(
         model=model_name, quantize_bits=quantize_bits, max_out_tokens=512,
-        init_on_device=on_tpu,
+        kv_cache_dtype=kv_cache_dtype, init_on_device=on_tpu,
     )
     log(f"[{label}] engine ready in {time.time()-t0:.1f}s")
     # dev (CPU/tiny) runs shrink the windows to fit the model's n_positions
-    B, T, short, long_ = (8, 128, 16, 128) if on_tpu else (4, 32, 8, 64)
+    B, T, short, long_ = (8, prompt_len, 16, 128) if on_tpu else (4, 32, 8, 64)
     rng = np.random.default_rng(0)
     prompt = rng.integers(0, engine.model_config.vocab_size, (B, T), dtype=np.int32)
 
@@ -396,6 +397,17 @@ def run_rung(name: str):
         emit(bench_inference("gpt-neo-2.7b" if on_tpu else "tiny", 0, "bf16"))
     elif name == "neo-int8":
         emit(bench_inference("gpt-neo-2.7b" if on_tpu else "tiny", 8, "int8"))
+    elif name == "decode-longctx":
+        # long-context decode, SAME-harness quantization ratio: at
+        # prompt 384 the KV-cache read rivals the weight read, so int8
+        # weights + int8 KV attack both roofline terms at once
+        m = "gpt2-xl" if on_tpu else "tiny"
+        pl = 384 if on_tpu else 32
+        r_bf = bench_inference(m, 0, "longctx-bf16", prompt_len=pl)
+        emit(r_bf)
+        r_q = bench_inference(m, 8, "longctx-int8w-int8kv", kv_cache_dtype="int8", prompt_len=pl)
+        r_q["speedup_vs_bf16_same_harness"] = round(r_q["value"] / max(r_bf["value"], 1e-9), 3)
+        emit(r_q)
     elif name == "774M-zero3":
         # Big-model rung: 774M with full on-device fp32 Adam state
         # (params 3.1G + m/v 6.2G ≈ 9.3G at gas==1), round-4 MFU
@@ -439,6 +451,9 @@ RUNGS = [
     # each (measured r4: full 7-rung suite finished in 338s of 1620)
     ("neo-bf16", 150, 360),
     ("neo-int8", 150, 360),
+    # same-harness long-context quantization ratio (bf16 vs int8w+int8kv
+    # in ONE child); measured r5 warm ~200s
+    ("decode-longctx", 260, 480),
 ]
 
 # Plausibility floors for each rung's PRIMARY record on REAL TPU —
@@ -456,6 +471,7 @@ RUNG_FLOORS = {
     "bert-s512": 20,         # samples/s (normal ~78)
     "neo-bf16": 200,         # tokens/s (normal ~930)
     "neo-int8": 200,         # tokens/s (normal ~1450)
+    "decode-longctx": 150,   # tokens/s, first (bf16) record (normal ~770)
 }
 
 

@@ -72,6 +72,7 @@ class InferenceEngine:
         params: Any = None,
         quantize_bits: int = 0,
         quantize_groups: int = 1,
+        kv_cache_dtype: str = "model",
         seed: int = 0,
         init_on_device: bool = False,
         **kwargs,
@@ -86,6 +87,13 @@ class InferenceEngine:
         self.mp_world_size = int(mp_size)
         self.dtype = dtype if dtype is not None else jnp.bfloat16
         self.max_out_tokens = int(max_out_tokens)
+        # "model" -> cache in self.dtype; "int8" -> quantized cache (the
+        # cache read rivals the weight read at long contexts; int8
+        # halves that roofline term — see ops/transformer/inference)
+        if kv_cache_dtype not in ("model", "int8"):
+            raise ValueError(f"kv_cache_dtype must be 'model' or 'int8', got {kv_cache_dtype!r}")
+        self.kv_cache_dtype = kv_cache_dtype
+        self._kv_dtype = "int8" if kv_cache_dtype == "int8" else self.dtype
         self._compiled: Dict[Any, Callable] = {}
 
         # -- resolve model family + params --------------------------------
@@ -342,7 +350,7 @@ class InferenceEngine:
                 )
 
                 def fn(p, ids):
-                    k0, v0 = init_kv_cache(cfg.n_layer, B, cfg.n_head, T, cfg.head_dim, self.dtype)
+                    k0, v0 = init_kv_cache(cfg.n_layer, B, cfg.n_head, T, cfg.head_dim, self._kv_dtype)
                     return forward_with_cache(p, ids, k0, v0, 0, icfg)[0]
 
             elif self._is_gpt:
@@ -387,7 +395,7 @@ class InferenceEngine:
             return jax.random.categorical(r, logits32, axis=-1).astype(jnp.int32)
 
         def gen(params, tokens, rng, attention_mask):
-            k_cache, v_cache = init_kv_cache(cfg.n_layer, B, cfg.n_head, T + N, cfg.head_dim, self.dtype)
+            k_cache, v_cache = init_kv_cache(cfg.n_layer, B, cfg.n_head, T + N, cfg.head_dim, self._kv_dtype)
             if masked:
                 # left-padded prompts: real positions start at 0 per
                 # example; padded cache slots are never attendable
@@ -412,9 +420,15 @@ class InferenceEngine:
             # each unrolled layer then owns its buffer and the stacked
             # cache's per-token slice/reassembly copies (profiled at
             # ~7ms/token at XL) disappear
-            n_layer = k_cache.shape[0]
-            k_tup = tuple(k_cache[i] for i in range(n_layer))
-            v_tup = tuple(v_cache[i] for i in range(n_layer))
+            n_layer = jax.tree.leaves(k_cache)[0].shape[0]
+
+            def _split_layers(c):
+                if isinstance(c, dict):
+                    return tuple({k: v[i] for k, v in c.items()} for i in range(n_layer))
+                return tuple(c[i] for i in range(n_layer))
+
+            k_tup = _split_layers(k_cache)
+            v_tup = _split_layers(v_cache)
 
             def body(carry, xs):
                 tok, kc, vc, pos, fin = carry

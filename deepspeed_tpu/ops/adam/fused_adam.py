@@ -58,6 +58,7 @@ class FusedAdam:
     """
 
     name = "adam"
+    supports_skip = True  # in-producer overflow skip (see update())
 
     def __init__(
         self,
@@ -73,8 +74,10 @@ class FusedAdam:
     ):
         if amsgrad:
             raise ValueError("FusedAdam does not support amsgrad (matches reference)")
-        if state_precision not in ("fp32", "8bit"):
-            raise ValueError(f"state_precision must be 'fp32' or '8bit', got {state_precision!r}")
+        if state_precision not in ("fp32", "bf16", "8bit"):
+            raise ValueError(
+                f"state_precision must be 'fp32', 'bf16' or '8bit', got {state_precision!r}"
+            )
         self.lr = lr
         self.b1, self.b2 = betas
         self.eps = eps
@@ -96,11 +99,45 @@ class FusedAdam:
                 return b
         return 0
 
+    @staticmethod
+    def _rbg_bits(key, shape):
+        """uint32 random bits from the TPU hardware generator — threefry
+        (jax.random.*) costs ~10 VPU ops/word, which at param-shaped
+        tensors would eat the bandwidth a compact state saves."""
+        try:
+            kd = jax.random.key_data(key)  # typed key
+        except TypeError:
+            kd = key  # raw uint32[2] key
+        kd = jnp.asarray(kd).astype(jnp.uint32).reshape(-1)
+        state = jnp.tile(kd[:2], 2)  # rbg state: uint32[4]
+        _, bits = jax.lax.rng_bit_generator(
+            state, shape, dtype=jnp.uint32,
+            algorithm=jax.lax.RandomAlgorithm.RNG_DEFAULT,
+        )
+        return bits
+
+    @classmethod
+    def _sr_bf16(cls, x32: jnp.ndarray, key: Optional[jax.Array]) -> jnp.ndarray:
+        """fp32 -> bf16 with stochastic rounding: add uniform bits below
+        the bf16 mantissa cut, then truncate.  Nearest rounding would
+        systematically drop EMA increments smaller than half a bf16 ulp
+        (~0.2% relative — v's per-step (1-b2) increment is smaller)."""
+        if key is None:
+            return x32.astype(jnp.bfloat16)
+        u = jax.lax.bitcast_convert_type(x32, jnp.uint32)
+        y = (u + (cls._rbg_bits(key, x32.shape) & jnp.uint32(0xFFFF))) & jnp.uint32(
+            0xFFFF0000
+        )
+        sr = jax.lax.bitcast_convert_type(y, jnp.float32)
+        return jnp.where(jnp.isfinite(x32), sr, x32).astype(jnp.bfloat16)
+
     def _v_encode(self, v32: jnp.ndarray, key: Optional[jax.Array]):
         """v (fp32, >=0) -> (uint8 codes of sqrt(v), per-block scales).
         sqrt halves the dynamic range the 8 linear bits must cover;
         stochastic rounding (when a key is given) keeps the EMA unbiased
         so sub-step increments are not systematically lost."""
+        if self.state_precision == "bf16":
+            return self._sr_bf16(v32, key), jnp.zeros((1,), jnp.float32)
         b = self._v_blocks(v32.size)
         if b == 0:
             # fp32 passthrough for tiny leaves; (1,) sentinel scale — a
@@ -110,20 +147,33 @@ class FusedAdam:
         s = jnp.maximum(jnp.max(u, axis=1, keepdims=True), 1e-30) / 255.0
         q = u / s
         if key is not None:
-            q = jnp.floor(q + jax.random.uniform(key, q.shape))
+            bits = self._rbg_bits(key, q.shape)
+            q = jnp.floor(q + bits.astype(jnp.float32) * (1.0 / 4294967296.0))
         else:
             q = jnp.round(q)
         codes = jnp.clip(q, 0, 255).astype(jnp.uint8).reshape(v32.shape)
         return codes, s[:, 0]
 
     def _v_decode(self, vq: jnp.ndarray, vs: jnp.ndarray) -> jnp.ndarray:
-        if vq.dtype != jnp.uint8:  # fp32 passthrough leaf
-            return vq
+        if vq.dtype != jnp.uint8:  # fp32/bf16 passthrough leaf
+            return vq.astype(jnp.float32)
         b = self._v_blocks(vq.size)
-        u = vq.astype(jnp.float32).reshape(-1, b) * vs[:, None]
+        # floor codes at half a quantization step: rounding a small-but-
+        # nonzero v to code 0 would hand Adam a ~eps denominator and an
+        # exploding update (observed as loss spikes); the floor bounds
+        # the update by lr*m/(absmax/510) while leaving codes >= 1
+        # unbiased
+        codes = jnp.maximum(vq.astype(jnp.float32), 0.5)
+        u = codes.reshape(-1, b) * vs[:, None]
         return jnp.square(u).reshape(vq.shape)
 
     def init(self, params: Any) -> AdamState:
+        if self.state_precision == "bf16":
+            zb = lambda: jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.bfloat16), params)
+            return AdamState8(
+                step=jnp.zeros((), jnp.int32), exp_avg=zb(), vq=zb(),
+                vs=jax.tree.map(lambda p: jnp.zeros((1,), jnp.float32), params),
+            )
         if self.state_precision == "8bit":
             m = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.bfloat16), params)
             vq = jax.tree.map(
@@ -149,46 +199,77 @@ class FusedAdam:
         params: Any,
         lr: Optional[jnp.ndarray] = None,
         rng: Optional[jax.Array] = None,
+        skip: Optional[jnp.ndarray] = None,
     ):
-        """Returns (updates, new_state); apply with ``p + u``."""
+        """Returns (updates, new_state); apply with ``p + u``.
+
+        ``skip``: optional traced scalar bool (overflow) — when set, the
+        state keeps its old values and updates come out zero, selected
+        INSIDE the producer pass.  An outer ``where(skip, old, new)``
+        over the state tree re-reads both trees (state-sized extra HBM
+        traffic each step — measured ~26 ms at 774M because the donated
+        output buffer forces `new` to materialize before the select);
+        in-producer selection fuses to the same single pass."""
         if isinstance(state, AdamState8):
-            return self._update_8bit(grads, state, params, lr, rng)
+            return self._update_8bit(grads, state, params, lr, rng, skip)
         lr = self.lr if lr is None else lr
-        step = state.step + 1
+        keep = None if skip is None else (1.0 - skip.astype(jnp.float32))
+        step = state.step + (1 if skip is None else jnp.where(skip, 0, 1))
         b1, b2 = self.b1, self.b2
         if self.bias_correction:
-            c1 = 1.0 - b1 ** step.astype(jnp.float32)
-            c2 = 1.0 - b2 ** step.astype(jnp.float32)
+            # bias corrections use the unconditional count: on a skipped
+            # step the stored count stays put and c2 = 1-b2^0 = 0 would
+            # divide by zero — the values don't matter there (updates
+            # are zeroed) but NaN would poison the keep-folded params
+            bstep = (state.step + 1).astype(jnp.float32)
+            c1 = 1.0 - b1 ** bstep
+            c2 = 1.0 - b2 ** bstep
         else:
             c1 = c2 = jnp.float32(1.0)
 
         def one(g, m, v, p):
             g = g.astype(jnp.float32)
+            if keep is not None:
+                # a skip step IS the non-finite-grads step: zero g first
+                # (0 * inf would poison the keep-folded arithmetic)
+                g = jnp.where(skip, 0.0, g)
             p32 = p.astype(jnp.float32)
             if not self.adam_w_mode and self.weight_decay > 0.0:
                 g = g + self.weight_decay * p32
-            m_new = b1 * m + (1.0 - b1) * g
-            v_new = b2 * v + (1.0 - b2) * g * g
+            if keep is None:
+                m_new = b1 * m + (1.0 - b1) * g
+                v_new = b2 * v + (1.0 - b2) * g * g
+            else:
+                # skip==1 ⇒ m/v keep their old values, one producer pass
+                m_new = m + keep * ((b1 - 1.0) * m + (1.0 - b1) * g)
+                v_new = v + keep * ((b2 - 1.0) * v + (1.0 - b2) * g * g)
             denom = jnp.sqrt(v_new / c2) + self.eps
             upd = -(lr * (m_new / c1) / denom)
             if self.adam_w_mode and self.weight_decay > 0.0:
                 upd = upd - lr * self.weight_decay * p32
+            if keep is not None:
+                upd = keep * upd
             return upd, m_new, v_new
 
         updates, m, v = _map_multi(one, 3, grads, state.exp_avg, state.exp_avg_sq, params)
         return updates, AdamState(step=step, exp_avg=m, exp_avg_sq=v)
 
-    def _update_8bit(self, grads, state: AdamState8, params, lr, rng):
+    def _update_8bit(self, grads, state: AdamState8, params, lr, rng, skip=None):
         """Adam step over the reduced-precision state.  Math is identical
         to the fp32 path on the DECODED values; only the storage format
         differs.  Per-leaf PRNG keys derive from (rng, leaf index) so
-        every block's stochastic rounding is independent."""
+        every block's stochastic rounding is independent.  ``skip``:
+        in-producer overflow skip (see ``update``); a skipped step
+        re-encodes the decoded v (adds one SR round-trip of noise to a
+        rare event) rather than re-reading the whole old state."""
         lr = self.lr if lr is None else lr
-        step = state.step + 1
+        keep = None if skip is None else (1.0 - skip.astype(jnp.float32))
+        step = state.step + (1 if skip is None else jnp.where(skip, 0, 1))
         b1, b2 = self.b1, self.b2
         if self.bias_correction:
-            c1 = 1.0 - b1 ** step.astype(jnp.float32)
-            c2 = 1.0 - b2 ** step.astype(jnp.float32)
+            bstep = (state.step + 1).astype(jnp.float32)  # see update(): skip-safe
+            c1 = 1.0 - b1 ** bstep
+            c2 = 1.0 - b2 ** bstep
         else:
             c1 = c2 = jnp.float32(1.0)
         gl, treedef = jax.tree.flatten(grads)
@@ -202,15 +283,25 @@ class FusedAdam:
         upds, ms, vqs, vss = [], [], [], []
         for i, (g, m, vq, vs, p) in enumerate(zip(gl, ml, vql, vsl, pl)):
             g32 = g.astype(jnp.float32)
+            if keep is not None:
+                g32 = jnp.where(skip, 0.0, g32)  # 0*inf would poison keep-folding
             p32 = p.astype(jnp.float32)
             if not self.adam_w_mode and self.weight_decay > 0.0:
                 g32 = g32 + self.weight_decay * p32
-            m_new = b1 * m.astype(jnp.float32) + (1.0 - b1) * g32
-            v_new = b2 * self._v_decode(vq, vs) + (1.0 - b2) * g32 * g32
+            m32 = m.astype(jnp.float32)
+            v32 = self._v_decode(vq, vs)
+            if keep is None:
+                m_new = b1 * m32 + (1.0 - b1) * g32
+                v_new = b2 * v32 + (1.0 - b2) * g32 * g32
+            else:
+                m_new = m32 + keep * ((b1 - 1.0) * m32 + (1.0 - b1) * g32)
+                v_new = v32 + keep * ((b2 - 1.0) * v32 + (1.0 - b2) * g32 * g32)
             denom = jnp.sqrt(v_new / c2) + self.eps
             upd = -(lr * (m_new / c1) / denom)
             if self.adam_w_mode and self.weight_decay > 0.0:
                 upd = upd - lr * self.weight_decay * p32
+            if keep is not None:
+                upd = keep * upd
             nvq, nvs = self._v_encode(v_new, keys[i])
             upds.append(upd)
             ms.append(m_new.astype(jnp.bfloat16))

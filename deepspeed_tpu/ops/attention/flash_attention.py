@@ -605,6 +605,7 @@ def _flash_bwd_dkv_kernel(
 def _flash_bwd_fused_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
     sm_scale, causal, block_q, kbias, fbias, keep_prob, kdrop=False,
+    q_row0=0, sq_full=None, sk_full=None,
 ):
     """Single-pass backward: one kernel computes dq, dk, dv together.
 
@@ -616,8 +617,11 @@ def _flash_bwd_fused_kernel(
     (ROUND3_NOTES "Known limits"), so the second exp is pure waste.
     dq accumulates across kv blocks by revisiting its (full-seq) output
     block, which stays resident in VMEM between sequential grid steps —
-    this bounds the fused kernel to seqs where sq·d fp32 fits VMEM
-    (~8k at d=64; longer seqs keep the two-pass path)."""
+    this bounds one CALL to seqs where sq·d fp32 fits VMEM (~8k at
+    d=64); longer sequences run as q-CHUNKED calls of this same kernel
+    (``_flash_bwd_fused_chunked``) with ``q_row0``/``sq_full``/
+    ``sk_full`` carrying the chunk's global position so causal masking
+    and the position-keyed dropout counter are chunking-invariant."""
     refs = list(rest)
     bias_ref = refs.pop(0) if (kbias or fbias) else None
     mask_ref = refs.pop(0) if keep_prob < 1.0 else None
@@ -626,9 +630,13 @@ def _flash_bwd_fused_kernel(
     block_k, d = k_ref.shape[1], k_ref.shape[2]
     seq_q = q_ref.shape[1]
     seq_k_total = pl.num_programs(1) * block_k
+    skf = seq_k_total if sk_full is None else sk_full
+    sqf = seq_q if sq_full is None else sq_full
     kv_idx = pl.program_id(1)
     bh_idx = pl.program_id(0)
-    causal_offset = seq_k_total - seq_q
+    # global q position of local row r is q_row0 + r; causal compares
+    # (skf - sqf) + global_q >= global_k
+    causal_offset = skf - sqf + q_row0
 
     @pl.when(kv_idx == 0)
     def _zero_dq():
@@ -665,7 +673,7 @@ def _flash_bwd_fused_kernel(
             if kdrop:
                 keep = _drop_keep_tile(
                     mask_ref[0], mask_ref[1], bh_idx,
-                    i * block_q, kv_idx * block_k, block_q, block_k, seq_k_total, keep_prob,
+                    q_row0 + i * block_q, kv_idx * block_k, block_q, block_k, skf, keep_prob,
                 )
             else:
                 keep = mask_ref[0, pl.dslice(i * block_q, block_q), :]
@@ -693,6 +701,7 @@ def _flash_bwd_fused_kernel(
 def _flash_bwd_fused_pallas(
     q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret,
     bias=None, mask=None, keep_prob: float = 1.0, drop_seed=None,
+    q_row0: int = 0, sq_full=None, sk_full=None,
 ):
     """Single-kernel backward (see ``_flash_bwd_fused_kernel``).  dq is
     accumulated in fp32 and cast at the end."""
@@ -705,7 +714,10 @@ def _flash_bwd_fused_pallas(
     extra_specs, extra_args = _kv_grid_extra_specs(mode, bias2, mask, h, sq, block_k, drop_seed)
 
     dq32, dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_fused_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q, **flags),
+        functools.partial(
+            _flash_bwd_fused_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
+            q_row0=q_row0, sq_full=sq_full, sk_full=sk_full, **flags,
+        ),
         grid=(bh, sk // block_k),
         in_specs=[
             pl.BlockSpec((1, sq, d), lambda bh_, ki: (bh_, 0, 0)),
@@ -740,9 +752,62 @@ def _flash_bwd_fused_pallas(
 
 
 # VMEM bound for the fused backward's resident per-program state:
-# q + do + dq(fp32) + k/v blocks, double-buffered — beyond this the
-# two-pass kernels take over.
+# q + do + dq(fp32) + k/v blocks, double-buffered — beyond this ONE
+# call's worth; longer sequences run q-chunked calls of the same kernel
+# (VERDICT r4 weak #3: 16k+ used to fall back to the two-pass kernels).
 _FUSED_BWD_MAX_SQ_BYTES = 1 << 21  # sq * d * 4 (fp32 dq) per program
+
+
+def _flash_bwd_fused_chunked(
+    q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret,
+    bias=None, mask=None, keep_prob: float = 1.0, drop_seed=None,
+):
+    """Fused single-pass backward for sequences whose fp32 dq exceeds a
+    program's VMEM share: split the q axis into chunks that fit, run the
+    fused kernel once per chunk (``q_row0``/``sq_full``/``sk_full`` keep
+    causal masking and the dropout counter position-exact), sum the
+    partial dk/dv in fp32.  Causal chunks slice their kv prefix — a
+    chunk never visits kv blocks entirely above its diagonal — so total
+    score work matches the monolithic kernel.  Explicit bias/mask
+    tensors are not chunked (long-context runs are causal + in-kernel
+    dropout); the dispatch sends those to the two-pass kernels."""
+    assert bias is None and mask is None, "chunked fused bwd: bias/mask unsupported"
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq = min(block_q, sq)
+    rows_max = _FUSED_BWD_MAX_SQ_BYTES // (d * 4)
+    cs = max(bq, rows_max // bq * bq)
+    dq_parts = []
+    dk32 = jnp.zeros((b, h, sk, d), jnp.float32)
+    dv32 = jnp.zeros((b, h, sk, d), jnp.float32)
+    for c0 in range(0, sq, cs):
+        ce = min(c0 + cs, sq)
+        qs = slice(c0, ce)
+        kv_hi = sk
+        if causal:
+            # highest k position this chunk can see: (sk - sq) + ce - 1
+            kv_hi = min(sk, max(block_k, -((sk - sq + ce) // -block_k) * block_k))
+        dq_c, dk_c, dv_c = _flash_bwd_fused_pallas(
+            q[:, :, qs], k[:, :, :kv_hi], v[:, :, :kv_hi], out[:, :, qs],
+            lse[:, :, qs], g[:, :, qs], causal, sm_scale, block_q, block_k,
+            interpret, keep_prob=keep_prob, drop_seed=drop_seed,
+            q_row0=c0, sq_full=sq, sk_full=sk,
+        )
+        dq_parts.append(dq_c)
+        pad = sk - kv_hi
+        dk_add = dk_c.astype(jnp.float32)
+        dv_add = dv_c.astype(jnp.float32)
+        if pad:
+            dk32 = dk32.at[:, :, :kv_hi].add(dk_add)
+            dv32 = dv32.at[:, :, :kv_hi].add(dv_add)
+        else:
+            dk32 = dk32 + dk_add
+            dv32 = dv32 + dv_add
+    return (
+        jnp.concatenate(dq_parts, axis=2),
+        dk32.astype(k.dtype),
+        dv32.astype(v.dtype),
+    )
 
 
 def _bwd_prologue(q, k, v, out, lse, g, bias, block_q, block_k, keep_prob, drop_seed):
@@ -905,15 +970,17 @@ def _bias_cotangent(q, k, v, out, lse, g, bias, mask, causal, sm_scale, keep_pro
 
 def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, keep_prob, bwd_block_q, bwd_block_k, res, g):
     q, k, v, out, lse, bias, mask, drop_seed = res
-    # single-pass backward when the full-seq fp32 dq accumulator fits a
-    # program's VMEM share — one exp per score instead of two (the d=64
+    # single-pass backward: one exp per score instead of two (the d=64
     # kernel is VPU-softmax-bound; measured ~20% faster bwd at GPT-2
-    # shapes); longer sequences fall back to the two-pass FA-2 kernels
-    bwd = (
-        _flash_bwd_fused_pallas
-        if q.shape[2] * q.shape[3] * 4 <= _FUSED_BWD_MAX_SQ_BYTES
-        else _flash_bwd_pallas
-    )
+    # shapes).  Sequences whose fp32 dq exceeds a program's VMEM share
+    # run the same kernel q-CHUNKED (r5); only explicit bias/mask
+    # tensors still take the two-pass FA-2 kernels at those sizes
+    if q.shape[2] * q.shape[3] * 4 <= _FUSED_BWD_MAX_SQ_BYTES:
+        bwd = _flash_bwd_fused_pallas
+    elif bias is None and mask is None:
+        bwd = _flash_bwd_fused_chunked
+    else:
+        bwd = _flash_bwd_pallas
     dq, dk, dv = bwd(
         q, k, v, out, lse, g, causal, sm_scale,
         bwd_block_q or block_q, bwd_block_k or block_k, interpret,

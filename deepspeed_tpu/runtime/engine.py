@@ -697,25 +697,40 @@ class DeepSpeedEngine:
             grads, grad_norm = _clip_by_global_norm(grads, self.config.gradient_clipping)
         lr = jnp.asarray(self.lr_schedule(state["global_step"]), jnp.float32)
         upd_kw = {}
-        if getattr(self.optimizer, "state_precision", "fp32") == "8bit":
+        if getattr(self.optimizer, "state_precision", "fp32") in ("8bit", "bf16"):
             # stochastic rounding of the 8-bit Adam state needs fresh
             # bits each step — without them v falls back to nearest
             # rounding and sub-LSB EMA increments are systematically lost
             upd_kw["rng"] = jax.random.fold_in(state["rng"], state["global_step"] + 997_001)
+        in_producer_skip = getattr(self.optimizer, "supports_skip", False)
+        if in_producer_skip:
+            # overflow handling happens INSIDE the optimizer's producer
+            # pass: updates come out zero and the state keeps its old
+            # values.  The alternative — where(overflow, old, new) over
+            # the state tree below — re-reads old AND new (state-sized
+            # extra HBM traffic; ~26 ms/step at 774M, because the donated
+            # output buffer forces `new` to materialize before the select)
+            upd_kw["skip"] = overflow
         updates, new_opt = self.optimizer.update(
             grads, state["opt_state"], state["params"], lr=lr, **upd_kw
         )
 
-        def apply_or_skip(p, u):
-            return jnp.where(overflow, p, (p.astype(jnp.float32) + u).astype(p.dtype))
+        if in_producer_skip:
+            new_params = jax.tree.map(
+                lambda p, u: (p.astype(jnp.float32) + u).astype(p.dtype),
+                state["params"], updates,
+            )
+        else:
+            def apply_or_skip(p, u):
+                return jnp.where(overflow, p, (p.astype(jnp.float32) + u).astype(p.dtype))
 
-        new_params = jax.tree.map(apply_or_skip, state["params"], updates)
-        # on overflow, keep the old optimizer state too
-        new_opt = jax.tree.map(
-            lambda old, new: jnp.where(overflow, old, new) if hasattr(old, "shape") else new,
-            state["opt_state"],
-            new_opt,
-        )
+            new_params = jax.tree.map(apply_or_skip, state["params"], updates)
+            # on overflow, keep the old optimizer state too
+            new_opt = jax.tree.map(
+                lambda old, new: jnp.where(overflow, old, new) if hasattr(old, "shape") else new,
+                state["opt_state"],
+                new_opt,
+            )
         if self.quantizer is not None:
             # MoQ: fake-quantize weights right after the update
             # (reference _take_model_step :1284-1290); an overflow step is
@@ -1196,32 +1211,8 @@ class DeepSpeedEngine:
             tuple(np.shape(x) for x in jax.tree.leaves(stacked)),
         )
         if tb_key not in self._compiled:
-            # with offload, the compiled program ends after the micro-batch
-            # scan — the optimizer step runs on host (ZeRO-Offload splits
-            # exactly here)
             apply_in_graph = not self._offload
-
-            if self._onebit_frozen:
-                full_step = self._frozen_full_step
-            elif apply_in_graph and self._use_grad_acc and not self.state["grad_acc"]:
-                # gas==1 fused path (no persistent accumulator was
-                # allocated): grads flow straight into the update
-                def full_step(state, stacked):
-                    mb = jax.tree.map(lambda x: jnp.squeeze(x, 0), stacked)
-                    state, loss, grads = self._micro_grads(state, mb)
-                    state, info = self._apply_update(state, grads)
-                    return state, loss, info
-            else:
-
-                def full_step(state, stacked):
-                    def body(st, mb):
-                        return self._micro_step_impl(st, mb)
-
-                    state, losses = jax.lax.scan(body, state, stacked)
-                    if apply_in_graph:
-                        state, info = self._apply_step_impl(state)
-                        return state, jnp.mean(losses), info
-                    return state, jnp.mean(losses)
+            full_step = self._full_step_fn()
 
             # AOT compile: the executable's cost_analysis feeds the flops
             # profiler for free (no second trace/compile at profile time).
@@ -1271,6 +1262,110 @@ class DeepSpeedEngine:
         self.tput_timer.stop(sync_token=loss)
         self._maybe_report_progress()
         return loss
+
+    def _full_step_fn(self) -> Callable:
+        """One full train step as a pure function ``(state, stacked) ->
+        (state, loss[, info])`` — the unit ``train_batch`` compiles and
+        ``train_batches`` scans.  With offload, the program ends after
+        the micro-batch scan (the optimizer step runs on host — ZeRO-
+        Offload splits exactly here)."""
+        apply_in_graph = not self._offload
+        if self._onebit_frozen:
+            return self._frozen_full_step
+        if apply_in_graph and self._use_grad_acc and not self.state["grad_acc"]:
+            # gas==1 fused path (no persistent accumulator was
+            # allocated): grads flow straight into the update
+            def full_step(state, stacked):
+                mb = jax.tree.map(lambda x: jnp.squeeze(x, 0), stacked)
+                state, loss, grads = self._micro_grads(state, mb)
+                state, info = self._apply_update(state, grads)
+                return state, loss, info
+
+            return full_step
+
+        def full_step(state, stacked):
+            def body(st, mb):
+                return self._micro_step_impl(st, mb)
+
+            state, losses = jax.lax.scan(body, state, stacked)
+            if apply_in_graph:
+                state, info = self._apply_step_impl(state)
+                return state, jnp.mean(losses), info
+            return state, jnp.mean(losses)
+
+        return full_step
+
+    def train_batches(self, batches, unroll: bool = False) -> np.ndarray:
+        """Run N full train steps in ONE compiled program — a
+        ``lax.scan`` of the train step over a stacked run of batches.
+
+        TPU-idiomatic driver loop: per-program dispatch costs (host RPC
+        latency, argument marshalling — ~10-30 ms/step through remote
+        runtimes) amortize over the whole run, the way t5x/pax drive
+        entire loops inside one program.  Semantics are identical to
+        calling ``train_batch`` N times: same grads, same updates, same
+        overflow skipping; per-step losses return as one (N,) array.
+
+        Not available with host offload (the optimizer step leaves the
+        graph) or across the 1-bit warmup→frozen transition (the state
+        layout changes mid-run) — those fall back to the per-step loop.
+        """
+        batches = list(batches)
+        n = len(batches)
+        if n == 0:
+            return np.zeros((0,), np.float32)
+        crosses_freeze = (
+            self._onebit_exchange_ok
+            and not self._onebit_frozen
+            and self._host_global_step + n > getattr(self.optimizer, "freeze_step", 0)
+        )
+        if self._offload or crosses_freeze or n == 1:
+            return np.asarray([float(self.train_batch(b)) for b in batches], np.float32)
+        self.tput_timer.start()
+        stacked = [self._stack_and_place(b) for b in batches]
+        run = jax.tree.map(lambda *xs: jnp.stack(xs), *stacked)
+        key = (
+            "train_batches", n, unroll, self._onebit_frozen, bool(self.state["grad_acc"]),
+            tuple(np.shape(x) for x in jax.tree.leaves(run)),
+        )
+        if key not in self._compiled:
+            full_step = self._full_step_fn()
+
+            def full_run(state, run):
+                def body(st, stk):
+                    st, loss, info = full_step(st, stk)
+                    return st, (loss, info["overflow"])
+
+                # unroll=n removes the while-loop: no carry double-buffer
+                # copies of the big state, at the cost of an n× graph
+                state, (losses, ovf) = jax.lax.scan(
+                    body, state, run, unroll=n if unroll else 1
+                )
+                return state, losses, jnp.sum(ovf.astype(jnp.int32))
+
+            scalar = self._sh(P())
+            self._compiled[key] = (
+                jax.jit(
+                    self._scoped(full_run), donate_argnums=(0,),
+                    out_shardings=(self._state_shardings, scalar, scalar),
+                )
+                .lower(self.state, run)
+                .compile()
+            )
+        self.state, losses, ovf_count = self._compiled[key](self.state, run)
+        losses = np.asarray(losses)
+        skipped = int(ovf_count)
+        if self.loss_scaler.dynamic:
+            self.skipped_steps += skipped
+            self._host_global_step += n - skipped
+        else:
+            self._host_global_step += n  # matches the per-step loop's host count
+        self._host_micro_step += n * self.gradient_accumulation_steps
+        self._last_loss = losses[-1]  # progress reports read these
+        self._last_info = {"overflow": skipped > 0}
+        self.tput_timer.stop(sync_token=losses[-1] if len(losses) else None)
+        self._maybe_report_progress()
+        return losses
 
     def eval_batch(self, batch: Any) -> Any:
         batch = self._prepare_batch(batch)

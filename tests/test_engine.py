@@ -153,3 +153,32 @@ def test_split_dcn_ici_factoring():
     assert np.prod(list(dcn.values())) == 16
     # non-factorable process count → None (caller falls back)
     assert split_dcn_ici(dict(zip(MESH_AXES, [1, 3, 1, 1, 1, 1])), 2) is None
+
+
+def test_train_batches_matches_per_step_loop():
+    """train_batches (N steps in one compiled lax.scan) must reproduce
+    the per-step train_batch loop exactly: same losses, same params,
+    same step counts — it only amortizes per-program dispatch."""
+    import numpy as np
+
+    from tests.simple_model import base_config, random_batches, simple_model_init, simple_model_loss
+
+    cfg = base_config(stage=2, mesh={"fsdp": 8}, gas=2)
+    batches = random_batches(5, 16, 64, seed=3)
+    e1, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_model_loss, model_parameters=simple_model_init(64), config=cfg
+    )
+    l_loop = [float(e1.train_batch(b)) for b in batches]
+    e2, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_model_loss, model_parameters=simple_model_init(64), config=cfg
+    )
+    l_run = e2.train_batches(batches)
+    np.testing.assert_allclose(l_run, l_loop, rtol=1e-5, atol=1e-6)
+    assert e2._host_global_step == e1._host_global_step == 5
+    p1 = jax.tree.leaves(e1.state["params"])[0]
+    p2 = jax.tree.leaves(e2.state["params"])[0]
+    np.testing.assert_allclose(np.asarray(p1), np.asarray(p2), rtol=1e-5, atol=1e-6)
+    # and a second run continues from the advanced state (cache hit path)
+    more = random_batches(2, 16, 64, seed=9)
+    l2 = e2.train_batches(more)
+    assert l2.shape == (2,) and np.isfinite(l2).all()

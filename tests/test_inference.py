@@ -401,3 +401,36 @@ def test_init_on_device_generates():
                                       init_on_device=True, quantize_bits=8)
     out8 = e8.generate(np.zeros((2, 4), np.int32), max_new_tokens=4)
     assert np.asarray(out8).shape == (2, 8)
+
+
+def test_int8_kv_cache_generate_matches_bf16():
+    """kv_cache_dtype='int8' (r5: per-row absmax cache quantization)
+    must reproduce the bf16-cache generation almost always — greedy
+    decode tolerates the ~0.4% cache rounding except at near-ties."""
+    import dataclasses as _dc
+
+    import deepspeed_tpu
+
+    cfg = _dc.replace(gpt2.GPT2_TINY, n_layer=2)
+    params = gpt2.init_params(cfg, seed=3)
+    kw = dict(model_config=cfg, params=params, mp_size=1)
+    e_bf = deepspeed_tpu.init_inference(**kw)
+    e_q = deepspeed_tpu.init_inference(kv_cache_dtype="int8", **kw)
+    prompts = np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 16), dtype=np.int32)
+    out_bf = np.asarray(e_bf.generate(prompts, max_new_tokens=12))
+    out_q = np.asarray(e_q.generate(prompts, max_new_tokens=12))
+    assert out_bf.shape == out_q.shape == (2, 28)
+    # token-level agreement: allow a few near-tie flips, require the bulk
+    agree = (out_bf == out_q).mean()
+    assert agree > 0.85, (agree, out_bf, out_q)
+
+
+def test_int8_kv_cache_bytes_halved():
+    """The int8 cache's HBM bytes are ~half the bf16 cache's."""
+    from deepspeed_tpu.ops.transformer.inference import init_kv_cache
+
+    kb, vb = init_kv_cache(4, 2, 4, 128, 64, jnp.bfloat16)
+    kq, vq = init_kv_cache(4, 2, 4, 128, 64, "int8")
+    b_bf = kb.size * kb.dtype.itemsize
+    b_q = sum(l.size * l.dtype.itemsize for l in jax.tree.leaves(kq))
+    assert b_q < 0.6 * b_bf, (b_q, b_bf)

@@ -4,11 +4,16 @@ The steady-state XL streaming record (tools/train_xl_onchip.py) is
 bound by the dev tunnel's ~10 MB/s host link — its wall time says
 nothing about the CHIP.  This tool measures what the chip itself does:
 each compiled stage program of the ZeRO-Infinity executor (group fwd,
-group vjp, embed, head, embed bwd) is timed ON DEVICE by chaining N
-iterations inside one jitted ``lax.scan`` (single dispatch + single
-sync, so the tunnel's ~100 ms RTT amortizes to nothing), then
+group vjp, embed, head+vjp, embed bwd) is timed ON DEVICE by chaining
+``iters`` iterations inside one jitted ``lax.scan`` (single dispatch +
+single sync, so the tunnel's ~100 ms RTT amortizes to nothing).  Every
+chain's per-iteration input GENUINELY depends on the carry — either
+the previous iteration's output feeds the next (group chains) or the
+input is gated by ``where(pred(carry), x, zeros)``, which XLA cannot
+simplify away (identical-branch selects could be, and were — review
+finding r5); so no stage is loop-invariant-hoistable.
 
-    chip_step_s = G * (t_group_fwd + t_group_bwd) + t_embed + t_head + t_embed_bwd
+    chip_step_s = G*(t_group_fwd + t_group_bwd) + t_embed + t_head + t_embed_bwd
     chip_mfu    = step_flops / (chip_step_s * peak_flops)
 
 This is the number a real deployment (PCIe-class host link, or fsdp
@@ -17,7 +22,6 @@ the bottleneck — the VERDICT r4 "missing #3" evidence row.
 
 Run: python tools/xl_chip_mfu.py [seq] [micro_bs] [buffer_count] [iters]
 """
-import functools
 import json
 import os
 import sys
@@ -62,12 +66,13 @@ def main():
     print(f"init {time.time() - t0:.0f}s  groups={engine.n_groups}", flush=True)
     spec = engine.spec
     G = engine.n_groups
+    n = iters
 
     rng = np.random.default_rng(0)
-    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (mb, seq), dtype=np.int32)}
+    tokens_np = rng.integers(0, cfg.vocab_size, (mb, seq), dtype=np.int32)
     res = engine._upload_resident()
     g0 = engine._upload_group(0)
-    mbatch = {k: jax.device_put(v, engine._batch_sh) for k, v in batch.items()}
+    mbatch = {"input_ids": jax.device_put(tokens_np, engine._batch_sh)}
     tokens = mbatch["input_ids"]
     rngs = engine._layer_rngs(0, 0)[0]
 
@@ -75,18 +80,22 @@ def main():
         # block_until_ready is unreliable through the tunnel; pull bytes
         np.asarray(jax.device_get(jax.tree.leaves(x)[0]))
 
-    def timed(fn, *args, warm=True):
-        if warm:
-            sync(fn(*args))  # compile + warm
+    def timed(fn, *args):
+        sync(fn(*args))  # compile + warm
         t0 = time.time()
         out = fn(*args)
         sync(out)
-        return (time.time() - t0) / iters
+        return (time.time() - t0) / n
 
-    n = iters
+    def gate(pred_scalar, x):
+        """where(pred, x, 0): carry-dependent and NOT simplifiable (the
+        compiler cannot prove pred) — the hoist-blocker for chains whose
+        natural input is loop-invariant."""
+        return jnp.where(pred_scalar, x, jnp.zeros_like(x))
 
     @jax.jit
     def chain_group_fwd(gp, x, r):
+        # output feeds the next iteration: naturally carry-dependent
         def body(x_, _):
             return spec.group(gp, x_, r, spec.deterministic), None
 
@@ -95,10 +104,11 @@ def main():
 
     @jax.jit
     def chain_group_bwd(gp, x, r, dy):
+        # cotangent chains through dx: naturally carry-dependent
         def body(dy_, _):
             _, vjp = jax.vjp(lambda g_, x_: spec.group(g_, x_, r, spec.deterministic), gp, x)
             dgp, dx = vjp(dy_)
-            return dx, None
+            return dx.astype(dy_.dtype), None
 
         out, _ = jax.lax.scan(body, dy, None, length=n)
         return out
@@ -106,10 +116,11 @@ def main():
     @jax.jit
     def chain_embed(r_, t_):
         def body(c, _):
-            return spec.embed(r_, t_) + 0.0 * c, None
+            y = spec.embed(r_, gate(jnp.isfinite(c), t_.astype(jnp.float32)).astype(t_.dtype))
+            return y.astype(jnp.float32).reshape(-1)[0], None
 
-        y, _ = jax.lax.scan(body, spec.embed(r_, t_), None, length=n)
-        return y
+        c, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=n)
+        return c
 
     @jax.jit
     def chain_head(r_, x_):
@@ -117,9 +128,9 @@ def main():
             def f(rr, xx):
                 return spec.head_loss(rr, xx, mbatch)
 
-            loss, vjp = jax.vjp(f, r_, x_)
+            loss, vjp = jax.vjp(f, r_, gate(jnp.isfinite(c), x_))
             d_res, dx = vjp(jnp.float32(1.0).astype(loss.dtype))
-            return c + loss.astype(jnp.float32), None
+            return loss.astype(jnp.float32) + dx.astype(jnp.float32).reshape(-1)[0], None
 
         y, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=n)
         return y
@@ -128,19 +139,20 @@ def main():
     def chain_embed_bwd(r_, t_, dx0):
         def body(c, _):
             _, vjp = jax.vjp(lambda rr: spec.embed(rr, t_), r_)
-            (d_res,) = vjp(dx0 + 0.0 * c)
-            return c + 1.0, None
+            (d_res,) = vjp(gate(jnp.isfinite(c), dx0))
+            return jax.tree.leaves(d_res)[0].astype(jnp.float32).reshape(-1)[0], None
 
         y, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=n)
         return y
 
     x0 = jax.jit(lambda r_, t_: spec.embed(r_, t_))(res, tokens)
-    dy = jnp.ones_like(x0)
-    t_gf = timed(chain_group_fwd, g0, x0, rngs)
+    y0 = jax.jit(lambda gp, x, r: spec.group(gp, x, r, spec.deterministic))(g0, x0, rngs)
+    dy = jnp.ones_like(y0)  # cotangent in the GROUP's output dtype
+    t_gf = timed(chain_group_fwd, g0, y0, rngs)
     t_gb = timed(chain_group_bwd, g0, x0, rngs, dy)
     t_em = timed(chain_embed, res, tokens)
     t_hd = timed(chain_head, res, x0)
-    t_eb = timed(chain_embed_bwd, res, tokens, dy)
+    t_eb = timed(chain_embed_bwd, res, tokens, jnp.ones_like(x0))
     print(
         f"per-program chip times: group_fwd={t_gf * 1000:.1f}ms "
         f"group_bwd={t_gb * 1000:.1f}ms embed={t_em * 1000:.1f}ms "
@@ -170,14 +182,19 @@ def main():
         },
         "seq": seq,
         "micro_bs": mb,
+        "iters": iters,
         "method": (
             "each streaming stage program timed on-chip via a jitted "
             f"lax.scan of {iters} chained iterations (one dispatch+sync, "
-            "tunnel RTT amortized); chip_step = G*(fwd+vjp) + embed + "
-            "head + embed_bwd; MFU = step_flops/(chip_step*peak). "
-            "Tunnel-bound phases (group upload over the ~10MB/s dev "
-            "link, grad drain) are excluded by construction — they "
-            "pipeline under compute on a PCIe-class host link."
+            "tunnel RTT amortized); every chain's input depends on its "
+            "carry (group chains feed outputs forward; fixed-input "
+            "chains gate through where(pred(carry), x, 0)), so nothing "
+            "is loop-invariant-hoistable. chip_step = G*(fwd+vjp) + "
+            "embed + head + embed_bwd; MFU = step_flops/(chip_step*"
+            "peak). Tunnel-bound phases (group upload over the ~10MB/s "
+            "dev link, grad drain, host Adam) are excluded by "
+            "construction — they pipeline under compute on a PCIe-class "
+            "host link."
         ),
     }
     print("RESULT " + json.dumps(rec), flush=True)
