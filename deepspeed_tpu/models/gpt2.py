@@ -258,7 +258,13 @@ def _sparse_attn(cfg: GPT2Config, q, k, v, T: int):
 
     sc = cfg.sparsity_config
     if sc is None:
-        block = 64 if T % 64 == 0 else 16
+        # prefer BIG blocks: the splash kernels run one (q-row, edge)
+        # pair per grid step, so per-step launch overhead (~1µs)
+        # amortizes over block² work — block 256 beat 128 by ~1.3x at
+        # 8k on v5e (r5 crossover sweep), and MXU efficiency rises too
+        # T/block must cover the 3-block sliding window or make_layout
+        # refuses (short sequences fall back to smaller blocks)
+        block = next((b for b in (256, 128, 64, 16) if T % b == 0 and T // b >= 3), 16)
         sc = BigBirdSparsityConfig(
             num_heads=cfg.n_head, block=block, num_random_blocks=1,
             num_sliding_window_blocks=3, num_global_blocks=1, attention="unidirectional",

@@ -15,12 +15,15 @@ TPU-native re-design (NOT a Triton port):
 * Two interchangeable kernels (``backend=`` on
   ``block_sparse_attention``; auto prefers splash):
 
-  - **splash** (default on MXU-worthy blocks): active K/V blocks are
-    gathered into compact O(nnz) strips and a fused Pallas program per
-    (batch·head, q-row-group) runs the whole online softmax — the
-    O(nnz·block²) fp32 score/probability tensors never touch HBM.
-    Measured 1.5×/3.2× over dense causal flash at seq 4k/16k on v5e
-    (``tools/bench_sparse.py``).
+  - **splash** (default on MXU-worthy blocks): one Pallas grid step per
+    (batch·head, q-row, edge), with the layout's kv-block index applied
+    in the K/V BlockSpec index_map (scalar-prefetch) — the "gather" IS
+    the pipeline's block fetch, so neither O(nnz) strips nor the
+    O(nnz·block²) fp32 score tensors ever touch HBM.  Online-softmax
+    state rides VMEM scratch across a row's sequential edge steps.
+    Measured kernel-level fwd+bwd vs dense causal flash on v5e (block
+    256): 1.21× at 8k, ~14× at 16k; full-train-step crossover ~10k
+    (``BENCH_CAPABILITY.json`` sparse_attention_crossover records).
   - **gather**: the XLA formulation (one ``take`` + dense masked
     block attention) — differentiable end-to-end; it is also the
     splash path's backward via recompute, and the numerics oracle.
@@ -502,76 +505,102 @@ def _masked_softmax(s):
 # bucket so they don't pad every row's degree to nb.
 
 
+def _dot_rhs_t(a, bt):
+    """a @ bt.T without materializing the transpose: contract a's last
+    dim with bt's LAST dim — (M, K) × (N, K) → (M, N)."""
+    return jax.lax.dot_general(
+        a, bt, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _dot_lhs_t(at, b):
+    """at.T @ b without materializing the transpose: contract FIRST
+    dims — (K, M) × (K, N) → (M, N)."""
+    return jax.lax.dot_general(
+        at, b, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
 def _splash_kernel(
-    idx_ref, valid_ref, q_ref, kv_ref, vv_ref, o_ref, *rest,
-    sm_scale: float, causal: bool, block: int, deg: int, heads: int, group: int,
+    idx_ref, valid_ref, q_ref, k_ref, v_ref, o_ref, *rest,
+    sm_scale: float, causal: bool, block: int, deg: int, heads: int,
 ):
-    # each program handles `group` consecutive q-rows — grid-step launch
-    # overhead dominates at long sequences, so amortize it.
-    # Optional trailing output: per-row logsumexp (8-sublane broadcast
-    # layout) saved for the backward, which then never recomputes the
-    # online-softmax stats (the flash kernels' attn_lse pattern).
-    lse_ref = rest[0] if rest else None
-    h = pl.program_id(0) % heads
-    g0 = pl.program_id(1)
+    """One (q-row, edge) pair per grid step; the EDGE axis is the
+    innermost grid dim and the layout's kv-block index is applied in the
+    K/V BlockSpec index_map (scalar-prefetch) — the "gather" is the
+    pipeline's own block fetch, so no O(nnz) strips ever materialize in
+    HBM.  The r4 design gathered strips in XLA first; measured at 8k
+    those gathers were most of the sparse step (9.7 ms of strips vs
+    ~4.5 ms of kernels) and three in-kernel-DMA alternatives all hit
+    Mosaic walls (2-D DMA of (block, 64) tiles: lane-dim < 128
+    rejected; transposed/padded staging: 14-16 ms of XLA relayouts;
+    1-D DMA + reshape: unsupported shape cast).
+
+    Online-softmax state (m, l, acc) lives in VMEM scratch that
+    persists across the sequential edge steps of one row; the output
+    (and optional lse) is written at the row's last edge."""
+    rest = list(rest)
+    m_scr, l_scr, acc_scr = rest[-3], rest[-2], rest[-1]
+    lse_ref = rest[0] if len(rest) == 4 else None
+    bh = pl.program_id(0)
+    h = bh % heads
+    row = pl.program_id(1)
+    e = pl.program_id(2)
     hd = q_ref.shape[-1]
 
-    def one_row(gi, _):
-        row = g0 * group + gi
-        q = q_ref[0, pl.dslice(gi * block, block), :]  # (block, hd)
+    @pl.when(e == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
 
-        def body(e, carry):
-            acc, m_prev, l_prev = carry
-            k = kv_ref[0, 0, pl.dslice(gi * deg * block + e * block, block), :]
-            v = vv_ref[0, 0, pl.dslice(gi * deg * block + e * block, block), :]
-            s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
-            ki = idx_ref[h, row * deg + e]
-            ok = valid_ref[h, row * deg + e] == 1
-            if causal:
-                q_pos = row * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
-                k_pos = ki * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
-                keep = jnp.logical_and(ok, q_pos >= k_pos)
-            else:
-                keep = jnp.broadcast_to(ok, (block, block))
-            s = jnp.where(keep, s, DEFAULT_MASK_VALUE)
-            m_cur = jnp.max(s, axis=1, keepdims=True)
-            m_new = jnp.maximum(m_prev, m_cur)
-            # p masked EXPLICITLY: if every entry of a row is masked,
-            # m_new == MASK_VALUE and exp(s - m_new) would be 1, faking a
-            # nonzero l — the zero-degree-row guard below depends on l==0
-            p = jnp.exp(s - m_new) * keep.astype(jnp.float32)
-            alpha = jnp.exp(m_prev - m_new)
-            l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
-            acc = acc * alpha + jnp.dot(p.astype(v.dtype), v, preferred_element_type=jnp.float32)
-            return acc, m_new, l_new
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    s = _dot_rhs_t(q, k) * sm_scale  # q @ k^T, contracting the hd dims
+    ki = idx_ref[h, row * deg + e]
+    ok = valid_ref[h, row * deg + e] == 1
+    if causal:
+        q_pos = row * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
+        k_pos = ki * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
+        keep = jnp.logical_and(ok, q_pos >= k_pos)
+    else:
+        keep = jnp.broadcast_to(ok, (block, block))
+    s = jnp.where(keep, s, DEFAULT_MASK_VALUE)
+    m_prev = m_scr[...]
+    l_prev = l_scr[...]
+    m_cur = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    # p masked EXPLICITLY: if every entry of a row is masked,
+    # m_new == MASK_VALUE and exp(s - m_new) would be 1, faking a
+    # nonzero l — the zero-degree-row guard below depends on l==0
+    p = jnp.exp(s - m_new) * keep.astype(jnp.float32)
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+    m_scr[...] = m_new
+    l_scr[...] = l_new
+    acc_scr[...] = acc_scr[...] * alpha + jnp.dot(
+        p.astype(v.dtype), v, preferred_element_type=jnp.float32
+    )
 
-        init = (
-            jnp.zeros((block, hd), jnp.float32),
-            jnp.full((block, 1), -jnp.inf, jnp.float32),
-            jnp.zeros((block, 1), jnp.float32),
-        )
-        acc, m, l = jax.lax.fori_loop(0, deg, body, init)
+    @pl.when(e == deg - 1)
+    def _flush():
+        l = l_scr[...]
         safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, pl.dslice(gi * block, block), :] = (acc / safe).astype(o_ref.dtype)
+        o_ref[0, 0] = (acc_scr[...] / safe).astype(o_ref.dtype)
         if lse_ref is not None:
-            # +inf for zero-degree rows ⇒ bwd's exp(s − lse) is exactly 0.
-            # Layout (group, 8, block): the store covers the FULL lane
-            # dim — a (8, group·block) row buffer sliced at gi·block
-            # fails Mosaic's 128-alignment rule for block < 128
+            # +inf for zero-degree rows ⇒ bwd's exp(s − lse) is exactly 0
+            m = m_scr[...]
             lse = jnp.where(
                 l[:, 0] == 0.0, jnp.inf, m[:, 0] + jnp.log(jnp.maximum(l[:, 0], 1e-37))
             )
-            lse_ref[0, gi] = jnp.broadcast_to(lse[None, :], (8, block))
-        return 0
-
-    jax.lax.fori_loop(0, group, one_row, 0)
+            lse_ref[0, 0] = jnp.broadcast_to(lse[None, :], (8, block))
 
 
-def _splash_prep(q, k, v, layout: np.ndarray, block: int, vmem_bufs: int = 2):
-    """Shared fwd/bwd staging: gathered K/V strips + SMEM index arrays.
-
-    ``vmem_bufs``: how many strip-sized VMEM buffers the kernel will hold
-    (fwd: k,v = 2; bwd: k,v,dk,dv = 4) — bounds the row-group size."""
+def _splash_prep(q, k, v, layout: np.ndarray, block: int):
+    """Shared fwd/bwd staging: SMEM index arrays + (bh, nb, block, hd)
+    block views of q/k/v — the kernels' K/V index_maps pick blocks
+    straight from these (no strip gathers)."""
     B, H, T, hd = q.shape
     nb = T // block
     idx_np, valid_np, drows_np, dvalid_np = _layout_gather_indices(layout)
@@ -582,59 +611,54 @@ def _splash_prep(q, k, v, layout: np.ndarray, block: int, vmem_bufs: int = 2):
     idx = jnp.asarray(idx_np)
     idx2 = jnp.asarray(idx_np.reshape(idx_np.shape[0], -1))
     valid2 = jnp.asarray(valid_np.astype(np.int32).reshape(valid_np.shape[0], -1))
-
-    kb = k.reshape(B, H, nb, block, hd)
-    vb = v.reshape(B, H, nb, block, hd)
-    gather = jax.vmap(
-        jax.vmap(lambda blocks, ids: jnp.take(blocks, ids, axis=0), in_axes=(0, 0)),
-        in_axes=(0, None),
-    )
-    # (B, H, nb, deg, block, hd) → (bh, nb/G, G·deg·block, hd): one
-    # compact KV strip per (batch·head, row-group), O(nnz) bytes in the
-    # input dtype.  G rows share a program to amortize grid-step launch
-    # overhead (the dominant cost at long sequences); VMEM bounds G.
-    group = 1
-    for g in (8, 4, 2):
-        if nb % g == 0 and vmem_bufs * g * deg * block * hd * q.dtype.itemsize <= (1 << 22):
-            group = g
-            break
-    kg = gather(kb, idx).reshape(B * H, nb // group, group * deg * block, hd)
-    vg = gather(vb, idx).reshape(B * H, nb // group, group * deg * block, hd)
-    qr = q.reshape(B * H, T, hd)
-    return qr, kg, vg, idx, idx2, valid2, deg, group, nb, drows_np, dvalid_np
+    qr = q.reshape(B * H, nb, block, hd)
+    kr = k.reshape(B * H, nb, block, hd)
+    vr = v.reshape(B * H, nb, block, hd)
+    return qr, kr, vr, idx, idx2, valid2, deg, nb, drows_np, dvalid_np
 
 
 def _splash_fwd(q, k, v, layout: np.ndarray, block: int, causal: bool, sm_scale: float, interpret: bool, want_lse: bool = False):
     from jax.experimental.pallas import tpu as pltpu
 
     B, H, T, hd = q.shape
-    qr, kg, vg, _idx, idx2, valid2, deg, group, nb, _dr, _dv = _splash_prep(q, k, v, layout, block)
+    qr, kr, vr, _idx, idx2, valid2, deg, nb, _dr, _dv = _splash_prep(q, k, v, layout, block)
+    H_ = H
 
-    strip_spec = pl.BlockSpec((1, 1, group * deg * block, hd), lambda b, r, idx, valid: (b, r, 0, 0))
-    row_spec = pl.BlockSpec((1, group * block, hd), lambda b, r, idx, valid: (b, r, 0))
-    out_specs = [row_spec]
-    out_shape = [jax.ShapeDtypeStruct((B * H, T, hd), q.dtype)]
+    q_spec = pl.BlockSpec((1, 1, block, hd), lambda b, r, e, idx, valid: (b, r, 0, 0))
+    kv_spec = pl.BlockSpec(
+        (1, 1, block, hd),
+        lambda b, r, e, idx, valid: (b, idx[b % H_, r * deg + e], 0, 0),
+    )
+    out_specs = [q_spec]
+    out_shape = [jax.ShapeDtypeStruct((B * H, nb, block, hd), q.dtype)]
     if want_lse:
         out_specs.append(
-            pl.BlockSpec((1, group, 8, block), lambda b, r, idx, valid: (b, r, 0, 0))
+            pl.BlockSpec((1, 1, 8, block), lambda b, r, e, idx, valid: (b, r, 0, 0))
         )
         out_shape.append(jax.ShapeDtypeStruct((B * H, nb, 8, block), jnp.float32))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(B * H, nb // group),
-        in_specs=[row_spec, strip_spec, strip_spec],
+        grid=(B * H, nb, deg),
+        in_specs=[q_spec, kv_spec, kv_spec],
         out_specs=out_specs,
-        scratch_shapes=[],
+        scratch_shapes=[
+            pltpu.VMEM((block, 1), jnp.float32),
+            pltpu.VMEM((block, 1), jnp.float32),
+            pltpu.VMEM((block, hd), jnp.float32),
+        ],
     )
     kern = functools.partial(
-        _splash_kernel, sm_scale=sm_scale, causal=causal, block=block, deg=deg, heads=H, group=group
+        _splash_kernel, sm_scale=sm_scale, causal=causal, block=block, deg=deg, heads=H
     )
     outs = pl.pallas_call(
         kern,
         grid_spec=grid_spec,
         out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+        ),
         interpret=interpret,
-    )(idx2, valid2, qr, kg, vg)
+    )(idx2, valid2, qr, kr, vr)
     if want_lse:
         out, lse = outs
         return out.reshape(B, H, T, hd), lse[:, :, 0, :].reshape(B, H, T)
@@ -642,72 +666,68 @@ def _splash_fwd(q, k, v, layout: np.ndarray, block: int, causal: bool, sm_scale:
 
 
 def _splash_bwd_kernel(
-    idx_ref, valid_ref, q_ref, kv_ref, vv_ref, lse_ref, g_ref, dq_ref, dk_ref, dv_ref,
-    *, sm_scale: float, causal: bool, block: int, deg: int, heads: int, group: int,
+    idx_ref, valid_ref, q_ref, k_ref, v_ref, lse_ref, g_ref, dq_ref, dk_ref, dv_ref,
+    dq_scr,
+    *, sm_scale: float, causal: bool, block: int, deg: int, heads: int,
 ):
-    """Single-pass backward over the gathered strips, one program per
-    (batch·head, q-row-group): P = exp(S − lse) rebuilds from the
-    forward's SAVED logsumexp (the r3 version recomputed the online
-    m/l stats in a first pass — one extra qk dot + exp per score, pure
-    waste once the fwd emits lse; same design as the flash fused
-    backward), then p → dp → ds accumulates dq and writes per-edge
-    dk/dv into STRIP-layout outputs (scattered back to blocks with a
-    segment-sum outside the kernel).  ``delta`` comes in precomputed
-    through the lse row buffer's sibling (see _splash_bwd)."""
-    h = pl.program_id(0) % heads
-    g0 = pl.program_id(1)
+    """Single-pass backward, one (q-row, edge) pair per grid step:
+    P = exp(S − lse) rebuilds from the forward's SAVED logsumexp, then
+    p → dp → ds accumulates dq in scratch (flushed at the row's last
+    edge) and writes per-edge dk/dv into STRIP-layout outputs
+    (scattered back to blocks with a segment-sum outside — different
+    rows hit the same kv block, which output revisiting cannot
+    accumulate).  K/V blocks arrive through the same index_map
+    "gather-in-the-pipeline" as the forward.  ``delta`` comes in
+    precomputed through the lse row buffer's sibling sublane."""
+    bh = pl.program_id(0)
+    h = bh % heads
+    row = pl.program_id(1)
+    e = pl.program_id(2)
     hd = q_ref.shape[-1]
 
-    def one_row(gi, _):
-        row_idx = g0 * group + gi
-        q = q_ref[0, pl.dslice(gi * block, block), :]
-        g = g_ref[0, pl.dslice(gi * block, block), :]
-        # (group, 8, block) layout: full-lane-dim reads (see fwd comment)
-        lse = lse_ref[0, gi, 0, :][:, None]
-        delta = lse_ref[0, gi, 1, :][:, None]
+    @pl.when(e == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
 
-        def body(e, dq):
-            k = kv_ref[0, 0, pl.dslice(gi * deg * block + e * block, block), :]
-            v = vv_ref[0, 0, pl.dslice(gi * deg * block + e * block, block), :]
-            s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
-            ki = idx_ref[h, row_idx * deg + e]
-            ok = valid_ref[h, row_idx * deg + e] == 1
-            if causal:
-                q_pos = row_idx * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
-                k_pos = ki * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
-                keep = jnp.logical_and(ok, q_pos >= k_pos)
-            else:
-                keep = jnp.broadcast_to(ok, (block, block))
-            s = jnp.where(keep, s, DEFAULT_MASK_VALUE)
-            # saved lse is +inf for zero-degree rows ⇒ p exactly 0
-            p = jnp.exp(s - lse) * keep.astype(jnp.float32)
-            dp = jnp.dot(g, v.T, preferred_element_type=jnp.float32)
-            ds = p * (dp - delta) * sm_scale
-            dq = dq + jnp.dot(ds.astype(k.dtype), k, preferred_element_type=jnp.float32)
-            off = gi * deg * block + e * block
-            dk_ref[0, 0, pl.dslice(off, block), :] = jnp.dot(
-                ds.astype(q.dtype).T, q, preferred_element_type=jnp.float32
-            ).astype(dk_ref.dtype)
-            dv_ref[0, 0, pl.dslice(off, block), :] = jnp.dot(
-                p.astype(g.dtype).T, g, preferred_element_type=jnp.float32
-            ).astype(dv_ref.dtype)
-            return dq
+    q = q_ref[0, 0]
+    g = g_ref[0, 0]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    # (1, 8, block) layout: full-lane-dim reads (see fwd comment)
+    lse = lse_ref[0, 0, 0, :][:, None]
+    delta = lse_ref[0, 0, 1, :][:, None]
+    s = _dot_rhs_t(q, k) * sm_scale
+    ki = idx_ref[h, row * deg + e]
+    ok = valid_ref[h, row * deg + e] == 1
+    if causal:
+        q_pos = row * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
+        k_pos = ki * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
+        keep = jnp.logical_and(ok, q_pos >= k_pos)
+    else:
+        keep = jnp.broadcast_to(ok, (block, block))
+    s = jnp.where(keep, s, DEFAULT_MASK_VALUE)
+    # saved lse is +inf for zero-degree rows ⇒ p exactly 0
+    p = jnp.exp(s - lse) * keep.astype(jnp.float32)
+    dp = _dot_rhs_t(g, v)  # g @ v^T
+    ds = p * (dp - delta) * sm_scale
+    dq_scr[...] = dq_scr[...] + jnp.dot(
+        ds.astype(k.dtype), k, preferred_element_type=jnp.float32
+    )
+    dk_ref[0, 0] = _dot_lhs_t(ds.astype(q.dtype), q).astype(dk_ref.dtype)  # ds^T @ q
+    dv_ref[0, 0] = _dot_lhs_t(p.astype(g.dtype), g).astype(dv_ref.dtype)  # p^T @ g
 
-        dq = jax.lax.fori_loop(0, deg, body, jnp.zeros((block, hd), jnp.float32))
-        dq_ref[0, pl.dslice(gi * block, block), :] = dq.astype(dq_ref.dtype)
-        return 0
-
-    jax.lax.fori_loop(0, group, one_row, 0)
+    @pl.when(e == deg - 1)
+    def _flush():
+        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
 
 
 def _splash_bwd(q, k, v, out, lse, g, layout: np.ndarray, block: int, causal: bool, sm_scale: float, interpret: bool):
     from jax.experimental.pallas import tpu as pltpu
 
     B, H, T, hd = q.shape
-    qr, kg, vg, idx, idx2, valid2, deg, group, nb, _dr, _dv = _splash_prep(
-        q, k, v, layout, block, vmem_bufs=4
-    )
-    gr = g.reshape(B * H, T, hd)
+    qr, kr, vr, idx, idx2, valid2, deg, nb, _dr, _dv = _splash_prep(q, k, v, layout, block)
+    H_ = H
+    gr = g.reshape(B * H, nb, block, hd)
     # per-row scalars ride ONE (bh, nb, 8, block) buffer: sublane 0 =
     # the fwd's saved lse, sublane 1 = delta = rowsum(dO ∘ O) (computed
     # here in XLA — one fused elementwise pass); the per-q-block trailing
@@ -718,29 +738,38 @@ def _splash_bwd(q, k, v, out, lse, g, layout: np.ndarray, block: int, causal: bo
         axis=2,
     )
 
-    strip_spec = pl.BlockSpec((1, 1, group * deg * block, hd), lambda b, r, idx, valid: (b, r, 0, 0))
-    row_spec = pl.BlockSpec((1, group * block, hd), lambda b, r, idx, valid: (b, r, 0))
-    lse_spec = pl.BlockSpec((1, group, 8, block), lambda b, r, idx, valid: (b, r, 0, 0))
+    q_spec = pl.BlockSpec((1, 1, block, hd), lambda b, r, e, idx, valid: (b, r, 0, 0))
+    kv_spec = pl.BlockSpec(
+        (1, 1, block, hd),
+        lambda b, r, e, idx, valid: (b, idx[b % H_, r * deg + e], 0, 0),
+    )
+    strip_spec = pl.BlockSpec(
+        (1, 1, block, hd), lambda b, r, e, idx, valid: (b, r * deg + e, 0, 0)
+    )
+    lse_spec = pl.BlockSpec((1, 1, 8, block), lambda b, r, e, idx, valid: (b, r, 0, 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(B * H, nb // group),
-        in_specs=[row_spec, strip_spec, strip_spec, lse_spec, row_spec],
-        out_specs=[row_spec, strip_spec, strip_spec],
-        scratch_shapes=[],
+        grid=(B * H, nb, deg),
+        in_specs=[q_spec, kv_spec, kv_spec, lse_spec, q_spec],
+        out_specs=[q_spec, strip_spec, strip_spec],
+        scratch_shapes=[pltpu.VMEM((block, hd), jnp.float32)],
     )
     kern = functools.partial(
-        _splash_bwd_kernel, sm_scale=sm_scale, causal=causal, block=block, deg=deg, heads=H, group=group
+        _splash_bwd_kernel, sm_scale=sm_scale, causal=causal, block=block, deg=deg, heads=H
     )
     dq, dk_strip, dv_strip = pl.pallas_call(
         kern,
         grid_spec=grid_spec,
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, T, hd), q.dtype),
-            jax.ShapeDtypeStruct((B * H, nb // group, group * deg * block, hd), k.dtype),
-            jax.ShapeDtypeStruct((B * H, nb // group, group * deg * block, hd), v.dtype),
+            jax.ShapeDtypeStruct((B * H, nb, block, hd), q.dtype),
+            jax.ShapeDtypeStruct((B * H, nb * deg, block, hd), k.dtype),
+            jax.ShapeDtypeStruct((B * H, nb * deg, block, hd), v.dtype),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+        ),
         interpret=interpret,
-    )(idx2, valid2, qr, kg, vg, rows, gr)
+    )(idx2, valid2, qr, kr, vr, rows, gr)
 
     # scatter-add the strip grads back to K/V blocks: segment-sum over
     # each head's (row, edge) -> k-block index map (the transpose of the
@@ -761,6 +790,7 @@ def _splash_bwd(q, k, v, out, lse, g, layout: np.ndarray, block: int, causal: bo
     dk = scatter(dk_strip).astype(k.dtype)
     dv = scatter(dv_strip).astype(v.dtype)
     return dq.reshape(B, H, T, hd), dk, dv
+
 
 
 def _on_tpu_backend() -> bool:
