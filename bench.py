@@ -102,14 +102,16 @@ def _timed_steps(engine, batches, steps, label):
     """Compile+warm, then best-of-2 timing windows with a true host sync
     (one bad window must not poison the record).
 
-    The window drives ``engine.train_batches`` (N steps in ONE compiled
-    lax.scan) when available: per-program dispatch overhead through a
-    remote runtime (~10-30 ms/step over the dev tunnel) amortizes over
-    the run, the way production TPU loops (t5x/pax) are driven.  The
-    per-step semantics are identical (pinned by
+    ``DS_BENCH_RUN_API=1`` drives ``engine.train_batches`` (N steps in
+    ONE compiled lax.scan; semantics pinned by
     tests/test_engine.py::test_train_batches_matches_per_step_loop)."""
+    # default OFF on the tunnel: the scanned multi-step program's carry
+    # double-buffer copies of the big state cost MORE than the per-step
+    # dispatch they save (774M: 271 vs 234 ms/step, r5 measured; see
+    # docs/design-notes.md) — flip on for backends where dispatch
+    # dominates
     use_run = hasattr(engine, "train_batches") and not getattr(engine, "_offload", False)
-    use_run = use_run and os.environ.get("DS_BENCH_RUN_API", "1") != "0"
+    use_run = use_run and os.environ.get("DS_BENCH_RUN_API", "0") == "1"
     tb_unroll = os.environ.get("DS_TB_UNROLL") == "1"
     t0 = time.time()
     if use_run:
