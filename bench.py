@@ -115,7 +115,9 @@ def _timed_steps(engine, batches, steps, label):
     tb_unroll = os.environ.get("DS_TB_UNROLL") == "1"
     t0 = time.time()
     if use_run:
-        losses = engine.train_batches(list(batches(2)), unroll=tb_unroll)
+        # warm with the SAME n=steps program the windows time — an
+        # n=2 warmup would leave window 1 paying the real compile
+        losses = engine.train_batches(list(batches(steps)), unroll=tb_unroll)
         loss = float(losses[-1])
     else:
         for batch in engine.prefetch_loader(batches(2)):

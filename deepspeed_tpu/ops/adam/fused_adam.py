@@ -131,12 +131,17 @@ class FusedAdam:
         sr = jax.lax.bitcast_convert_type(y, jnp.float32)
         return jnp.where(jnp.isfinite(x32), sr, x32).astype(jnp.bfloat16)
 
-    def _v_encode(self, v32: jnp.ndarray, key: Optional[jax.Array]):
+    def _v_encode(self, v32: jnp.ndarray, key: Optional[jax.Array], skip=None):
         """v (fp32, >=0) -> (uint8 codes of sqrt(v), per-block scales).
         sqrt halves the dynamic range the 8 linear bits must cover;
         stochastic rounding (when a key is given) keeps the EMA unbiased
-        so sub-step increments are not systematically lost."""
+        so sub-step increments are not systematically lost.  ``skip``:
+        on overflow-skipped steps the rounding switches to NEAREST so
+        re-encode(decode(v)) is (near-)idempotent — SR would otherwise
+        random-walk the stored codes across a burst of skips."""
         if self.state_precision == "bf16":
+            # bf16 SR is naturally idempotent on exact-bf16 inputs (the
+            # low mantissa bits are zero, so the added noise masks away)
             return self._sr_bf16(v32, key), jnp.zeros((1,), jnp.float32)
         b = self._v_blocks(v32.size)
         if b == 0:
@@ -147,8 +152,10 @@ class FusedAdam:
         s = jnp.maximum(jnp.max(u, axis=1, keepdims=True), 1e-30) / 255.0
         q = u / s
         if key is not None:
-            bits = self._rbg_bits(key, q.shape)
-            q = jnp.floor(q + bits.astype(jnp.float32) * (1.0 / 4294967296.0))
+            noise = self._rbg_bits(key, q.shape).astype(jnp.float32) * (1.0 / 4294967296.0)
+            if skip is not None:
+                noise = jnp.where(skip, 0.5, noise)  # nearest on skipped steps
+            q = jnp.floor(q + noise)
         else:
             q = jnp.round(q)
         codes = jnp.clip(q, 0, 255).astype(jnp.uint8).reshape(v32.shape)
@@ -302,7 +309,7 @@ class FusedAdam:
                 upd = upd - lr * self.weight_decay * p32
             if keep is not None:
                 upd = keep * upd
-            nvq, nvs = self._v_encode(v_new, keys[i])
+            nvq, nvs = self._v_encode(v_new, keys[i], skip)
             upds.append(upd)
             ms.append(m_new.astype(jnp.bfloat16))
             vqs.append(nvq)

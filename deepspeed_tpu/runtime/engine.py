@@ -1334,25 +1334,25 @@ class DeepSpeedEngine:
             def full_run(state, run):
                 def body(st, stk):
                     st, loss, info = full_step(st, stk)
-                    return st, (loss, info["overflow"])
+                    return st, (loss, info["overflow"], info["lr"], info["grad_norm"])
 
                 # unroll=n removes the while-loop: no carry double-buffer
                 # copies of the big state, at the cost of an n× graph
-                state, (losses, ovf) = jax.lax.scan(
+                state, (losses, ovf, lrs, gns) = jax.lax.scan(
                     body, state, run, unroll=n if unroll else 1
                 )
-                return state, losses, jnp.sum(ovf.astype(jnp.int32))
+                return state, losses, jnp.sum(ovf.astype(jnp.int32)), lrs[-1], gns[-1]
 
             scalar = self._sh(P())
             self._compiled[key] = (
                 jax.jit(
                     self._scoped(full_run), donate_argnums=(0,),
-                    out_shardings=(self._state_shardings, scalar, scalar),
+                    out_shardings=(self._state_shardings, scalar, scalar, scalar, scalar),
                 )
                 .lower(self.state, run)
                 .compile()
             )
-        self.state, losses, ovf_count = self._compiled[key](self.state, run)
+        self.state, losses, ovf_count, last_lr, last_gn = self._compiled[key](self.state, run)
         losses = np.asarray(losses)
         skipped = int(ovf_count)
         if self.loss_scaler.dynamic:
@@ -1361,8 +1361,12 @@ class DeepSpeedEngine:
         else:
             self._host_global_step += n  # matches the per-step loop's host count
         self._host_micro_step += n * self.gradient_accumulation_steps
-        self._last_loss = losses[-1]  # progress reports read these
-        self._last_info = {"overflow": skipped > 0}
+        # progress reports read these — same dict shape as the per-step
+        # loop (lr/grad_norm from the LAST step of the run).  NB the
+        # step_per_print/monitor cadence coalesces: boundaries crossed
+        # strictly inside the run emit one report at run end
+        self._last_loss = losses[-1]
+        self._last_info = {"lr": last_lr, "grad_norm": last_gn, "overflow": skipped > 0}
         self.tput_timer.stop(sync_token=losses[-1] if len(losses) else None)
         self._maybe_report_progress()
         return losses

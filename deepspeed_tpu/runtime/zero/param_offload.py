@@ -120,7 +120,10 @@ class ZeroInfinityEngine:
         pg_shards = max(1, mesh_info.fsdp_world_size) if zc.stage >= 3 else 1
         opt_dev = 0 if zc.offload_optimizer.enabled else 12  # fp32 master+m+v
         opt_shards = max(1, mesh_info.fsdp_world_size) if zc.stage >= 1 else 1
-        per_dev = n * (dt * 2 / pg_shards + opt_dev / opt_shards)  # params+grads, opt
+        # grads accumulate in fp32 on device regardless of compute dtype
+        # (and stay on device even with offload_optimizer) — counting
+        # them at compute width under-estimated bf16 runs by 2 B/param
+        per_dev = n * ((dt + 4) / pg_shards + opt_dev / opt_shards)
         if per_dev > 0.9 * float(hbm):
             raise RuntimeError(
                 f"offload_param requested but this combination cannot stream "
@@ -867,13 +870,9 @@ class ZeroInfinityEngine:
         if not os.path.exists(opt_path):
             logger.warning(f"ZeRO-Infinity checkpoint {path} not found")
             return None, {}
-        self._host_opt.load(opt_path)
-        masters = self._host_opt.masters_tree()
-        self._params_host = masters
-        self._blocks_host = masters[self.spec.blocks_key]
-        self._resident_host = {k: v for k, v in masters.items() if k != self.spec.blocks_key}
-        if self._param_swapper is not None:
-            self._swap_out_all_groups()
+        # topology validation BEFORE any state is replaced: loading a
+        # mismatched slice layout would corrupt the masters and only
+        # raise afterwards (review finding r5)
         meta = {}
         meta_path = os.path.join(path, "meta.json")
         if os.path.exists(meta_path):
@@ -891,6 +890,13 @@ class ZeroInfinityEngine:
                 f"{jax.process_count()} — the per-rank master files would "
                 "mis-slice the fsdp axis. Restore with a matching topology."
             )
+        self._host_opt.load(opt_path)
+        masters = self._host_opt.masters_tree()
+        self._params_host = masters
+        self._blocks_host = masters[self.spec.blocks_key]
+        self._resident_host = {k: v for k, v in masters.items() if k != self.spec.blocks_key}
+        if self._param_swapper is not None:
+            self._swap_out_all_groups()
         self.global_steps = int(meta.get("global_step", 0))
         self.skipped_steps = int(meta.get("skipped_steps", 0))
         log_dist(f"loaded ZeRO-Infinity checkpoint {path} (global_step={self.global_steps})")
