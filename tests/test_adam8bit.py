@@ -132,3 +132,33 @@ def test_engine_8bit_checkpoint_roundtrip(tmp_path):
     assert isinstance(e2.state["opt_state"], AdamState8)
     resumed = float(e2.train_batch(probe))
     np.testing.assert_allclose(cont, resumed, rtol=1e-4, atol=1e-5)
+
+
+def test_8bit_state_stable_across_skipped_steps():
+    """Overflow-skipped steps must not perturb the quantized state: the
+    skip path rounds v codes to NEAREST (re-encode(decode) idempotent up
+    to scale re-derivation) and bf16 m is exactly preserved — a burst of
+    skips may not random-walk the state (review finding r5)."""
+    rng = np.random.default_rng(4)
+    p = {"w": jnp.asarray(rng.standard_normal((64, 512)).astype(np.float32))}
+    opt = FusedAdam(lr=1e-2, state_precision="8bit")
+    state = opt.init(p)
+    key = jax.random.PRNGKey(0)
+    g_good = {"w": jnp.asarray(rng.standard_normal((64, 512)).astype(np.float32))}
+    # build up some real state first
+    for i in range(3):
+        _, state = opt.update(g_good, state, p, rng=jax.random.fold_in(key, i),
+                              skip=jnp.bool_(False))
+    m0 = np.asarray(state.exp_avg["w"])
+    vq0 = np.asarray(state.vq["w"])
+    g_bad = {"w": jnp.full((64, 512), np.inf, jnp.float32)}
+    for i in range(5):  # a burst of skips
+        upd, state = opt.update(g_bad, state, p, rng=jax.random.fold_in(key, 100 + i),
+                                skip=jnp.bool_(True))
+        assert float(jnp.max(jnp.abs(upd["w"]))) == 0.0  # no param motion
+    np.testing.assert_array_equal(np.asarray(state.exp_avg["w"]), m0)
+    # v codes: nearest re-encode of the decoded value — at most one code
+    # step of drift across the whole burst, never a random walk
+    drift = np.abs(np.asarray(state.vq["w"]).astype(np.int32) - vq0.astype(np.int32))
+    assert drift.max() <= 1, drift.max()
+    assert int(state.step) == 3  # skips did not count
