@@ -197,6 +197,49 @@ def test_splash_grads_match_gather():
 
 @pytest.mark.slow
 @pytest.mark.parametrize("causal", [False, True])
+def test_splash_bwd_with_untouched_kv_columns(causal):
+    """A hand-built layout where some kv columns are attended by NO row:
+    the dkv kernel's placeholder-edge branch (`_layout_dkv_edges`
+    appends one invalid edge per empty column) must write exact zeros to
+    those dk/dv blocks instead of leaving garbage in never-visited
+    output blocks."""
+    r = np.random.default_rng(6)
+    B, H, T, hd, block = 1, 2, 256, 64, 64
+    nb = T // block
+    layout = np.zeros((H, nb, nb), np.uint8)
+    # every row attends column 0; head 0 adds (1,1), head 1 adds (2,2).
+    # Untouched columns: head 0 → {2, 3}, head 1 → {1, 3} — both a
+    # mid-sequence empty column and the final one (which doubles as the
+    # enumeration's padding target, the easier case)
+    for rr in range(nb):
+        layout[:, rr, 0] = 1
+    layout[0, 1, 1] = 1
+    layout[1, 2, 2] = 1
+    if causal:
+        layout = np.tril(layout)
+    q, k, v = (jnp.asarray(r.standard_normal((B, H, T, hd)) * 0.3, jnp.float32) for _ in range(3))
+
+    def loss(backend):
+        return lambda q, k, v: jnp.sum(
+            block_sparse_attention(q, k, v, layout, block, causal=causal, backend=backend) ** 2
+        )
+
+    g_s = jax.grad(loss("splash"), argnums=(0, 1, 2))(q, k, v)
+    g_g = jax.grad(loss("gather"), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_s, g_g):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5)
+    # the untouched columns' dk/dv really are zero (and not merely
+    # tiny) — mid-sequence empty columns included, not just the final
+    # column the padding rides on
+    dk = np.asarray(g_s[1]).reshape(B, H, nb, block, hd)
+    dv = np.asarray(g_s[2]).reshape(B, H, nb, block, hd)
+    for h, col in ((0, 2), (0, 3), (1, 1), (1, 3)):
+        assert np.all(dk[:, h, col] == 0.0), (h, col)
+        assert np.all(dv[:, h, col] == 0.0), (h, col)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("causal", [False, True])
 def test_splash_pallas_bwd_with_dense_global_rows(causal):
     """The dedicated Pallas backward + the dense-bucket (horizontal
     global rows) autodiff path composing: grads must match the gather
