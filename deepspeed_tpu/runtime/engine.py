@@ -1295,7 +1295,7 @@ class DeepSpeedEngine:
 
         return full_step
 
-    def train_batches(self, batches, unroll: bool = False) -> np.ndarray:
+    def train_batches(self, batches, unroll=False) -> np.ndarray:
         """Run N full train steps in ONE compiled program — a
         ``lax.scan`` of the train step over a stacked run of batches.
 
@@ -1309,6 +1309,11 @@ class DeepSpeedEngine:
         Not available with host offload (the optimizer step leaves the
         graph) or across the 1-bit warmup→frozen transition (the state
         layout changes mid-run) — those fall back to the per-step loop.
+
+        ``unroll``: False = plain ``lax.scan`` (one XLA while loop,
+        carry double-buffered per iteration); True = fully unrolled
+        (no loop, n× graph); an int k = k step bodies per while
+        iteration — carry copies amortize 1/k at k× graph size.
         """
         batches = list(batches)
         n = len(batches)
@@ -1324,8 +1329,9 @@ class DeepSpeedEngine:
         self.tput_timer.start()
         stacked = [self._stack_and_place(b) for b in batches]
         run = jax.tree.map(lambda *xs: jnp.stack(xs), *stacked)
+        unroll_k = n if unroll is True else max(1, min(int(unroll), n))
         key = (
-            "train_batches", n, unroll, self._onebit_frozen, bool(self.state["grad_acc"]),
+            "train_batches", n, unroll_k, self._onebit_frozen, bool(self.state["grad_acc"]),
             tuple(np.shape(x) for x in jax.tree.leaves(run)),
         )
         if key not in self._compiled:
@@ -1339,7 +1345,7 @@ class DeepSpeedEngine:
                 # unroll=n removes the while-loop: no carry double-buffer
                 # copies of the big state, at the cost of an n× graph
                 state, (losses, ovf, lrs, gns) = jax.lax.scan(
-                    body, state, run, unroll=n if unroll else 1
+                    body, state, run, unroll=unroll_k
                 )
                 return state, losses, jnp.sum(ovf.astype(jnp.int32)), lrs[-1], gns[-1]
 

@@ -182,3 +182,28 @@ def test_train_batches_matches_per_step_loop():
     more = random_batches(2, 16, 64, seed=9)
     l2 = e2.train_batches(more)
     assert l2.shape == (2,) and np.isfinite(l2).all()
+
+
+def test_train_batches_int_unroll_matches_plain_scan():
+    """unroll=k (k bodies per while iteration) is a pure scheduling
+    knob: losses and final params must match the plain scan bit-for-bit
+    modulo float reassociation, including k that does not divide n."""
+    import numpy as np
+
+    from tests.simple_model import base_config, random_batches, simple_model_init, simple_model_loss
+
+    cfg = base_config(stage=2, mesh={"fsdp": 8}, gas=1)
+    batches = random_batches(5, 16, 64, seed=7)
+    e1, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_model_loss, model_parameters=simple_model_init(64), config=cfg
+    )
+    l_plain = e1.train_batches(batches)
+    e2, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_model_loss, model_parameters=simple_model_init(64), config=cfg
+    )
+    l_unroll = e2.train_batches(batches, unroll=2)
+    np.testing.assert_allclose(l_unroll, l_plain, rtol=1e-5, atol=1e-6)
+    p1 = jax.tree.leaves(e1.state["params"])[0]
+    p2 = jax.tree.leaves(e2.state["params"])[0]
+    np.testing.assert_allclose(np.asarray(p1), np.asarray(p2), rtol=1e-5, atol=1e-6)
+    assert e2._host_global_step == 5

@@ -53,12 +53,15 @@ def run_mode(mode: str, seq: int, n_layer: int, steps: int):
     )
     rng = np.random.default_rng(0)
 
+    # global batch = dp world (1 on the single TPU chip; the 8-CPU dev
+    # mesh shards one sample per device — tokens/s stays per-chip)
+    dp = engine.mesh_info.dp_world_size
     def batches(n):
         for _ in range(n):
-            yield {"input_ids": rng.integers(0, cfg.vocab_size, (1, seq), dtype=np.int32)}
+            yield {"input_ids": rng.integers(0, cfg.vocab_size, (dp, seq), dtype=np.int32)}
 
     dt = bench._timed_steps(engine, batches, steps, f"long-{mode}-{seq}")
-    tok_s = seq / dt
+    tok_s = seq / dt  # per-chip: the dp-sized global batch cancels the dp chips
     print(f"[long-context {mode}] seq={seq} L={n_layer}: step={dt*1e3:.1f}ms tokens/s={tok_s:,.0f}", flush=True)
     return dt, tok_s
 
