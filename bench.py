@@ -446,23 +446,20 @@ def run_rung(name: str):
         # inside the full train step at 16k — the reference's headline
         # long-seq claim is "up to 6.3x" (sparse-attention blog :32);
         # same harness as tools/bench_long_context.py, driver-captured
-        from tools.bench_long_context import run_mode
+        from tools.bench_long_context import make_record, run_mode
 
         seq, n_layer = (16384, 8) if on_tpu else (512, 2)
         steps = 4 if on_tpu else 2
         dt_f, tok_f = run_mode("flash", seq, n_layer, steps)
         dt_s, tok_s = run_mode("sparse", seq, n_layer, steps)
-        speedup = dt_f / dt_s
-        emit({
-            "metric": f"long_context_seq{seq}_sparse_train_tokens_per_sec",
-            "value": round(tok_s, 1),
-            "unit": "tokens/s (full train step, 1 chip)",
-            "dense_flash_tokens_per_sec": round(tok_f, 1),
-            "sparse_over_dense": round(speedup, 2),
-            # baseline = the reference's 6.3x sparse-over-dense claim
-            "vs_baseline": round(speedup / 6.3, 3),
-            "n_layer": n_layer,
-        })
+        rec = make_record(seq, n_layer, dt_f, tok_f, dt_s, tok_s)
+        # baseline = the reference's 6.3x sparse-over-dense claim.  NB
+        # the denominator is OUR dense path, which r5.1 made 2.19x
+        # faster at 16k (splash-dense routing) — the reference ratio was
+        # against its own unimproved dense; vs the r5.0 dense path the
+        # same sparse step measures ~11.9x (see the record note)
+        rec["vs_baseline"] = round(rec["sparse_over_dense"] / 6.3, 3)
+        emit(rec)
     else:
         raise SystemExit(f"unknown rung '{name}'")
 

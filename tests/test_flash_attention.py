@@ -44,6 +44,46 @@ def test_blockwise_xla_matches_reference():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
+def test_vmem_bound_causal_routes_through_splash(monkeypatch):
+    """Causal self-attention past the kernel's VMEM envelope routes to
+    the splash kernel with a dense lower-triangular layout (a kv-blocked
+    flash); fwd AND grads must match the reference.  d=512 trips the
+    guard (sq*d*4*4 >= 8MB) at a CPU-testable sq=1024.  The route is
+    pinned by a spy: _blockwise_xla matching the reference too would
+    otherwise mask a lost/inverted routing condition."""
+    from deepspeed_tpu.ops.attention import sparse as sparse_mod
+
+    calls = []
+    real_splash = sparse_mod.splash_attention
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return real_splash(*a, **kw)
+
+    monkeypatch.setattr(sparse_mod, "splash_attention", spy)
+    r = np.random.default_rng(11)
+    B, H, T, d = 1, 2, 1024, 512
+    q, k, v = (jnp.asarray(r.standard_normal((B, H, T, d)) * 0.1, jnp.float32) for _ in range(3))
+    # the guard condition the route lives behind
+    assert T * d * 4 * 4 >= 2**23
+
+    def f_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, interpret=True) ** 2)
+
+    def f_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=True) ** 2)
+
+    np.testing.assert_allclose(
+        float(f_flash(q, k, v)), float(f_ref(q, k, v)), rtol=1e-4
+    )
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4)
+    assert calls, "flash_attention did not route through splash_attention"
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_backward_matches_reference(causal):
     q, k, v = qkv(b=1, h=2, sq=128, sk=128, d=32)

@@ -4,9 +4,11 @@ The steady-state XL streaming record (tools/train_xl_onchip.py) is
 bound by the dev tunnel's ~10 MB/s host link — its wall time says
 nothing about the CHIP.  This tool measures what the chip itself does:
 each compiled stage program of the ZeRO-Infinity executor (group fwd,
-group vjp, embed, head+vjp, embed bwd) is timed ON DEVICE by chaining
-``iters`` iterations inside one jitted ``lax.scan`` (single dispatch +
-single sync, so the tunnel's ~100 ms RTT amortizes to nothing).  Every
+group vjp, embed, head+vjp, embed bwd) is timed ON DEVICE by the
+marginal two-length method: jitted ``lax.scan`` chains of ``iters``
+and ``4*iters`` iterations returning scalars, per-iteration time =
+(wall_4n − wall_n)/(3n), so the tunnel's variable dispatch+readback
+RTT (and any activation-fetch cost) cancels.  Every
 chain's per-iteration input GENUINELY depends on the carry — either
 the previous iteration's output feeds the next (group chains) or the
 input is gated by ``where(pred(carry), x, zeros)``, which XLA cannot
@@ -25,6 +27,7 @@ Run: python tools/xl_chip_mfu.py [seq] [micro_bs] [buffer_count] [iters]
 import json
 import os
 import sys
+import functools
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -43,7 +46,7 @@ def main():
     seq = int(sys.argv[1]) if len(sys.argv) > 1 else 256
     mb = int(sys.argv[2]) if len(sys.argv) > 2 else 4
     lpg = int(sys.argv[3]) if len(sys.argv) > 3 else 8
-    iters = int(sys.argv[4]) if len(sys.argv) > 4 else 8
+    iters = int(sys.argv[4]) if len(sys.argv) > 4 else 16
 
     cfg = gpt2.GPT2_XL
     model_fn, init_fn, _ = gpt2.make_model(cfg)
@@ -81,11 +84,34 @@ def main():
         np.asarray(jax.device_get(jax.tree.leaves(x)[0]))
 
     def timed(fn, *args):
-        sync(fn(*args))  # compile + warm
-        t0 = time.time()
-        out = fn(*args)
-        sync(out)
-        return (time.time() - t0) / n
+        """Marginal two-length timing: wall(4n-chain) − wall(n-chain)
+        = 3n iterations of pure chip time — the tunnel's dispatch+
+        readback RTT (tens to hundreds of ms, variable) cancels instead
+        of inflating every per-program number by RTT/n (the r5.0 single
+        -chain numbers carried that artifact).  Chains return SCALARS
+        (a full activation fetch is ~0.3s on the 10 MB/s link — more
+        than the chain itself); the 3n span + best-of-5 per length
+        keeps residual RTT jitter well under the measured delta."""
+        sync(fn(n, *args))  # compile + warm (n)
+        sync(fn(4 * n, *args))  # compile + warm (4n)
+
+        def best(length):
+            b = float("inf")
+            for _ in range(5):
+                t0 = time.time()
+                sync(fn(length, *args))
+                b = min(b, time.time() - t0)
+            return b
+
+        delta = best(4 * n) - best(n)
+        if delta <= 0:
+            # publishing a record with a zeroed stage would silently
+            # inflate the MFU — abort instead
+            raise SystemExit(
+                f"non-positive marginal delta {delta:.4f}s — RTT jitter "
+                "exceeds the chain span; re-run with a larger iters"
+            )
+        return delta / (3 * n)
 
     def gate(pred_scalar, x):
         """where(pred, x, 0): carry-dependent and NOT simplifiable (the
@@ -93,37 +119,38 @@ def main():
         natural input is loop-invariant."""
         return jnp.where(pred_scalar, x, jnp.zeros_like(x))
 
-    @jax.jit
-    def chain_group_fwd(gp, x, r):
-        # output feeds the next iteration: naturally carry-dependent
+    @functools.partial(jax.jit, static_argnums=0)
+    def chain_group_fwd(length, gp, x, r):
+        # output feeds the next iteration: naturally carry-dependent;
+        # scalar result — fetching a full activation would dominate wall
         def body(x_, _):
             return spec.group(gp, x_, r, spec.deterministic), None
 
-        y, _ = jax.lax.scan(body, x, None, length=n)
-        return y
+        y, _ = jax.lax.scan(body, x, None, length=length)
+        return jnp.mean(y.astype(jnp.float32))
 
-    @jax.jit
-    def chain_group_bwd(gp, x, r, dy):
+    @functools.partial(jax.jit, static_argnums=0)
+    def chain_group_bwd(length, gp, x, r, dy):
         # cotangent chains through dx: naturally carry-dependent
         def body(dy_, _):
             _, vjp = jax.vjp(lambda g_, x_: spec.group(g_, x_, r, spec.deterministic), gp, x)
             dgp, dx = vjp(dy_)
             return dx.astype(dy_.dtype), None
 
-        out, _ = jax.lax.scan(body, dy, None, length=n)
-        return out
+        out, _ = jax.lax.scan(body, dy, None, length=length)
+        return jnp.mean(out.astype(jnp.float32))
 
-    @jax.jit
-    def chain_embed(r_, t_):
+    @functools.partial(jax.jit, static_argnums=0)
+    def chain_embed(length, r_, t_):
         def body(c, _):
             y = spec.embed(r_, gate(jnp.isfinite(c), t_.astype(jnp.float32)).astype(t_.dtype))
             return y.astype(jnp.float32).reshape(-1)[0], None
 
-        c, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=n)
+        c, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=length)
         return c
 
-    @jax.jit
-    def chain_head(r_, x_):
+    @functools.partial(jax.jit, static_argnums=0)
+    def chain_head(length, r_, x_):
         def body(c, _):
             def f(rr, xx):
                 return spec.head_loss(rr, xx, mbatch)
@@ -132,17 +159,17 @@ def main():
             d_res, dx = vjp(jnp.float32(1.0).astype(loss.dtype))
             return loss.astype(jnp.float32) + dx.astype(jnp.float32).reshape(-1)[0], None
 
-        y, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=n)
+        y, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=length)
         return y
 
-    @jax.jit
-    def chain_embed_bwd(r_, t_, dx0):
+    @functools.partial(jax.jit, static_argnums=0)
+    def chain_embed_bwd(length, r_, t_, dx0):
         def body(c, _):
             _, vjp = jax.vjp(lambda rr: spec.embed(rr, t_), r_)
             (d_res,) = vjp(gate(jnp.isfinite(c), dx0))
             return jax.tree.leaves(d_res)[0].astype(jnp.float32).reshape(-1)[0], None
 
-        y, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=n)
+        y, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=length)
         return y
 
     x0 = jax.jit(lambda r_, t_: spec.embed(r_, t_))(res, tokens)
@@ -184,10 +211,14 @@ def main():
         "micro_bs": mb,
         "iters": iters,
         "method": (
-            "each streaming stage program timed on-chip via a jitted "
-            f"lax.scan of {iters} chained iterations (one dispatch+sync, "
-            "tunnel RTT amortized); every chain's input depends on its "
-            "carry (group chains feed outputs forward; fixed-input "
+            "marginal two-length chained timing: each stage program runs "
+            f"as a jitted lax.scan chain of {iters} and {4 * iters} "
+            "iterations returning a SCALAR (best-of-5 each); "
+            "per-iteration chip time = (wall_4n - wall_n)/(3n), so the "
+            "tunnel's variable dispatch+readback RTT cancels (r5.0 "
+            "single-chain numbers carried RTT/n inflation and fetched "
+            "full activations). Every chain's input depends on "
+            "its carry (group chains feed outputs forward; fixed-input "
             "chains gate through where(pred(carry), x, 0)), so nothing "
             "is loop-invariant-hoistable. chip_step = G*(fwd+vjp) + "
             "embed + head + embed_bwd; MFU = step_flops/(chip_step*"
