@@ -1132,23 +1132,25 @@ def flash_attention(
         if bias is not None or drop_seed is not None:
             # scores must materialize beyond the kernel's VMEM envelope
             return reference()
-        if causal and sq == sk and sq % 128 == 0:
-            # VMEM-bound causal self-attention: the splash kernel with a
-            # dense lower-triangular layout IS a kv-blocked flash — K/V
-            # stream per block through the grid instead of sitting fully
-            # resident, so the VMEM bound disappears.  Measured at 16k
-            # (B1 H12 d64, v5e): fwd 56.8ms vs 63.6ms blockwise-XLA,
-            # fwd+bwd 112.3ms vs 208.4ms (1.86×).  Bidirectional shapes
-            # stay on the blockwise path: an all-ones layout would route
-            # every row to the splash dense-row bucket (full-degree rows
-            # materialize), gaining nothing.
+        if sq == sk and sq % 128 == 0:
+            # VMEM-bound self-attention: the splash kernel with a dense
+            # layout (lower-triangular when causal, all-ones otherwise)
+            # IS a kv-blocked flash — K/V stream per block through the
+            # grid instead of sitting fully resident, so the VMEM bound
+            # disappears.  Measured at 16k causal (B1 H12 d64, v5e):
+            # fwd 56.8ms vs 63.6ms blockwise-XLA, fwd+bwd 112.3ms vs
+            # 208.4ms (1.86×).  An all-ones layout carries no padding
+            # penalty (every row has uniform full degree), so the
+            # dense-row bucket exemption in `_dense_row_mask` keeps all
+            # rows on the streaming kernel.
             from deepspeed_tpu.ops.attention.sparse import splash_attention
 
             blk = 256 if sq % 256 == 0 else 128
             nbq = sq // blk
-            tril = np.tril(np.ones((h, nbq, nbq), np.uint8))
+            full = np.ones((h, nbq, nbq), np.uint8)
+            layout = np.tril(full) if causal else full
             return splash_attention(
-                q, k, v, tril, blk, causal=True, sm_scale=sm_scale, interpret=interpret
+                q, k, v, layout, blk, causal=causal, sm_scale=sm_scale, interpret=interpret
             )
         return _blockwise_xla(q, k, v, causal=causal, sm_scale=sm_scale, block_k=bk)
     bbq = pick(sq, bwd_block_q) if bwd_block_q else None

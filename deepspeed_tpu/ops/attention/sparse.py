@@ -322,15 +322,28 @@ class BSLongformerSparsityConfig(SparsityConfig):
 NEG_INF = -1e30
 
 
-def _dense_row_mask(layout: np.ndarray) -> np.ndarray:
+def _dense_row_mask(layout: np.ndarray, exempt_uniform_full: bool = False) -> np.ndarray:
     """(H, nb) bool: q-rows at FULL degree, routed to the dense bucket.
     Single definition shared by the row-major (`_layout_gather_indices`)
     and column-major (`_layout_dkv_edges`) enumerations — they must
-    agree or dense rows' dk/dv would double-count or drop."""
-    return layout.sum(-1) >= layout.shape[-1]
+    agree or dense rows' dk/dv would double-count or drop.
+
+    ``exempt_uniform_full`` (the SPLASH path only): the bucket exists so
+    a FEW full rows (BigBird/Longformer horizontal globals) don't pad
+    every sparse row's degree up to nb.  When EVERY row of every head is
+    full-degree (an all-ones layout — the flash_attention VMEM-fallback
+    uses splash as a plain kv-blocked dense kernel), there is no padding
+    penalty and no reason to materialize: no row goes to the bucket.
+    The XLA *gather* formulation must NOT take this exemption — its
+    per-row K/V gather at deg=nb would replicate full K/V nb-fold; the
+    bucket is exactly its cheap path for full rows."""
+    mask = layout.sum(-1) >= layout.shape[-1]
+    if exempt_uniform_full and mask.all():
+        return np.zeros_like(mask)
+    return mask
 
 
-def _layout_gather_indices(layout: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+def _layout_gather_indices(layout: np.ndarray, exempt_uniform_full: bool = False) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Row-bucketed layout prep — the analog of the reference's C++ LUT
     helper (``csrc/sparse_attention/utils.cpp``), plain numpy.
 
@@ -347,7 +360,7 @@ def _layout_gather_indices(layout: np.ndarray) -> Tuple[np.ndarray, np.ndarray, 
     """
     H, nb, _ = layout.shape
     row_deg = layout.sum(-1)  # (H, nb)
-    dense_mask = _dense_row_mask(layout)
+    dense_mask = _dense_row_mask(layout, exempt_uniform_full)
     sparse_deg = int(np.where(dense_mask, 0, row_deg).max())
     deg = max(1, sparse_deg)
     idx = np.zeros((H, nb, deg), np.int32)
@@ -634,7 +647,7 @@ def _splash_prep(q, k, v, layout: np.ndarray, block: int):
     straight from these (no strip gathers)."""
     B, H, T, hd = q.shape
     nb = T // block
-    idx_np, valid_np, drows_np, dvalid_np = _layout_gather_indices(layout)
+    idx_np, valid_np, drows_np, dvalid_np = _layout_gather_indices(layout, exempt_uniform_full=True)
     deg = idx_np.shape[-1]
     # prefetch arrays live in SMEM, where the LAST dim pads to 128
     # lanes — keep them 2-D (H, nb·deg) or a (H, nb, deg) layout costs
@@ -754,7 +767,7 @@ def _layout_dkv_edges(layout: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.nd
     Returns (qidx, kcol, flags), each (H, E) int32; flags bit0 = edge
     valid, bit1 = first edge of its column run, bit2 = last."""
     H, nb, _ = layout.shape
-    dense_mask = _dense_row_mask(layout)
+    dense_mask = _dense_row_mask(layout, exempt_uniform_full=True)
     per_head: List[List[Tuple[int, int, int]]] = []
     for h in range(H):
         edges: List[Tuple[int, int, int]] = []
@@ -995,7 +1008,7 @@ def splash_attention(q, k, v, layout: np.ndarray, block: int, causal: bool = Fal
     out = _splash_attention(
         q, k, v, _LayoutKey(layout), int(block), bool(causal), float(sm_scale), bool(interpret)
     )
-    _idx, _valid, drows_np, dvalid_np = _layout_gather_indices(layout)
+    _idx, _valid, drows_np, dvalid_np = _layout_gather_indices(layout, exempt_uniform_full=True)
     if drows_np.shape[1] > 0:
         out = _apply_dense_rows(out, q, k, v, drows_np, dvalid_np, block, causal, sm_scale, None)
     return out

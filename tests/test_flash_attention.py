@@ -45,13 +45,15 @@ def test_blockwise_xla_matches_reference():
 
 
 @pytest.mark.slow
-def test_vmem_bound_causal_routes_through_splash(monkeypatch):
-    """Causal self-attention past the kernel's VMEM envelope routes to
-    the splash kernel with a dense lower-triangular layout (a kv-blocked
-    flash); fwd AND grads must match the reference.  d=512 trips the
-    guard (sq*d*4*4 >= 8MB) at a CPU-testable sq=1024.  The route is
-    pinned by a spy: _blockwise_xla matching the reference too would
-    otherwise mask a lost/inverted routing condition."""
+@pytest.mark.parametrize("causal", [True, False])
+def test_vmem_bound_attention_routes_through_splash(monkeypatch, causal):
+    """Self-attention past the kernel's VMEM envelope routes to the
+    splash kernel with a dense layout (tril when causal, all-ones
+    otherwise — the all-full-degree exemption keeps every row on the
+    streaming kernel); fwd AND grads must match the reference.  d=512
+    trips the guard (sq*d*4*4 >= 8MB) at a CPU-testable sq=1024.  The
+    route is pinned by a spy: _blockwise_xla matching the reference too
+    would otherwise mask a lost/inverted routing condition."""
     from deepspeed_tpu.ops.attention import sparse as sparse_mod
 
     calls = []
@@ -69,10 +71,10 @@ def test_vmem_bound_causal_routes_through_splash(monkeypatch):
     assert T * d * 4 * 4 >= 2**23
 
     def f_flash(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, causal=True, interpret=True) ** 2)
+        return jnp.sum(flash_attention(q, k, v, causal=causal, interpret=True) ** 2)
 
     def f_ref(q, k, v):
-        return jnp.sum(mha_reference(q, k, v, causal=True) ** 2)
+        return jnp.sum(mha_reference(q, k, v, causal=causal) ** 2)
 
     np.testing.assert_allclose(
         float(f_flash(q, k, v)), float(f_ref(q, k, v)), rtol=1e-4

@@ -155,6 +155,10 @@ def test_gpt2_sparse_attention_mode():
 # ---------------------------------------------------------------------------
 
 SPLASH_CASES = [
+    # all-ones layout: every row full-degree — the _dense_row_mask
+    # exemption keeps all rows on the streaming kernel (the layout the
+    # flash_attention VMEM-fallback routes through)
+    ("dense-all", DenseSparsityConfig(num_heads=4, block=64), False),
     ("fixed-bi", FixedSparsityConfig(num_heads=4, block=64, num_local_blocks=2, num_global_blocks=1), False),
     ("fixed-uni", FixedSparsityConfig(num_heads=4, block=64, num_local_blocks=2, attention="unidirectional"), True),
     ("bigbird", BigBirdSparsityConfig(num_heads=4, block=64, num_random_blocks=1, num_sliding_window_blocks=3, num_global_blocks=1), False),
