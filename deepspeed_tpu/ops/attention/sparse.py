@@ -322,6 +322,12 @@ class BSLongformerSparsityConfig(SparsityConfig):
 NEG_INF = -1e30
 
 
+def _head_uniform(layout: np.ndarray) -> bool:
+    """True when every head shares one layout (the default: configs
+    propagate head 0 unless ``different_layout_per_head``)."""
+    return layout.shape[0] == 1 or bool(np.all(layout == layout[:1]))
+
+
 def _dense_row_mask(layout: np.ndarray, exempt_uniform_full: bool = False) -> np.ndarray:
     """(H, nb) bool: q-rows at FULL degree, routed to the dense bucket.
     Single definition shared by the row-major (`_layout_gather_indices`)
@@ -647,25 +653,32 @@ def _splash_prep(q, k, v, layout: np.ndarray, block: int):
     straight from these (no strip gathers)."""
     B, H, T, hd = q.shape
     nb = T // block
+    # Head-uniform layouts (the default: configs propagate head 0) keep
+    # ONE row of prefetch indices instead of H — SMEM is ~1MB/core and
+    # the (H, E) form bursts it at long sequences (32k dense-tril:
+    # 12 heads × ~16k edges × 4B ≈ 780KB PER ARRAY)
+    if _head_uniform(layout):
+        layout = layout[:1]
+    lh = layout.shape[0]
     idx_np, valid_np, drows_np, dvalid_np = _layout_gather_indices(layout, exempt_uniform_full=True)
     deg = idx_np.shape[-1]
     # prefetch arrays live in SMEM, where the LAST dim pads to 128
-    # lanes — keep them 2-D (H, nb·deg) or a (H, nb, deg) layout costs
+    # lanes — keep them 2-D (lh, nb·deg) or a (lh, nb, deg) layout costs
     # 32x its logical bytes and overflows SMEM at long sequences
     idx2 = jnp.asarray(idx_np.reshape(idx_np.shape[0], -1))
     valid2 = jnp.asarray(valid_np.astype(np.int32).reshape(valid_np.shape[0], -1))
     qr = q.reshape(B * H, nb, block, hd)
     kr = k.reshape(B * H, nb, block, hd)
     vr = v.reshape(B * H, nb, block, hd)
-    return qr, kr, vr, idx2, valid2, deg, nb, drows_np, dvalid_np
+    return qr, kr, vr, idx2, valid2, deg, nb, lh, drows_np, dvalid_np
 
 
 def _splash_fwd(q, k, v, layout: np.ndarray, block: int, causal: bool, sm_scale: float, interpret: bool, want_lse: bool = False):
     from jax.experimental.pallas import tpu as pltpu
 
     B, H, T, hd = q.shape
-    qr, kr, vr, idx2, valid2, deg, nb, _dr, _dv = _splash_prep(q, k, v, layout, block)
-    H_ = H
+    qr, kr, vr, idx2, valid2, deg, nb, lh, _dr, _dv = _splash_prep(q, k, v, layout, block)
+    H_ = lh
 
     q_spec = pl.BlockSpec((1, 1, block, hd), lambda b, r, e, idx, valid: (b, r, 0, 0))
     kv_spec = pl.BlockSpec(
@@ -691,7 +704,7 @@ def _splash_fwd(q, k, v, layout: np.ndarray, block: int, causal: bool, sm_scale:
         ],
     )
     kern = functools.partial(
-        _splash_kernel, sm_scale=sm_scale, causal=causal, block=block, deg=deg, heads=H
+        _splash_kernel, sm_scale=sm_scale, causal=causal, block=block, deg=deg, heads=lh
     )
     outs = pl.pallas_call(
         kern,
@@ -764,8 +777,11 @@ def _layout_dkv_edges(layout: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.nd
     (full-degree) rows are excluded, matching ``_layout_gather_indices``:
     their gradient flows through the XLA dense bucket's autodiff.
 
-    Returns (qidx, kcol, flags), each (H, E) int32; flags bit0 = edge
-    valid, bit1 = first edge of its column run, bit2 = last."""
+    Returns (qidx, kcol, flags), each (LH, E) int32 where LH = 1 for
+    head-uniform layouts (SMEM: see `_splash_prep`) else H; flags bit0 =
+    edge valid, bit1 = first edge of its column run, bit2 = last."""
+    if _head_uniform(layout):
+        layout = layout[:1]
     H, nb, _ = layout.shape
     dense_mask = _dense_row_mask(layout, exempt_uniform_full=True)
     per_head: List[List[Tuple[int, int, int]]] = []
@@ -843,8 +859,8 @@ def _splash_bwd(q, k, v, out, lse, g, layout: np.ndarray, block: int, causal: bo
     from jax.experimental.pallas import tpu as pltpu
 
     B, H, T, hd = q.shape
-    qr, kr, vr, idx2, valid2, deg, nb, _dr, _dv = _splash_prep(q, k, v, layout, block)
-    H_ = H
+    qr, kr, vr, idx2, valid2, deg, nb, lh, _dr, _dv = _splash_prep(q, k, v, layout, block)
+    H_ = lh
     gr = g.reshape(B * H, nb, block, hd)
     # per-row scalars ride ONE (bh, nb, 8, block) buffer: sublane 0 =
     # the fwd's saved lse, sublane 1 = delta = rowsum(dO ∘ O) (computed
@@ -871,7 +887,7 @@ def _splash_bwd(q, k, v, out, lse, g, layout: np.ndarray, block: int, causal: bo
         scratch_shapes=[pltpu.VMEM((block, hd), jnp.float32)],
     )
     dq_kern = functools.partial(
-        _splash_dq_kernel, sm_scale=sm_scale, causal=causal, block=block, deg=deg, heads=H
+        _splash_dq_kernel, sm_scale=sm_scale, causal=causal, block=block, deg=deg, heads=lh
     )
     (dq,) = pl.pallas_call(
         dq_kern,
@@ -891,6 +907,10 @@ def _splash_bwd(q, k, v, out, lse, g, layout: np.ndarray, block: int, causal: bo
     kcol = jnp.asarray(kcol_np)
     flags = jnp.asarray(flags_np)
     E = qidx_np.shape[1]
+    # head count of the dkv arrays themselves — 1 for head-uniform
+    # layouts (must match the kernel's `heads` or h = bh % heads reads
+    # SMEM out of bounds on hardware; interpret mode clamps and hides it)
+    assert qidx_np.shape[0] == lh, (qidx_np.shape, lh)
     eq_spec = pl.BlockSpec(
         (1, 1, block, hd), lambda b, e, qidx, kcol, flags: (b, qidx[b % H_, e], 0, 0)
     )
@@ -911,7 +931,7 @@ def _splash_bwd(q, k, v, out, lse, g, layout: np.ndarray, block: int, causal: bo
         ],
     )
     dkv_kern = functools.partial(
-        _splash_dkv_kernel, sm_scale=sm_scale, causal=causal, block=block, heads=H
+        _splash_dkv_kernel, sm_scale=sm_scale, causal=causal, block=block, heads=lh
     )
     dk, dv = pl.pallas_call(
         dkv_kern,

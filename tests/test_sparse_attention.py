@@ -162,6 +162,9 @@ SPLASH_CASES = [
     ("fixed-bi", FixedSparsityConfig(num_heads=4, block=64, num_local_blocks=2, num_global_blocks=1), False),
     ("fixed-uni", FixedSparsityConfig(num_heads=4, block=64, num_local_blocks=2, attention="unidirectional"), True),
     ("bigbird", BigBirdSparsityConfig(num_heads=4, block=64, num_random_blocks=1, num_sliding_window_blocks=3, num_global_blocks=1), False),
+    # per-head layouts: exercises the (H, E) prefetch path — the
+    # head-uniform cases above all take the single-row SMEM form
+    ("bigbird-perhead", BigBirdSparsityConfig(num_heads=4, block=64, num_random_blocks=2, num_sliding_window_blocks=3, num_global_blocks=1, different_layout_per_head=True), False),
     ("longformer", BSLongformerSparsityConfig(num_heads=4, block=64, num_sliding_window_blocks=3, global_block_indices=[0, 2]), False),
 ]
 
@@ -181,11 +184,29 @@ def test_splash_kernel_matches_masked_dense(name, cfg, causal):
 
 
 @pytest.mark.slow
-def test_splash_grads_match_gather():
+@pytest.mark.parametrize("per_head", [False, True])
+def test_splash_grads_match_gather(per_head):
     r = np.random.default_rng(4)
     B, H, T, hd, block = 1, 2, 256, 64, 64
-    cfg = FixedSparsityConfig(num_heads=H, block=block, num_local_blocks=2, attention="unidirectional")
-    layout = cfg.make_layout(T)
+    if per_head:
+        # distinct layouts per head: the (H, E) prefetch form in BOTH
+        # backward kernels (uniform layouts take the single-row form).
+        # Hand-built so the heads GENUINELY differ — a window+global
+        # config at small nb can saturate the grid and collapse to the
+        # uniform form, silently untesting this path
+        from deepspeed_tpu.ops.attention.sparse import _head_uniform
+
+        nb = T // block
+        layout = np.zeros((H, nb, nb), np.uint8)
+        for rr in range(nb):
+            layout[0, rr, max(0, rr - 1): rr + 1] = 1  # head 0: window 2
+            layout[1, rr, 0] = 1                       # head 1: global col + diag
+            layout[1, rr, rr] = 1
+        layout = np.tril(layout)
+        assert not _head_uniform(layout)
+    else:
+        cfg = FixedSparsityConfig(num_heads=H, block=block, num_local_blocks=2, attention="unidirectional")
+        layout = cfg.make_layout(T)
     q, k, v = (jnp.asarray(r.standard_normal((B, H, T, hd)) * 0.3, jnp.float32) for _ in range(3))
 
     def loss(backend):
