@@ -1,0 +1,27 @@
+"""``deepspeed_tpu.analysis`` — ds_lint, the repo's JAX trace-safety and
+sharding static-analysis subsystem.
+
+Usage:
+
+* CLI: ``bin/ds_lint deepspeed_tpu/`` or ``python -m deepspeed_tpu.analysis``;
+* library: :func:`lint_paths` returns a structured :class:`LintResult`.
+
+Design: pure-``ast`` (never imports the linted code, no JAX needed at
+analysis time), a severity-tiered rule registry, inline suppressions
+(``# ds-lint: disable=<rule>``), and a checked-in baseline for
+grandfathered findings.  See docs/ds_lint.md for the rule catalog.
+"""
+from deepspeed_tpu.analysis.core import Finding, Rule, Severity, all_rules, get_rule, register
+from deepspeed_tpu.analysis.runner import LintResult, collect_py_files, lint_paths
+
+__all__ = [
+    "Finding",
+    "LintResult",
+    "Rule",
+    "Severity",
+    "all_rules",
+    "collect_py_files",
+    "get_rule",
+    "lint_paths",
+    "register",
+]

@@ -1,0 +1,4 @@
+"""``python -m deepspeed_tpu.analysis`` — same CLI as bin/ds_lint."""
+from deepspeed_tpu.analysis.cli import main
+
+main()

@@ -1,0 +1,93 @@
+"""Baseline ("grandfather") file support.
+
+A baseline records the findings that existed when a rule was introduced
+so the gate only trips on *new* findings.  Fingerprints are
+line-number-free: ``sha1(rule | relative path | stripped source line |
+occurrence index)`` — editing an unrelated part of a file doesn't churn
+the baseline, while changing the offending line itself (hopefully to fix
+it) retires the entry.
+
+Format (checked in at the repo root as ``.ds_lint_baseline.json``):
+
+    {"version": 1, "findings": [
+        {"rule": "...", "path": "...", "line": 12, "fingerprint": "..."},
+        ...
+    ]}
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Set
+
+from deepspeed_tpu.analysis.core import Finding
+
+BASELINE_NAME = ".ds_lint_baseline.json"
+
+
+def fingerprint(rule: str, rel_path: str, line_text: str, occurrence: int) -> str:
+    key = "|".join((rule, rel_path.replace(os.sep, "/"), line_text.strip(), str(occurrence)))
+    return hashlib.sha1(key.encode("utf-8")).hexdigest()[:20]
+
+
+def assign_fingerprints(findings: List[Finding], root: str, sources: Dict[str, str]) -> None:
+    """Fill ``finding.fingerprint`` in place.  ``sources`` maps display
+    path -> file source.  Occurrence indices disambiguate identical
+    lines (e.g. two ``float(x)`` calls on copy-pasted lines)."""
+    counters: Dict[tuple, int] = {}
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule)):
+        src = sources.get(f.path, "")
+        lines = src.splitlines()
+        line_text = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
+        rel = os.path.relpath(os.path.abspath(f.path), root)
+        key = (f.rule, rel, line_text.strip())
+        occ = counters.get(key, 0)
+        counters[key] = occ + 1
+        f.fingerprint = fingerprint(f.rule, rel, line_text, occ)
+
+
+def discover(paths: Iterable[str]) -> Optional[str]:
+    """Find the nearest ``.ds_lint_baseline.json``: cwd first, then
+    walking up from the first linted path."""
+    cand = os.path.join(os.getcwd(), BASELINE_NAME)
+    if os.path.isfile(cand):
+        return cand
+    for p in paths:
+        d = os.path.abspath(p)
+        if os.path.isfile(d):
+            d = os.path.dirname(d)
+        while True:
+            cand = os.path.join(d, BASELINE_NAME)
+            if os.path.isfile(cand):
+                return cand
+            parent = os.path.dirname(d)
+            if parent == d:
+                break
+            d = parent
+        break  # only the first path anchors discovery
+    return None
+
+
+def load(path: str) -> Set[str]:
+    with open(path, "r") as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or "findings" not in data:
+        raise ValueError(f"{path} is not a ds_lint baseline file")
+    return {entry["fingerprint"] for entry in data["findings"]}
+
+
+def save(path: str, findings: List[Finding]) -> None:
+    entries = [
+        {
+            "rule": f.rule,
+            "path": f.path.replace(os.sep, "/"),
+            "line": f.line,
+            "severity": f.severity.name,
+            "fingerprint": f.fingerprint,
+        }
+        for f in sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))
+    ]
+    with open(path, "w") as f:
+        json.dump({"version": 1, "tool": "ds_lint", "findings": entries}, f, indent=1)
+        f.write("\n")

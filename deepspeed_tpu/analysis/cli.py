@@ -1,0 +1,141 @@
+"""``ds_lint`` command-line interface.
+
+Exit codes: 0 clean (or only findings below the failing tier), 1 new
+findings at/above the failing tier (default: tier A), 2 usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+from deepspeed_tpu.analysis import baseline as baseline_mod
+from deepspeed_tpu.analysis.core import Severity, all_rules
+from deepspeed_tpu.analysis.runner import LintResult, lint_paths
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ds_lint",
+        description="JAX trace-safety & sharding static analysis for deepspeed_tpu "
+        "(AST-based; never imports the linted code).",
+    )
+    p.add_argument("paths", nargs="*", help="files or directories to lint")
+    p.add_argument("--baseline", metavar="PATH", help=f"baseline file (default: nearest {baseline_mod.BASELINE_NAME})")
+    p.add_argument("--no-baseline", action="store_true", help="ignore any baseline file")
+    p.add_argument(
+        "--write-baseline", action="store_true",
+        help="record all current findings as the new baseline and exit 0",
+    )
+    p.add_argument("--select", metavar="RULES", help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--disable", metavar="RULES", help="comma-separated rule ids to skip")
+    p.add_argument(
+        "--fail-on", default="A", choices=["A", "B", "C"],
+        help="lowest tier that fails the run (default: A)",
+    )
+    p.add_argument("--format", default="text", choices=["text", "json"], dest="fmt")
+    p.add_argument("--list-rules", action="store_true", help="print the rule catalog and exit")
+    p.add_argument("-q", "--quiet", action="store_true", help="findings only, no summary")
+    return p
+
+
+def _split(raw: Optional[str]) -> Optional[List[str]]:
+    if not raw:
+        return None
+    return [part.strip() for part in raw.split(",") if part.strip()]
+
+
+def _print_catalog() -> None:
+    rules = all_rules()
+    width = max(len(r) for r in rules)
+    for rid in sorted(rules, key=lambda r: (-rules[r].tier, r)):
+        rule = rules[rid]
+        print(f"[{rule.tier.name}] {rid.ljust(width)}  {rule.description}")
+
+
+def _summarize(result: LintResult, elapsed: float, fail_on: Severity, quiet: bool) -> None:
+    if quiet:
+        return
+    tiers = ", ".join(f"{result.count(t)} tier-{t.name}" for t in (Severity.A, Severity.B, Severity.C))
+    bits = [f"{len(result.findings)} finding(s) ({tiers})", f"{result.files} file(s)"]
+    if result.baselined:
+        bits.append(f"{len(result.baselined)} baselined")
+    if result.suppressed:
+        bits.append(f"{result.suppressed} suppressed")
+    if result.parse_errors:
+        bits.append(f"{len(result.parse_errors)} unparsable")
+    print(f"ds_lint: {', '.join(bits)} in {elapsed:.2f}s (failing tier: {fail_on.name}+)")
+
+
+def cli_main(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.list_rules:
+        _print_catalog()
+        return 0
+    if not args.paths:
+        print("ds_lint: no paths given (try `ds_lint deepspeed_tpu/`)", file=sys.stderr)
+        return 2
+    fail_on = Severity.parse(args.fail_on)
+    baseline_path = args.baseline
+    if args.write_baseline and baseline_path is None:
+        # Resolve the target file BEFORE linting so fingerprints are
+        # rooted at its directory — otherwise a first-time baseline
+        # would be written with roots that never match on re-read.
+        baseline_path = baseline_mod.discover(args.paths) or os.path.join(
+            os.getcwd(), baseline_mod.BASELINE_NAME
+        )
+    start = time.monotonic()
+    try:
+        result = lint_paths(
+            args.paths,
+            select=_split(args.select),
+            disable=_split(args.disable),
+            baseline_path=baseline_path,
+            use_baseline=not args.no_baseline,
+        )
+    except (FileNotFoundError, KeyError, ValueError) as e:
+        print(f"ds_lint: error: {e}", file=sys.stderr)
+        return 2
+    elapsed = time.monotonic() - start
+
+    if args.write_baseline:
+        baseline_mod.save(baseline_path, result.all_current)
+        print(f"ds_lint: wrote {len(result.all_current)} finding(s) to {baseline_path}")
+        return 0
+
+    if args.fmt == "json":
+        print(
+            json.dumps(
+                {
+                    "findings": [
+                        {
+                            "rule": f.rule, "path": f.path, "line": f.line, "col": f.col,
+                            "severity": f.severity.name, "message": f.message,
+                            "fingerprint": f.fingerprint,
+                        }
+                        for f in result.findings + result.parse_errors
+                    ],
+                    "baselined": len(result.baselined),
+                    "suppressed": result.suppressed,
+                    "files": result.files,
+                },
+                indent=1,
+            )
+        )
+    else:
+        for f in result.parse_errors + result.findings:
+            print(f.format())
+        _summarize(result, elapsed, fail_on, args.quiet)
+
+    return 1 if result.failing(fail_on) else 0
+
+
+def main() -> None:
+    sys.exit(cli_main())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,152 @@
+"""Per-file and per-project analysis context.
+
+``FileContext`` owns one parsed module: source text, AST, the import
+alias table (so rules resolve ``np.array`` vs ``import numpy as xp``),
+and the inline-suppression table parsed from ``# ds-lint:`` comments.
+
+Suppression syntax (checked by tests/test_ds_lint.py):
+
+* ``x = float(y)  # ds-lint: disable=host-sync-in-jit`` — same line;
+* a standalone ``# ds-lint: disable=<rule>[,<rule>...]`` comment line
+  suppresses the next non-comment line;
+* ``# ds-lint: disable-file=<rule>[,<rule>...]`` anywhere suppresses the
+  rule(s) for the whole file;
+* ``all`` is accepted in place of a rule list.
+"""
+from __future__ import annotations
+
+import ast
+import re
+import tokenize
+from dataclasses import dataclass, field
+from io import StringIO
+from typing import Dict, List, Optional, Set, Tuple
+
+_SUPPRESS_RE = re.compile(r"#\s*ds-lint:\s*(disable(?:-file)?)\s*=\s*([A-Za-z0-9_,\- ]+)")
+
+
+def _parse_rule_list(raw: str) -> Set[str]:
+    return {part.strip() for part in raw.split(",") if part.strip()}
+
+
+@dataclass
+class Suppressions:
+    by_line: Dict[int, Set[str]] = field(default_factory=dict)
+    file_wide: Set[str] = field(default_factory=set)
+
+    def is_suppressed(self, rule_id: str, line: int) -> bool:
+        if "all" in self.file_wide or rule_id in self.file_wide:
+            return True
+        rules = self.by_line.get(line, ())
+        return "all" in rules or rule_id in rules
+
+
+def parse_suppressions(source: str) -> Suppressions:
+    """Tokenize the file and collect ``# ds-lint:`` pragmas.  Falls back
+    to a line-regex scan if tokenization fails (e.g. decode edge cases)
+    so a weird file can't crash the linter."""
+    sup = Suppressions()
+    comments: List[Tuple[int, int, str]] = []  # (line, col, text)
+    try:
+        for tok in tokenize.generate_tokens(StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                comments.append((tok.start[0], tok.start[1], tok.string))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        for i, text in enumerate(source.splitlines(), start=1):
+            if "#" in text:
+                col = text.index("#")
+                comments.append((i, col, text[col:]))
+    lines = source.splitlines()
+
+    def _next_code_line(after: int) -> int:
+        # The first following line that isn't blank or comment-only.
+        for i in range(after, len(lines)):
+            stripped = lines[i].strip()
+            if stripped and not stripped.startswith("#"):
+                return i + 1
+        return after + 1
+
+    for line, col, text in comments:
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        kind, rules = m.group(1), _parse_rule_list(m.group(2))
+        if kind == "disable-file":
+            sup.file_wide |= rules
+        elif col == 0 or not lines[line - 1][:col].strip():
+            # Standalone comment: applies to the next non-comment line.
+            sup.by_line.setdefault(_next_code_line(line), set()).update(rules)
+        else:
+            sup.by_line.setdefault(line, set()).update(rules)
+    return sup
+
+
+@dataclass
+class FileContext:
+    path: str  # as given to the runner (display path)
+    source: str
+    tree: ast.Module
+    suppressions: Suppressions
+    # import alias -> canonical dotted module ("np" -> "numpy",
+    # "jnp" -> "jax.numpy", "jax" -> "jax"); from-imports map the bound
+    # name to "module.name" ("device_get" -> "jax.device_get").
+    aliases: Dict[str, str] = field(default_factory=dict)
+    _traced: Optional[set] = None  # lazily-computed traced FunctionDef ids
+
+    @classmethod
+    def parse(cls, path: str, source: str) -> "FileContext":
+        tree = ast.parse(source, filename=path)
+        ctx = cls(path=path, source=source, tree=tree, suppressions=parse_suppressions(source))
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        ctx.aliases[a.asname] = a.name
+                    else:
+                        # `import jax.numpy` binds the ROOT name `jax`,
+                        # not the dotted module — map it to itself so a
+                        # sibling `import jax` isn't shadowed.
+                        root = a.name.split(".")[0]
+                        ctx.aliases[root] = root
+            elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+                for a in node.names:
+                    ctx.aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        return ctx
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Dotted canonical name for a Name/Attribute chain, resolving
+        the leading segment through the import table.  ``np.random.rand``
+        -> ``numpy.random.rand``; unknown heads resolve to themselves so
+        local helpers still produce a usable name."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        head = self.aliases.get(node.id, node.id)
+        parts.append(head)
+        return ".".join(reversed(parts))
+
+    def traced_functions(self) -> set:
+        """ids of FunctionDef nodes that execute under a JAX trace (see
+        deepspeed_tpu.analysis.traced)."""
+        if self._traced is None:
+            from deepspeed_tpu.analysis.traced import find_traced_functions
+
+            self._traced = find_traced_functions(self)
+        return self._traced
+
+
+@dataclass
+class ProjectContext:
+    root: str
+    files: List[FileContext]
+
+    def find(self, suffix: str) -> Optional[FileContext]:
+        """First file whose normalized path ends with ``suffix``."""
+        suffix = suffix.replace("\\", "/")
+        for fc in self.files:
+            if fc.path.replace("\\", "/").endswith(suffix):
+                return fc
+        return None

@@ -1,0 +1,109 @@
+"""Core lint model: severities, findings, rules, and the rule registry.
+
+The linter is a pure-``ast`` pass (no imports of the linted code, no JAX
+at analysis time) so it runs in well under a second on this package and
+can gate CI on machines with no accelerator at all.  Rules register
+themselves via :func:`register`; the runner instantiates each selected
+rule once per invocation and feeds it either one file at a time
+(``scope == "file"``) or the whole project (``scope == "project"``, for
+cross-file checks like config-key drift).
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Callable, Dict, Iterable, Optional
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from deepspeed_tpu.analysis.context import FileContext, ProjectContext
+
+
+class Severity(enum.IntEnum):
+    """Finding tiers.  A fails CI on new findings, B is a warning the
+    report surfaces, C is advice.  Ordering: A > B > C."""
+
+    C = 1
+    B = 2
+    A = 3
+
+    @classmethod
+    def parse(cls, name: str) -> "Severity":
+        try:
+            return cls[name.upper()]
+        except KeyError:
+            raise ValueError(f"unknown severity tier {name!r} (expected A, B or C)")
+
+
+@dataclass
+class Finding:
+    """One lint hit.  ``fingerprint`` is filled in by the runner (it
+    depends on the baseline root, which rules don't know about)."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    severity: Severity = Severity.A
+    fingerprint: str = ""
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.severity.name}] {self.rule}: {self.message}"
+
+
+@dataclass
+class Rule:
+    """A registered rule.  ``check`` receives a ``FileContext`` for
+    file-scope rules or a ``ProjectContext`` for project-scope rules and
+    yields findings (severity defaults to the rule tier but a rule may
+    emit mixed tiers, e.g. config-key drift)."""
+
+    id: str
+    tier: Severity
+    description: str
+    check: Callable[..., Iterable[Finding]]
+    scope: str = "file"  # "file" | "project"
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(rule_id: str, tier: Severity, description: str, scope: str = "file"):
+    """Decorator: register ``fn(ctx) -> Iterable[Finding]`` as a rule."""
+
+    def deco(fn):
+        if rule_id in _REGISTRY:
+            raise ValueError(f"duplicate rule id {rule_id!r}")
+        _REGISTRY[rule_id] = Rule(id=rule_id, tier=tier, description=description, check=fn, scope=scope)
+        return fn
+
+    return deco
+
+
+def all_rules() -> Dict[str, Rule]:
+    """Return the registry, importing the built-in rule modules on first
+    use so ``import deepspeed_tpu.analysis`` stays cheap."""
+    import deepspeed_tpu.analysis.rules  # noqa: F401  (side effect: registration)
+
+    return dict(_REGISTRY)
+
+
+def get_rule(rule_id: str) -> Rule:
+    rules = all_rules()
+    if rule_id not in rules:
+        raise KeyError(f"unknown rule {rule_id!r}; known: {sorted(rules)}")
+    return rules[rule_id]
+
+
+def make_finding(
+    rule: Rule, ctx: "FileContext", node, message: str, severity: Optional[Severity] = None
+) -> Finding:
+    """Convenience for rules: build a Finding anchored at an AST node."""
+    return Finding(
+        rule=rule.id,
+        path=ctx.path,
+        line=getattr(node, "lineno", 1),
+        col=getattr(node, "col_offset", 0) + 1,
+        message=message,
+        severity=severity if severity is not None else rule.tier,
+    )

@@ -1,0 +1,13 @@
+"""Built-in rule modules.  Importing this package registers every rule
+with the core registry (deepspeed_tpu.analysis.core)."""
+from deepspeed_tpu.analysis.rules import (  # noqa: F401
+    config_drift,
+    donation,
+    dtype_rules,
+    host_sync,
+    jit_hygiene,
+    prng,
+    sharding,
+    side_effects,
+    static_args,
+)

@@ -1,0 +1,105 @@
+"""Rule: config-key-drift — constants table vs accessor drift.
+
+``config/constants.py`` is the single source of truth for the JSON key
+surface; ``config/config.py`` is supposed to consume it via ``C.KEY``.
+Two drift modes, mirroring how the reference repo rotted:
+
+* tier A: ``C.SOMETHING`` referenced by an accessor but absent from the
+  constants module — an AttributeError waiting for that config path;
+* tier B: a string literal key in an accessor (``_pop(d, "stage")``)
+  that duplicates an existing constant's value — the two copies will
+  eventually disagree.
+
+Project-scope: only fires when both files are inside the linted tree.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict
+
+from deepspeed_tpu.analysis.core import Finding, Severity, register
+
+_ACCESSOR_FUNCS = {"_pop", "get", "pop"}
+
+
+def _constants_table(fc):
+    """(all module-level names, name -> string-value for str constants)."""
+    names = set()
+    strings: Dict[str, str] = {}
+    for node in fc.tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    names.add(tgt.id)
+                    if isinstance(node.value, ast.Constant) and isinstance(node.value.value, str):
+                        strings[tgt.id] = node.value.value
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+            names.add(node.target.id)
+    return names, strings
+
+
+@register(
+    "config-key-drift",
+    Severity.A,
+    "config accessors drifting from config/constants.py (missing constant or duplicated literal)",
+    scope="project",
+)
+def check(rule, project):
+    constants_fc = project.find("config/constants.py")
+    config_fc = project.find("config/config.py")
+    if constants_fc is None or config_fc is None:
+        return
+    names, strings = _constants_table(constants_fc)
+    # A literal is only "drift" when exactly one constant owns that value;
+    # generic sub-keys like "enabled" (FP16_ENABLED == BF16_ENABLED == ...)
+    # are ambiguous, not drifted.
+    value_owners: Dict[str, list] = {}
+    for name, value in strings.items():
+        value_owners.setdefault(value, []).append(name)
+    value_to_name = {v: owners[0] for v, owners in value_owners.items() if len(owners) == 1}
+
+    # alias(es) under which the constants module is imported in config.py
+    const_aliases = {
+        alias
+        for alias, target in config_fc.aliases.items()
+        if target.split(".")[-1] == "constants" or target.endswith(".constants")
+    }
+
+    for node in ast.walk(config_fc.tree):
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id in const_aliases
+            and node.attr not in names
+            and node.attr.isupper()
+        ):
+            yield Finding(
+                rule=rule.id, path=config_fc.path, line=node.lineno,
+                col=node.col_offset + 1, severity=Severity.A,
+                message=f"{node.value.id}.{node.attr} is not defined in "
+                f"{constants_fc.path} (AttributeError on this config path)",
+            )
+        elif isinstance(node, ast.Call):
+            fname = None
+            if isinstance(node.func, ast.Name):
+                fname = node.func.id
+            elif isinstance(node.func, ast.Attribute):
+                fname = node.func.attr
+            if fname not in _ACCESSOR_FUNCS:
+                continue
+            # _pop(d, "key", ...) / d.get("key", ...) — key is arg 1 or 0.
+            key_idx = 1 if isinstance(node.func, ast.Name) else 0
+            if key_idx < len(node.args):
+                key = node.args[key_idx]
+                if (
+                    isinstance(key, ast.Constant)
+                    and isinstance(key.value, str)
+                    and key.value in value_to_name
+                ):
+                    yield Finding(
+                        rule=rule.id, path=config_fc.path, line=key.lineno,
+                        col=key.col_offset + 1, severity=Severity.B,
+                        message=f"literal {key.value!r} duplicates constants."
+                        f"{value_to_name[key.value]}; use the constant so the key "
+                        "surface has one source of truth",
+                    )

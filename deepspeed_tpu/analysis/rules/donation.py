@@ -1,0 +1,105 @@
+"""Rule: donated-buffer-reuse — reading a buffer after donating it.
+
+``donate_argnums`` hands the input buffer to XLA for reuse; touching the
+Python reference afterwards returns garbage on TPU (and only *sometimes*
+errors on CPU, which is why tests don't catch it).  The engine's idiom
+``state = step(state)`` is safe — the donated name is rebound by the
+same statement — and is recognized as such.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from deepspeed_tpu.analysis.core import Severity, make_finding, register
+from deepspeed_tpu.analysis.rules.static_args import _is_jit_call
+
+FunctionNode = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _donated_positions(jit_call: ast.Call) -> List[int]:
+    for kw in jit_call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return [v.value]
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return [e.value for e in v.elts if isinstance(e, ast.Constant) and isinstance(e.value, int)]
+    return []
+
+
+def _name_events(scope: ast.AST, name: str) -> List[Tuple[int, int, str]]:
+    """(line, col, 'load'|'store') events for ``name`` in a scope."""
+    events = []
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Name) and node.id == name:
+            kind = "store" if isinstance(node.ctx, (ast.Store, ast.Del)) else "load"
+            events.append((node.lineno, node.col_offset, kind, node))
+    return sorted(events, key=lambda e: (e[0], e[1]))
+
+
+def _check_scope(rule, ctx, scope):
+    # donating callables bound to names in this scope
+    donating: Dict[str, List[int]] = {}
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Assign) and _is_jit_call(ctx, node.value):
+            pos = _donated_positions(node.value)
+            if pos:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        donating[tgt.id] = pos
+        elif (
+            isinstance(node, ast.Assign)
+            and isinstance(node.value, ast.Subscript)
+        ):
+            # `f = self._compiled["x"]` — opaque; can't track, skip.
+            pass
+
+    for node in ast.walk(scope):
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Name) and node.func.id in donating:
+            pos = donating[node.func.id]
+        elif _is_jit_call(ctx, node.func):
+            pos = _donated_positions(node.func)
+        else:
+            continue
+        for p in pos:
+            if p >= len(node.args) or not isinstance(node.args[p], ast.Name):
+                continue
+            donated = node.args[p].id
+            end = node.end_lineno or node.lineno
+            events = _name_events(scope, donated)
+            # `state = step(state)` — a store on the call's own statement
+            # lines is the engine's rebind idiom: the donated name is
+            # immediately rebound to the result, so later reads are fine.
+            if any(kind == "store" and node.lineno <= line <= end for line, col, kind, ref in events):
+                continue
+            for line, col, kind, ref in events:
+                if line <= end:
+                    continue
+                if kind == "store":
+                    break  # rebound before any read: safe
+                yield make_finding(
+                    rule, ctx, ref,
+                    f"'{donated}' is read after being donated (donate_argnums={p}) at "
+                    f"line {node.lineno}; the buffer was handed to XLA and its contents "
+                    "are undefined — rebind the result or drop donation",
+                )
+                break  # one finding per donation site is enough
+
+
+@register(
+    "donated-buffer-reuse",
+    Severity.A,
+    "a Python reference is read after its buffer was donated to a jit call",
+)
+def check(rule, ctx):
+    scopes = [n for n in ast.walk(ctx.tree) if isinstance(n, FunctionNode)]
+    seen_lines = set()
+    for scope in scopes:
+        for f in _check_scope(rule, ctx, scope):
+            key = (f.line, f.col)
+            if key not in seen_lines:  # nested scopes re-walk inner code
+                seen_lines.add(key)
+                yield f

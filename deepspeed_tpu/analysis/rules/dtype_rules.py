@@ -1,0 +1,56 @@
+"""Rule: float64-promotion — accidental double precision.
+
+TPUs have no fast f64 path and this repo runs with x64 disabled, so an
+explicit float64 request either silently becomes f32 (misleading) or —
+with x64 on — drags a 2x-memory, many-times-slower dtype through the
+whole program via promotion.  ``dtype=float`` and ``.astype(float)``
+are the sneaky spellings: Python's ``float`` *is* float64.
+"""
+from __future__ import annotations
+
+import ast
+
+from deepspeed_tpu.analysis.core import Severity, make_finding, register
+
+_F64_ATTRS = {"jax.numpy.float64", "numpy.float64", "jax.numpy.complex128", "numpy.complex128"}
+
+
+def _is_f64_node(ctx, node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and node.value in ("float64", "double", "complex128"):
+        return True
+    if isinstance(node, ast.Name) and node.id == "float" and "float" not in ctx.aliases:
+        return True
+    resolved = ctx.resolve(node)
+    return resolved in _F64_ATTRS
+
+
+@register(
+    "float64-promotion",
+    Severity.B,
+    "explicit float64 dtype in jax/jnp code: silently downcast with x64 off, slow with it on",
+)
+def check(rule, ctx):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        resolved = ctx.resolve(node.func) or ""
+        # jnp.<ctor>(..., dtype=float64-ish) and jnp.zeros(..., float) etc.
+        if resolved.startswith("jax.numpy.") or resolved.startswith("jax."):
+            for kw in node.keywords:
+                if kw.arg == "dtype" and _is_f64_node(ctx, kw.value):
+                    yield make_finding(
+                        rule, ctx, kw.value,
+                        f"dtype float64 passed to {resolved}; use jnp.float32/bfloat16 "
+                        "(x64 is disabled on the TPU path)",
+                    )
+        # x.astype(float) / x.astype("float64") / x.astype(jnp.float64)
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "astype"
+            and node.args
+            and _is_f64_node(ctx, node.args[0])
+        ):
+            yield make_finding(
+                rule, ctx, node.args[0],
+                ".astype(float64) promotes to double precision; use float32/bfloat16",
+            )

@@ -1,0 +1,71 @@
+"""Rule: host-sync-in-jit — device→host synchronization under trace.
+
+The exact failure mode of runtime/engine.py's host offload path
+(``np.array(jax.device_get(...))``, ``float(...)``) is *correct* there
+because that code runs on the host between jitted calls — but the same
+calls inside a traced step function either fail at trace time or, worse,
+silently fall out of the compiled computation and force a blocking
+transfer every step.  This is the repo's number-one "silent 10x
+slowdown" pattern.
+"""
+from __future__ import annotations
+
+import ast
+
+from deepspeed_tpu.analysis.core import Severity, make_finding, register
+from deepspeed_tpu.analysis.traced import iter_own_nodes, traced_defs
+
+_NP_MATERIALIZE = {"array", "asarray", "asanyarray", "ascontiguousarray"}
+_SYNC_METHODS = {
+    "item": "`.item()` forces a device→host sync under trace",
+    "tolist": "`.tolist()` forces a device→host sync under trace",
+    "block_until_ready": "`.block_until_ready()` blocks the host inside a traced function",
+}
+_CASTS = {"float", "int", "bool", "complex"}
+
+
+@register(
+    "host-sync-in-jit",
+    Severity.A,
+    "host synchronization (float()/.item()/np.array()/jax.device_get/"
+    "block_until_ready) inside a jit/trace context",
+)
+def check(rule, ctx):
+    for fn in traced_defs(ctx):
+        for node in iter_own_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = ctx.resolve(node.func)
+            if resolved == "jax.device_get":
+                yield make_finding(
+                    rule, ctx, node,
+                    f"jax.device_get inside traced function '{fn.name}' pulls the value "
+                    "to host every step; return it from the jitted function instead",
+                )
+            elif resolved and resolved.startswith("numpy.") and resolved.split(".")[-1] in _NP_MATERIALIZE:
+                yield make_finding(
+                    rule, ctx, node,
+                    f"{resolved} inside traced function '{fn.name}' materializes a host "
+                    "array (sync + constant-folds the tracer); use jnp equivalents",
+                )
+            elif (
+                isinstance(node.func, ast.Name)
+                and node.func.id in _CASTS
+                and node.func.id == ctx.aliases.get(node.func.id, node.func.id)
+                and len(node.args) == 1
+                and not isinstance(node.args[0], ast.Constant)
+            ):
+                yield make_finding(
+                    rule, ctx, node,
+                    f"{node.func.id}() on a traced value in '{fn.name}' is a concretization "
+                    "(host sync / TracerConversionError); keep it a jnp scalar",
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SYNC_METHODS
+                and not node.args
+                and not node.keywords
+            ):
+                yield make_finding(
+                    rule, ctx, node, f"{_SYNC_METHODS[node.func.attr]} (in '{fn.name}')"
+                )

@@ -1,0 +1,82 @@
+"""Rules: jit call hygiene — mesh scoping and compile-cache discipline.
+
+* bare-jit: library code should wrap step functions with the engine's
+  mesh scoping (``self._scoped`` / ``scoped_to``) or pass explicit
+  shardings, so the ambient mesh governs layout instead of whatever XLA
+  guesses — a GSPMD prerequisite (arXiv:2004.13336 relies on every
+  update being annotation-driven).
+* jit-in-loop: ``jax.jit(...)`` in a loop body builds a fresh callable
+  (and hashes a fresh cache key) per iteration; hoist it or cache it the
+  way runtime/engine.py:_get_compiled does.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from deepspeed_tpu.analysis.core import Severity, make_finding, register
+from deepspeed_tpu.analysis.rules.static_args import _is_jit_call
+
+_SCOPED_WRAPPERS = {"_scoped", "scoped_to"}
+_SHARDING_KWARGS = {"in_shardings", "out_shardings", "in_axis_resources", "out_axis_resources"}
+
+
+@register(
+    "bare-jit",
+    Severity.B,
+    "jax.jit without mesh scoping (scoped wrapper or explicit in_/out_shardings)",
+)
+def check_bare_jit(rule, ctx):
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and _is_jit_call(ctx, node)):
+            continue
+        if any(kw.arg in _SHARDING_KWARGS for kw in node.keywords):
+            continue
+        target = node.args[0] if node.args else None
+        if isinstance(target, ast.Call):
+            fname = None
+            if isinstance(target.func, ast.Name):
+                fname = target.func.id
+            elif isinstance(target.func, ast.Attribute):
+                fname = target.func.attr
+            if fname in _SCOPED_WRAPPERS:
+                continue
+        yield make_finding(
+            rule, ctx, node,
+            "bare jax.jit: wrap the function with the mesh-scoped helper "
+            "(self._scoped / scoped_to) or pass explicit in_/out_shardings so "
+            "GSPMD sees the intended layout",
+        )
+
+
+def _collect_loop_nodes(node: ast.AST, in_loop: bool, out: List[ast.AST]) -> None:
+    """Collect nodes lexically inside a loop body.  Nested function defs
+    reset the loop context (they may run outside the loop); loops set it;
+    comprehensions don't count (building a list of jitted fns once is a
+    legitimate pattern)."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            _collect_loop_nodes(child, False, out)
+        elif isinstance(child, (ast.For, ast.AsyncFor, ast.While)):
+            _collect_loop_nodes(child, True, out)
+        else:
+            if in_loop:
+                out.append(child)
+            _collect_loop_nodes(child, in_loop, out)
+
+
+@register(
+    "jit-in-loop",
+    Severity.B,
+    "jax.jit called inside a loop body: re-wraps (and can re-trace) every iteration",
+)
+def check_jit_in_loop(rule, ctx):
+    nodes: List[ast.AST] = []
+    _collect_loop_nodes(ctx.tree, False, nodes)
+    for node in nodes:
+        if isinstance(node, ast.Call) and _is_jit_call(ctx, node):
+            yield make_finding(
+                rule, ctx, node,
+                "jax.jit inside a loop body builds a new wrapper every iteration; "
+                "hoist it out of the loop or cache it (cf. engine._get_compiled)",
+            )

@@ -1,0 +1,57 @@
+"""Rule: prng-key-reuse — the same PRNG key fed to multiple samplers.
+
+``jax.random`` is splittable-by-contract: reusing one key in two draws
+yields correlated (often identical) streams — the training-run
+equivalent of seeding dropout and init with the same bits.  Flagged per
+function: a key variable consumed by ≥2 sampler calls with no
+``split``/``fold_in`` of that key anywhere in the function.
+"""
+from __future__ import annotations
+
+import ast
+
+from deepspeed_tpu.analysis.core import Severity, make_finding, register
+from deepspeed_tpu.analysis.traced import FunctionNode
+
+_NON_SAMPLERS = {"split", "fold_in", "PRNGKey", "key", "wrap_key_data", "key_data", "clone"}
+
+
+@register(
+    "prng-key-reuse",
+    Severity.B,
+    "one PRNG key consumed by multiple jax.random draws without split/fold_in",
+)
+def check(rule, ctx):
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, FunctionNode):
+            continue
+        key_vars = set()
+        split_vars = set()
+        uses = {}  # var -> [call nodes in source order]
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                resolved = ctx.resolve(node.value.func) or ""
+                if resolved in ("jax.random.PRNGKey", "jax.random.key"):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            key_vars.add(tgt.id)
+            if isinstance(node, ast.Call):
+                resolved = ctx.resolve(node.func) or ""
+                if not resolved.startswith("jax.random."):
+                    continue
+                tail = resolved.split(".")[-1]
+                args = [a for a in node.args if isinstance(a, ast.Name)]
+                if tail in ("split", "fold_in"):
+                    for a in args:
+                        split_vars.add(a.id)
+                elif tail not in _NON_SAMPLERS:
+                    if node.args and isinstance(node.args[0], ast.Name):
+                        uses.setdefault(node.args[0].id, []).append(node)
+        for var, calls in uses.items():
+            if var in key_vars and var not in split_vars and len(calls) >= 2:
+                for call in sorted(calls, key=lambda c: (c.lineno, c.col_offset))[1:]:
+                    yield make_finding(
+                        rule, ctx, call,
+                        f"PRNG key '{var}' already consumed by an earlier draw in "
+                        f"'{fn.name}'; jax.random.split it so the streams are independent",
+                    )

@@ -1,0 +1,67 @@
+"""Rule: missing-sharding-constraint — unpinned collective outputs.
+
+In ``comm/`` and ``runtime/zero/``, a function that issues collectives
+(psum / all_gather / ppermute ...) but never mentions a sharding
+construct leaves the result layout to XLA's propagation pass; under
+GSPMD that is exactly where weight-update sharding (arXiv:2004.13336)
+silently degrades to replication.  Tier C: advice, not a gate — inside
+``shard_map`` bodies the layout is pinned by the enclosing specs, which
+the lexical check can only see when they share a file.
+"""
+from __future__ import annotations
+
+import ast
+
+from deepspeed_tpu.analysis.core import Severity, make_finding, register
+from deepspeed_tpu.analysis.traced import FunctionNode
+
+_COLLECTIVES = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "ppermute", "pshuffle", "psum_scatter", "axis_index",
+}
+_SHARDING_MARKERS = {
+    "with_sharding_constraint", "NamedSharding", "PartitionSpec", "shard_map",
+}
+_PATH_SEGMENTS = ("comm/", "zero/")
+
+
+def _applies(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return any(seg in p for seg in _PATH_SEGMENTS)
+
+
+@register(
+    "missing-sharding-constraint",
+    Severity.C,
+    "collective-issuing function in comm//zero/ with no sharding annotation in sight",
+)
+def check(rule, ctx):
+    if not _applies(ctx.path):
+        return
+    # File-wide marker scan: a module whose jit entry points pin layouts
+    # usually does so near the collectives; one marker clears the file's
+    # helper functions too (lexical heuristic, tier C).
+    file_has_marker = any(
+        isinstance(n, (ast.Name, ast.Attribute))
+        and (getattr(n, "id", None) or getattr(n, "attr", None)) in _SHARDING_MARKERS
+        for n in ast.walk(ctx.tree)
+    )
+    if file_has_marker:
+        return
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, FunctionNode):
+            continue
+        collectives = [
+            node
+            for node in ast.walk(fn)
+            if isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _COLLECTIVES
+        ]
+        if collectives:
+            yield make_finding(
+                rule, ctx, fn,
+                f"'{fn.name}' issues {len(collectives)} collective(s) but the module "
+                "never pins a layout (with_sharding_constraint / NamedSharding / "
+                "shard_map); XLA propagation decides the output sharding",
+            )

@@ -1,0 +1,84 @@
+"""Rules: Python side effects under trace.
+
+A traced function's Python body runs once per compilation, not once per
+step — prints vanish after the first call, ``np.random`` draws are baked
+in as compile-time constants (every step reuses the same "random"
+numbers), and writes to ``self``/globals leak tracers out of the trace.
+"""
+from __future__ import annotations
+
+import ast
+
+from deepspeed_tpu.analysis.core import Severity, make_finding, register
+from deepspeed_tpu.analysis.traced import iter_own_nodes, traced_defs
+
+
+@register(
+    "print-under-trace",
+    Severity.B,
+    "print()/breakpoint() in a traced function only fires at trace time; use jax.debug.print",
+)
+def check_print(rule, ctx):
+    for fn in traced_defs(ctx):
+        for node in iter_own_nodes(fn):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in ("print", "breakpoint")
+                and node.func.id == ctx.aliases.get(node.func.id, node.func.id)
+            ):
+                yield make_finding(
+                    rule, ctx, node,
+                    f"{node.func.id}() in traced function '{fn.name}' runs at trace time "
+                    "only (once per compile); use jax.debug.print for per-step output",
+                )
+
+
+@register(
+    "np-random-under-trace",
+    Severity.A,
+    "np.random draws in a traced function are baked in as constants; use jax.random with a key",
+)
+def check_np_random(rule, ctx):
+    for fn in traced_defs(ctx):
+        for node in iter_own_nodes(fn):
+            if isinstance(node, ast.Call):
+                resolved = ctx.resolve(node.func)
+                if resolved and resolved.startswith("numpy.random."):
+                    yield make_finding(
+                        rule, ctx, node,
+                        f"{resolved} in traced function '{fn.name}' is evaluated once at "
+                        "trace time and constant-folded — every step reuses the same draw; "
+                        "thread a jax.random key instead",
+                    )
+
+
+@register(
+    "global-mutation-under-trace",
+    Severity.A,
+    "global/self mutation in a traced function leaks tracers and skips cached executions",
+)
+def check_global_mutation(rule, ctx):
+    for fn in traced_defs(ctx):
+        for node in iter_own_nodes(fn):
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                kw = "global" if isinstance(node, ast.Global) else "nonlocal"
+                yield make_finding(
+                    rule, ctx, node,
+                    f"{kw} {', '.join(node.names)} in traced function '{fn.name}': the "
+                    "mutation happens at trace time only and can leak tracers",
+                )
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                for tgt in targets:
+                    if (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        yield make_finding(
+                            rule, ctx, node,
+                            f"assignment to self.{tgt.attr} in traced function '{fn.name}' "
+                            "is a trace-time side effect (leaked tracer; not re-run on "
+                            "cached executions); return the value instead",
+                        )

@@ -1,0 +1,110 @@
+"""Rule: unhashable-static-arg — static jit arguments must be hashable.
+
+``static_argnums`` values key the jit cache by ``hash(arg)``: passing a
+list/dict/set raises at call time, and a mutable default on a static
+parameter is a latent version of the same bug.  Caught lexically at the
+jit wrap site and at resolvable call sites.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from deepspeed_tpu.analysis.core import Severity, make_finding, register
+
+_MUTABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)
+
+
+def _is_jit_call(ctx, node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    resolved = ctx.resolve(node.func)
+    if not resolved:
+        return False
+    parts = resolved.split(".")
+    return parts[-1] in ("jit", "pjit") and (parts[0] == "jax" or len(parts) == 1)
+
+
+def _static_positions(jit_call: ast.Call) -> Optional[List[int]]:
+    """Literal static_argnums positions, or None if not statically known."""
+    for kw in jit_call.keywords:
+        if kw.arg == "static_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return [v.value]
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = []
+                for elt in v.elts:
+                    if not (isinstance(elt, ast.Constant) and isinstance(elt.value, int)):
+                        return None
+                    out.append(elt.value)
+                return out
+            return None
+    return None
+
+
+@register(
+    "unhashable-static-arg",
+    Severity.A,
+    "static_argnums positions fed a list/dict/set (unhashable → TypeError, or silently "
+    "wrong cache keys via mutable defaults)",
+)
+def check(rule, ctx):
+    # Local defs, to cross-check static positions against parameter defaults.
+    local_defs = {
+        n.name: n
+        for n in ast.walk(ctx.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    # name -> static positions, for `f = jax.jit(g, static_argnums=...)`.
+    wrapped_names = {}
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and _is_jit_call(ctx, node.value):
+            pos = _static_positions(node.value)
+            if pos is not None:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        wrapped_names[tgt.id] = pos
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        # `jax.jit(f, static_argnums=(1,))(a, [2])` — direct invocation.
+        if _is_jit_call(ctx, node.func):
+            pos = _static_positions(node.func)
+            if pos is not None:
+                for p in pos:
+                    if p < len(node.args) and isinstance(node.args[p], _MUTABLE):
+                        yield make_finding(
+                            rule, ctx, node.args[p],
+                            f"argument {p} is marked static but a "
+                            f"{type(node.args[p]).__name__.lower()} literal is passed "
+                            "(unhashable); pass a tuple or hashable config object",
+                        )
+        # `f(a, [2])` where f = jax.jit(g, static_argnums=(1,)).
+        elif isinstance(node.func, ast.Name) and node.func.id in wrapped_names:
+            for p in wrapped_names[node.func.id]:
+                if p < len(node.args) and isinstance(node.args[p], _MUTABLE):
+                    yield make_finding(
+                        rule, ctx, node.args[p],
+                        f"argument {p} of '{node.func.id}' is static but a "
+                        f"{type(node.args[p]).__name__.lower()} literal is passed (unhashable)",
+                    )
+        # `jax.jit(g, static_argnums=...)` where g's static param has a
+        # mutable default — hashability bug waiting for the default path.
+        if _is_jit_call(ctx, node):
+            pos = _static_positions(node)
+            target = node.args[0] if node.args else None
+            if pos is not None and isinstance(target, ast.Name) and target.id in local_defs:
+                fn = local_defs[target.id]
+                params = fn.args.args
+                defaults = fn.args.defaults
+                offset = len(params) - len(defaults)
+                for p in pos:
+                    if offset <= p < len(params) and isinstance(defaults[p - offset], _MUTABLE):
+                        yield make_finding(
+                            rule, ctx, defaults[p - offset],
+                            f"static parameter '{params[p].arg}' of '{fn.name}' has a "
+                            "mutable (unhashable) default",
+                        )

@@ -1,0 +1,138 @@
+"""Lint runner: file collection, rule dispatch, suppression + baseline
+filtering.  ``lint_paths`` is the library entry point (the CLI and the
+test suite both go through it)."""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+from deepspeed_tpu.analysis import baseline as baseline_mod
+from deepspeed_tpu.analysis.context import FileContext, ProjectContext
+from deepspeed_tpu.analysis.core import Finding, Severity, all_rules
+
+_SKIP_DIRS = {"__pycache__", ".git", ".tox", ".venv", "node_modules", "build", "dist"}
+
+
+def collect_py_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+        elif os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS and not d.startswith("."))
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+        else:
+            raise FileNotFoundError(p)
+    return out
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding] = field(default_factory=list)  # new, reportable
+    baselined: List[Finding] = field(default_factory=list)
+    suppressed: int = 0
+    files: int = 0
+    parse_errors: List[Finding] = field(default_factory=list)
+    baseline_path: Optional[str] = None
+
+    def count(self, tier: Severity) -> int:
+        return sum(1 for f in self.findings if f.severity == tier)
+
+    def failing(self, fail_on: Severity = Severity.A) -> List[Finding]:
+        return [f for f in self.findings + self.parse_errors if f.severity >= fail_on]
+
+    @property
+    def all_current(self) -> List[Finding]:
+        """Every live (non-suppressed) finding — what --write-baseline records."""
+        return self.findings + self.baselined
+
+
+def _select_rules(select: Optional[Iterable[str]], disable: Optional[Iterable[str]]):
+    rules = all_rules()
+    if select:
+        unknown = set(select) - set(rules)
+        if unknown:
+            raise KeyError(f"unknown rule(s): {sorted(unknown)}")
+        rules = {rid: r for rid, r in rules.items() if rid in set(select)}
+    if disable:
+        unknown = set(disable) - set(all_rules())
+        if unknown:
+            raise KeyError(f"unknown rule(s): {sorted(unknown)}")
+        rules = {rid: r for rid, r in rules.items() if rid not in set(disable)}
+    return rules
+
+
+def lint_paths(
+    paths: Sequence[str],
+    select: Optional[Iterable[str]] = None,
+    disable: Optional[Iterable[str]] = None,
+    baseline_path: Optional[str] = None,
+    use_baseline: bool = True,
+) -> LintResult:
+    result = LintResult()
+
+    # -- parse ---------------------------------------------------------
+    contexts: List[FileContext] = []
+    sources: Dict[str, str] = {}
+    for path in collect_py_files(paths):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+        except (OSError, UnicodeDecodeError) as e:
+            result.parse_errors.append(
+                Finding("parse-error", path, 1, 1, f"cannot read file: {e}", Severity.A)
+            )
+            continue
+        sources[path] = source
+        try:
+            contexts.append(FileContext.parse(path, source))
+        except SyntaxError as e:
+            result.parse_errors.append(
+                Finding("parse-error", path, e.lineno or 1, 1, f"syntax error: {e.msg}", Severity.A)
+            )
+    result.files = len(contexts)
+    by_path = {fc.path: fc for fc in contexts}
+
+    # -- run rules -----------------------------------------------------
+    root = os.path.commonpath([os.path.abspath(p) for p in paths]) if paths else os.getcwd()
+    if os.path.isfile(root):
+        root = os.path.dirname(root)
+    project = ProjectContext(root=root, files=contexts)
+
+    raw: List[Finding] = []
+    for rule in _select_rules(select, disable).values():
+        if rule.scope == "project":
+            raw.extend(rule.check(rule, project))
+        else:
+            for fc in contexts:
+                raw.extend(rule.check(rule, fc))
+
+    # -- suppressions --------------------------------------------------
+    live: List[Finding] = []
+    for f in raw:
+        fc = by_path.get(f.path)
+        if fc is not None and fc.suppressions.is_suppressed(f.rule, f.line):
+            result.suppressed += 1
+        else:
+            live.append(f)
+
+    # -- baseline ------------------------------------------------------
+    if baseline_path is None and use_baseline:
+        baseline_path = baseline_mod.discover(paths)
+    result.baseline_path = baseline_path
+    fp_root = os.path.dirname(os.path.abspath(baseline_path)) if baseline_path else root
+    baseline_mod.assign_fingerprints(live, fp_root, sources)
+
+    known: Set[str] = set()
+    if use_baseline and baseline_path and os.path.isfile(baseline_path):
+        known = baseline_mod.load(baseline_path)
+    for f in live:
+        (result.baselined if f.fingerprint in known else result.findings).append(f)
+
+    result.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    result.baselined.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return result

@@ -1,0 +1,180 @@
+"""Traced-function discovery.
+
+The highest-value rules (host syncs, side effects, np.random) only apply
+*inside a JAX trace*: ``np.array(x)`` in a host path is fine, the same
+call inside a ``@jax.jit`` step function is a silent device→host sync on
+every step.  This module computes, per file, the set of function defs
+that (conservatively) execute under trace:
+
+1. functions decorated with a trace transform (``@jax.jit``,
+   ``@functools.partial(jax.jit, ...)``, ``@jax.checkpoint`` ...);
+2. functions *passed to* a trace-transform call anywhere in the module
+   (``jax.jit(step)``, ``jax.lax.scan(body, ...)``,
+   ``jax.grad(loss_fn)``), including through this repo's mesh wrappers
+   (``self._scoped(fn)``, ``scoped_to(mesh, fn)``,
+   ``self._get_compiled(name, fn)``);
+3. the closure: functions defined inside a traced function, and local
+   functions *called* from a traced body (``f()`` or ``self.f()``).
+
+This is a lexical, per-module analysis: cross-module call graphs are out
+of scope, which keeps the linter O(parse) and false-positive-poor; the
+baseline file absorbs what it can't see.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set
+
+from deepspeed_tpu.analysis.context import FileContext
+
+FunctionNode = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# Parameter annotations that declare a host-side contract: a helper whose
+# every parameter is one of these never receives tracers, so the call-graph
+# closure below doesn't follow edges into it (e.g. flash_attention's
+# `_drop_threshold(keep_prob: float)` computing a host constant).
+_HOST_ANNOTATIONS = {
+    "float", "int", "bool", "str", "bytes", "tuple", "list", "dict",
+    "np.ndarray", "numpy.ndarray", "Path",
+}
+
+# Last path segment of a jax transform that establishes a trace.
+TRANSFORMS = {
+    "jit", "pjit", "grad", "value_and_grad", "vmap", "pmap", "checkpoint",
+    "remat", "shard_map", "scan", "cond", "while_loop", "fori_loop",
+    "switch", "associative_scan", "custom_jvp", "custom_vjp", "named_call",
+}
+# This repo's jit-adjacent wrappers: functions passed through them end up
+# under jax.jit (runtime/engine.py:_get_compiled, parallel/sequence.py).
+LOCAL_WRAPPERS = {"_scoped", "scoped_to", "_get_compiled"}
+
+
+def is_trace_entry(resolved: Optional[str]) -> bool:
+    if not resolved:
+        return False
+    parts = resolved.split(".")
+    last = parts[-1]
+    if last in LOCAL_WRAPPERS:
+        return True
+    if last not in TRANSFORMS:
+        return False
+    # Require a jax-ish head so a user-defined `scan()` helper doesn't
+    # mark its callbacks; bare names come from `from jax import jit`.
+    return parts[0] in ("jax", "self") or len(parts) == 1
+
+
+def iter_own_nodes(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function body without descending into nested function
+    defs (nested defs are analyzed as their own traced functions)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, FunctionNode):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _decorator_targets(ctx: FileContext, dec: ast.AST) -> List[str]:
+    """Resolved names a decorator may apply: the decorator itself, its
+    call target, and (for functools.partial) the partial'd function."""
+    out = []
+    if isinstance(dec, ast.Call):
+        r = ctx.resolve(dec.func)
+        if r:
+            out.append(r)
+        if r and r.split(".")[-1] == "partial":
+            for arg in dec.args[:1]:
+                ra = ctx.resolve(arg)
+                if ra:
+                    out.append(ra)
+    else:
+        r = ctx.resolve(dec)
+        if r:
+            out.append(r)
+    return out
+
+
+def collect_functions(tree: ast.Module) -> List[ast.AST]:
+    return [n for n in ast.walk(tree) if isinstance(n, FunctionNode)]
+
+
+def _host_only_signature(fn: ast.AST) -> bool:
+    """True when every parameter is annotated with a host-side type —
+    such helpers are host computations even when called from traced
+    code, so trace-ness doesn't propagate into them."""
+    args = fn.args
+    params = list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+    if not params or (params and params[0].arg in ("self", "cls")):
+        return False
+    for p in params:
+        if p.annotation is None:
+            return False
+        ann = ast.unparse(p.annotation)
+        if ann not in _HOST_ANNOTATIONS:
+            return False
+    return True
+
+
+def find_traced_functions(ctx: FileContext) -> Set[int]:
+    """Return ``id()``s of FunctionDef nodes considered traced."""
+    defs = collect_functions(ctx.tree)
+    by_name: Dict[str, List[ast.AST]] = {}
+    for fn in defs:
+        by_name.setdefault(fn.name, []).append(fn)
+
+    traced: Set[int] = set()
+
+    # 1. trace-transform decorators
+    for fn in defs:
+        for dec in fn.decorator_list:
+            if any(is_trace_entry(t) for t in _decorator_targets(ctx, dec)):
+                traced.add(id(fn))
+                break
+
+    # 2. functions referenced in the args of a trace-transform call
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and is_trace_entry(ctx.resolve(node.func))):
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            for ref in ast.walk(arg):
+                name = None
+                if isinstance(ref, ast.Name):
+                    name = ref.id
+                elif isinstance(ref, ast.Attribute):
+                    name = ref.attr
+                if name:
+                    for fnode in by_name.get(name, ()):
+                        traced.add(id(fnode))
+
+    # 3. closure: nested defs + locally-called functions, to fixpoint
+    changed = True
+    while changed:
+        changed = False
+        for fn in defs:
+            if id(fn) not in traced:
+                continue
+            for sub in ast.walk(fn):
+                if isinstance(sub, FunctionNode) and sub is not fn and id(sub) not in traced:
+                    traced.add(id(sub))
+                    changed = True
+                elif isinstance(sub, ast.Call):
+                    cname = None
+                    if isinstance(sub.func, ast.Name):
+                        cname = sub.func.id
+                    elif (
+                        isinstance(sub.func, ast.Attribute)
+                        and isinstance(sub.func.value, ast.Name)
+                        and sub.func.value.id == "self"
+                    ):
+                        cname = sub.func.attr
+                    for fnode in by_name.get(cname, ()):
+                        if id(fnode) not in traced and not _host_only_signature(fnode):
+                            traced.add(id(fnode))
+                            changed = True
+    return traced
+
+
+def traced_defs(ctx: FileContext) -> List[ast.AST]:
+    """The traced FunctionDef nodes themselves, in source order."""
+    ids = ctx.traced_functions()
+    return [fn for fn in collect_functions(ctx.tree) if id(fn) in ids]

@@ -15,9 +15,10 @@ reference, per the TPU design stance (SURVEY.md §5.6):
 from __future__ import annotations
 
 import dataclasses
+import difflib
 import json
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Iterable, List, Optional
 
 from deepspeed_tpu.config import constants as C
 
@@ -30,11 +31,41 @@ def _pop(d: Dict[str, Any], key: str, default: Any = None) -> Any:
     return d.pop(key, default)
 
 
-def _check_empty(d: Dict[str, Any], block: str) -> None:
+def _pop_alias(d: Dict[str, Any], key: str, alias: str, default: Any, block: str) -> Any:
+    """Pop a key that also has a reference-compat alias.  Supplying both
+    spellings raises instead of silently dropping one (the module's
+    unknown-keys-raise stance applies to conflicts too)."""
+    if key in d and alias in d:
+        raise DeepSpeedConfigError(
+            f"'{block}.{key}' and its alias '{block}.{alias}' are both set; use one"
+        )
+    return d.pop(key, d.pop(alias, default))
+
+
+def _describe_unknown(keys: Iterable[str], block: str, valid: Iterable[str]) -> str:
+    """'zero_optimization.offload_param.buffer_sz' (did you mean
+    'buffer_size'?), ... — full nested paths plus nearest-key hints."""
+    valid = sorted(str(v) for v in valid)
+    parts = []
+    for key in sorted(str(k) for k in keys):
+        path = f"{block}.{key}" if block else key
+        close = difflib.get_close_matches(key, valid, n=1, cutoff=0.6)
+        hint = f" (did you mean '{close[0]}'?)" if close else ""
+        parts.append(f"'{path}'{hint}")
+    return ", ".join(parts)
+
+
+def _check_empty(d: Dict[str, Any], block: str, valid: Iterable[str] = ()) -> None:
     if d:
         raise DeepSpeedConfigError(
-            f"Unknown key(s) in '{block}' config block: {sorted(d.keys())}"
+            f"Unknown config key(s): {_describe_unknown(d.keys(), block, valid)}"
         )
+
+
+def _known_keys(cls, *aliases: str) -> Iterable[str]:
+    """A block's accepted keys: its dataclass field names plus any
+    reference-compat aliases the parser also pops."""
+    return tuple(f.name for f in dataclasses.fields(cls)) + aliases
 
 
 @dataclass
@@ -72,7 +103,7 @@ class OffloadDeviceConfig:
             max_in_cpu=int(_pop(d, "max_in_cpu", 1_000_000_000)),
             ratio=float(_pop(d, "ratio", 1.0)),
         )
-        _check_empty(d, block)
+        _check_empty(d, block, _known_keys(cls))
         if out.device not in ("none", "cpu", "nvme"):
             raise DeepSpeedConfigError(f"{block}.device must be none|cpu|nvme, got {out.device}")
         return out
@@ -121,7 +152,7 @@ class ZeroConfig:
         if cpu_offload and not offload_optimizer.enabled:
             offload_optimizer = dataclasses.replace(offload_optimizer, device="cpu")
         out = cls(
-            stage=int(_pop(d, "stage", C.ZERO_STAGE_DEFAULT)),
+            stage=int(_pop(d, C.ZERO_STAGE, C.ZERO_STAGE_DEFAULT)),
             contiguous_gradients=bool(_pop(d, "contiguous_gradients", True)),
             reduce_scatter=bool(_pop(d, "reduce_scatter", True)),
             reduce_bucket_size=int(_pop(d, "reduce_bucket_size", 500_000_000)),
@@ -133,21 +164,31 @@ class ZeroConfig:
             offload_param=offload_param,
             offload_optimizer=offload_optimizer,
             sub_group_size=int(_pop(d, "sub_group_size", 1_000_000_000)),
-            prefetch_bucket_size=int(_pop(d, "stage3_prefetch_bucket_size", _pop(d, "prefetch_bucket_size", 50_000_000))),
+            prefetch_bucket_size=int(_pop_alias(d, "stage3_prefetch_bucket_size", "prefetch_bucket_size", 50_000_000, C.ZERO_OPTIMIZATION)),
             param_persistence_threshold=int(
-                _pop(d, "stage3_param_persistence_threshold", _pop(d, "param_persistence_threshold", 100_000))
+                _pop_alias(d, "stage3_param_persistence_threshold", "param_persistence_threshold", 100_000, C.ZERO_OPTIMIZATION)
             ),
-            max_live_parameters=int(_pop(d, "stage3_max_live_parameters", _pop(d, "max_live_parameters", 1_000_000_000))),
-            max_reuse_distance=int(_pop(d, "stage3_max_reuse_distance", _pop(d, "max_reuse_distance", 1_000_000_000))),
+            max_live_parameters=int(_pop_alias(d, "stage3_max_live_parameters", "max_live_parameters", 1_000_000_000, C.ZERO_OPTIMIZATION)),
+            max_reuse_distance=int(_pop_alias(d, "stage3_max_reuse_distance", "max_reuse_distance", 1_000_000_000, C.ZERO_OPTIMIZATION)),
             gather_fp16_weights_on_model_save=bool(
-                _pop(d, "stage3_gather_fp16_weights_on_model_save", _pop(d, "gather_fp16_weights_on_model_save", False))
+                _pop_alias(d, "stage3_gather_fp16_weights_on_model_save", "gather_fp16_weights_on_model_save", False, C.ZERO_OPTIMIZATION)
             ),
             round_robin_gradients=bool(_pop(d, "round_robin_gradients", False)),
             ignore_unused_parameters=bool(_pop(d, "ignore_unused_parameters", True)),
             legacy_stage1=bool(_pop(d, "legacy_stage1", False)),
             cpu_offload=cpu_offload,
         )
-        _check_empty(d, C.ZERO_OPTIMIZATION)
+        _check_empty(
+            d, C.ZERO_OPTIMIZATION,
+            _known_keys(
+                cls,
+                "stage3_prefetch_bucket_size",
+                "stage3_param_persistence_threshold",
+                "stage3_max_live_parameters",
+                "stage3_max_reuse_distance",
+                "stage3_gather_fp16_weights_on_model_save",
+            ),
+        )
         if not (0 <= out.stage <= C.MAX_STAGE_ZERO_OPTIMIZATION):
             raise DeepSpeedConfigError(f"zero_optimization.stage must be in [0,3], got {out.stage}")
         return out
@@ -175,7 +216,7 @@ class Fp16Config:
             hysteresis=int(_pop(d, C.FP16_HYSTERESIS, C.FP16_HYSTERESIS_DEFAULT)),
             min_loss_scale=float(_pop(d, C.FP16_MIN_LOSS_SCALE, C.FP16_MIN_LOSS_SCALE_DEFAULT)),
         )
-        _check_empty(d, C.FP16)
+        _check_empty(d, C.FP16, _known_keys(cls))
         return out
 
     @property
@@ -193,7 +234,7 @@ class Bf16Config:
             return cls()
         d = dict(d)
         out = cls(enabled=bool(_pop(d, C.BF16_ENABLED, C.BF16_ENABLED_DEFAULT)))
-        _check_empty(d, C.BF16)
+        _check_empty(d, C.BF16, _known_keys(cls))
         return out
 
 
@@ -213,7 +254,7 @@ class OptimizerConfig:
             params=dict(_pop(d, C.OPTIMIZER_PARAMS, {}) or {}),
             legacy_fusion=bool(_pop(d, C.LEGACY_FUSION, C.LEGACY_FUSION_DEFAULT)),
         )
-        _check_empty(d, C.OPTIMIZER)
+        _check_empty(d, C.OPTIMIZER, _known_keys(cls))
         if out.type is not None and not isinstance(out.type, str):
             raise DeepSpeedConfigError("optimizer.type must be a string")
         return out
@@ -234,7 +275,7 @@ class SchedulerConfig:
             return cls()
         d = dict(d)
         out = cls(type=_pop(d, C.TYPE, None), params=dict(_pop(d, C.SCHEDULER_PARAMS, {}) or {}))
-        _check_empty(d, C.SCHEDULER)
+        _check_empty(d, C.SCHEDULER, _known_keys(cls))
         return out
 
 
@@ -268,7 +309,7 @@ class MeshConfig:
             seq=int(_pop(d, "seq", 1)),
             expert=int(_pop(d, "expert", 1)),
         )
-        _check_empty(d, C.MESH)
+        _check_empty(d, C.MESH, _known_keys(cls))
         return out
 
 
@@ -298,7 +339,7 @@ class ActivationCheckpointingConfig:
             synchronize_checkpoint_boundary=bool(_pop(d, "synchronize_checkpoint_boundary", False)),
             profile=bool(_pop(d, "profile", False)),
         )
-        _check_empty(d, "activation_checkpointing")
+        _check_empty(d, "activation_checkpointing", _known_keys(cls))
         return out
 
 
@@ -326,7 +367,7 @@ class FlopsProfilerConfig:
             detailed=bool(_pop(d, "detailed", True)),
             output_file=_pop(d, "output_file", None),
         )
-        _check_empty(d, "flops_profiler")
+        _check_empty(d, "flops_profiler", _known_keys(cls))
         return out
 
 
@@ -346,7 +387,7 @@ class TensorboardConfig:
             output_path=_pop(d, C.TENSORBOARD_OUTPUT_PATH, C.TENSORBOARD_OUTPUT_PATH_DEFAULT),
             job_name=_pop(d, C.TENSORBOARD_JOB_NAME, C.TENSORBOARD_JOB_NAME_DEFAULT),
         )
-        _check_empty(d, C.TENSORBOARD)
+        _check_empty(d, C.TENSORBOARD, _known_keys(cls))
         return out
 
 
@@ -377,7 +418,7 @@ class PipelineConfig:
         )
         if out.schedule not in ("1f1b", "gpipe"):
             raise ValueError(f"pipeline.schedule must be '1f1b' or 'gpipe', got {out.schedule!r}")
-        _check_empty(d, C.PIPELINE)
+        _check_empty(d, C.PIPELINE, _known_keys(cls))
         return out
 
 
@@ -403,7 +444,7 @@ class AioConfig:
             single_submit=bool(_pop(d, "single_submit", False)),
             overlap_events=bool(_pop(d, "overlap_events", True)),
         )
-        _check_empty(d, "aio")
+        _check_empty(d, "aio", _known_keys(cls))
         return out
 
 
@@ -441,8 +482,8 @@ class QuantizeTrainingConfig:
             quantize_verbose=bool(_pop(d, "quantize_verbose", False)),
             quantizer_kernel=bool(_pop(d, "quantizer_kernel", False)),
             quantize_type=_pop(d, "quantize_type", "symmetric"),
-            quantize_bits_start=int(_pop(d, "quantize_bits_start", _pop(d, "start_bits", 16))),
-            quantize_bits_target=int(_pop(d, "quantize_bits_target", _pop(d, "target_bits", 8))),
+            quantize_bits_start=int(_pop_alias(d, "quantize_bits_start", "start_bits", 16, "quantize_training")),
+            quantize_bits_target=int(_pop_alias(d, "quantize_bits_target", "target_bits", 8, "quantize_training")),
             quantize_schedule_offset=int(_pop(d, "quantize_schedule_offset", 1000)),
             quantize_groups=int(_pop(d, "quantize_groups", 1)),
             fp16_mixed_quantize=bool(_pop(d, "fp16_mixed_quantize", False)),
@@ -457,7 +498,7 @@ class QuantizeTrainingConfig:
             eigenvalue_layer_name=_pop(d, "eigenvalue_layer_name", "bert.encoder.layer"),
             eigenvalue_layer_num=int(_pop(d, "eigenvalue_layer_num", 0)),
         )
-        _check_empty(d, "quantize_training")
+        _check_empty(d, "quantize_training", _known_keys(cls, "start_bits", "target_bits"))
         return out
 
 
@@ -477,7 +518,7 @@ class ProgressiveLayerDropConfig:
             theta=float(_pop(d, "theta", 0.5)),
             gamma=float(_pop(d, "gamma", 0.001)),
         )
-        _check_empty(d, "progressive_layer_drop")
+        _check_empty(d, "progressive_layer_drop", _known_keys(cls))
         return out
 
 
@@ -552,7 +593,10 @@ class DeepSpeedConfig:
 
         unknown = set(d.keys()) - _KNOWN_TOP_LEVEL
         if unknown:
-            raise DeepSpeedConfigError(f"Unknown top-level config key(s): {sorted(unknown)}")
+            raise DeepSpeedConfigError(
+                "Unknown top-level config key(s): "
+                + _describe_unknown(unknown, "", _KNOWN_TOP_LEVEL)
+            )
 
         self._raw = d
         self.train_batch_size = d.get(C.TRAIN_BATCH_SIZE)

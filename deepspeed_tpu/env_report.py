@@ -46,6 +46,25 @@ def op_report(verbose: bool = True) -> bool:
     return ok
 
 
+def _compilation_cache_status() -> str:
+    """Whether XLA's persistent compilation cache is on, and where.
+    Checked the same way jax resolves it: config flag first, then the
+    environment variable."""
+    import jax
+
+    cache_dir = None
+    try:
+        cache_dir = jax.config.jax_compilation_cache_dir
+    except AttributeError:
+        pass
+    cache_dir = cache_dir or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if not cache_dir:
+        return "disabled"
+    min_size = getattr(jax.config, "jax_persistent_cache_min_entry_size_bytes", None)
+    detail = f", min entry size {min_size}B" if min_size else ""
+    return f"enabled ({cache_dir}{detail})"
+
+
 def debug_report() -> None:
     import jax
 
@@ -53,14 +72,17 @@ def debug_report() -> None:
     print("DeepSpeed-TPU general environment info:")
     from deepspeed_tpu.version import __version__
 
+    devices = jax.devices()
     rows = [
         ("deepspeed_tpu version", __version__),
         ("jax version", jax.__version__),
         ("default backend", jax.default_backend()),
+        ("detected platform", devices[0].platform if devices else "none"),
         ("device count", jax.device_count()),
         ("local device count", jax.local_device_count()),
         ("process count", jax.process_count()),
-        ("devices", ", ".join(str(d) for d in jax.devices()[:8]) + (" ..." if jax.device_count() > 8 else "")),
+        ("devices", ", ".join(str(d) for d in devices[:8]) + (" ..." if jax.device_count() > 8 else "")),
+        ("compilation cache", _compilation_cache_status()),
     ]
     try:
         import jaxlib

@@ -50,7 +50,8 @@ def _split_flat(buf, shapes):
     is bounded by _STAGE_CHUNK_BYTES chunking, not aliasing."""
     outs, off = [], 0
     for shp in shapes:
-        n = int(np.prod(shp)) if shp else 1
+        # static `shapes` (static_argnums=1): host int math, not a sync
+        n = int(np.prod(shp)) if shp else 1  # ds-lint: disable=host-sync-in-jit
         outs.append(jax.lax.dynamic_slice(buf, (off,), (n,)).reshape(shp))
         off += n
     return outs

@@ -50,7 +50,8 @@ def log_rank_file(*msgs: Any, path_template: str = "/tmp/ds_tpu_debug_rank{rank}
 def tensor_fingerprint(x: Any) -> str:
     """Small stable summary for divergence hunts: shape/dtype/norm/head."""
     arr = np.asarray(x)
-    flat = arr.reshape(-1).astype(np.float64) if arr.size else arr.reshape(-1)
+    # f64 on purpose: fingerprints must not collide at f32 rounding
+    flat = arr.reshape(-1).astype(np.float64) if arr.size else arr.reshape(-1)  # ds-lint: disable=float64-promotion
     head = np.array2string(flat[:4], precision=5) if arr.size else "[]"
     norm = float(np.linalg.norm(flat)) if arr.size else 0.0
     return f"shape={arr.shape} dtype={arr.dtype} l2={norm:.6g} head={head}"

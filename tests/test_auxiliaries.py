@@ -268,3 +268,27 @@ def test_debug_helpers(tmp_path):
     printflock("hello")  # must not raise
     log_rank_file("x", path_template=str(tmp_path / "r{rank}.txt"))
     assert (tmp_path / "r0.txt").read_text().strip() == "x"
+
+
+def test_env_report_rows(capsys, monkeypatch):
+    from deepspeed_tpu import env_report
+
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+    # jax may have latched the env var into the config flag at import
+    prev_cache_dir = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    try:
+        env_report.debug_report()
+        out = capsys.readouterr().out
+        for row in (
+            "jax version", "jaxlib version", "detected platform",
+            "device count", "compilation cache",
+        ):
+            assert row in out, row
+        assert "disabled" in out  # no persistent cache configured
+
+        monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", "/tmp/xla-cache")
+        env_report.debug_report()
+        assert "enabled (/tmp/xla-cache" in capsys.readouterr().out
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev_cache_dir)

@@ -1,0 +1,919 @@
+"""ds_lint (deepspeed_tpu.analysis) tests.
+
+Every shipped rule has at least one failing fixture and one clean
+fixture; plus suppression syntax, baseline round-trips, CLI exit codes,
+and the self-run gate (the linter must be clean on deepspeed_tpu/ with
+the checked-in baseline, in well under the 15s budget).
+"""
+import json
+import os
+import textwrap
+import time
+
+import pytest
+
+from deepspeed_tpu.analysis import Severity, all_rules, lint_paths
+from deepspeed_tpu.analysis import baseline as baseline_mod
+from deepspeed_tpu.analysis.cli import cli_main
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_src(tmp_path, src, rule=None, name="mod.py", **kw):
+    p = tmp_path / name
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    kw.setdefault("use_baseline", False)
+    return lint_paths([str(p)], select=[rule] if rule else None, **kw)
+
+
+def rule_ids(result):
+    return [f.rule for f in result.findings]
+
+
+# ---------------------------------------------------------------------------
+# registry sanity
+# ---------------------------------------------------------------------------
+
+
+def test_rule_catalog_shape():
+    rules = all_rules()
+    assert len(rules) >= 10
+    assert all(r.tier in (Severity.A, Severity.B, Severity.C) for r in rules.values())
+    assert all(r.description for r in rules.values())
+    # the rules named in the issue all exist
+    for rid in (
+        "host-sync-in-jit", "print-under-trace", "np-random-under-trace",
+        "global-mutation-under-trace", "unhashable-static-arg",
+        "donated-buffer-reuse", "float64-promotion", "config-key-drift",
+        "bare-jit", "missing-sharding-constraint",
+    ):
+        assert rid in rules, rid
+
+
+# ---------------------------------------------------------------------------
+# host-sync-in-jit
+# ---------------------------------------------------------------------------
+
+
+class TestHostSync:
+    def test_flags_syncs_in_jitted_function(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def step(state, g):
+                h = np.array(g)
+                s = float(h.sum())
+                v = state.item()
+                jax.device_get(state)
+                state.block_until_ready()
+                return s
+            """,
+            "host-sync-in-jit",
+        )
+        msgs = " ".join(f.message for f in res.findings)
+        assert len(res.findings) == 5
+        assert all(f.severity == Severity.A for f in res.findings)
+        assert "numpy.array" in msgs and "device_get" in msgs and "block_until_ready" in msgs
+
+    def test_flags_through_jit_call_and_scan_body(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import jax
+
+            def body(carry, x):
+                return carry, float(x)
+
+            def outer(xs):
+                return jax.lax.scan(body, 0.0, xs)
+            """,
+            "host-sync-in-jit",
+        )
+        assert rule_ids(res) == ["host-sync-in-jit"]
+
+    def test_flags_helper_called_from_traced(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import jax
+
+            def helper(x):
+                return x.item()
+
+            @jax.jit
+            def step(x):
+                return helper(x)
+            """,
+            "host-sync-in-jit",
+        )
+        assert rule_ids(res) == ["host-sync-in-jit"]
+
+    def test_dotted_import_does_not_shadow_root_alias(self, tmp_path):
+        # `import jax.numpy` binds the root name `jax`; it must not make
+        # `jax.device_get` resolve as jax.numpy.device_get
+        res = lint_src(
+            tmp_path,
+            """
+            import jax
+            import jax.numpy
+
+            @jax.jit
+            def step(x):
+                return jax.device_get(x)
+            """,
+            "host-sync-in-jit",
+        )
+        assert rule_ids(res) == ["host-sync-in-jit"]
+
+    def test_clean_host_path_and_jnp_code(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            def host_apply(grads):
+                # not traced: host optimizer path, syncs are the point
+                g = np.array(jax.device_get(grads))
+                return float(g.sum())
+
+            @jax.jit
+            def step(state):
+                return jnp.sum(state) * 2
+            """,
+            "host-sync-in-jit",
+        )
+        assert res.findings == []
+
+    def test_host_annotated_helper_not_traced(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import jax
+
+            def threshold(keep_prob: float) -> int:
+                return int(keep_prob * 4294967296.0)
+
+            @jax.jit
+            def step(x):
+                t = threshold(0.9)
+                return x * t
+            """,
+            "host-sync-in-jit",
+        )
+        assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# print-under-trace / np-random-under-trace / global-mutation-under-trace
+# ---------------------------------------------------------------------------
+
+
+class TestSideEffects:
+    def test_print_flagged(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import jax
+
+            @jax.jit
+            def step(x):
+                print("loss", x)
+                return x
+            """,
+            "print-under-trace",
+        )
+        assert rule_ids(res) == ["print-under-trace"]
+        assert res.findings[0].severity == Severity.B
+
+    def test_print_clean_with_debug_print_and_host(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import jax
+
+            @jax.jit
+            def step(x):
+                jax.debug.print("loss {}", x)
+                return x
+
+            def report(x):
+                print("host-side is fine", x)
+            """,
+            "print-under-trace",
+        )
+        assert res.findings == []
+
+    def test_np_random_flagged(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def dropout(x):
+                mask = np.random.rand(*x.shape) > 0.5
+                return x * mask
+            """,
+            "np-random-under-trace",
+        )
+        assert rule_ids(res) == ["np-random-under-trace"]
+        assert "constant" in res.findings[0].message
+
+    def test_np_random_clean(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import jax
+            import numpy as np
+
+            def make_batch(rng):
+                return np.random.rand(4, 4)  # host data pipeline: fine
+
+            @jax.jit
+            def dropout(x, key):
+                mask = jax.random.bernoulli(key, 0.5, x.shape)
+                return x * mask
+            """,
+            "np-random-under-trace",
+        )
+        assert res.findings == []
+
+    def test_global_mutation_flagged(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import jax
+
+            _step_count = 0
+
+            @jax.jit
+            def step(self, x):
+                global _step_count
+                _step_count += 1
+                self.cache = x
+                return x
+            """,
+            "global-mutation-under-trace",
+        )
+        assert rule_ids(res) == ["global-mutation-under-trace"] * 2
+        msgs = " ".join(f.message for f in res.findings)
+        assert "global" in msgs and "self.cache" in msgs
+
+    def test_global_mutation_clean_outside_trace(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            class Engine:
+                def set_mesh(self, mesh):
+                    self.mesh = mesh  # plain host method: fine
+            """,
+            "global-mutation-under-trace",
+        )
+        assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# unhashable-static-arg
+# ---------------------------------------------------------------------------
+
+
+class TestStaticArgs:
+    def test_direct_call_with_list(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import jax
+
+            def f(x, cfg):
+                return x
+
+            y = jax.jit(f, static_argnums=(1,))(1, [2, 3])
+            """,
+            "unhashable-static-arg",
+        )
+        assert rule_ids(res) == ["unhashable-static-arg"]
+
+    def test_wrapped_name_call_and_mutable_default(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import jax
+
+            def f(x, cfg={}):
+                return x
+
+            g = jax.jit(f, static_argnums=(1,))
+            y = g(1, {"a": 1})
+            """,
+            "unhashable-static-arg",
+        )
+        assert len(res.findings) == 2  # default dict + call-site dict
+
+    def test_clean_with_tuple(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import jax
+
+            def f(x, cfg):
+                return x
+
+            g = jax.jit(f, static_argnums=(1,))
+            y = g(1, (2, 3))
+            """,
+            "unhashable-static-arg",
+        )
+        assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# donated-buffer-reuse
+# ---------------------------------------------------------------------------
+
+
+class TestDonation:
+    def test_read_after_donation(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import jax
+
+            def train(step_fn, state):
+                step = jax.jit(step_fn, donate_argnums=(0,))
+                new_state = step(state)
+                return state, new_state  # state's buffer is gone
+            """,
+            "donated-buffer-reuse",
+        )
+        assert rule_ids(res) == ["donated-buffer-reuse"]
+        assert "donate_argnums=0" in res.findings[0].message
+
+    def test_inline_jit_donation(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import jax
+
+            def train(step_fn, state):
+                out = jax.jit(step_fn, donate_argnums=(0,))(state)
+                loss = state["loss"]
+                return out, loss
+            """,
+            "donated-buffer-reuse",
+        )
+        assert rule_ids(res) == ["donated-buffer-reuse"]
+
+    def test_rebind_is_clean(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import jax
+
+            def train(step_fn, state):
+                step = jax.jit(step_fn, donate_argnums=(0,))
+                state = step(state)   # engine idiom: rebind
+                state = step(state)
+                return state
+            """,
+            "donated-buffer-reuse",
+        )
+        assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# float64-promotion
+# ---------------------------------------------------------------------------
+
+
+class TestFloat64:
+    def test_flags_explicit_f64(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import jax.numpy as jnp
+
+            def init(n):
+                a = jnp.zeros(n, dtype=jnp.float64)
+                b = jnp.arange(n, dtype="float64")
+                c = jnp.ones(n, dtype=float)
+                return a.astype("float64") + b + c
+            """,
+            "float64-promotion",
+        )
+        assert len(res.findings) == 4
+        assert all(f.severity == Severity.B for f in res.findings)
+
+    def test_clean_f32_and_bf16(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import jax.numpy as jnp
+            import numpy as np
+
+            def init(n):
+                a = jnp.zeros(n, dtype=jnp.float32)
+                b = jnp.ones(n, dtype=jnp.bfloat16)
+                c = np.zeros(n, dtype=np.float64)  # host-side f64 is allowed
+                return a, b, c
+            """,
+            "float64-promotion",
+        )
+        assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# config-key-drift
+# ---------------------------------------------------------------------------
+
+_CONSTANTS_SRC = """
+ZERO_OPTIMIZATION = "zero_optimization"
+ZERO_STAGE = "stage"
+ZERO_STAGE_DEFAULT = 0
+FP16_ENABLED = "enabled"
+BF16_ENABLED = "enabled"
+"""
+
+
+class TestConfigDrift:
+    def _project(self, tmp_path, config_src):
+        (tmp_path / "config").mkdir()
+        (tmp_path / "config" / "constants.py").write_text(textwrap.dedent(_CONSTANTS_SRC))
+        (tmp_path / "config" / "config.py").write_text(textwrap.dedent(config_src))
+        return lint_paths([str(tmp_path)], select=["config-key-drift"], use_baseline=False)
+
+    def test_missing_constant_is_tier_a(self, tmp_path):
+        res = self._project(
+            tmp_path,
+            """
+            from config import constants as C
+
+            def parse(d):
+                return d.get(C.ZERO_OPTIMIZATION, C.MISSING_DEFAULT)
+            """,
+        )
+        assert [f.severity for f in res.findings] == [Severity.A]
+        assert "MISSING_DEFAULT" in res.findings[0].message
+
+    def test_literal_duplicating_unique_constant_is_tier_b(self, tmp_path):
+        res = self._project(
+            tmp_path,
+            """
+            from config import constants as C
+
+            def parse(d):
+                stage = d.get("stage", 0)          # drift: C.ZERO_STAGE exists
+                on = d.get("enabled", False)       # ambiguous value: not drift
+                return stage, on
+            """,
+        )
+        assert [f.severity for f in res.findings] == [Severity.B]
+        assert "ZERO_STAGE" in res.findings[0].message
+
+    def test_clean_accessors(self, tmp_path):
+        res = self._project(
+            tmp_path,
+            """
+            from config import constants as C
+
+            def parse(d):
+                return d.get(C.ZERO_STAGE, C.ZERO_STAGE_DEFAULT)
+            """,
+        )
+        assert res.findings == []
+
+    def test_no_findings_without_both_files(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            from config import constants as C
+            X = C.ANYTHING_AT_ALL
+            """,
+            "config-key-drift",
+        )
+        assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# bare-jit / jit-in-loop
+# ---------------------------------------------------------------------------
+
+
+class TestJitHygiene:
+    def test_bare_jit_flagged(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import jax
+
+            def compile_step(fn):
+                return jax.jit(fn, donate_argnums=(0,))
+            """,
+            "bare-jit",
+        )
+        assert rule_ids(res) == ["bare-jit"]
+
+    def test_scoped_or_sharded_jit_clean(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import jax
+            from deepspeed_tpu.parallel.sequence import scoped_to
+
+            def compile_step(self, fn, mesh, sh):
+                a = jax.jit(scoped_to(mesh, fn))
+                b = jax.jit(self._scoped(fn), donate_argnums=(0,))
+                c = jax.jit(fn, out_shardings=sh)
+                return a, b, c
+            """,
+            "bare-jit",
+        )
+        assert res.findings == []
+
+    def test_jit_in_loop_flagged(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import jax
+
+            def sweep(fns, x):
+                outs = []
+                for fn in fns:
+                    outs.append(jax.jit(fn)(x))
+                return outs
+            """,
+            "jit-in-loop",
+        )
+        assert rule_ids(res) == ["jit-in-loop"]
+
+    def test_jit_outside_loop_clean(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import jax
+
+            def sweep(fn, xs):
+                step = jax.jit(fn)
+                return [step(x) for x in xs]
+
+            def cached(self, fn, xs):
+                for x in xs:
+                    if "step" not in self._compiled:
+                        # defs inside loops are not themselves loop work
+                        def build():
+                            return jax.jit(fn)
+                return xs
+            """,
+            "jit-in-loop",
+        )
+        # the comprehension is not a For statement, and the nested def
+        # resets the loop context
+        assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# missing-sharding-constraint
+# ---------------------------------------------------------------------------
+
+
+class TestSharding:
+    def test_unpinned_collective_in_comm(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import jax
+
+            def all_reduce(x, axis):
+                return jax.lax.psum(x, axis)
+            """,
+            "missing-sharding-constraint",
+            name="comm/reduce.py",
+        )
+        assert rule_ids(res) == ["missing-sharding-constraint"]
+        assert res.findings[0].severity == Severity.C
+
+    def test_clean_when_module_pins_layout(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def all_reduce(x, axis, mesh):
+                out = jax.lax.psum(x, axis)
+                return jax.lax.with_sharding_constraint(
+                    out, NamedSharding(mesh, PartitionSpec()))
+            """,
+            "missing-sharding-constraint",
+            name="comm/reduce.py",
+        )
+        assert res.findings == []
+
+    def test_not_applied_outside_comm_and_zero(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import jax
+
+            def all_reduce(x, axis):
+                return jax.lax.psum(x, axis)
+            """,
+            "missing-sharding-constraint",
+            name="models/layer.py",
+        )
+        assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# prng-key-reuse
+# ---------------------------------------------------------------------------
+
+
+class TestPrngReuse:
+    def test_reused_key_flagged(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import jax
+
+            def init(n):
+                key = jax.random.PRNGKey(0)
+                w = jax.random.normal(key, (n, n))
+                b = jax.random.uniform(key, (n,))
+                return w, b
+            """,
+            "prng-key-reuse",
+        )
+        assert rule_ids(res) == ["prng-key-reuse"]
+        assert "split" in res.findings[0].message
+
+    def test_split_keys_clean(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import jax
+
+            def init(n):
+                key = jax.random.PRNGKey(0)
+                kw, kb = jax.random.split(key)
+                w = jax.random.normal(kw, (n, n))
+                b = jax.random.uniform(kb, (n,))
+                return w, b
+            """,
+            "prng-key-reuse",
+        )
+        assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+
+class TestSuppression:
+    SRC = """
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def step(x):
+        a = float(x){inline}
+        return a
+    """
+
+    def test_same_line_disable(self, tmp_path):
+        src = self.SRC.format(inline="  # ds-lint: disable=host-sync-in-jit")
+        res = lint_src(tmp_path, src, "host-sync-in-jit")
+        assert res.findings == [] and res.suppressed == 1
+
+    def test_standalone_comment_suppresses_next_line(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import jax
+
+            @jax.jit
+            def step(x):
+                # ds-lint: disable=host-sync-in-jit
+                a = float(x)
+                return a
+            """,
+            "host-sync-in-jit",
+        )
+        assert res.findings == [] and res.suppressed == 1
+
+    def test_standalone_pragma_skips_intervening_comments(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import jax
+
+            @jax.jit
+            def step(x):
+                # ds-lint: disable=host-sync-in-jit
+                # host int math on a static shape, not a sync
+                a = float(x)
+                return a
+            """,
+            "host-sync-in-jit",
+        )
+        assert res.findings == [] and res.suppressed == 1
+
+    def test_disable_file(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            # ds-lint: disable-file=host-sync-in-jit
+            import jax
+
+            @jax.jit
+            def step(x):
+                return float(x) + int(x)
+            """,
+            "host-sync-in-jit",
+        )
+        assert res.findings == [] and res.suppressed == 2
+
+    def test_disable_all(self, tmp_path):
+        src = self.SRC.format(inline="  # ds-lint: disable=all")
+        res = lint_src(tmp_path, src, "host-sync-in-jit")
+        assert res.findings == [] and res.suppressed == 1
+
+    def test_other_rule_not_suppressed(self, tmp_path):
+        src = self.SRC.format(inline="  # ds-lint: disable=print-under-trace")
+        res = lint_src(tmp_path, src, "host-sync-in-jit")
+        assert rule_ids(res) == ["host-sync-in-jit"]
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+_VIOLATION = """
+import jax
+
+@jax.jit
+def step(x):
+    return float(x)
+"""
+
+
+class TestBaseline:
+    def test_roundtrip_grandfathers_existing(self, tmp_path):
+        mod = tmp_path / "mod.py"
+        mod.write_text(textwrap.dedent(_VIOLATION))
+        bl = tmp_path / ".ds_lint_baseline.json"
+
+        first = lint_paths([str(mod)], baseline_path=str(bl))
+        assert len(first.findings) == 1
+        baseline_mod.save(str(bl), first.all_current)
+
+        second = lint_paths([str(mod)], baseline_path=str(bl))
+        assert second.findings == [] and len(second.baselined) == 1
+
+    def test_new_finding_not_grandfathered(self, tmp_path):
+        mod = tmp_path / "mod.py"
+        mod.write_text(textwrap.dedent(_VIOLATION))
+        bl = tmp_path / ".ds_lint_baseline.json"
+        baseline_mod.save(str(bl), lint_paths([str(mod)], baseline_path=str(bl)).all_current)
+
+        mod.write_text(textwrap.dedent(_VIOLATION) + "\n\n@jax.jit\ndef step2(x):\n    return int(x)\n")
+        res = lint_paths([str(mod)], baseline_path=str(bl))
+        assert len(res.findings) == 1 and res.findings[0].line > 5
+        assert len(res.baselined) == 1
+
+    def test_fingerprint_survives_line_drift(self, tmp_path):
+        mod = tmp_path / "mod.py"
+        mod.write_text(textwrap.dedent(_VIOLATION))
+        bl = tmp_path / ".ds_lint_baseline.json"
+        baseline_mod.save(str(bl), lint_paths([str(mod)], baseline_path=str(bl)).all_current)
+
+        # prepend unrelated code: line numbers shift, fingerprints don't
+        mod.write_text("X = 1\nY = 2\n" + textwrap.dedent(_VIOLATION))
+        res = lint_paths([str(mod)], baseline_path=str(bl))
+        assert res.findings == [] and len(res.baselined) == 1
+
+    def test_discovery_walks_up(self, tmp_path, monkeypatch):
+        pkg = tmp_path / "pkg" / "sub"
+        pkg.mkdir(parents=True)
+        mod = pkg / "mod.py"
+        mod.write_text(textwrap.dedent(_VIOLATION))
+        bl = tmp_path / ".ds_lint_baseline.json"
+        res0 = lint_paths([str(mod)], baseline_path=str(bl))
+        baseline_mod.save(str(bl), res0.all_current)
+
+        monkeypatch.chdir(tmp_path / "pkg")
+        res = lint_paths([str(mod)])  # no explicit baseline: discovered
+        assert res.baseline_path == str(bl)
+        assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+class TestCli:
+    def test_exit_zero_on_clean_tree(self, tmp_path):
+        (tmp_path / "ok.py").write_text("import jax.numpy as jnp\n\n\ndef f(x):\n    return jnp.sum(x)\n")
+        assert cli_main([str(tmp_path), "--no-baseline"]) == 0
+
+    def test_exit_one_on_tier_a(self, tmp_path, capsys):
+        mod = tmp_path / "bad.py"
+        mod.write_text(textwrap.dedent(_VIOLATION))
+        assert cli_main([str(mod), "--no-baseline"]) == 1
+        out = capsys.readouterr().out
+        assert "host-sync-in-jit" in out and "[A]" in out
+
+    def test_tier_b_only_fails_with_fail_on_b(self, tmp_path):
+        mod = tmp_path / "warn.py"
+        mod.write_text("import jax\n\n\ndef f(fn):\n    return jax.jit(fn)\n")
+        assert cli_main([str(mod), "--no-baseline"]) == 0
+        assert cli_main([str(mod), "--no-baseline", "--fail-on", "B"]) == 1
+
+    def test_select_and_disable(self, tmp_path):
+        mod = tmp_path / "bad.py"
+        mod.write_text(textwrap.dedent(_VIOLATION))
+        assert cli_main([str(mod), "--no-baseline", "--select", "prng-key-reuse"]) == 0
+        assert cli_main([str(mod), "--no-baseline", "--disable", "host-sync-in-jit"]) == 0
+        assert cli_main([str(mod), "--no-baseline", "--select", "no-such-rule"]) == 2
+
+    def test_write_baseline_then_clean(self, tmp_path, monkeypatch):
+        mod = tmp_path / "bad.py"
+        mod.write_text(textwrap.dedent(_VIOLATION))
+        bl = tmp_path / ".ds_lint_baseline.json"
+        assert cli_main([str(mod), "--baseline", str(bl), "--write-baseline"]) == 0
+        data = json.loads(bl.read_text())
+        assert data["version"] == 1 and len(data["findings"]) == 1
+        assert cli_main([str(mod), "--baseline", str(bl)]) == 0
+
+    def test_first_time_write_baseline_from_cwd(self, tmp_path, monkeypatch):
+        # fingerprint roots must match between the --write-baseline run
+        # (no baseline exists yet, file lands in cwd) and the next run
+        # (which discovers that file): pkg-relative vs cwd-relative paths
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "bad.py").write_text(textwrap.dedent(_VIOLATION))
+        monkeypatch.chdir(tmp_path)
+        assert cli_main(["pkg", "--write-baseline"]) == 0
+        assert (tmp_path / baseline_mod.BASELINE_NAME).is_file()
+        assert cli_main(["pkg"]) == 0  # everything just written is grandfathered
+
+    def test_json_format(self, tmp_path, capsys):
+        mod = tmp_path / "bad.py"
+        mod.write_text(textwrap.dedent(_VIOLATION))
+        assert cli_main([str(mod), "--no-baseline", "--format", "json"]) == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["findings"][0]["rule"] == "host-sync-in-jit"
+        assert payload["findings"][0]["severity"] == "A"
+
+    def test_list_rules(self, capsys):
+        assert cli_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        assert "host-sync-in-jit" in out and "config-key-drift" in out
+
+    def test_no_paths_is_usage_error(self):
+        assert cli_main([]) == 2
+
+    def test_syntax_error_file_fails(self, tmp_path, capsys):
+        (tmp_path / "broken.py").write_text("def f(:\n")
+        assert cli_main([str(tmp_path), "--no-baseline"]) == 1
+        assert "parse-error" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# self-run: the repo gates on itself
+# ---------------------------------------------------------------------------
+
+
+class TestSelfRun:
+    def test_package_is_clean_with_baseline(self):
+        baseline = os.path.join(REPO_ROOT, ".ds_lint_baseline.json")
+        assert os.path.isfile(baseline), "checked-in baseline missing"
+        start = time.monotonic()
+        res = lint_paths(
+            [os.path.join(REPO_ROOT, "deepspeed_tpu")], baseline_path=baseline
+        )
+        elapsed = time.monotonic() - start
+        new = [f.format() for f in res.findings + res.parse_errors]
+        assert new == [], "new ds_lint findings:\n" + "\n".join(new)
+        assert elapsed < 15.0, f"ds_lint self-run took {elapsed:.1f}s (budget 15s)"
+
+    def test_seeded_violation_is_caught(self, tmp_path):
+        # the acceptance check: introducing a violation next to the real
+        # package must flip the gate even with the baseline applied
+        baseline = os.path.join(REPO_ROOT, ".ds_lint_baseline.json")
+        bad = tmp_path / "seeded.py"
+        bad.write_text(textwrap.dedent(_VIOLATION))
+        res = lint_paths(
+            [os.path.join(REPO_ROOT, "deepspeed_tpu"), str(bad)],
+            baseline_path=baseline,
+        )
+        assert [f.rule for f in res.failing()] == ["host-sync-in-jit"]
