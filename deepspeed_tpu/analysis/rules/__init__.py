@@ -1,6 +1,7 @@
 """Built-in rule modules.  Importing this package registers every rule
 with the core registry (deepspeed_tpu.analysis.core)."""
 from deepspeed_tpu.analysis.rules import (  # noqa: F401
+    atomic_write,
     config_drift,
     donation,
     dtype_rules,

@@ -1,0 +1,75 @@
+"""Rule: checkpoint metadata must go through the atomic-write helper.
+
+A bare ``open(path, "w")`` of ``latest`` / ``meta.json`` /
+``manifest.json`` can tear: a crash between ``open`` and ``close``
+leaves a truncated pointer or metadata file, which is exactly the
+failure mode the resilience subsystem exists to remove.  The sanctioned
+path is :func:`deepspeed_tpu.resilience.atomic.atomic_write_text`
+(tmp file + fsync + ``os.replace``), so this rule flags any write-mode
+``open`` whose path expression mentions one of the checkpoint metadata
+names — outside the helper module itself.
+"""
+from __future__ import annotations
+
+import ast
+
+from deepspeed_tpu.analysis.core import Severity, make_finding, register
+
+_META_NAMES = {"latest", "meta.json", "manifest.json"}
+_META_NAME_VARS = {"LATEST_FILE", "META_FILE", "MANIFEST_FILE"}
+_WRITE_CHARS = set("wax+")
+
+
+def _open_mode(node: ast.Call):
+    """The mode literal of an ``open()`` call, or None if absent/dynamic."""
+    if len(node.args) >= 2:
+        mode = node.args[1]
+    else:
+        mode = next((kw.value for kw in node.keywords if kw.arg == "mode"), None)
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return mode.value
+    return None
+
+
+def _meta_target(node: ast.AST):
+    """A checkpoint-metadata name mentioned anywhere in the path
+    expression (string constant or one of the conventional constants)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            base = sub.value.replace("\\", "/").rsplit("/", 1)[-1]
+            if base in _META_NAMES:
+                return sub.value
+        if isinstance(sub, ast.Name) and sub.id in _META_NAME_VARS:
+            return sub.id
+    return None
+
+
+@register(
+    "non-atomic-checkpoint-write",
+    Severity.B,
+    "checkpoint metadata written with bare open(..., 'w'); use resilience.atomic.atomic_write_text",
+)
+def check_atomic_write(rule, ctx):
+    if ctx.path.replace("\\", "/").endswith("resilience/atomic.py"):
+        return  # the helper's own implementation
+    for node in ast.walk(ctx.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "open"
+            and ctx.aliases.get("open", "open") == "open"
+        ):
+            continue
+        mode = _open_mode(node)
+        if mode is None or not (_WRITE_CHARS & set(mode)):
+            continue
+        if not node.args:
+            continue
+        hit = _meta_target(node.args[0])
+        if hit is not None:
+            yield make_finding(
+                rule, ctx, node,
+                f"checkpoint metadata ('{hit}') written with bare open(..., {mode!r}) — a "
+                "crash mid-write tears the file; use "
+                "deepspeed_tpu.resilience.atomic.atomic_write_text (tmp + fsync + os.replace)",
+            )

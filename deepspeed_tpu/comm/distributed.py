@@ -63,10 +63,29 @@ def init_distributed(
             logger.info("init_distributed: single-process run, skipping jax.distributed")
         return
 
-    jax.distributed.initialize(
+    # Coordinator races are the normal case at pod scale (workers come up
+    # before rank 0's server listens); bounded retry with backoff instead
+    # of dying on the first connection refusal.  DS_DIST_INIT_RETRIES
+    # tunes the attempt budget (the config object doesn't exist yet here).
+    from deepspeed_tpu.resilience.policy import RetryPolicy, retry_call
+
+    policy = RetryPolicy(
+        max_attempts=int(os.environ.get("DS_DIST_INIT_RETRIES", "3")),
+        backoff_seconds=float(os.environ.get("DS_DIST_INIT_BACKOFF", "2.0")),
+        retry_on=(OSError, RuntimeError),
+    )
+    retry_call(
+        policy,
+        jax.distributed.initialize,
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
+        # per-process jitter seed: a shared seed would re-synchronize the
+        # whole pod's retries into the very storm the jitter breaks
+        seed=int(process_id or 0),
+        on_retry=lambda attempt, e, pause: logger.warning(
+            f"init_distributed attempt {attempt} failed ({e}); retrying in {pause:.1f}s"
+        ),
     )
     _initialized = True
     if verbose:

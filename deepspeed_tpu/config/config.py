@@ -314,6 +314,175 @@ class MeshConfig:
 
 
 @dataclass
+class ResilienceCheckpointConfig:
+    """``resilience.checkpoint`` — durability of the checkpoint tree."""
+
+    atomic: bool = C.CHECKPOINT_ATOMIC_DEFAULT
+    verify_on_load: bool = C.CHECKPOINT_VERIFY_ON_LOAD_DEFAULT
+    checksum: str = C.CHECKPOINT_CHECKSUM_DEFAULT
+    keep_last_n: int = C.CHECKPOINT_KEEP_LAST_N_DEFAULT  # 0 = keep all
+    keep_every: int = C.CHECKPOINT_KEEP_EVERY_DEFAULT  # pin step multiples
+    fail_on_missing: bool = C.CHECKPOINT_FAIL_ON_MISSING_DEFAULT
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]], block: str) -> "ResilienceCheckpointConfig":
+        if d is None:
+            return cls()
+        d = dict(d)
+        out = cls(
+            atomic=bool(_pop(d, "atomic", C.CHECKPOINT_ATOMIC_DEFAULT)),
+            verify_on_load=bool(_pop(d, "verify_on_load", C.CHECKPOINT_VERIFY_ON_LOAD_DEFAULT)),
+            checksum=str(_pop(d, "checksum", C.CHECKPOINT_CHECKSUM_DEFAULT)).lower(),
+            keep_last_n=int(_pop(d, "keep_last_n", C.CHECKPOINT_KEEP_LAST_N_DEFAULT)),
+            keep_every=int(_pop(d, "keep_every", C.CHECKPOINT_KEEP_EVERY_DEFAULT)),
+            fail_on_missing=bool(_pop(d, C.CHECKPOINT_FAIL_ON_MISSING, C.CHECKPOINT_FAIL_ON_MISSING_DEFAULT)),
+        )
+        _check_empty(d, block, _known_keys(cls))
+        if out.checksum not in C.CHECKPOINT_CHECKSUM_ALGORITHMS:
+            raise DeepSpeedConfigError(
+                f"'{block}.checksum' must be one of {C.CHECKPOINT_CHECKSUM_ALGORITHMS}, got '{out.checksum}'"
+            )
+        return out
+
+
+@dataclass
+class WatchdogConfig:
+    """``resilience.watchdog`` — SIGTERM/SIGINT → emergency checkpoint at
+    the next step boundary, then exit with a scheduler-readable code."""
+
+    enabled: bool = C.WATCHDOG_ENABLED_DEFAULT
+    grace_seconds: float = C.WATCHDOG_GRACE_SECONDS_DEFAULT
+    exit_code: int = C.WATCHDOG_EXIT_CODE_DEFAULT
+    save_dir: Optional[str] = None  # default: the engine's last ckpt dir
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]], block: str) -> "WatchdogConfig":
+        if d is None:
+            return cls()
+        d = dict(d)
+        out = cls(
+            enabled=bool(_pop(d, "enabled", C.WATCHDOG_ENABLED_DEFAULT)),
+            grace_seconds=float(_pop(d, "grace_seconds", C.WATCHDOG_GRACE_SECONDS_DEFAULT)),
+            exit_code=int(_pop(d, "exit_code", C.WATCHDOG_EXIT_CODE_DEFAULT)),
+            save_dir=_pop(d, "save_dir", None),
+        )
+        _check_empty(d, block, _known_keys(cls))
+        if not (0 <= out.exit_code <= 255):
+            raise DeepSpeedConfigError(f"'{block}.exit_code' must be in [0, 255], got {out.exit_code}")
+        if out.grace_seconds < 0:
+            raise DeepSpeedConfigError(f"'{block}.grace_seconds' must be >= 0, got {out.grace_seconds}")
+        return out
+
+
+@dataclass
+class RetryConfig:
+    """``resilience.retry`` — the shared bounded-retry policy applied to
+    checkpoint I/O and distributed init."""
+
+    max_attempts: int = C.RETRY_MAX_ATTEMPTS_DEFAULT
+    backoff_seconds: float = C.RETRY_BACKOFF_SECONDS_DEFAULT
+    backoff_max_seconds: float = C.RETRY_BACKOFF_MAX_SECONDS_DEFAULT
+    jitter: float = C.RETRY_JITTER_DEFAULT
+    timeout_seconds: Optional[float] = None
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]], block: str) -> "RetryConfig":
+        if d is None:
+            return cls()
+        d = dict(d)
+        out = cls(
+            max_attempts=int(_pop(d, "max_attempts", C.RETRY_MAX_ATTEMPTS_DEFAULT)),
+            backoff_seconds=float(_pop(d, "backoff_seconds", C.RETRY_BACKOFF_SECONDS_DEFAULT)),
+            backoff_max_seconds=float(_pop(d, "backoff_max_seconds", C.RETRY_BACKOFF_MAX_SECONDS_DEFAULT)),
+            jitter=float(_pop(d, "jitter", C.RETRY_JITTER_DEFAULT)),
+            timeout_seconds=_pop(d, "timeout_seconds", None),
+        )
+        _check_empty(d, block, _known_keys(cls))
+        if out.max_attempts < 1:
+            raise DeepSpeedConfigError(f"'{block}.max_attempts' must be >= 1, got {out.max_attempts}")
+        return out
+
+    def policy(self):
+        """Materialize as a runtime RetryPolicy (lazy import keeps config
+        parsing free of the resilience package)."""
+        from deepspeed_tpu.resilience.policy import RetryPolicy
+
+        return RetryPolicy(
+            max_attempts=self.max_attempts,
+            backoff_seconds=self.backoff_seconds,
+            backoff_max_seconds=self.backoff_max_seconds,
+            jitter=self.jitter,
+            timeout_seconds=self.timeout_seconds,
+        )
+
+
+@dataclass
+class DivergenceConfig:
+    """``resilience.divergence`` — N consecutive NaN/overflow-skipped
+    steps trip a configurable action (warn / lower the loss-scale floor /
+    auto-rollback to the last verified checkpoint)."""
+
+    enabled: bool = C.DIVERGENCE_ENABLED_DEFAULT
+    threshold: int = C.DIVERGENCE_THRESHOLD_DEFAULT
+    action: str = C.DIVERGENCE_ACTION_WARN
+    # Opt-in host sync: without dynamic loss scaling (bf16 default) there
+    # is no overflow flag, so NaN detection must read the loss each step.
+    check_loss: bool = False
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]], block: str) -> "DivergenceConfig":
+        if d is None:
+            return cls()
+        d = dict(d)
+        out = cls(
+            enabled=bool(_pop(d, "enabled", C.DIVERGENCE_ENABLED_DEFAULT)),
+            threshold=int(_pop(d, "threshold", C.DIVERGENCE_THRESHOLD_DEFAULT)),
+            action=str(_pop(d, "action", C.DIVERGENCE_ACTION_WARN)).lower(),
+            check_loss=bool(_pop(d, "check_loss", False)),
+        )
+        _check_empty(d, block, _known_keys(cls))
+        if out.action not in C.DIVERGENCE_ACTIONS:
+            raise DeepSpeedConfigError(
+                f"'{block}.action' must be one of {C.DIVERGENCE_ACTIONS}, got '{out.action}'"
+            )
+        if out.threshold < 1:
+            raise DeepSpeedConfigError(f"'{block}.threshold' must be >= 1, got {out.threshold}")
+        return out
+
+
+@dataclass
+class ResilienceConfig:
+    """``resilience`` block (TPU-native extension; docs/resilience.md)."""
+
+    checkpoint: ResilienceCheckpointConfig = field(default_factory=ResilienceCheckpointConfig)
+    watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
+    retry: RetryConfig = field(default_factory=RetryConfig)
+    divergence: DivergenceConfig = field(default_factory=DivergenceConfig)
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ResilienceConfig":
+        if d is None:
+            return cls()
+        d = dict(d)
+        out = cls(
+            checkpoint=ResilienceCheckpointConfig.from_dict(
+                _pop(d, C.RESILIENCE_CHECKPOINT, None), f"{C.RESILIENCE}.{C.RESILIENCE_CHECKPOINT}"
+            ),
+            watchdog=WatchdogConfig.from_dict(
+                _pop(d, C.RESILIENCE_WATCHDOG, None), f"{C.RESILIENCE}.{C.RESILIENCE_WATCHDOG}"
+            ),
+            retry=RetryConfig.from_dict(
+                _pop(d, C.RESILIENCE_RETRY, None), f"{C.RESILIENCE}.{C.RESILIENCE_RETRY}"
+            ),
+            divergence=DivergenceConfig.from_dict(
+                _pop(d, C.RESILIENCE_DIVERGENCE, None), f"{C.RESILIENCE}.{C.RESILIENCE_DIVERGENCE}"
+            ),
+        )
+        _check_empty(d, C.RESILIENCE, _known_keys(cls))
+        return out
+
+
+@dataclass
 class ActivationCheckpointingConfig:
     """Reference ``runtime/activation_checkpointing/config.py``.  On TPU,
     ``partition_activations`` maps to sharding saved residuals over the
@@ -561,6 +730,7 @@ _KNOWN_TOP_LEVEL = {
     C.PIPELINE,
     C.CHECKPOINT_TAG_VALIDATION,
     C.MESH,
+    C.RESILIENCE,
     "activation_checkpointing",
     "flops_profiler",
     "aio",
@@ -620,6 +790,7 @@ class DeepSpeedConfig:
         self.quantize_training = QuantizeTrainingConfig.from_dict(d.get("quantize_training"))
         self.progressive_layer_drop = ProgressiveLayerDropConfig.from_dict(d.get("progressive_layer_drop"))
         self.sparse_attention = SparseAttentionConfig.from_dict(d.get("sparse_attention"))
+        self.resilience = ResilienceConfig.from_dict(d.get(C.RESILIENCE))
         self.elasticity_dict = d.get("elasticity")
 
         self.gradient_clipping = float(d.get(C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT))

@@ -154,3 +154,41 @@ CHECKPOINT_TAG_VALIDATION_MODES = ["Warn", "Ignore", "Fail"]
 # Mesh (TPU-native extension: named-axis SPMD mesh replaces process groups)
 #############################################
 MESH = "mesh"
+
+#############################################
+# Resilience (atomic checkpoints, preemption watchdog, failure policies)
+#############################################
+RESILIENCE = "resilience"
+
+RESILIENCE_CHECKPOINT = "checkpoint"
+CHECKPOINT_ATOMIC_DEFAULT = True
+CHECKPOINT_VERIFY_ON_LOAD_DEFAULT = True
+CHECKPOINT_CHECKSUM_DEFAULT = "sha256"
+CHECKPOINT_CHECKSUM_ALGORITHMS = ["sha256", "crc32", "none"]
+CHECKPOINT_KEEP_LAST_N_DEFAULT = 0  # 0 = keep everything
+CHECKPOINT_KEEP_EVERY_DEFAULT = 0  # 0 = no step-multiple pinning
+CHECKPOINT_FAIL_ON_MISSING = "fail_on_missing"
+CHECKPOINT_FAIL_ON_MISSING_DEFAULT = False
+
+RESILIENCE_WATCHDOG = "watchdog"
+WATCHDOG_ENABLED_DEFAULT = False
+WATCHDOG_GRACE_SECONDS_DEFAULT = 60.0
+WATCHDOG_EXIT_CODE_DEFAULT = 43  # "preempted and saved" (docs/resilience.md)
+
+RESILIENCE_RETRY = "retry"
+RETRY_MAX_ATTEMPTS_DEFAULT = 3
+RETRY_BACKOFF_SECONDS_DEFAULT = 0.5
+RETRY_BACKOFF_MAX_SECONDS_DEFAULT = 30.0
+RETRY_JITTER_DEFAULT = 0.25
+
+RESILIENCE_DIVERGENCE = "divergence"
+DIVERGENCE_ENABLED_DEFAULT = True
+DIVERGENCE_THRESHOLD_DEFAULT = 20
+DIVERGENCE_ACTION_WARN = "warn"
+DIVERGENCE_ACTION_FLOOR = "floor_loss_scale"
+DIVERGENCE_ACTION_ROLLBACK = "rollback"
+DIVERGENCE_ACTIONS = [
+    DIVERGENCE_ACTION_WARN,
+    DIVERGENCE_ACTION_FLOOR,
+    DIVERGENCE_ACTION_ROLLBACK,
+]

@@ -94,9 +94,57 @@ def debug_report() -> None:
         print(f"{name} " + "." * (30 - len(name)) + f" {value}")
 
 
+def resilience_report(config=None) -> None:
+    """Resilience configuration summary rows (docs/resilience.md).
+    ``config`` may be a DeepSpeedConfig, a ResilienceConfig, or None
+    (prints the defaults a config-less run gets)."""
+    from deepspeed_tpu.config.config import ResilienceConfig
+
+    r = getattr(config, "resilience", config)
+    if r is None:
+        r = ResilienceConfig()
+    ck, wd, rt, dv = r.checkpoint, r.watchdog, r.retry, r.divergence
+    print()
+    print("resilience configuration:")
+    rows = [
+        (
+            "atomic checkpoints",
+            f"enabled (verify_on_load={'on' if ck.verify_on_load else 'off'}, checksum={ck.checksum})"
+            if ck.atomic
+            else f"{YELLOW}DISABLED{END} (non-atomic legacy writes)",
+        ),
+        (
+            "retention policy",
+            "keep all tags"
+            if ck.keep_last_n <= 0
+            else f"keep_last_n={ck.keep_last_n}"
+            + (f", keep_every={ck.keep_every} steps" if ck.keep_every > 0 else ""),
+        ),
+        (
+            "preemption watchdog",
+            f"enabled (grace {wd.grace_seconds:g}s, exit code {wd.exit_code})"
+            if wd.enabled
+            else "disabled",
+        ),
+        (
+            "retry policy",
+            f"{rt.max_attempts} attempt(s), backoff {rt.backoff_seconds:g}s "
+            f"(cap {rt.backoff_max_seconds:g}s"
+            + (f", deadline {rt.timeout_seconds:g}s)" if rt.timeout_seconds else ")"),
+        ),
+        (
+            "divergence guard",
+            f"{dv.action} after {dv.threshold} skipped steps" if dv.enabled else "disabled",
+        ),
+    ]
+    for name, value in rows:
+        print(f"{name} " + "." * (30 - len(name)) + f" {value}")
+
+
 def cli_main() -> int:
     ok = op_report()
     debug_report()
+    resilience_report()
     return 0 if ok else 1
 
 

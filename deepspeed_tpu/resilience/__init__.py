@@ -1,0 +1,48 @@
+"""Resilience subsystem: the machinery that lets a multi-day run survive
+preemption, bad disks and divergence (reference DeepSpeed earns this
+with battle-hardened checkpoint/restore paths; here it is an explicit
+subsystem with a fault-injection harness proving each recovery path —
+``tests/test_resilience.py``).
+
+Pillars:
+
+* :mod:`~deepspeed_tpu.resilience.atomic` — atomic metadata writes and
+  per-tag size+checksum manifests (a tag exists fully or not at all);
+* :mod:`~deepspeed_tpu.resilience.manager` — stage/commit/quarantine/
+  retention over a checkpoint tree;
+* :mod:`~deepspeed_tpu.resilience.policy` — the shared retry policy
+  (checkpoint I/O, distributed init) and the divergence guard;
+* :mod:`~deepspeed_tpu.resilience.watchdog` — SIGTERM → emergency
+  checkpoint at the next step boundary → distinctive exit code;
+* :mod:`~deepspeed_tpu.resilience.faults` — the deterministic fault
+  injector the tests drive everything with.
+"""
+from deepspeed_tpu.resilience.atomic import (  # noqa: F401
+    MANIFEST_FILE,
+    atomic_write_text,
+    file_digest,
+    fsync_dir,
+    verify_manifest,
+    write_manifest,
+)
+from deepspeed_tpu.resilience.faults import (  # noqa: F401
+    FaultInjector,
+    InjectedFault,
+    InjectedKill,
+)
+from deepspeed_tpu.resilience.policy import (  # noqa: F401
+    DivergenceGuard,
+    RetryError,
+    RetryPolicy,
+    retry,
+    retry_call,
+)
+from deepspeed_tpu.resilience.watchdog import (  # noqa: F401
+    EXIT_PREEMPTED_SAVED,
+    PreemptionWatchdog,
+)
+from deepspeed_tpu.resilience import manager  # noqa: F401
+
+
+class CheckpointNotFoundError(RuntimeError):
+    """Strict-mode load found no loadable (verified) checkpoint."""

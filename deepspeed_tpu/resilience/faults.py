@@ -1,0 +1,150 @@
+"""Deterministic fault injection for the resilience test harness.
+
+The resilience code calls :func:`check`/:func:`check_flag` at named
+sites (``"ckpt.commit"``, ``"ckpt.latest"``, ``"engine.force_overflow"``,
+...).  In production no injector is installed and both are near-free
+attribute checks.  Under test, a seeded :class:`FaultInjector` is
+installed as a context manager and fires exactly the failures its plan
+describes — I/O errors, kill-mid-save, forced overflow steps — so every
+recovery path is provable, repeatably.
+
+Two failure shapes:
+
+* :class:`InjectedFault` (an ``OSError``) — a transient I/O error; the
+  retry policy is expected to absorb it.
+* :class:`InjectedKill` (a ``BaseException``) — models the process dying
+  at that instruction.  Deliberately NOT an ``Exception`` so no
+  ``except Exception`` cleanup handler in the code under test can "survive"
+  a death the real process would not.
+"""
+from __future__ import annotations
+
+import os
+import random
+from typing import Dict, List, Optional, Tuple
+
+
+class InjectedFault(OSError):
+    """A planned transient I/O failure."""
+
+
+class InjectedKill(BaseException):
+    """A planned process death (uncatchable by ``except Exception``)."""
+
+
+_ACTIVE: Optional["FaultInjector"] = None
+
+
+def check(site: str, path: Optional[str] = None) -> None:
+    """Raise if the active injector has a raising plan armed for ``site``."""
+    if _ACTIVE is not None:
+        _ACTIVE.fire(site, path)
+
+
+def check_flag(site: str) -> bool:
+    """True if the active injector has a non-raising flag armed for
+    ``site`` (e.g. "pretend this step overflowed")."""
+    if _ACTIVE is None:
+        return False
+    return _ACTIVE.fire_flag(site)
+
+
+class FaultInjector:
+    """Seeded, per-site fault plans.  Use as a context manager::
+
+        inj = FaultInjector(seed=0)
+        inj.fail("ckpt.save.state", times=2)      # first two calls raise
+        inj.kill("ckpt.commit")                   # then die at commit
+        with inj:
+            engine.save_checkpoint(d)
+    """
+
+    def __init__(self, seed: int = 0):
+        self.rng = random.Random(seed)
+        self._plans: Dict[str, dict] = {}
+        self.log: List[Tuple[str, str]] = []  # (site, event)
+
+    # -- plan registration ------------------------------------------------
+    def _plan(self, site: str, exc, times: int, after: int, probability: Optional[float]) -> None:
+        self._plans[site] = {
+            "exc": exc, "times": times, "after": after,
+            "probability": probability, "calls": 0, "fired": 0,
+        }
+
+    def fail(self, site: str, times: int = 1, after: int = 0, exc=InjectedFault,
+             probability: Optional[float] = None) -> "FaultInjector":
+        """Arm ``site`` to raise ``exc`` for its next ``times`` triggers
+        (skipping the first ``after`` calls)."""
+        self._plan(site, exc, times, after, probability)
+        return self
+
+    def kill(self, site: str, after: int = 0) -> "FaultInjector":
+        """Arm ``site`` to simulate process death (InjectedKill)."""
+        self._plan(site, InjectedKill, 1, after, None)
+        return self
+
+    def flag(self, site: str, times: int = 1, after: int = 0) -> "FaultInjector":
+        """Arm a non-raising flag at ``site`` (check_flag returns True)."""
+        self._plan(site, None, times, after, None)
+        return self
+
+    # -- firing -----------------------------------------------------------
+    def _triggers(self, plan: dict) -> bool:
+        plan["calls"] += 1
+        if plan["fired"] >= plan["times"] or plan["calls"] <= plan["after"]:
+            return False
+        if plan["probability"] is not None and self.rng.random() >= plan["probability"]:
+            return False
+        plan["fired"] += 1
+        return True
+
+    def fire(self, site: str, path: Optional[str] = None) -> None:
+        plan = self._plans.get(site)
+        if plan is None or plan["exc"] is None:
+            return
+        if self._triggers(plan):
+            self.log.append((site, plan["exc"].__name__))
+            raise plan["exc"](f"injected fault at site '{site}'" + (f" ({path})" if path else ""))
+
+    def fire_flag(self, site: str) -> bool:
+        plan = self._plans.get(site)
+        if plan is None or plan["exc"] is not None:
+            return False
+        if self._triggers(plan):
+            self.log.append((site, "flag"))
+            return True
+        return False
+
+    def calls(self, site: str) -> int:
+        plan = self._plans.get(site)
+        return plan["calls"] if plan else 0
+
+    # -- direct corruption helpers (for committed tags) -------------------
+    @staticmethod
+    def truncate_file(path: str, keep_bytes: int = 0) -> None:
+        with open(path, "r+b") as f:
+            f.truncate(keep_bytes)
+
+    def corrupt_file(self, path: str) -> None:
+        """Flip one byte in the middle of the file (seeded position)."""
+        size = os.path.getsize(path)
+        if size == 0:
+            return
+        pos = self.rng.randrange(size)
+        with open(path, "r+b") as f:
+            f.seek(pos)
+            b = f.read(1)
+            f.seek(pos)
+            f.write(bytes([b[0] ^ 0xFF]))
+
+    # -- installation -----------------------------------------------------
+    def __enter__(self) -> "FaultInjector":
+        global _ACTIVE
+        if _ACTIVE is not None:
+            raise RuntimeError("a FaultInjector is already active")
+        _ACTIVE = self
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        global _ACTIVE
+        _ACTIVE = None

@@ -9,23 +9,35 @@ restoring job uses — which subsumes the reference's elastic-DP checkpoint
 machinery (stage2.py:1828-2004) and ``MegatronSDLoader`` MP resize
 (state_dict_factory.py:199) in one mechanism.
 
-Kept semantics: ``latest`` tag file, client_state round-trip, tag
-validation mode.  The ``zero_to_fp32`` analog (full fp32 state_dict from a
-sharded checkpoint) is ``consolidate_fp32_state_dict`` below.
+Durability (deepspeed_tpu.resilience, docs/resilience.md): a tag is
+written into ``<tag>.tmp``, a size+checksum ``manifest.json`` goes in
+last, and a single rename publishes it — a kill at any point leaves the
+previous tree intact.  On load the manifest is re-verified; a corrupt
+tag is quarantined (``<tag>.corrupt``) and the load falls back to the
+newest verified tag.  Checkpoint I/O runs under the configured retry
+policy, and retention GC (``keep_last_n``/``keep_every``) runs after
+each successful save.
+
+Kept semantics: ``latest`` tag file (written atomically), client_state
+round-trip, tag validation mode.  The ``zero_to_fp32`` analog (full fp32
+state_dict from a sharded checkpoint) is ``consolidate_fp32_state_dict``
+below.
 """
 from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepspeed_tpu.resilience import CheckpointNotFoundError, atomic, faults, manager
+from deepspeed_tpu.resilience.policy import retry_call
 from deepspeed_tpu.utils.logging import log_dist, logger
 
-LATEST_FILE = "latest"
+LATEST_FILE = manager.LATEST_FILE
 
 
 def _ckpt_path(save_dir: str, tag: str) -> str:
@@ -38,6 +50,21 @@ def _checkpointer():
     return ocp.StandardCheckpointer()
 
 
+def _resilience_cfg(engine):
+    cfg = getattr(getattr(engine, "config", None), "resilience", None)
+    if cfg is None:
+        from deepspeed_tpu.config.config import ResilienceConfig
+
+        cfg = ResilienceConfig()
+    return cfg
+
+
+def _note_ckpt_dir(engine, directory: str) -> None:
+    note = getattr(engine, "_note_checkpoint_dir", None)
+    if note is not None:
+        note(directory)
+
+
 def save_checkpoint(
     engine,
     save_dir: str,
@@ -45,26 +72,17 @@ def save_checkpoint(
     client_state: Optional[dict] = None,
     save_latest: bool = True,
 ) -> str:
+    rcfg = _resilience_cfg(engine)
+    ck = rcfg.checkpoint
     if tag is None:
         tag = f"global_step{int(engine.state['global_step'])}"
-    path = _ckpt_path(save_dir, tag)
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-
-    ckptr = _checkpointer()
-    # flat-padded ZeRO leaves are stored in their natural shapes so the
-    # checkpoint is independent of this job's fsdp degree
-    ckptr.save(os.path.join(path, "state"), engine._to_portable_state(engine.state), force=True)
-    ckptr.wait_until_finished()
-
-    # ZeRO-Offload/Infinity: fp32 masters + moments live on host, outside
-    # engine.state — persist them beside the sharded state (reference
-    # writes *_optim_states.pt per rank; host state is process-local here)
-    save_host = getattr(engine, "_save_host_optimizer", None)
-    if save_host is not None:
-        save_host(path)
+    tag = str(tag)
+    save_dir = os.path.abspath(save_dir)
+    final_path = _ckpt_path(save_dir, tag)
+    os.makedirs(save_dir, exist_ok=True)
 
     meta = {
-        "tag": str(tag),
+        "tag": tag,
         "global_step": int(engine.state["global_step"]),
         "micro_step": int(engine.state["micro_step"]),
         "global_samples": int(engine.state["global_samples"]),
@@ -80,14 +98,119 @@ def save_checkpoint(
         "client_state": client_state or {},
         "ds_tpu_version": _version(),
     }
+
+    def _barrier(name: str) -> None:
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(f"ckpt_{name}_{tag}")
+
+    def _write_tag() -> None:
+        faults.check("ckpt.save.state", path=final_path)
+        if ck.atomic:
+            # rank 0 owns the staging-dir lifecycle (clearing a leftover
+            # from a crashed save must not race other ranks' writes);
+            # everyone else waits, then writes into it
+            if jax.process_index() == 0:
+                target = manager.begin_stage(save_dir, tag)
+            else:
+                target = manager.stage_path(save_dir, tag)
+            _barrier("stage")
+        else:
+            target = final_path
+        os.makedirs(target, exist_ok=True)
+        try:
+            ckptr = _checkpointer()
+            # flat-padded ZeRO leaves are stored in their natural shapes so
+            # the checkpoint is independent of this job's fsdp degree
+            ckptr.save(
+                os.path.join(target, "state"), engine._to_portable_state(engine.state), force=True
+            )
+            ckptr.wait_until_finished()
+
+            # ZeRO-Offload/Infinity: fp32 masters + moments live on host,
+            # outside engine.state — persist them beside the sharded state
+            # (reference writes *_optim_states.pt per rank; host state is
+            # process-local here)
+            save_host = getattr(engine, "_save_host_optimizer", None)
+            if save_host is not None:
+                save_host(target)
+            # every rank's plain-file writes (host optimizer npz) must be
+            # complete before rank 0 hashes the tree into the manifest
+            _barrier("host_state")
+
+            if jax.process_index() == 0:
+                faults.check("ckpt.save.meta", path=target)
+                atomic.atomic_write_text(
+                    os.path.join(target, "meta.json"), json.dumps(meta, indent=2)
+                )
+                if ck.atomic:
+                    # manifest last: its presence certifies completeness
+                    atomic.write_manifest(target, algorithm=ck.checksum)
+                    manager.commit_tag(save_dir, tag)
+            # no rank reads `latest` / proceeds past the save until the
+            # tag is committed everywhere
+            _barrier("commit")
+        except OSError:
+            if ck.atomic and jax.process_index() == 0:
+                manager.abort_stage(save_dir, tag)
+            raise
+
+    policy = rcfg.retry.policy()
+    if jax.process_count() > 1:
+        # _write_tag is a collective (staging/commit barriers): retrying
+        # it on ONE rank would desync the barrier sequence and hang the
+        # job — without cross-rank retry agreement, fail fast instead
+        import dataclasses as _dc
+
+        policy = _dc.replace(policy, max_attempts=1)
+    retry_call(
+        policy,
+        _write_tag,
+        on_retry=lambda attempt, e, pause: logger.warning(
+            f"checkpoint save of '{tag}' failed (attempt {attempt}: {e}); retrying in {pause:.1f}s"
+        ),
+    )
+
     if jax.process_index() == 0:
-        with open(os.path.join(path, "meta.json"), "w") as f:
-            json.dump(meta, f, indent=2)
         if save_latest:
-            with open(os.path.join(os.path.abspath(save_dir), LATEST_FILE), "w") as f:
-                f.write(str(tag))
-    log_dist(f"saved checkpoint {path}")
-    return path
+            retry_call(rcfg.retry.policy(), manager.write_latest, save_dir, tag)
+        deleted = manager.retention_gc(
+            save_dir, keep_last_n=ck.keep_last_n, keep_every=ck.keep_every, protect=(tag,)
+        )
+        if deleted:
+            log_dist(f"retention gc: deleted old tag(s) {deleted} (keep_last_n={ck.keep_last_n})")
+    _note_ckpt_dir(engine, save_dir)
+    log_dist(f"saved checkpoint {final_path}")
+    return final_path
+
+
+def _broadcast_tag(tag: Optional[str]) -> Optional[str]:
+    """Share rank 0's resolved tag with every process (no-op
+    single-process).  Fixed-width uint8 buffer; empty means None."""
+    if jax.process_count() <= 1:
+        return tag
+    from jax.experimental import multihost_utils
+
+    buf = np.zeros(256, np.uint8)
+    if tag:
+        raw = str(tag).encode()[:256]
+        buf[: len(raw)] = np.frombuffer(raw, np.uint8)
+    out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+    decoded = bytes(out[: int(np.max(np.nonzero(out)[0], initial=-1)) + 1]).decode(errors="ignore")
+    return decoded or None
+
+
+def _load_candidates(load_dir: str, requested: Optional[str], explicit: bool) -> List[str]:
+    """Tags to try, in order: the requested one first, then (unless the
+    tag was named explicitly by the caller) every other committed tag
+    newest-first — the fallback scan for a stale/corrupt ``latest``."""
+    candidates: List[str] = [requested] if requested else []
+    if not explicit:
+        for t in manager.newest_first(load_dir):
+            if t not in candidates:
+                candidates.append(t)
+    return candidates
 
 
 def load_checkpoint(
@@ -97,22 +220,86 @@ def load_checkpoint(
     load_optimizer_states: bool = True,
     load_lr_scheduler_states: bool = True,
     load_module_only: bool = False,
+    strict: Optional[bool] = None,
 ):
     """Returns (path, client_state) like the reference (engine.py:1654),
-    or (None, {}) if nothing to load."""
-    load_dir = os.path.abspath(load_dir)
-    if tag is None:
-        latest = os.path.join(load_dir, LATEST_FILE)
-        if not os.path.exists(latest):
-            logger.warning(f"no '{LATEST_FILE}' file at {load_dir}; nothing loaded")
-            return None, {}
-        with open(latest) as f:
-            tag = f.read().strip()
-    path = _ckpt_path(load_dir, tag)
-    if not os.path.isdir(path):
-        logger.warning(f"checkpoint {path} not found")
-        return None, {}
+    or (None, {}) if nothing loadable was found.
 
+    ``strict=True`` (or config ``resilience.checkpoint.fail_on_missing``)
+    raises :class:`CheckpointNotFoundError` instead of the silent
+    ``(None, {})``.  With ``verify_on_load`` (default), every candidate
+    tag's manifest is re-checked first; corrupt tags are quarantined to
+    ``<tag>.corrupt`` and the newest verified tag wins.
+    """
+    rcfg = _resilience_cfg(engine)
+    ck = rcfg.checkpoint
+    if strict is None:
+        strict = ck.fail_on_missing
+    load_dir = os.path.abspath(load_dir)
+    explicit = tag is not None
+    requested = str(tag) if explicit else manager.read_latest(load_dir)
+    if requested is None and not explicit:
+        logger.warning(f"no '{LATEST_FILE}' file at {load_dir}; scanning for committed tags")
+
+    tried: List[str] = []
+    chosen: Optional[str] = None
+    if jax.process_index() == 0:
+        # rank 0 alone resolves the candidate (verify + quarantine): a
+        # per-rank decision could quarantine/restore DIFFERENT tags and
+        # silently resume ranks at different steps
+        for cand in _load_candidates(load_dir, requested, explicit):
+            path = _ckpt_path(load_dir, cand)
+            if not os.path.isdir(path):
+                tried.append(f"'{cand}': missing")
+                continue
+            if ck.verify_on_load:
+                ok, notes = manager.verify_tag(load_dir, cand)
+                if not ok:
+                    dest = manager.quarantine_tag(load_dir, cand)
+                    logger.warning(
+                        f"checkpoint tag '{cand}' failed verification ({'; '.join(notes)}); "
+                        f"quarantined to {os.path.basename(dest)}"
+                    )
+                    tried.append(f"'{cand}': corrupt ({notes[0]})")
+                    continue
+                if notes:
+                    logger.warning(f"checkpoint tag '{cand}': {'; '.join(notes)}")
+            if cand != requested:
+                logger.warning(
+                    f"falling back to verified tag '{cand}' (requested "
+                    f"{'nothing' if requested is None else repr(requested)})"
+                )
+            chosen = cand
+            break
+    chosen = _broadcast_tag(chosen)
+    if chosen is not None:
+        return _restore_tag(
+            engine,
+            _ckpt_path(load_dir, chosen),
+            load_optimizer_states=load_optimizer_states,
+            load_lr_scheduler_states=load_lr_scheduler_states,
+            load_module_only=load_module_only,
+        )
+
+    detail = f" (requested tag '{requested}')" if requested else ""
+    attempts = f"; tried: {', '.join(tried)}" if tried else ""
+    msg = f"no loadable checkpoint under {load_dir}{detail}{attempts}"
+    if strict:
+        raise CheckpointNotFoundError(
+            msg + "; pass strict=False or set 'resilience.checkpoint.fail_on_missing' = false "
+            "for the legacy (None, {}) return"
+        )
+    logger.warning(msg + "; nothing loaded")
+    return None, {}
+
+
+def _restore_tag(
+    engine,
+    path: str,
+    load_optimizer_states: bool = True,
+    load_lr_scheduler_states: bool = True,
+    load_module_only: bool = False,
+) -> Tuple[str, Dict[str, Any]]:
     # phase-dependent state layouts (1-bit Adam's compressed phase) must
     # be aligned with the tag's step count BEFORE the restore target is
     # built, or the on-disk tree won't match
@@ -222,6 +409,7 @@ def load_checkpoint(
     # reconcile the engine's host-side step mirrors with the restored state
     engine._host_global_step = int(engine.state["global_step"])
     engine._host_micro_step = int(engine.state["micro_step"])
+    _note_ckpt_dir(engine, os.path.dirname(path))
     log_dist(f"loaded checkpoint {path} (global_step={engine._host_global_step})")
     return path, client_state
 

@@ -330,6 +330,31 @@ class DeepSpeedEngine:
                 f"with full-precision allreduce ({'; '.join(failed)})"
             )
 
+        # -- resilience (watchdog / divergence guard / checkpoint dirs) ----
+        # (docs/resilience.md; engines built without a DeepSpeedConfig
+        # resilience block fall back to the defaults)
+        from deepspeed_tpu.config.config import ResilienceConfig
+        from deepspeed_tpu.resilience import DivergenceGuard, PreemptionWatchdog
+
+        self.resilience = getattr(config, "resilience", None) or ResilienceConfig()
+        self._divergence_guard = (
+            DivergenceGuard(
+                threshold=self.resilience.divergence.threshold,
+                action=self.resilience.divergence.action,
+            )
+            if self.resilience.divergence.enabled
+            else None
+        )
+        # the directory emergency saves / auto-rollback target: explicit
+        # watchdog.save_dir, else wherever the run last saved/loaded
+        self._resilience_ckpt_dir: Optional[str] = self.resilience.watchdog.save_dir
+        self._watchdog = None
+        if self.resilience.watchdog.enabled:
+            self._watchdog = PreemptionWatchdog(
+                grace_seconds=self.resilience.watchdog.grace_seconds,
+                exit_code=self.resilience.watchdog.exit_code,
+            ).install()
+
         # -- host-side bookkeeping ----------------------------------------
         from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
         from deepspeed_tpu.utils.monitor import TensorBoardMonitor
@@ -900,12 +925,13 @@ class DeepSpeedEngine:
         log_dist("1-bit Adam: rolled back to warmup (pre-freeze) state layout")
 
     def _purge_train_executables(self) -> None:
-        """Drop compiled steps that close over the opt-state layout
-        (called at every 1-bit phase transition)."""
+        """Drop compiled steps that close over opt-state layout or
+        loss-scaler constants (1-bit phase transitions, divergence-guard
+        loss-scale-floor changes)."""
         self._compiled = {
             k: v
             for k, v in self._compiled.items()
-            if not (isinstance(k, tuple) and k[0] == "train_batch")
+            if not (isinstance(k, tuple) and k[0] in ("train_batch", "train_batches"))
             and k not in ("micro_step", "apply_step")
         }
 
@@ -1134,6 +1160,7 @@ class DeepSpeedEngine:
         self.state, loss = fn(self.state, batch)
         self._host_micro_step += 1
         self._cached_loss = loss
+        self._last_loss = loss  # step()'s divergence check_loss reads this
         if self.wall_clock_breakdown:
             self.timers(FORWARD_TIMER).stop(sync_token=loss)
         return loss
@@ -1171,8 +1198,10 @@ class DeepSpeedEngine:
             else:
                 fn = self._get_compiled("apply_step", self._apply_step_impl)
                 self.state, info = fn(self.state)
+            overflowed = False
             if self.loss_scaler.dynamic:
-                if bool(info["overflow"]):
+                overflowed = bool(info["overflow"])
+                if overflowed:
                     self.skipped_steps += 1
                     log_dist(f"step skipped on overflow; loss scale -> {self.loss_scale}")
                 elif not self._offload:
@@ -1180,6 +1209,7 @@ class DeepSpeedEngine:
             elif not self._offload:
                 self._host_global_step += 1
             self._maybe_report_progress()
+            self._on_step_boundary(overflowed, loss=self._last_loss)
         if self.wall_clock_breakdown:
             self.timers(STEP_TIMER).stop(sync_token=self.state["global_step"])
             self.timers.log([FORWARD_TIMER, BACKWARD_TIMER, STEP_TIMER])
@@ -1250,8 +1280,10 @@ class DeepSpeedEngine:
         self._last_loss = loss
         self._last_info = info  # lr / grad_norm / overflow of this step
         # host sync on the overflow flag only when dynamic scaling is live
+        overflowed = False
         if self.loss_scaler.dynamic:
-            if bool(info["overflow"]):
+            overflowed = bool(info["overflow"])
+            if overflowed:
                 self.skipped_steps += 1
                 log_dist(f"step skipped on overflow; loss scale -> {self.loss_scale}")
             elif not self._offload:
@@ -1261,6 +1293,7 @@ class DeepSpeedEngine:
         self._host_micro_step += self.gradient_accumulation_steps
         self.tput_timer.stop(sync_token=loss)
         self._maybe_report_progress()
+        self._on_step_boundary(overflowed, loss=loss)
         return loss
 
     def _full_step_fn(self) -> Callable:
@@ -1375,6 +1408,19 @@ class DeepSpeedEngine:
         self._last_info = {"lr": last_lr, "grad_norm": last_gn, "overflow": skipped > 0}
         self.tput_timer.stop(sync_token=losses[-1] if len(losses) else None)
         self._maybe_report_progress()
+        # the compiled run only exposes the skip COUNT, not per-step order:
+        # a fully-skipped run provably contains n consecutive skips (feed
+        # the guard one record per step so n >= threshold trips it within
+        # the run); partially-skipped runs reset the streak
+        records = n if skipped == n else 1
+        guard = getattr(self, "_divergence_guard", None)
+        trips_before = guard.trips if guard is not None else 0
+        for i in range(records):
+            self._on_step_boundary(
+                skipped == n, loss=self._last_loss if i == records - 1 else None
+            )
+            if guard is not None and guard.trips > trips_before:
+                break  # one action per detection, not one per threshold-multiple
         return losses
 
     def eval_batch(self, batch: Any) -> Any:
@@ -1420,6 +1466,98 @@ class DeepSpeedEngine:
                     events.append((f"Train/Samples/train_loss", float(self._last_loss)))
                 self.monitor.write_events(events, samples)
                 self.monitor.flush()
+
+    # ------------------------------------------------------------------
+    # resilience: preemption + divergence handling (docs/resilience.md)
+    # ------------------------------------------------------------------
+    def _note_checkpoint_dir(self, directory: str) -> None:
+        """Remember where this run checkpoints (emergency saves and
+        divergence rollback target it)."""
+        self._resilience_ckpt_dir = os.path.abspath(directory)
+
+    def _on_step_boundary(self, overflowed: bool, loss=None) -> None:
+        """Host-side hook after every optimizer-step boundary: first honor
+        a pending preemption request, then feed the divergence guard."""
+        wd = getattr(self, "_watchdog", None)
+        if wd is not None and wd.preemption_requested:
+            self._handle_preemption()
+        guard = getattr(self, "_divergence_guard", None)
+        if guard is None:
+            return
+        from deepspeed_tpu.resilience import faults
+
+        diverged = bool(overflowed) or faults.check_flag("engine.force_overflow")
+        if not diverged and self.resilience.divergence.check_loss and loss is not None:
+            # opt-in host sync: the only NaN signal without dynamic loss
+            # scaling (bf16 default has no overflow flag)
+            diverged = not bool(np.isfinite(np.asarray(jax.device_get(loss))))
+        action = guard.record(diverged)
+        if action is not None:
+            self._apply_divergence_action(action)
+
+    def _handle_preemption(self) -> None:
+        """Emergency checkpoint + exit.  Exit-code contract: the
+        configured code (default 43) means "preempted AND saved" — a
+        scheduler can requeue and resume blindly; exit 1 means the save
+        did not happen (deadline passed or save failed) — treat as a
+        crash and resume from the previous tag."""
+        wd = self._watchdog
+        log_dist(
+            f"preemption signal ({wd.signal_name}) received; attempting emergency "
+            f"checkpoint ({wd.remaining():.0f}s of grace left)"
+        )
+        if self._resilience_ckpt_dir is None:
+            logger.error(
+                "preempted but no checkpoint dir is known (no prior save/load and no "
+                "'resilience.watchdog.save_dir'); exiting WITHOUT saving"
+            )
+            raise SystemExit(1)
+        if wd.remaining() <= 0:
+            logger.error(
+                f"preemption grace deadline ({wd.grace_seconds}s) already passed; "
+                "exiting WITHOUT saving"
+            )
+            raise SystemExit(1)
+        try:
+            path = self.save_checkpoint(self._resilience_ckpt_dir)
+        except BaseException as e:  # a failed save must NOT exit as "saved"
+            logger.error(f"emergency checkpoint failed: {e!r}")
+            raise SystemExit(1) from e
+        log_dist(f"emergency checkpoint saved to {path}; exiting with code {wd.exit_code}")
+        raise SystemExit(wd.exit_code)
+
+    def _apply_divergence_action(self, action: str) -> None:
+        n = self.resilience.divergence.threshold
+        if action == C.DIVERGENCE_ACTION_FLOOR:
+            old = self.loss_scaler.min_scale
+            self.loss_scaler.min_scale = max(old / 2.0, 2.0**-24)
+            # the floor is baked into compiled steps as a constant
+            self._purge_train_executables()
+            logger.warning(
+                f"divergence guard: {n} consecutive skipped steps — lowering loss-scale "
+                f"floor {old} -> {self.loss_scaler.min_scale} (recompiling train step)"
+            )
+        elif action == C.DIVERGENCE_ACTION_ROLLBACK:
+            if self._resilience_ckpt_dir is None:
+                logger.error(
+                    f"divergence guard: {n} consecutive skipped steps and action=rollback, "
+                    "but no checkpoint dir is known (no prior save/load); cannot roll back"
+                )
+                return
+            logger.warning(
+                f"divergence guard: {n} consecutive skipped steps — rolling back to the "
+                f"last verified checkpoint under {self._resilience_ckpt_dir}"
+            )
+            # strict=False even under fail_on_missing: a failed rollback
+            # must degrade to the error log below, not crash the step
+            path, _ = self.load_checkpoint(self._resilience_ckpt_dir, strict=False)
+            if path is None:
+                logger.error("divergence rollback found no loadable checkpoint")
+        else:
+            logger.warning(
+                f"divergence guard: {n} consecutive NaN/overflow-skipped steps "
+                f"(loss scale {self.loss_scale}) — the run is likely diverging"
+            )
 
     # ------------------------------------------------------------------
     # checkpointing (engine.save_checkpoint, reference :1854)

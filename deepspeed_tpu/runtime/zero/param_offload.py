@@ -833,11 +833,11 @@ class ZeroInfinityEngine:
                     "process_count": jax.process_count(),
                     "masters_sharded": self._masters_sharded,
                 }
-                with open(os.path.join(path, "meta.json"), "w") as f:
-                    json.dump(meta, f, indent=2)
+                from deepspeed_tpu.resilience.atomic import atomic_write_text
+
+                atomic_write_text(os.path.join(path, "meta.json"), json.dumps(meta, indent=2))
                 if save_latest:
-                    with open(os.path.join(os.path.abspath(save_dir), "latest"), "w") as f:
-                        f.write(str(tag))
+                    atomic_write_text(os.path.join(os.path.abspath(save_dir), "latest"), str(tag))
             except Exception as e:  # noqa: BLE001
                 meta_err = e
         _sync_ok(meta_err is None, "meta/latest", meta_err)

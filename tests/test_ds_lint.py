@@ -47,6 +47,7 @@ def test_rule_catalog_shape():
         "global-mutation-under-trace", "unhashable-static-arg",
         "donated-buffer-reuse", "float64-promotion", "config-key-drift",
         "bare-jit", "missing-sharding-constraint",
+        "non-atomic-checkpoint-write",  # PR 2 resilience tier-B rule
     ):
         assert rid in rules, rid
 
@@ -668,6 +669,69 @@ class TestPrngReuse:
                 return w, b
             """,
             "prng-key-reuse",
+        )
+        assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# non-atomic-checkpoint-write (tier B, PR 2 resilience subsystem)
+# ---------------------------------------------------------------------------
+
+
+class TestAtomicCheckpointWrite:
+    def test_flags_bare_meta_and_latest_writes(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import json
+            import os
+
+            LATEST_FILE = "latest"
+
+            def save(path, save_dir, meta, tag):
+                with open(os.path.join(path, "meta.json"), "w") as f:
+                    json.dump(meta, f)
+                with open(os.path.join(save_dir, LATEST_FILE), mode="w") as f:
+                    f.write(tag)
+            """,
+            "non-atomic-checkpoint-write",
+        )
+        assert rule_ids(res) == ["non-atomic-checkpoint-write"] * 2
+        assert all(f.severity == Severity.B for f in res.findings)
+        assert "atomic_write_text" in res.findings[0].message
+
+    def test_clean_reads_other_files_and_helper(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import os
+
+            from deepspeed_tpu.resilience.atomic import atomic_write_text
+
+            def save(path, save_dir, tag, log_lines):
+                # read mode is fine
+                with open(os.path.join(path, "meta.json")) as f:
+                    meta = f.read()
+                # non-metadata writes are fine
+                with open(os.path.join(path, "train.log"), "w") as f:
+                    f.writelines(log_lines)
+                # the sanctioned path
+                atomic_write_text(os.path.join(save_dir, "latest"), tag)
+                return meta
+            """,
+            "non-atomic-checkpoint-write",
+        )
+        assert res.findings == []
+
+    def test_dynamic_mode_not_flagged(self, tmp_path):
+        # a non-literal mode can't be proven to write; stay quiet
+        res = lint_src(
+            tmp_path,
+            """
+            def touch(path, mode):
+                return open(path + "/meta.json", mode)
+            """,
+            "non-atomic-checkpoint-write",
         )
         assert res.findings == []
 
