@@ -100,7 +100,10 @@ def _setup_jax_cache():
 
 def _timed_steps(engine, batches, steps, label):
     """Compile+warm, then best-of-2 timing windows with a true host sync
-    (one bad window must not poison the record).
+    (one bad window must not poison the record).  Returns ``(dt,
+    phases)`` — ``phases`` is the engine StepTimeline's per-step mean
+    over the final window (data_wait/compute/ckpt_stall attribution;
+    docs/performance.md), emitted into every training record.
 
     ``DS_BENCH_RUN_API=1`` drives ``engine.train_batches`` (N steps in
     ONE compiled lax.scan; semantics pinned by
@@ -140,8 +143,9 @@ def _timed_steps(engine, batches, steps, label):
                 loss = engine.train_batch(batch)
             loss = float(loss)
         dt = min(dt, (time.time() - t0) / steps)
-    log(f"[{label}] timing windows done")
-    return dt
+    phases = engine.timeline.summary(steps)
+    log(f"[{label}] timing windows done; {engine.timeline.format_summary(steps)}")
+    return dt, phases
 
 
 def _device_or_host_init(family_mod, cfg, on_tpu):
@@ -193,7 +197,7 @@ def bench_model(cfg, micro_bs, gas, seq, steps, zero_stage, label, opt_params=No
         for _ in range(n):
             yield {"input_ids": rng.integers(0, cfg.vocab_size, (global_bs, seq), dtype=np.int32)}
 
-    dt = _timed_steps(engine, batches, steps, label)
+    dt, phases = _timed_steps(engine, batches, steps, label)
 
     tokens_per_sec_chip = global_bs * seq / dt / n_dev
     # Training FLOPs/token ≈ 6*N + 12*L*D*seq (attention term)
@@ -211,6 +215,10 @@ def bench_model(cfg, micro_bs, gas, seq, steps, zero_stage, label, opt_params=No
         "vs_baseline": round(mfu / 0.35, 4),
         "mfu_pct": round(mfu * 100, 2),
         "step_ms": round(dt * 1000, 1),
+        # per-phase attribution (overlap subsystem; docs/performance.md)
+        "steps_per_s": round(1.0 / dt, 3),
+        "data_wait_ms": phases.get("data_wait_ms", 0.0),
+        "ckpt_stall_ms": phases.get("ckpt_stall_ms", 0.0),
         "micro_bs": micro_bs,
         "gas": gas,
         "seq": seq,
@@ -295,7 +303,7 @@ def bench_bert(seq: int, micro_bs: int, gas: int, steps: int):
                 "next_sentence_label": rng.integers(0, 2, (global_bs,), dtype=np.int32),
             }
 
-    dt = _timed_steps(engine, batches, steps, label)
+    dt, phases = _timed_steps(engine, batches, steps, label)
     samples_s = global_bs / dt / n_dev
     n_params = cfg.num_params()
     flops_per_token = 6 * n_params + 12 * cfg.num_hidden_layers * cfg.hidden_size * seq
@@ -309,6 +317,9 @@ def bench_bert(seq: int, micro_bs: int, gas: int, steps: int):
         "value": round(samples_s, 1),
         "unit": "samples/s",
         "achieved_tflops": round(tflops, 1),
+        "steps_per_s": round(1.0 / dt, 3),
+        "data_wait_ms": phases.get("data_wait_ms", 0.0),
+        "ckpt_stall_ms": phases.get("ckpt_stall_ms", 0.0),
         "micro_bs": micro_bs,
         "gas": gas,
         "seq": seq,

@@ -11,4 +11,5 @@ from deepspeed_tpu.analysis.rules import (  # noqa: F401
     sharding,
     side_effects,
     static_args,
+    timing,
 )

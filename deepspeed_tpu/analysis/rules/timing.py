@@ -1,0 +1,150 @@
+"""Rule: unfenced-timing — wall-clock deltas around jitted calls with no
+device fence between them.
+
+XLA dispatch is asynchronous: a compiled call returns the moment the
+work is *enqueued*.  ``t0 = time.perf_counter(); step(x); dt =
+time.perf_counter() - t0`` therefore measures Python dispatch overhead,
+not the step — numbers that look 10-100x too good and silently steer
+optimization work at nothing.  Honest timing blocks on the result
+(``jax.block_until_ready``, ``device_get``, ``float(loss)``, ...)
+before reading the second clock; the engine's ``StepTimeline`` and
+``SynchronizedWallClockTimer`` both fence this way.
+
+Detection (lexical, per function): a clock read assigned to a name, a
+later ``<clock>() - name`` delta, and — in the statement window between
+the two — a call recognizably dispatching compiled work (a call to a
+``jax.jit``/AOT-compiled callable bound in this module, a direct
+``jax.jit(f)(...)``, a function this module passes to a trace
+transform, or the engine's compiled-step entry points) with no fencing
+call anywhere in the window.  Tier C: timings lie quietly; the code
+still runs.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from deepspeed_tpu.analysis.core import Severity, make_finding, register
+from deepspeed_tpu.analysis.traced import FunctionNode, collect_functions, iter_own_nodes
+
+_CLOCKS = {"time.time", "time.perf_counter", "time.monotonic"}
+# resolved suffixes that block on device work before the second clock read
+_FENCE_SUFFIXES = ("block_until_ready", "device_get", "wait_until_finished")
+_FENCE_METHODS = {"block_until_ready", "item", "tolist", "wait_until_finished"}
+_FENCE_CASTS = {"float", "int", "bool"}
+_FENCE_NP = {"numpy.asarray", "numpy.array"}
+# engine entry points that run a compiled step (host-side API; the
+# callee body lives in another module, out of lexical reach)
+_DISPATCH_METHODS = {"train_batch", "train_batches", "eval_batch", "predict"}
+
+
+def _is_clock_call(ctx, node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and not node.args
+        and not node.keywords
+        and ctx.resolve(node.func) in _CLOCKS
+    )
+
+
+def _jit_factory(ctx, value: ast.AST) -> bool:
+    """Does this assigned value produce a compiled callable?  Covers
+    ``jax.jit(...)``/``pjit(...)``, ``self._get_compiled(...)``, and AOT
+    ``....lower(...).compile()`` chains."""
+    if not isinstance(value, ast.Call):
+        return False
+    resolved = ctx.resolve(value.func) or ""
+    last = resolved.split(".")[-1]
+    if last in ("jit", "pjit", "_get_compiled"):
+        return True
+    # ``jax.jit(f).lower(args).compile()``
+    return last == "compile" and isinstance(value.func, ast.Attribute)
+
+
+@register(
+    "unfenced-timing",
+    Severity.C,
+    "time.time()/perf_counter() delta around a jitted call with no "
+    "block_until_ready (async dispatch makes the measurement a lie)",
+)
+def check(rule, ctx):
+    traced_ids = ctx.traced_functions()
+    # names this module binds to compiled callables or passes to a trace
+    # transform — a call to one of these dispatches device work
+    jitted_names: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            value = getattr(node, "value", None)
+            if value is not None and _jit_factory(ctx, value):
+                targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        jitted_names.add(t.id)
+                    elif isinstance(t, ast.Attribute):
+                        jitted_names.add(t.attr)
+    for fn in collect_functions(ctx.tree):
+        if id(fn) in traced_ids:
+            jitted_names.add(fn.name)
+
+    def dispatches(call: ast.Call) -> bool:
+        func = call.func
+        if isinstance(func, ast.Name) and func.id in jitted_names:
+            return True
+        if isinstance(func, ast.Attribute) and func.attr in (jitted_names | _DISPATCH_METHODS):
+            return True
+        # direct jax.jit(f)(x) / compiled-dict lookups self._compiled[...](x)
+        if isinstance(func, ast.Call) and _jit_factory(ctx, func):
+            return True
+        return isinstance(func, ast.Subscript) and ctx.resolve(func.value) is not None and (
+            ctx.resolve(func.value) or ""
+        ).endswith("_compiled")
+
+    def fences(call: ast.Call) -> bool:
+        resolved = ctx.resolve(call.func) or ""
+        if resolved.endswith(_FENCE_SUFFIXES) or resolved in _FENCE_NP:
+            return True
+        if isinstance(call.func, ast.Attribute) and call.func.attr in _FENCE_METHODS:
+            return True
+        return (
+            isinstance(call.func, ast.Name)
+            and call.func.id in _FENCE_CASTS
+            and call.func.id == ctx.aliases.get(call.func.id, call.func.id)
+            and len(call.args) == 1
+            and not isinstance(call.args[0], ast.Constant)
+        )
+
+    for fn in collect_functions(ctx.tree):
+        if id(fn) in traced_ids:
+            continue  # inside a trace this is host-sync-in-jit territory
+        # two passes: iter_own_nodes walks a stack, not source order, so
+        # starts must be fully known before deltas are matched
+        starts: Dict[str, int] = {}
+        calls: List[ast.Call] = []
+        own = list(iter_own_nodes(fn))
+        for node in own:
+            if isinstance(node, ast.Assign) and _is_clock_call(ctx, node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        starts[t.id] = node.lineno
+            elif isinstance(node, ast.Call):
+                calls.append(node)
+        deltas: List = []  # (delta_node, start_line)
+        for node in own:
+            if (
+                isinstance(node, ast.BinOp)
+                and isinstance(node.op, ast.Sub)
+                and _is_clock_call(ctx, node.left)
+                and isinstance(node.right, ast.Name)
+                and node.right.id in starts
+                and node.lineno > starts[node.right.id]
+            ):
+                deltas.append((node, starts[node.right.id]))
+        for delta, start_line in deltas:
+            window = [c for c in calls if start_line <= c.lineno <= delta.lineno]
+            if any(dispatches(c) for c in window) and not any(fences(c) for c in window):
+                yield make_finding(
+                    rule, ctx, delta,
+                    f"wall-clock delta in '{fn.name}' spans a jitted call with no "
+                    "block_until_ready/device_get fence — async dispatch means this "
+                    "measures Python overhead, not the compiled step",
+                )

@@ -483,6 +483,121 @@ class ResilienceConfig:
 
 
 @dataclass
+class PrefetchOverlapConfig:
+    """``overlap.prefetch`` — pipelined load + sharded ``device_put`` of
+    input batches ahead of the compiled step (``engine.prefetch_loader``)."""
+
+    enabled: bool = C.PREFETCH_ENABLED_DEFAULT
+    depth: int = C.PREFETCH_DEPTH_DEFAULT  # batches in flight per stage
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]], block: str) -> "PrefetchOverlapConfig":
+        if d is None:
+            return cls()
+        d = dict(d)
+        out = cls(
+            enabled=bool(_pop(d, "enabled", C.PREFETCH_ENABLED_DEFAULT)),
+            depth=int(_pop(d, "depth", C.PREFETCH_DEPTH_DEFAULT)),
+        )
+        _check_empty(d, block, _known_keys(cls))
+        if out.depth < 1:
+            raise DeepSpeedConfigError(f"'{block}.depth' must be >= 1, got {out.depth}")
+        return out
+
+
+@dataclass
+class AsyncCheckpointConfig:
+    """``overlap.async_checkpoint`` — snapshot device state at the step
+    boundary, run the stage->manifest->rename commit on a background
+    thread (docs/performance.md; durability contract per
+    docs/resilience.md is unchanged)."""
+
+    enabled: bool = C.ASYNC_CHECKPOINT_ENABLED_DEFAULT
+    drain_timeout_seconds: float = C.ASYNC_CHECKPOINT_DRAIN_TIMEOUT_DEFAULT
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]], block: str) -> "AsyncCheckpointConfig":
+        if d is None:
+            return cls()
+        d = dict(d)
+        out = cls(
+            enabled=bool(_pop(d, "enabled", C.ASYNC_CHECKPOINT_ENABLED_DEFAULT)),
+            drain_timeout_seconds=float(
+                _pop(d, "drain_timeout_seconds", C.ASYNC_CHECKPOINT_DRAIN_TIMEOUT_DEFAULT)
+            ),
+        )
+        _check_empty(d, block, _known_keys(cls))
+        if out.drain_timeout_seconds <= 0:
+            raise DeepSpeedConfigError(
+                f"'{block}.drain_timeout_seconds' must be > 0, got {out.drain_timeout_seconds}"
+            )
+        return out
+
+
+@dataclass
+class TimelineConfig:
+    """``overlap.timeline`` — per-step wall-time attribution
+    (data_wait / compute / ckpt_stall / compile / other).
+
+    ``fence``: per-step ``block_until_ready`` before the compute note.
+    Honest per-step compute attribution requires it, but it costs a full
+    host<->device round trip per step (exactly what ThroughputTimer
+    avoids off report steps).  ``null`` (default) follows
+    ``wall_clock_breakdown``; without the fence the timeline still
+    attributes the host-measurable phases (data_wait / ckpt_stall /
+    compile) and omits ``compute`` rather than record an unfenced lie."""
+
+    enabled: bool = C.TIMELINE_ENABLED_DEFAULT
+    window: int = C.TIMELINE_WINDOW_DEFAULT
+    fence: Optional[bool] = None  # None = follow wall_clock_breakdown
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]], block: str) -> "TimelineConfig":
+        if d is None:
+            return cls()
+        d = dict(d)
+        fence = _pop(d, "fence", None)
+        out = cls(
+            enabled=bool(_pop(d, "enabled", C.TIMELINE_ENABLED_DEFAULT)),
+            window=int(_pop(d, "window", C.TIMELINE_WINDOW_DEFAULT)),
+            fence=None if fence is None else bool(fence),
+        )
+        _check_empty(d, block, _known_keys(cls))
+        if out.window < 1:
+            raise DeepSpeedConfigError(f"'{block}.window' must be >= 1, got {out.window}")
+        return out
+
+
+@dataclass
+class OverlapConfig:
+    """``overlap`` block (TPU-native extension; docs/performance.md)."""
+
+    prefetch: PrefetchOverlapConfig = field(default_factory=PrefetchOverlapConfig)
+    async_checkpoint: AsyncCheckpointConfig = field(default_factory=AsyncCheckpointConfig)
+    timeline: TimelineConfig = field(default_factory=TimelineConfig)
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "OverlapConfig":
+        if d is None:
+            return cls()
+        d = dict(d)
+        out = cls(
+            prefetch=PrefetchOverlapConfig.from_dict(
+                _pop(d, C.OVERLAP_PREFETCH, None), f"{C.OVERLAP}.{C.OVERLAP_PREFETCH}"
+            ),
+            async_checkpoint=AsyncCheckpointConfig.from_dict(
+                _pop(d, C.OVERLAP_ASYNC_CHECKPOINT, None),
+                f"{C.OVERLAP}.{C.OVERLAP_ASYNC_CHECKPOINT}",
+            ),
+            timeline=TimelineConfig.from_dict(
+                _pop(d, C.OVERLAP_TIMELINE, None), f"{C.OVERLAP}.{C.OVERLAP_TIMELINE}"
+            ),
+        )
+        _check_empty(d, C.OVERLAP, _known_keys(cls))
+        return out
+
+
+@dataclass
 class ActivationCheckpointingConfig:
     """Reference ``runtime/activation_checkpointing/config.py``.  On TPU,
     ``partition_activations`` maps to sharding saved residuals over the
@@ -731,6 +846,7 @@ _KNOWN_TOP_LEVEL = {
     C.CHECKPOINT_TAG_VALIDATION,
     C.MESH,
     C.RESILIENCE,
+    C.OVERLAP,
     "activation_checkpointing",
     "flops_profiler",
     "aio",
@@ -791,6 +907,7 @@ class DeepSpeedConfig:
         self.progressive_layer_drop = ProgressiveLayerDropConfig.from_dict(d.get("progressive_layer_drop"))
         self.sparse_attention = SparseAttentionConfig.from_dict(d.get("sparse_attention"))
         self.resilience = ResilienceConfig.from_dict(d.get(C.RESILIENCE))
+        self.overlap = OverlapConfig.from_dict(d.get(C.OVERLAP))
         self.elasticity_dict = d.get("elasticity")
 
         self.gradient_clipping = float(d.get(C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT))

@@ -181,6 +181,23 @@ RETRY_BACKOFF_SECONDS_DEFAULT = 0.5
 RETRY_BACKOFF_MAX_SECONDS_DEFAULT = 30.0
 RETRY_JITTER_DEFAULT = 0.25
 
+#############################################
+# Overlap (input prefetch, async checkpointing, step-phase timeline)
+#############################################
+OVERLAP = "overlap"
+
+OVERLAP_PREFETCH = "prefetch"
+PREFETCH_ENABLED_DEFAULT = True
+PREFETCH_DEPTH_DEFAULT = 2
+
+OVERLAP_ASYNC_CHECKPOINT = "async_checkpoint"
+ASYNC_CHECKPOINT_ENABLED_DEFAULT = False
+ASYNC_CHECKPOINT_DRAIN_TIMEOUT_DEFAULT = 300.0  # seconds
+
+OVERLAP_TIMELINE = "timeline"
+TIMELINE_ENABLED_DEFAULT = True
+TIMELINE_WINDOW_DEFAULT = 512  # steps retained for summaries
+
 RESILIENCE_DIVERGENCE = "divergence"
 DIVERGENCE_ENABLED_DEFAULT = True
 DIVERGENCE_THRESHOLD_DEFAULT = 20

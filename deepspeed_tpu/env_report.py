@@ -141,10 +141,47 @@ def resilience_report(config=None) -> None:
         print(f"{name} " + "." * (30 - len(name)) + f" {value}")
 
 
+def overlap_report(config=None) -> None:
+    """Overlap configuration summary rows (docs/performance.md).
+    ``config`` may be a DeepSpeedConfig, an OverlapConfig, or None
+    (prints the defaults a config-less run gets)."""
+    from deepspeed_tpu.config.config import OverlapConfig
+
+    o = getattr(config, "overlap", config)
+    if o is None or not hasattr(o, "prefetch"):
+        o = OverlapConfig()
+    pf, ac, tl = o.prefetch, o.async_checkpoint, o.timeline
+    print()
+    print("overlap configuration:")
+    rows = [
+        (
+            "input prefetch",
+            f"enabled (depth {pf.depth}, pipelined load+place)"
+            if pf.enabled
+            else f"{YELLOW}DISABLED{END} (train step waits on host transfer)",
+        ),
+        (
+            "async checkpointing",
+            f"enabled (drain timeout {ac.drain_timeout_seconds:g}s)"
+            if ac.enabled
+            else "disabled (saves stall training for the full write)",
+        ),
+        (
+            "step timeline",
+            f"enabled (window {tl.window} steps: data_wait/compute/ckpt_stall/other)"
+            if tl.enabled
+            else "disabled",
+        ),
+    ]
+    for name, value in rows:
+        print(f"{name} " + "." * (30 - len(name)) + f" {value}")
+
+
 def cli_main() -> int:
     ok = op_report()
     debug_report()
     resilience_report()
+    overlap_report()
     return 0 if ok else 1
 
 

@@ -19,7 +19,8 @@ from __future__ import annotations
 import os
 import re
 import shutil
-from typing import Iterable, List, Optional, Tuple
+import threading
+from typing import Iterable, List, Optional, Set, Tuple
 
 from deepspeed_tpu.resilience import atomic, faults
 from deepspeed_tpu.utils.logging import logger
@@ -29,18 +30,66 @@ STAGING_SUFFIX = ".tmp"
 QUARANTINE_SUFFIX = ".corrupt"
 _STEP_RE = re.compile(r"(\d+)\s*$")
 
+# In-process registry of staging dirs an in-flight save OWNS (sync saves
+# between begin_stage and commit/abort; async saves for the lifetime of
+# the background commit).  begin_stage refuses to clear an owned dir —
+# the "leftover from a crashed save" heuristic must not rmtree a dir a
+# live background writer is mid-write into — and retention GC protects
+# the tags being (re-)staged.  A real crash clears the registry with the
+# process, so crashed leftovers are still reclaimed on the next save.
+_ACTIVE_STAGES: Set[str] = set()
+_ACTIVE_LOCK = threading.Lock()
+
+
+class StageInFlightError(RuntimeError):
+    """begin_stage was asked for a staging dir an in-flight save owns
+    (the caller should drain the pending save first)."""
+
 
 def stage_path(root: str, tag: str) -> str:
     return os.path.join(os.path.abspath(root), str(tag) + STAGING_SUFFIX)
 
 
+def release_stage(root: str, tag: str) -> None:
+    """Drop ownership of ``<tag>.tmp`` (idempotent; commit/abort call
+    this, and an async writer's cleanup calls it after a simulated
+    kill so the dead save's leftover behaves like a crash leftover)."""
+    with _ACTIVE_LOCK:
+        _ACTIVE_STAGES.discard(stage_path(root, tag))
+
+
+def active_stage_tags(root: str) -> Set[str]:
+    """Tags with an owned (in-flight) staging dir under ``root``."""
+    root = os.path.abspath(root)
+    with _ACTIVE_LOCK:
+        owned = set(_ACTIVE_STAGES)
+    out = set()
+    for path in owned:
+        if os.path.dirname(path) == root:
+            name = os.path.basename(path)
+            out.add(name[: -len(STAGING_SUFFIX)])
+    return out
+
+
 def begin_stage(root: str, tag: str) -> str:
     """Create a fresh staging dir for ``tag`` (clearing any leftover
-    from a previous crashed/failed attempt)."""
+    from a previous crashed/failed attempt) and take ownership of it.
+    Raises :class:`StageInFlightError` if a live save already owns it."""
     path = stage_path(root, tag)
-    if os.path.isdir(path):
-        shutil.rmtree(path)
-    os.makedirs(path)
+    with _ACTIVE_LOCK:
+        if path in _ACTIVE_STAGES:
+            raise StageInFlightError(
+                f"staging dir {path} is owned by an in-flight save; drain it first"
+            )
+        _ACTIVE_STAGES.add(path)
+    try:
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        os.makedirs(path)
+    except BaseException:
+        with _ACTIVE_LOCK:
+            _ACTIVE_STAGES.discard(path)
+        raise
     return path
 
 
@@ -56,6 +105,7 @@ def commit_tag(root: str, tag: str) -> str:
         shutil.rmtree(final)
     os.rename(staged, final)
     atomic.fsync_dir(root)
+    release_stage(root, tag)
     return final
 
 
@@ -63,6 +113,7 @@ def abort_stage(root: str, tag: str) -> None:
     path = stage_path(root, tag)
     if os.path.isdir(path):
         shutil.rmtree(path, ignore_errors=True)
+    release_stage(root, tag)
 
 
 def quarantine_tag(root: str, tag: str) -> str:
@@ -173,16 +224,24 @@ def retention_gc(
     ``keep_every > 0`` additionally pins any tag whose global step is a
     multiple of it (coarse long-horizon history under a tight window).
     Tags in ``protect`` (and the ``latest`` target) are never deleted;
-    quarantined/staging dirs are never touched here."""
+    quarantined/staging dirs are never touched here — ``<tag>.tmp``
+    dirs never count toward ``keep_last_n`` and a tag whose staging dir
+    an in-flight async save owns is protected, so a background commit
+    can never race the sweeper."""
     if keep_last_n <= 0:
         return []
     root = os.path.abspath(root)
     protected = set(str(t) for t in protect)
+    protected |= active_stage_tags(root)
     latest = read_latest(root)
     if latest:
         protected.add(latest)
     deleted: List[str] = []
-    for i, tag in enumerate(newest_first(root)):
+    # newest_first() excludes staging/quarantine names already; the
+    # re-check here is deliberate belt-and-braces — a .tmp dir counted
+    # toward keep_last_n would silently shrink the durable window
+    candidates = [t for t in newest_first(root) if not t.endswith(STAGING_SUFFIX)]
+    for i, tag in enumerate(candidates):
         if i < keep_last_n or tag in protected:
             continue
         step = tag_step(root, tag)

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -65,23 +66,8 @@ def _note_ckpt_dir(engine, directory: str) -> None:
         note(directory)
 
 
-def save_checkpoint(
-    engine,
-    save_dir: str,
-    tag: Optional[str] = None,
-    client_state: Optional[dict] = None,
-    save_latest: bool = True,
-) -> str:
-    rcfg = _resilience_cfg(engine)
-    ck = rcfg.checkpoint
-    if tag is None:
-        tag = f"global_step{int(engine.state['global_step'])}"
-    tag = str(tag)
-    save_dir = os.path.abspath(save_dir)
-    final_path = _ckpt_path(save_dir, tag)
-    os.makedirs(save_dir, exist_ok=True)
-
-    meta = {
+def _build_meta(engine, tag: str, client_state: Optional[dict]) -> Dict[str, Any]:
+    return {
         "tag": tag,
         "global_step": int(engine.state["global_step"]),
         "micro_step": int(engine.state["micro_step"]),
@@ -98,6 +84,81 @@ def save_checkpoint(
         "client_state": client_state or {},
         "ds_tpu_version": _version(),
     }
+
+
+def save_checkpoint(
+    engine,
+    save_dir: str,
+    tag: Optional[str] = None,
+    client_state: Optional[dict] = None,
+    save_latest: bool = True,
+    async_save: Optional[bool] = None,
+) -> str:
+    """Write one checkpoint tag.  ``async_save=None`` defers to the
+    engine's ``overlap.async_checkpoint`` config: when an async writer is
+    armed, the device state is snapshotted to host (the only stall) and
+    the stage->manifest->rename commit runs on a background thread —
+    training resumes immediately and the returned path is where the tag
+    WILL be committed (``engine._async_writer.drain()`` to wait).  Any
+    save request drains an in-flight async save first."""
+    rcfg = _resilience_cfg(engine)
+    ck = rcfg.checkpoint
+    if tag is None:
+        tag = f"global_step{int(engine.state['global_step'])}"
+    tag = str(tag)
+    save_dir = os.path.abspath(save_dir)
+    final_path = _ckpt_path(save_dir, tag)
+    os.makedirs(save_dir, exist_ok=True)
+
+    # the stall clock starts BEFORE the drain: waiting out the previous
+    # in-flight commit is checkpoint-induced training stall and must
+    # show up in the ckpt_stall phase, not hide in "other"
+    timeline = getattr(engine, "timeline", None)
+    t_stall = time.perf_counter()
+    writer = getattr(engine, "_async_writer", None)
+    if writer is not None:
+        # sync saves drain too: they share the tree's staging/latest/GC
+        # state with whatever commit is still in flight
+        writer.drain()
+    use_async = (writer is not None) if async_save is None else (bool(async_save) and writer is not None)
+    if use_async:
+        blockers = []
+        if jax.process_count() > 1:
+            blockers.append("multi-process saves are collective (staging barriers)")
+        if getattr(engine, "_host_opt", None) is not None:
+            blockers.append("host-offload optimizer state lives outside engine.state")
+        if not ck.atomic:
+            blockers.append("'resilience.checkpoint.atomic' is off")
+        if blockers:
+            logger.warning(
+                f"async checkpoint save unavailable ({'; '.join(blockers)}); saving synchronously"
+            )
+            use_async = False
+
+    if use_async:
+        path = _submit_async_save(
+            engine, writer, save_dir, tag, final_path, rcfg, client_state, save_latest
+        )
+        if timeline is not None:
+            timeline.note("ckpt_stall", time.perf_counter() - t_stall)
+        return path
+    path = _sync_save(engine, save_dir, tag, final_path, rcfg, client_state, save_latest)
+    if timeline is not None:
+        timeline.note("ckpt_stall", time.perf_counter() - t_stall)
+    return path
+
+
+def _sync_save(
+    engine,
+    save_dir: str,
+    tag: str,
+    final_path: str,
+    rcfg,
+    client_state: Optional[dict],
+    save_latest: bool,
+) -> str:
+    ck = rcfg.checkpoint
+    meta = _build_meta(engine, tag, client_state)
 
     def _barrier(name: str) -> None:
         if jax.process_count() > 1:
@@ -155,6 +216,12 @@ def save_checkpoint(
             if ck.atomic and jax.process_index() == 0:
                 manager.abort_stage(save_dir, tag)
             raise
+        finally:
+            if ck.atomic and jax.process_index() == 0:
+                # after this frame unwinds no live save owns the staging
+                # dir (a real crash clears the in-memory registry with
+                # the process; a simulated kill must match)
+                manager.release_stage(save_dir, tag)
 
     policy = rcfg.retry.policy()
     if jax.process_count() > 1:
@@ -182,6 +249,93 @@ def save_checkpoint(
             log_dist(f"retention gc: deleted old tag(s) {deleted} (keep_last_n={ck.keep_last_n})")
     _note_ckpt_dir(engine, save_dir)
     log_dist(f"saved checkpoint {final_path}")
+    return final_path
+
+
+def _snapshot_state_to_host(engine) -> Any:
+    """Portable-layout state with every leaf materialized on host.
+    ``copy_to_host_async`` fans the D2H transfers out first so the
+    blocking ``np.asarray`` walk overlaps them; after this returns,
+    training may donate/overwrite the device buffers freely."""
+    portable = engine._to_portable_state(engine.state)
+    for leaf in jax.tree.leaves(portable):
+        copy_async = getattr(leaf, "copy_to_host_async", None)
+        if copy_async is not None:
+            try:
+                copy_async()
+            except Exception:  # noqa: BLE001 — fall back to the sync pull
+                pass
+    return jax.tree.map(np.asarray, portable)
+
+
+def _submit_async_save(
+    engine,
+    writer,
+    save_dir: str,
+    tag: str,
+    final_path: str,
+    rcfg,
+    client_state: Optional[dict],
+    save_latest: bool,
+) -> str:
+    """Snapshot now (the only training stall), commit in the background.
+
+    The background job is the SAME single-process commit protocol as
+    :func:`_sync_save` — stage into ``<tag>.tmp`` under the in-flight
+    registry, meta, manifest last, one rename, latest pointer, retention
+    GC — so every fault-injection durability property carries over: a
+    kill at any background instruction leaves the previous tree (plus a
+    ``.tmp`` leftover) and never a loadable-but-corrupt tag."""
+    ck = rcfg.checkpoint
+    meta = _build_meta(engine, tag, client_state)  # device->host scalar reads
+    snapshot = _snapshot_state_to_host(engine)
+    # built on the CALLER thread: the orbax import chain registers
+    # threading/concurrent.futures atexit hooks, which raise if first
+    # reached from the background thread during interpreter shutdown
+    # (a script whose last act is this save)
+    ckptr = _checkpointer()
+    policy = rcfg.retry.policy()
+
+    def commit() -> None:
+        def _write() -> None:
+            faults.check("ckpt.save.state", path=final_path)
+            target = manager.begin_stage(save_dir, tag)
+            try:
+                ckptr.save(os.path.join(target, "state"), snapshot, force=True)
+                ckptr.wait_until_finished()
+                faults.check("ckpt.save.meta", path=target)
+                atomic.atomic_write_text(
+                    os.path.join(target, "meta.json"), json.dumps(meta, indent=2)
+                )
+                # manifest last: its presence certifies completeness
+                atomic.write_manifest(target, algorithm=ck.checksum)
+                manager.commit_tag(save_dir, tag)
+            except OSError:
+                manager.abort_stage(save_dir, tag)
+                raise
+            finally:
+                manager.release_stage(save_dir, tag)
+
+        retry_call(
+            policy,
+            _write,
+            on_retry=lambda attempt, e, pause: logger.warning(
+                f"async checkpoint save of '{tag}' failed (attempt {attempt}: {e}); "
+                f"retrying in {pause:.1f}s"
+            ),
+        )
+        if save_latest:
+            retry_call(rcfg.retry.policy(), manager.write_latest, save_dir, tag)
+        deleted = manager.retention_gc(
+            save_dir, keep_last_n=ck.keep_last_n, keep_every=ck.keep_every, protect=(tag,)
+        )
+        if deleted:
+            log_dist(f"retention gc: deleted old tag(s) {deleted} (keep_last_n={ck.keep_last_n})")
+        log_dist(f"async checkpoint save of {final_path} committed")
+
+    writer.submit(tag, final_path, commit)
+    _note_ckpt_dir(engine, save_dir)
+    log_dist(f"async checkpoint save of {final_path} submitted; training resumes")
     return final_path
 
 
@@ -235,6 +389,11 @@ def load_checkpoint(
     ck = rcfg.checkpoint
     if strict is None:
         strict = ck.fail_on_missing
+    writer = getattr(engine, "_async_writer", None)
+    if writer is not None:
+        # restoring while a background commit mutates the tree (rename,
+        # latest update, GC) would race the candidate scan
+        writer.drain()
     load_dir = os.path.abspath(load_dir)
     explicit = tag is not None
     requested = str(tag) if explicit else manager.read_latest(load_dir)

@@ -118,6 +118,11 @@ class DeepSpeedDataLoader:
 class DevicePrefetchLoader:
     """Wraps any batch iterator with ahead-of-time ``jax.device_put``.
 
+    NOTE: ``engine.prefetch_loader`` now routes through the two-stage
+    pipelined ``runtime.overlap.DevicePrefetcher`` (load and place
+    overlap each other AND the step); this single-worker wrapper stays
+    for direct users of the plain ``device_put`` path.
+
     The engine's compiled step dispatches asynchronously; what serializes
     a remote/tunneled TPU is the per-step host→device input transfer.
     Keeping ``prefetch_depth`` batches in flight overlaps the next

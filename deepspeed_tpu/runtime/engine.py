@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -354,6 +355,33 @@ class DeepSpeedEngine:
                 grace_seconds=self.resilience.watchdog.grace_seconds,
                 exit_code=self.resilience.watchdog.exit_code,
             ).install()
+
+        # -- overlap: input prefetch / async checkpointing / step timeline
+        # (docs/performance.md; runtime/overlap/)
+        from deepspeed_tpu.config.config import OverlapConfig
+        from deepspeed_tpu.runtime.overlap import AsyncCheckpointWriter, StepTimeline
+
+        self.overlap = getattr(config, "overlap", None) or OverlapConfig()
+        self.timeline = StepTimeline(
+            enabled=self.overlap.timeline.enabled, window=self.overlap.timeline.window
+        )
+        # per-step compute fencing costs a host<->device round trip per
+        # step (the sync ThroughputTimer deliberately avoids off report
+        # steps); default follows the wall_clock_breakdown opt-in, whose
+        # per-step timers already sync
+        fence = self.overlap.timeline.fence
+        self._timeline_fence = config.wall_clock_breakdown if fence is None else bool(fence)
+        self._async_writer = (
+            AsyncCheckpointWriter(
+                drain_timeout_seconds=self.overlap.async_checkpoint.drain_timeout_seconds
+            )
+            if self.overlap.async_checkpoint.enabled
+            else None
+        )
+        # executables built so far — the compile-stability regression
+        # tests pin this to 1 over a steady-state training loop (any
+        # shape/static-arg drift shows up as a recount)
+        self.compilation_count = 0
 
         # -- host-side bookkeeping ----------------------------------------
         from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
@@ -780,6 +808,7 @@ class DeepSpeedEngine:
     def _get_compiled(self, name: str, fn, donate: bool = True):
         if name not in self._compiled:
             self._compiled[name] = jax.jit(self._scoped(fn), donate_argnums=(0,) if donate else ())
+            self.compilation_count += 1
         return self._compiled[name]
 
     # ------------------------------------------------------------------
@@ -1112,17 +1141,24 @@ class DeepSpeedEngine:
 
         return jax.tree.map(one, batch)
 
-    def prefetch_loader(self, loader, prefetch_depth: int = 2):
-        """Wrap a host batch iterator so stacking + device placement run
-        ahead in a worker thread (runtime/dataloader.py
-        ``DevicePrefetchLoader``); feed the result to ``train_batch``."""
-        from deepspeed_tpu.runtime.dataloader import DevicePrefetchLoader
+    def prefetch_loader(self, loader, prefetch_depth: Optional[int] = None):
+        """Wrap a host batch iterator so loader pulls and stacking +
+        sharded device placement run ahead of the compiled step as a
+        two-stage pipeline (runtime/overlap ``DevicePrefetcher``); feed
+        the result to ``train_batch``.  ``prefetch_depth`` defaults to
+        the ``overlap.prefetch.depth`` config (2 = double buffering);
+        with ``overlap.prefetch.enabled = false`` the wrap is a
+        synchronous pass-through (A/B knob for measuring the overlap) —
+        unless the caller passes ``prefetch_depth`` explicitly, which is
+        a direct API request for background prefetch and wins over the
+        config default."""
+        from deepspeed_tpu.runtime.overlap import DevicePrefetcher, InlineLoader
 
-        return DevicePrefetchLoader(
-            loader,
-            prefetch_depth=prefetch_depth,
-            transform=lambda b: _PlacedBatch(self._stack_and_place(b)),
-        )
+        place = lambda b: _PlacedBatch(self._stack_and_place(b))  # noqa: E731
+        if not self.overlap.prefetch.enabled and prefetch_depth is None:
+            return InlineLoader(loader, place, timeline=self.timeline)
+        depth = self.overlap.prefetch.depth if prefetch_depth is None else int(prefetch_depth)
+        return DevicePrefetcher(loader, depth=depth, place_fn=place, timeline=self.timeline)
 
     def _prepare_batch(self, batch: Any) -> Any:
         def put(x):
@@ -1155,9 +1191,14 @@ class DeepSpeedEngine:
             self._state_shardings["grad_acc"] = acc_sh
         if self.wall_clock_breakdown:
             self.timers(FORWARD_TIMER).start()
-        batch = self._prepare_batch(batch)
+        with self.timeline.phase("data_wait"):
+            batch = self._prepare_batch(batch)
         fn = self._get_compiled("micro_step", self._micro_step_impl)
+        t_compute = time.perf_counter()
         self.state, loss = fn(self.state, batch)
+        if self.timeline.enabled and self._timeline_fence:
+            jax.block_until_ready(loss)
+            self.timeline.note("compute", time.perf_counter() - t_compute)
         self._host_micro_step += 1
         self._cached_loss = loss
         self._last_loss = loss  # step()'s divergence check_loss reads this
@@ -1210,6 +1251,7 @@ class DeepSpeedEngine:
                 self._host_global_step += 1
             self._maybe_report_progress()
             self._on_step_boundary(overflowed, loss=self._last_loss)
+            self.timeline.end_step()
         if self.wall_clock_breakdown:
             self.timers(STEP_TIMER).stop(sync_token=self.state["global_step"])
             self.timers.log([FORWARD_TIMER, BACKWARD_TIMER, STEP_TIMER])
@@ -1232,7 +1274,12 @@ class DeepSpeedEngine:
             and self._host_global_step >= self.optimizer.freeze_step
         ):
             self._enter_onebit_frozen()
+        was_placed = isinstance(batch, _PlacedBatch)
+        t_place = time.perf_counter()
         stacked = self._stack_and_place(batch)
+        if not was_placed:
+            # prefetched batches had their wait noted by the prefetcher
+            self.timeline.note("data_wait", time.perf_counter() - t_place)
 
         tb_key = (
             "train_batch",
@@ -1256,12 +1303,14 @@ class DeepSpeedEngine:
                           {"lr": scalar, "grad_norm": scalar, "overflow": scalar})
             else:
                 out_sh = (self._state_shardings, scalar)
-            executable = (
-                jax.jit(self._scoped(full_step), donate_argnums=(0,), out_shardings=out_sh)
-                .lower(self.state, stacked)
-                .compile()
-            )
+            with self.timeline.phase("compile"):
+                executable = (
+                    jax.jit(self._scoped(full_step), donate_argnums=(0,), out_shardings=out_sh)
+                    .lower(self.state, stacked)
+                    .compile()
+                )
             self._compiled[tb_key] = executable
+            self.compilation_count += 1
             try:
                 cost = executable.cost_analysis() or {}
                 if isinstance(cost, list):
@@ -1271,11 +1320,20 @@ class DeepSpeedEngine:
                 self._train_step_cost = {}
         profile_step = self._host_global_step + 1
         self.flops_profiler.start_step(profile_step)
+        t_compute = time.perf_counter()
         if self._offload:
             self.state, loss = self._compiled[tb_key](self.state, stacked)
             info = self._host_apply_step()
         else:
             self.state, loss, info = self._compiled[tb_key](self.state, stacked)
+        if self.timeline.enabled and self._timeline_fence:
+            # fence: XLA dispatch is async — an unfenced delta would only
+            # measure Python overhead (ds_lint `unfenced-timing`).  Off
+            # (the default without wall_clock_breakdown), no compute note
+            # is recorded: host-measurable phases stay honest and the hot
+            # path keeps its dispatch pipelining
+            jax.block_until_ready(loss)
+            self.timeline.note("compute", time.perf_counter() - t_compute)
         self.flops_profiler.end_step(profile_step, cost=self._train_step_cost, sync_token=loss)
         self._last_loss = loss
         self._last_info = info  # lr / grad_norm / overflow of this step
@@ -1294,6 +1352,7 @@ class DeepSpeedEngine:
         self.tput_timer.stop(sync_token=loss)
         self._maybe_report_progress()
         self._on_step_boundary(overflowed, loss=loss)
+        self.timeline.end_step()
         return loss
 
     def _full_step_fn(self) -> Callable:
@@ -1360,8 +1419,9 @@ class DeepSpeedEngine:
         if self._offload or crosses_freeze or n == 1:
             return np.asarray([float(self.train_batch(b)) for b in batches], np.float32)
         self.tput_timer.start()
-        stacked = [self._stack_and_place(b) for b in batches]
-        run = jax.tree.map(lambda *xs: jnp.stack(xs), *stacked)
+        with self.timeline.phase("data_wait"):
+            stacked = [self._stack_and_place(b) for b in batches]
+            run = jax.tree.map(lambda *xs: jnp.stack(xs), *stacked)
         unroll_k = n if unroll is True else max(1, min(int(unroll), n))
         key = (
             "train_batches", n, unroll_k, self._onebit_frozen, bool(self.state["grad_acc"]),
@@ -1383,16 +1443,20 @@ class DeepSpeedEngine:
                 return state, losses, jnp.sum(ovf.astype(jnp.int32)), lrs[-1], gns[-1]
 
             scalar = self._sh(P())
-            self._compiled[key] = (
-                jax.jit(
-                    self._scoped(full_run), donate_argnums=(0,),
-                    out_shardings=(self._state_shardings, scalar, scalar, scalar, scalar),
+            with self.timeline.phase("compile"):
+                self._compiled[key] = (
+                    jax.jit(
+                        self._scoped(full_run), donate_argnums=(0,),
+                        out_shardings=(self._state_shardings, scalar, scalar, scalar, scalar),
+                    )
+                    .lower(self.state, run)
+                    .compile()
                 )
-                .lower(self.state, run)
-                .compile()
-            )
+            self.compilation_count += 1
+        t_compute = time.perf_counter()
         self.state, losses, ovf_count, last_lr, last_gn = self._compiled[key](self.state, run)
-        losses = np.asarray(losses)
+        losses = np.asarray(losses)  # materializing = the compute fence
+        self.timeline.note("compute", time.perf_counter() - t_compute)
         skipped = int(ovf_count)
         if self.loss_scaler.dynamic:
             self.skipped_steps += skipped
@@ -1421,6 +1485,7 @@ class DeepSpeedEngine:
             )
             if guard is not None and guard.trips > trips_before:
                 break  # one action per detection, not one per threshold-multiple
+        self.timeline.end_step(count=n)
         return losses
 
     def eval_batch(self, batch: Any) -> Any:
@@ -1455,6 +1520,8 @@ class DeepSpeedEngine:
             self.progressive_layer_drop.update_state(step)
         if step > 0 and step % self.config.steps_per_print == 0:
             log_dist(f"step={step} lr={self.get_lr()[0]:.3e} loss_scale={self.loss_scale:.1f}")
+            if self.wall_clock_breakdown and self.timeline.enabled:
+                log_dist(self.timeline.format_summary(self.config.steps_per_print))
             if self.monitor.enabled:
                 # reference tags (engine.py:1178-1188, :1356-1382)
                 samples = int(self.state["global_samples"])
@@ -1518,8 +1585,20 @@ class DeepSpeedEngine:
                 "exiting WITHOUT saving"
             )
             raise SystemExit(1)
+        writer = self._async_writer
+        if writer is not None and writer.in_flight:
+            # drain-before-exit: an in-flight background commit must land
+            # (or provably fail) before the emergency save touches the
+            # tree; the budget is capped by the remaining grace window
+            log_dist("draining in-flight async checkpoint before the emergency save")
+            try:
+                writer.drain(timeout=max(1.0, min(writer.drain_timeout_seconds, wd.remaining())))
+            except BaseException as e:  # hung drain => cannot certify "saved"
+                logger.error(f"drain of in-flight async save failed: {e!r}")
+                raise SystemExit(1) from e
         try:
-            path = self.save_checkpoint(self._resilience_ckpt_dir)
+            # synchronous: exit code 43 must certify a COMMITTED tag
+            path = self.save_checkpoint(self._resilience_ckpt_dir, async_save=False)
         except BaseException as e:  # a failed save must NOT exit as "saved"
             logger.error(f"emergency checkpoint failed: {e!r}")
             raise SystemExit(1) from e
@@ -1562,10 +1641,13 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # checkpointing (engine.save_checkpoint, reference :1854)
     # ------------------------------------------------------------------
-    def save_checkpoint(self, save_dir: str, tag: Optional[str] = None, client_state: Optional[dict] = None, save_latest: bool = True):
+    def save_checkpoint(self, save_dir: str, tag: Optional[str] = None, client_state: Optional[dict] = None, save_latest: bool = True, async_save: Optional[bool] = None):
+        """``async_save``: None defers to the ``overlap.async_checkpoint``
+        config; True/False forces the background/synchronous path for
+        this save (see docs/performance.md)."""
         from deepspeed_tpu.runtime.checkpointing import save_checkpoint as _save
 
-        return _save(self, save_dir, tag=tag, client_state=client_state, save_latest=save_latest)
+        return _save(self, save_dir, tag=tag, client_state=client_state, save_latest=save_latest, async_save=async_save)
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None, **kw):
         from deepspeed_tpu.runtime.checkpointing import load_checkpoint as _load

@@ -1,0 +1,164 @@
+"""Background checkpoint commits: training resumes while bytes hit disk.
+
+A synchronous atomic save (PR 2's stage -> manifest -> rename protocol)
+stalls training for the full serialize+hash+fsync — seconds to minutes
+at scale.  The async path splits the save at the only point that needs
+the device state to hold still:
+
+1. **snapshot** (caller thread, the only stall): device state is copied
+   to host (``copy_to_host_async`` fan-out, then ``device_get``) at the
+   step boundary — after this, training may donate/overwrite the device
+   buffers freely;
+2. **commit** (background thread): the snapshot runs the *unchanged*
+   stage -> meta -> manifest -> rename protocol against the checkpoint
+   tree, so every durability property proven by the PR 2 fault-injection
+   harness holds for async saves too — a kill mid-commit leaves the
+   previous tree plus a ``.tmp`` staging dir, never a loadable-but-
+   corrupt tag.
+
+One save is in flight at a time: a second save request **drains** the
+in-flight one first (so tags commit in submission order and the staging
+registry in ``resilience.manager`` never sees two owners of one dir).
+The preemption watchdog drains synchronously before its emergency save,
+keeping the exit-43 => committed-checkpoint contract intact.
+
+A failed background commit is logged and surfaced on the next
+:meth:`drain` (``PendingSave.error``); it never takes down the training
+thread — the durability model is "the previous tag survives", same as a
+crash at that instruction would have left.
+"""
+from __future__ import annotations
+
+import atexit
+import threading
+import time
+from typing import Callable, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class PendingSave:
+    """Handle for one in-flight (or finished) background save."""
+
+    def __init__(self, tag: str, final_path: str):
+        self.tag = tag
+        self.final_path = final_path
+        self.started_at = time.monotonic()
+        self.error: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def ok(self) -> bool:
+        return self.done and self.error is None
+
+    @property
+    def done(self) -> bool:
+        return self._thread is not None and not self._thread.is_alive()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Join the background commit; True if it finished (ok or not)
+        within ``timeout``."""
+        if self._thread is None:
+            return True
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
+
+
+class AsyncCheckpointWriter:
+    """Serializes background saves: at most one in flight, drained in
+    submission order."""
+
+    def __init__(self, drain_timeout_seconds: float = 300.0):
+        self.drain_timeout_seconds = float(drain_timeout_seconds)
+        self._lock = threading.Lock()
+        self._pending: Optional[PendingSave] = None
+        self._atexit_registered = False
+        self.last_error: Optional[BaseException] = None
+        self.completed = 0
+        self.failed = 0
+
+    def _register_exit_drain(self) -> None:
+        """A script whose last act is a save must not lose it.  The
+        commit runs orbax, which schedules onto ThreadPoolExecutors —
+        and ``concurrent.futures`` disables ALL executors from its own
+        threading-atexit hook at the very start of interpreter shutdown.
+        Threading-atexit callbacks run in reverse registration order, so
+        registering the drain here (long after concurrent.futures
+        imported) runs it BEFORE executors are disabled; plain
+        ``atexit`` would be too late (observed:
+        "cannot schedule new futures after interpreter shutdown")."""
+        register = getattr(threading, "_register_atexit", None)
+        if register is not None:
+            register(self._exit_drain)
+        else:  # pragma: no cover - future-python fallback, best effort
+            atexit.register(self._exit_drain)
+
+    def _exit_drain(self) -> None:
+        try:
+            if self.in_flight:
+                logger.warning("draining in-flight async checkpoint at interpreter exit")
+            self.drain()  # also surfaces a finished-but-failed commit
+        except BaseException as e:  # noqa: BLE001 — exit path must not throw
+            logger.error(f"async checkpoint drain at exit failed: {e!r}")
+
+    @property
+    def in_flight(self) -> bool:
+        p = self._pending
+        return p is not None and not p.done
+
+    def submit(self, tag: str, final_path: str, commit_fn: Callable[[], None]) -> PendingSave:
+        """Start ``commit_fn`` on a background thread.  The caller must
+        :meth:`drain` first — two concurrent saves would race the
+        checkpoint tree's staging/latest/GC state."""
+        with self._lock:
+            if self._pending is not None and not self._pending.done:
+                raise RuntimeError(
+                    f"async save of '{self._pending.tag}' still in flight; drain() first"
+                )
+            pending = PendingSave(tag, final_path)
+
+            def run():
+                try:
+                    commit_fn()
+                except BaseException as e:  # noqa: BLE001 — surfaced via drain()
+                    pending.error = e
+
+            if not self._atexit_registered:
+                self._register_exit_drain()
+                self._atexit_registered = True
+            t = threading.Thread(target=run, daemon=True, name=f"ds-async-ckpt-{tag}")
+            pending._thread = t
+            self._pending = pending
+            t.start()
+            return pending
+
+    def drain(self, timeout: Optional[float] = None) -> Optional[PendingSave]:
+        """Wait for the in-flight save (if any) to finish and return its
+        handle.  Raises ``TimeoutError`` if it does not finish within
+        ``timeout`` (default: ``drain_timeout_seconds``) — callers on an
+        exit path treat that as "not saved".  A failed commit is logged
+        and recorded (``last_error``) but NOT re-raised: the previous
+        tag is still the durable state, and the caller's next save
+        proceeds fresh."""
+        with self._lock:
+            pending = self._pending
+        if pending is None:
+            return None
+        timeout = self.drain_timeout_seconds if timeout is None else float(timeout)
+        if not pending.wait(timeout):
+            raise TimeoutError(
+                f"async save of '{pending.tag}' did not finish within {timeout:.0f}s"
+            )
+        with self._lock:
+            if self._pending is pending:
+                self._pending = None
+        if pending.error is not None:
+            self.failed += 1
+            self.last_error = pending.error
+            logger.error(
+                f"async checkpoint save of '{pending.tag}' failed: {pending.error!r} "
+                "(the previously committed tag is still the durable state)"
+            )
+        else:
+            self.completed += 1
+        return pending

@@ -1,0 +1,137 @@
+"""Step-phase timeline: honest per-step wall-time attribution.
+
+Every training step's wall time is split into named phases:
+
+* ``data_wait``  — host blocked waiting for an input batch (loader pull,
+  stacking, ``device_put`` transfer, prefetch-queue wait);
+* ``compute``    — dispatch of the compiled step until its outputs are
+  ready, recorded ONLY when the engine fences it with
+  ``jax.block_until_ready`` (``overlap.timeline.fence``, defaulting to
+  the ``wall_clock_breakdown`` opt-in) — XLA dispatch is asynchronous,
+  so an unfenced delta only measures Python overhead (the ds_lint
+  ``unfenced-timing`` rule) and a per-step fence costs the round trip
+  ThroughputTimer deliberately avoids off report steps;
+* ``ckpt_stall`` — time training was stalled on checkpoint I/O (the
+  full save for synchronous saves; snapshot+submit for async saves);
+* ``compile``    — building a new executable (trace+lower+compile);
+* ``other``      — whatever remains of the step wall (host bookkeeping,
+  logging, monitor flushes).
+
+Notes accumulate into a *pending* record; :meth:`end_step` closes it
+against the wall clock since the previous step boundary, so host work
+that happens between steps (e.g. a checkpoint save between two
+``train_batch`` calls) is attributed to the step that paid for it.
+
+The timeline itself is pure host bookkeeping (two ``perf_counter``
+reads and a dict update per note): enabled without the fence it does
+not change the hot path and still attributes every host-measurable
+phase; the per-step device fence is the engine's (opt-in) choice.
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Deque, Dict, List, Optional
+
+PHASES = ("data_wait", "compute", "ckpt_stall", "compile", "other")
+
+
+class StepTimeline:
+    """Rolling per-step phase attribution over the last ``window`` steps."""
+
+    def __init__(self, enabled: bool = True, window: int = 512):
+        self.enabled = bool(enabled)
+        self.window = max(1, int(window))
+        self.records: Deque[Dict[str, float]] = deque(maxlen=self.window)
+        self.total_steps = 0
+        self._pending: Dict[str, float] = {}
+        self._last_boundary: Optional[float] = None
+
+    # -- recording --------------------------------------------------------
+    def note(self, phase: str, seconds: float) -> None:
+        """Accumulate ``seconds`` of ``phase`` into the pending step."""
+        if not self.enabled:
+            return
+        self._pending[phase] = self._pending.get(phase, 0.0) + float(seconds)
+
+    @contextmanager
+    def phase(self, name: str):
+        """Time a host block and note it under ``name``."""
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.note(name, time.perf_counter() - t0)
+
+    def end_step(self, count: int = 1) -> None:
+        """Close the pending record against the wall clock.  ``count > 1``
+        spreads the window evenly over ``count`` steps (one compiled
+        multi-step run, e.g. ``train_batches``)."""
+        if not self.enabled:
+            return
+        now = time.perf_counter()
+        if self._last_boundary is None:
+            # first boundary: no previous anchor, the wall is whatever
+            # was explicitly noted (avoids charging engine build time
+            # to step 1's "other")
+            wall = sum(self._pending.values())
+        else:
+            wall = now - self._last_boundary
+        self._last_boundary = now
+        noted = sum(self._pending.values())
+        other = max(0.0, wall - noted)
+        count = max(1, int(count))
+        rec = {p: self._pending.get(p, 0.0) / count for p in PHASES if p != "other"}
+        rec["other"] = (self._pending.get("other", 0.0) + other) / count
+        rec["wall"] = max(wall, noted) / count
+        for _ in range(count):
+            self.records.append(dict(rec))
+        self.total_steps += count
+        self._pending = {}
+
+    def reset_window(self) -> None:
+        """Drop recorded steps (keep the wall anchor); the next
+        ``summary()`` covers only steps recorded after this call."""
+        self.records.clear()
+
+    # -- reporting --------------------------------------------------------
+    def summary(self, last_n: Optional[int] = None) -> Dict[str, float]:
+        """Mean per-step milliseconds per phase over the last ``last_n``
+        recorded steps (default: the whole window), plus ``steps_per_s``
+        derived from the mean step wall."""
+        recs: List[Dict[str, float]] = list(self.records)
+        if last_n is not None:
+            recs = recs[-int(last_n):]
+        out = {f"{p}_ms": 0.0 for p in PHASES}
+        out["wall_ms"] = 0.0
+        out["steps"] = len(recs)
+        out["steps_per_s"] = 0.0
+        if not recs:
+            return out
+        n = len(recs)
+        for p in PHASES:
+            out[f"{p}_ms"] = round(sum(r.get(p, 0.0) for r in recs) * 1000.0 / n, 3)
+        wall = sum(r.get("wall", 0.0) for r in recs) / n
+        out["wall_ms"] = round(wall * 1000.0, 3)
+        out["steps_per_s"] = round(1.0 / wall, 3) if wall > 0 else 0.0
+        return out
+
+    def format_summary(self, last_n: Optional[int] = None) -> str:
+        """One log line: phase means and their share of the step wall."""
+        s = self.summary(last_n)
+        if not s["steps"]:
+            return "step timeline: no steps recorded"
+        wall = max(s["wall_ms"], 1e-9)
+        parts = [
+            f"{p}: {s[f'{p}_ms']:.1f}ms ({100.0 * s[f'{p}_ms'] / wall:.0f}%)"
+            for p in PHASES
+            if s[f"{p}_ms"] > 0.0 or p in ("data_wait", "compute")
+        ]
+        return (
+            f"step timeline over {s['steps']} step(s): wall {s['wall_ms']:.1f}ms "
+            f"({s['steps_per_s']:.2f} steps/s) | " + " | ".join(parts)
+        )

@@ -48,6 +48,7 @@ def test_rule_catalog_shape():
         "donated-buffer-reuse", "float64-promotion", "config-key-drift",
         "bare-jit", "missing-sharding-constraint",
         "non-atomic-checkpoint-write",  # PR 2 resilience tier-B rule
+        "unfenced-timing",  # PR 3 overlap tier-C rule
     ):
         assert rid in rules, rid
 
@@ -739,6 +740,114 @@ class TestAtomicCheckpointWrite:
 # ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
+
+
+class TestUnfencedTiming:
+    def test_flags_delta_around_jit_bound_callable(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import time
+            import jax
+
+            def step(x):
+                return x * 2
+
+            f = jax.jit(step)
+
+            def bench(x):
+                t0 = time.perf_counter()
+                y = f(x)
+                dt = time.perf_counter() - t0
+                return dt
+            """,
+            "unfenced-timing",
+        )
+        assert rule_ids(res) == ["unfenced-timing"]
+        assert res.findings[0].severity == Severity.C
+        assert "block_until_ready" in res.findings[0].message
+
+    def test_flags_engine_step_api_and_direct_jit_call(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import time
+            import jax
+
+            class Driver:
+                def run(self, eng, b, g, x):
+                    t0 = time.time()
+                    eng.train_batch(b)
+                    dt1 = time.time() - t0
+                    t1 = time.perf_counter()
+                    jax.jit(g)(x)
+                    dt2 = time.perf_counter() - t1
+                    return dt1, dt2
+            """,
+            "unfenced-timing",
+        )
+        assert rule_ids(res) == ["unfenced-timing", "unfenced-timing"]
+
+    def test_clean_when_fenced(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import time
+            import jax
+
+            def step(x):
+                return x * 2
+
+            f = jax.jit(step)
+
+            def bench_block(x):
+                t0 = time.perf_counter()
+                y = f(x)
+                jax.block_until_ready(y)
+                return time.perf_counter() - t0
+
+            def bench_float(eng, b):
+                t0 = time.time()
+                loss = float(eng.train_batch(b))
+                return time.time() - t0
+            """,
+            "unfenced-timing",
+        )
+        assert rule_ids(res) == []
+
+    def test_clean_when_no_jitted_call_in_window(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import time
+
+            def bench(load):
+                t0 = time.time()
+                data = load()
+                return time.time() - t0
+            """,
+            "unfenced-timing",
+        )
+        assert rule_ids(res) == []
+
+    def test_traced_functions_are_out_of_scope(self, tmp_path):
+        # timing INSIDE a jit is host-sync-in-jit territory, not this rule
+        res = lint_src(
+            tmp_path,
+            """
+            import time
+            import jax
+
+            @jax.jit
+            def step(x):
+                t0 = time.perf_counter()
+                y = x * 2
+                dt = time.perf_counter() - t0
+                return y
+            """,
+            "unfenced-timing",
+        )
+        assert rule_ids(res) == []
 
 
 class TestSuppression:

@@ -298,6 +298,58 @@ class TestCheckpointFaults:
         (empty_root / "logs").mkdir(parents=True)
         assert load_checkpoint(None, str(empty_root)) == (None, {})
 
+    def test_retention_gc_never_counts_staging_dirs(self, tmp_path):
+        # .tmp staging dirs (crashed or in-flight saves) must neither be
+        # deleted by GC nor consume keep_last_n slots — an async commit's
+        # staging dir counted as "newest tag" would silently shrink the
+        # durable window
+        import json as _json
+
+        for i in range(1, 5):
+            d = tmp_path / f"global_step{i}"
+            d.mkdir()
+            (d / "meta.json").write_text(_json.dumps({"global_step": i}))
+        for i in (6, 7):
+            d = tmp_path / f"global_step{i}.tmp"
+            d.mkdir()
+            (d / "meta.json").write_text(_json.dumps({"global_step": i}))
+        deleted = manager.retention_gc(str(tmp_path), keep_last_n=2)
+        assert sorted(deleted) == ["global_step1", "global_step2"]
+        names = sorted(os.listdir(tmp_path))
+        # both staging dirs survived untouched; the two newest tags kept
+        assert names == [
+            "global_step3", "global_step4", "global_step6.tmp", "global_step7.tmp",
+        ]
+
+    def test_retention_gc_protects_tag_with_inflight_stage(self, tmp_path):
+        import json as _json
+
+        for i in range(1, 4):
+            d = tmp_path / f"global_step{i}"
+            d.mkdir()
+            (d / "meta.json").write_text(_json.dumps({"global_step": i}))
+        # an async writer owns global_step1's staging dir (re-save in flight)
+        manager.begin_stage(str(tmp_path), "global_step1")
+        try:
+            deleted = manager.retention_gc(str(tmp_path), keep_last_n=1)
+            assert deleted == ["global_step2"]  # step1 protected, step3 in window
+            assert (tmp_path / "global_step1").is_dir()
+        finally:
+            manager.abort_stage(str(tmp_path), "global_step1")
+        # ownership released: the next sweep may collect it
+        assert manager.retention_gc(str(tmp_path), keep_last_n=1) == ["global_step1"]
+
+    def test_begin_stage_refuses_dir_owned_by_inflight_save(self, tmp_path):
+        manager.begin_stage(str(tmp_path), "t")
+        try:
+            with pytest.raises(manager.StageInFlightError):
+                manager.begin_stage(str(tmp_path), "t")
+        finally:
+            manager.abort_stage(str(tmp_path), "t")
+        # released (crash-leftover semantics): a fresh save reclaims it
+        assert manager.begin_stage(str(tmp_path), "t").endswith("t.tmp")
+        manager.abort_stage(str(tmp_path), "t")
+
     def test_retention_keep_last_n_and_keep_every(self, tmp_path):
         eng = make_engine(
             resilience={"checkpoint": {"keep_last_n": 2, "keep_every": 3}}
