@@ -115,12 +115,18 @@ def _timed_steps(engine, batches, steps, label):
     # dominates
     use_run = hasattr(engine, "train_batches") and not getattr(engine, "_offload", False)
     use_run = use_run and os.environ.get("DS_BENCH_RUN_API", "0") == "1"
-    # DS_TB_UNROLL: "1" = fully unrolled, an int k>1 = k bodies per
-    # while iteration (carry copies amortize 1/k), unset/"" = plain scan
+    # DS_TB_UNROLL: "full" = fully unrolled (no while loop), an int
+    # k >= 2 = partial unroll (k step bodies per while iteration, carry
+    # copies amortize 1/k), unset/""/"1" = plain scan.  "1" deliberately
+    # means the same as engine.train_batches(unroll=1) — the two
+    # surfaces used to give the literal 1 opposite meanings (ADVICE r5)
     _u = os.environ.get("DS_TB_UNROLL", "")
-    if _u and not _u.isdigit():
-        raise SystemExit(f"DS_TB_UNROLL must be an integer, got {_u!r}")
-    tb_unroll = True if _u == "1" else (int(_u) if _u and int(_u) > 1 else False)
+    if _u == "full":
+        tb_unroll = True
+    elif _u and not _u.isdigit():
+        raise SystemExit(f"DS_TB_UNROLL must be an integer or 'full', got {_u!r}")
+    else:
+        tb_unroll = int(_u) if _u else False  # 1 == plain scan, like the engine
     t0 = time.time()
     if use_run:
         # warm with the SAME n=steps program the windows time — an
@@ -199,6 +205,11 @@ def bench_model(cfg, micro_bs, gas, seq, steps, zero_stage, label, opt_params=No
 
     dt, phases = _timed_steps(engine, batches, steps, label)
 
+    if engine._sanitizer is not None:
+        # ds_san guards/signatures perturb the thing being measured;
+        # never let a sanitized number look like a clean record
+        log(f"[{label}] WARNING: ds_san is armed — timings include sanitizer overhead")
+
     tokens_per_sec_chip = global_bs * seq / dt / n_dev
     # Training FLOPs/token ≈ 6*N + 12*L*D*seq (attention term)
     n_params = cfg.num_params()
@@ -222,6 +233,7 @@ def bench_model(cfg, micro_bs, gas, seq, steps, zero_stage, label, opt_params=No
         "micro_bs": micro_bs,
         "gas": gas,
         "seq": seq,
+        **({"ds_san": True} if engine._sanitizer is not None else {}),
     }
 
 
@@ -323,6 +335,7 @@ def bench_bert(seq: int, micro_bs: int, gas: int, steps: int):
         "micro_bs": micro_bs,
         "gas": gas,
         "seq": seq,
+        **({"ds_san": True} if engine._sanitizer is not None else {}),
     }
 
 

@@ -1,15 +1,20 @@
-"""``deepspeed_tpu.analysis`` — ds_lint, the repo's JAX trace-safety and
-sharding static-analysis subsystem.
+"""``deepspeed_tpu.analysis`` — the repo's own correctness tooling:
+ds_lint (AST trace-safety/sharding static analysis) and ds_san (the
+trace-time & runtime sanitizer, :mod:`deepspeed_tpu.analysis.sanitizer`).
 
 Usage:
 
-* CLI: ``bin/ds_lint deepspeed_tpu/`` or ``python -m deepspeed_tpu.analysis``;
+* CLI: ``bin/ds_lint deepspeed_tpu/`` or ``python -m deepspeed_tpu.analysis``
+  (``sanitize`` subcommand dispatches to ds_san);
 * library: :func:`lint_paths` returns a structured :class:`LintResult`.
 
-Design: pure-``ast`` (never imports the linted code, no JAX needed at
-analysis time), a severity-tiered rule registry, inline suppressions
-(``# ds-lint: disable=<rule>``), and a checked-in baseline for
-grandfathered findings.  See docs/ds_lint.md for the rule catalog.
+Design: the lint path is pure-``ast`` (never imports the linted code, no
+JAX needed at analysis time), a severity-tiered rule registry, inline
+suppressions (``# ds-lint: disable=<rule>``), and a checked-in baseline
+for grandfathered findings.  ds_san reuses the same Finding/severity/
+baseline/suppression machinery at runtime (docs/ds_san.md); importing it
+(and therefore JAX) stays lazy so the linter keeps its sub-second start.
+See docs/ds_lint.md for the rule catalog.
 """
 from deepspeed_tpu.analysis.core import Finding, Rule, Severity, all_rules, get_rule, register
 from deepspeed_tpu.analysis.runner import LintResult, collect_py_files, lint_paths

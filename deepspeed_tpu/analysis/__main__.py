@@ -1,4 +1,8 @@
-"""``python -m deepspeed_tpu.analysis`` — same CLI as bin/ds_lint."""
+"""``python -m deepspeed_tpu.analysis`` — subcommand router:
+
+* ``sanitize [...]`` / ``sanitize -- <cmd>`` — ds_san runtime sanitizer;
+* ``lint [...]`` or bare paths — ds_lint (same CLI as bin/ds_lint).
+"""
 from deepspeed_tpu.analysis.cli import main
 
 main()

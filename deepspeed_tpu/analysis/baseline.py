@@ -77,7 +77,7 @@ def load(path: str) -> Set[str]:
     return {entry["fingerprint"] for entry in data["findings"]}
 
 
-def save(path: str, findings: List[Finding]) -> None:
+def save(path: str, findings: List[Finding], tool: str = "ds_lint") -> None:
     entries = [
         {
             "rule": f.rule,
@@ -89,5 +89,5 @@ def save(path: str, findings: List[Finding]) -> None:
         for f in sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))
     ]
     with open(path, "w") as f:
-        json.dump({"version": 1, "tool": "ds_lint", "findings": entries}, f, indent=1)
+        json.dump({"version": 1, "tool": tool, "findings": entries}, f, indent=1)
         f.write("\n")

@@ -1,4 +1,6 @@
-"""``ds_lint`` command-line interface.
+"""``ds_lint`` command-line interface (and the ``deepspeed_tpu.analysis``
+subcommand router: ``sanitize`` dispatches to ds_san, ``lint``/bare
+paths run the AST linter).
 
 Exit codes: 0 clean (or only findings below the failing tier), 1 new
 findings at/above the failing tier (default: tier A), 2 usage error.
@@ -71,6 +73,15 @@ def _summarize(result: LintResult, elapsed: float, fail_on: Severity, quiet: boo
 
 
 def cli_main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    if argv and argv[0] == "sanitize":
+        # the runtime sanitizer lives behind its own subcommand so the
+        # lint path stays jax-free (and sub-second)
+        from deepspeed_tpu.analysis.sanitizer.cli import sanitize_main
+
+        return sanitize_main(argv[1:])
+    if argv and argv[0] == "lint":
+        argv = argv[1:]
     args = _build_parser().parse_args(argv)
     if args.list_rules:
         _print_catalog()

@@ -1,0 +1,52 @@
+"""``deepspeed_tpu.analysis.sanitizer`` — ds_san, the trace-time &
+runtime sanitizer.
+
+ds_lint (the sibling AST linter) proves the *source* looks trace-safe;
+ds_san proves the *running program* stays on the hot path.  It is an
+opt-in instrumentation layer (config block ``sanitizer``, env
+``DS_SAN=1``, CLI ``python -m deepspeed_tpu.analysis sanitize``) that
+wraps the engine step, jit entry points, the overlap prefetcher and the
+resilience checkpoint paths with five checkers:
+
+* **recompile** — fingerprints abstract argument signatures per compiled
+  function; on a cache miss explains *which* arg's shape/dtype/static
+  value changed, and fails when compiles exceed a budget
+  (``san-recompile`` / ``san-recompile-storm``);
+* **transfer** — wires ``jax.transfer_guard`` around the hot region and
+  attributes any implicit device↔host transfer to a Python stack frame
+  (``san-transfer``);
+* **donation** — registers donated buffers per call site and attributes
+  use-after-donation errors to the donating call (``san-donation``);
+* **sharding** — compares actual ``Array.sharding`` of engine params /
+  optimizer state against the declared partition specs every N steps and
+  after checkpoint load (``san-sharding-drift``);
+* **nonfinite** — on a DivergenceGuard trip, re-runs the step's forward
+  under ``checkify`` to name the first op producing non-finite values
+  (``san-nonfinite``).
+
+Findings flow through the same :class:`~deepspeed_tpu.analysis.core.
+Finding` / severity / baseline machinery as ds_lint: one report format,
+one suppression syntax (``# ds-lint: disable=<rule>`` on the attributed
+line), one CI gate.  See docs/ds_san.md.
+"""
+from deepspeed_tpu.analysis.sanitizer.core import (  # noqa: F401
+    RULES,
+    Sanitizer,
+    TransferViolation,
+    caller_site,
+    get_active,
+    install,
+    maybe_from_config,
+    uninstall,
+)
+
+__all__ = [
+    "RULES",
+    "Sanitizer",
+    "TransferViolation",
+    "caller_site",
+    "get_active",
+    "install",
+    "maybe_from_config",
+    "uninstall",
+]

@@ -1,0 +1,200 @@
+"""``python -m deepspeed_tpu.analysis sanitize`` — the ds_san CLI.
+
+Three shapes:
+
+* ``sanitize`` — run the built-in smoke training loop with all five
+  checkers armed and one *seeded* violation per checker; verifies every
+  checker fired and that the storm + implicit-transfer findings are
+  attributed to the guilty source lines.  The sanitizer's self-test.
+* ``sanitize --clean`` — same loop with no seeded violations; gates on
+  any new finding at/above ``--fail-on`` (CI regression mode: the hot
+  path must stay sanitizer-clean).
+* ``sanitize -- <cmd> [args...]`` — run an arbitrary training command
+  with ``DS_SAN=1`` exported; the child's engine hooks record findings
+  and write a JSON report at exit, which this parent reads, filters
+  against ``.ds_san_baseline.json``, and gates on.
+
+Exit codes match ds_lint: 0 clean, 1 gate failure, 2 usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+from typing import List, Optional
+
+from deepspeed_tpu.analysis import baseline as baseline_mod
+from deepspeed_tpu.analysis.core import Severity
+
+SAN_BASELINE_NAME = ".ds_san_baseline.json"
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ds_san",
+        description="trace-time & runtime sanitizer for deepspeed_tpu "
+        "(recompile storms, implicit transfers, use-after-donation, "
+        "sharding drift, NaN provenance)",
+    )
+    p.add_argument("--clean", action="store_true", help="smoke loop without seeded violations (CI gate mode)")
+    p.add_argument("--steps", type=int, default=4, help="clean training steps in the smoke loop")
+    p.add_argument("--budget", type=int, default=None, help="compile budget per call site")
+    p.add_argument("--fail-on", default="A", choices=["A", "B", "C"], help="lowest tier that fails the gate")
+    p.add_argument("--baseline", metavar="PATH", help=f"baseline file (default: ./{SAN_BASELINE_NAME} if present)")
+    p.add_argument("--no-baseline", action="store_true", help="ignore any baseline file")
+    p.add_argument("--write-baseline", action="store_true", help="record current findings as the new baseline")
+    p.add_argument("--report", metavar="PATH", help="also write the JSON report here")
+    p.add_argument("--format", default="text", choices=["text", "json"], dest="fmt")
+    p.add_argument("cmd", nargs=argparse.REMAINDER, help="-- <command> to run under DS_SAN=1")
+    return p
+
+
+def _split_cmd(raw: List[str]) -> Optional[List[str]]:
+    if not raw:
+        return None
+    if raw[0] == "--":
+        raw = raw[1:]
+    return raw or None
+
+
+def _baseline_fps(args) -> set:
+    if args.no_baseline:
+        return set()
+    path = args.baseline or (SAN_BASELINE_NAME if os.path.isfile(SAN_BASELINE_NAME) else None)
+    if path and os.path.isfile(path):
+        return baseline_mod.load(path)
+    return set()
+
+
+def _gate(findings: List[dict], fail_on: Severity, known: set) -> List[dict]:
+    """New findings at/above the failing tier."""
+    return [
+        f for f in findings
+        if Severity.parse(f["severity"]) >= fail_on and f.get("fingerprint") not in known
+    ]
+
+
+def _print_findings(findings: List[dict], fmt: str, header: str = "") -> None:
+    if fmt == "json":
+        print(json.dumps({"findings": findings}, indent=1))
+        return
+    if header and findings:
+        print(header)
+    for f in findings:
+        print(f"{f['path']}:{f['line']}:{f.get('col', 1)}: [{f['severity']}] {f['rule']}: {f['message']}")
+
+
+def _run_smoke(args) -> int:
+    # A CPU dev box exposes one device; the drift/ZeRO paths need a real
+    # mesh.  Must happen before the first jax array op.
+    if os.environ.get("JAX_PLATFORMS", "cpu") in ("", "cpu") and "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    from deepspeed_tpu.analysis.sanitizer import core as san_core
+    from deepspeed_tpu.analysis.sanitizer.smoke import run_smoke
+    from deepspeed_tpu.config.config import SanitizerConfig
+
+    cfg_d = {"enabled": True}
+    if args.budget is not None:
+        cfg_d["compile_budget"] = args.budget
+    san = san_core.install(san_core.Sanitizer(SanitizerConfig.from_dict(cfg_d)))
+    try:
+        result = run_smoke(san, seed_violations=not args.clean, steps=args.steps)
+        san.assign_fingerprints()
+        report = san.to_json()
+        if args.report:
+            san.write_report(args.report)
+        findings = report["findings"]
+        known = _baseline_fps(args)
+        if args.write_baseline:
+            path = args.baseline or SAN_BASELINE_NAME
+            baseline_mod.save(path, san.findings, tool="ds_san")
+            print(f"ds_san: wrote {len(san.findings)} finding(s) to {path}")
+            return 0
+        _print_findings(findings, args.fmt)
+
+        fail_on = Severity.parse(args.fail_on)
+        rc = 0
+        if args.clean:
+            new = _gate(findings, fail_on, known)
+            if new:
+                print(f"ds_san: FAIL — {len(new)} new finding(s) at tier {args.fail_on}+ in the clean smoke loop")
+                rc = 1
+            else:
+                print(f"ds_san: clean smoke loop — no new findings ({len(findings)} total, {len(known)} baselined)")
+        else:
+            problems = result["missing"] + result["misattributed"]
+            unexpected = _gate(
+                [f for f in findings if any(
+                    f["rule"] == u.rule and f["line"] == u.line and f["path"] == u.path
+                    for u in result["unexpected"]
+                )],
+                fail_on, known,
+            )
+            for m in result["missing"]:
+                print(f"ds_san: self-test FAIL — checker did not fire: {m}")
+            for m in result["misattributed"]:
+                print(f"ds_san: self-test FAIL — wrong attribution: {m}")
+            if unexpected:
+                print(f"ds_san: self-test FAIL — {len(unexpected)} unexpected finding(s) in the clean phase")
+            if problems or unexpected:
+                rc = 1
+            else:
+                print(
+                    f"ds_san: self-test OK — all {len(result['verified'])} seeded checkers "
+                    "fired and attributed correctly "
+                    f"({', '.join(result['verified'])})"
+                )
+        return rc
+    finally:
+        san_core.uninstall()
+
+
+def _run_wrapped(args, cmd: List[str]) -> int:
+    env = dict(os.environ)
+    env["DS_SAN"] = "1"
+    report_path = args.report or os.path.join(
+        tempfile.mkdtemp(prefix="ds_san_"), "report.json"
+    )
+    env["DS_SAN_REPORT"] = report_path
+    if args.budget is not None:
+        env["DS_SAN_BUDGET"] = str(args.budget)
+    child = subprocess.call(cmd, env=env)
+    if not os.path.isfile(report_path):
+        print(
+            f"ds_san: wrapped command exited {child} and wrote no report at {report_path} "
+            "(did it build a DeepSpeedEngine?)",
+            file=sys.stderr,
+        )
+        return child if child != 0 else 2
+    with open(report_path) as f:
+        report = json.load(f)
+    findings = report.get("findings", [])
+    _print_findings(findings, args.fmt)
+    known = _baseline_fps(args)
+    new = _gate(findings, Severity.parse(args.fail_on), known)
+    if args.fmt == "text":
+        print(
+            f"ds_san: wrapped run exited {child}; {len(findings)} finding(s), "
+            f"{len(new)} new at tier {args.fail_on}+ ({len(known)} baselined)"
+        )
+    if child != 0:
+        return child
+    return 1 if new else 0
+
+
+def sanitize_main(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    cmd = _split_cmd(args.cmd)
+    if cmd:
+        return _run_wrapped(args, cmd)
+    return _run_smoke(args)
+
+
+if __name__ == "__main__":
+    sys.exit(sanitize_main())

@@ -1,0 +1,308 @@
+"""ds_san core: the :class:`Sanitizer` (checker registry + finding
+sink), call-site attribution, and the install/active machinery.
+
+Activation mirrors ``resilience.faults``: in production no sanitizer is
+installed and every engine hook is a near-free ``None`` check.  Under
+``DS_SAN=1`` (or a ``sanitizer`` config block with ``enabled: true``)
+one module-level :class:`Sanitizer` is installed and the hooks light up.
+
+Findings reuse :class:`deepspeed_tpu.analysis.core.Finding` so ds_lint
+and ds_san share one report format, one fingerprint/baseline mechanism
+(``.ds_san_baseline.json``) and one suppression syntax — a runtime
+finding attributed to ``file:line`` is suppressed by the same
+``# ds-lint: disable=<rule>`` pragma an AST finding would be.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import sys
+import traceback
+from typing import Any, Dict, List, Optional, Tuple
+
+from deepspeed_tpu.analysis.core import Finding, Severity
+
+# rule id -> (tier, description).  Tier A fails the gate on new findings.
+RULES: Dict[str, Tuple[Severity, str]] = {
+    "san-recompile": (
+        Severity.B,
+        "a compiled function re-traced: the abstract signature of its arguments changed",
+    ),
+    "san-recompile-storm": (
+        Severity.A,
+        "compiles for one call site exceeded the budget (silent recompilation storm)",
+    ),
+    "san-transfer": (
+        Severity.A,
+        "implicit device<->host transfer inside a guarded hot region",
+    ),
+    "san-donation": (
+        Severity.A,
+        "use of a buffer after it was donated to a compiled call",
+    ),
+    "san-sharding-drift": (
+        Severity.A,
+        "actual Array.sharding drifted from the declared partition spec",
+    ),
+    "san-nonfinite": (
+        Severity.A,
+        "non-finite values produced by the step (first guilty op named by checkify)",
+    ),
+}
+
+
+class TransferViolation(RuntimeError):
+    """Raised (after the finding is recorded) when the transfer guard
+    trips — carries the attributed site so callers can decide to swallow
+    (smoke/test fixtures) or propagate (real training loops)."""
+
+    def __init__(self, message: str, finding: Optional[Finding] = None):
+        super().__init__(message)
+        self.finding = finding
+
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))  # .../analysis
+_SAN_DIR = os.path.join(_PKG_DIR, "sanitizer")
+_DSTPU_DIR = os.path.dirname(_PKG_DIR)  # .../deepspeed_tpu
+
+
+def _is_internal(path: str) -> bool:
+    """Frames the attribution walk skips: the sanitizer itself, jax/
+    jaxlib internals, stdlib importlib/contextlib plumbing.  smoke.py is
+    exempt — it plays the user code whose guilty lines the self-test
+    must attribute."""
+    p = path.replace(os.sep, "/")
+    if p.endswith("/analysis/sanitizer/smoke.py"):
+        return False
+    if "/analysis/sanitizer/" in p:
+        return True
+    for marker in ("/jax/", "/jaxlib/", "/jax_graft/", "/contextlib.py", "/importlib/"):
+        if marker in p:
+            return True
+    return False
+
+
+_ENGINE_FRAME_SUFFIXES = ("runtime/engine.py", "runtime/checkpointing.py")
+
+
+def caller_site(tb=None, skip_engine: bool = False) -> Tuple[str, int, str]:
+    """``(path, line, function)`` of the frame a finding should anchor
+    to.  From a traceback (``tb``) the walk takes the DEEPEST non-internal
+    frame — the line that wrote the violating call.  From the live stack
+    it takes the NEAREST non-internal caller.  ``skip_engine`` also steps
+    over ``runtime/engine.py`` / ``runtime/checkpointing.py`` frames: a
+    storm caused by a user loop feeding drifting shapes belongs to the
+    loop, not to ``engine.train_batch``, and a drift found on restore
+    belongs to the ``load_checkpoint`` call site — anchoring at a fixed
+    library line would make every occurrence share one fingerprint."""
+    if tb is not None:
+        frames = traceback.extract_tb(tb)
+    else:
+        frames = traceback.extract_stack()[:-1]  # drop caller_site itself
+        frames = list(reversed(frames))  # nearest caller first
+    candidates = [f for f in frames if not _is_internal(f.filename)]
+    if skip_engine:
+        candidates = [
+            f for f in candidates
+            if not f.filename.replace(os.sep, "/").endswith(_ENGINE_FRAME_SUFFIXES)
+        ] or candidates
+    if tb is not None:
+        pick = candidates[-1] if candidates else (frames[-1] if frames else None)
+    else:
+        pick = candidates[0] if candidates else (frames[0] if frames else None)
+    if pick is None:
+        return ("<unknown>", 0, "<unknown>")
+    return (pick.filename, pick.lineno or 0, pick.name)
+
+
+class Sanitizer:
+    """Checker registry + finding sink for one sanitized run.
+
+    ``config`` is a ``deepspeed_tpu.config.config.SanitizerConfig`` (or
+    anything duck-typed like one); ``None`` means all checkers at the
+    default budgets."""
+
+    def __init__(self, config: Any = None):
+        from deepspeed_tpu.analysis.sanitizer.donation import DonationTracker
+        from deepspeed_tpu.analysis.sanitizer.drift import ShardingDriftChecker
+        from deepspeed_tpu.analysis.sanitizer.nanprobe import NanProbe
+        from deepspeed_tpu.analysis.sanitizer.recompile import RecompileDetector
+        from deepspeed_tpu.analysis.sanitizer.transfer import TransferChecker
+
+        if config is None:
+            from deepspeed_tpu.config.config import SanitizerConfig
+
+            config = SanitizerConfig(enabled=True)
+        self.config = config
+        self.findings: List[Finding] = []
+        self._suppressed = 0
+        self._sources: Dict[str, str] = {}  # path -> source (for fingerprints)
+        self._suppressions: Dict[str, Any] = {}  # path -> Suppressions
+        checkers = set(config.checkers)
+        self.recompile = RecompileDetector(
+            self, enabled="recompile" in checkers, budget=config.compile_budget
+        )
+        self.transfer = TransferChecker(self, enabled="transfer" in checkers)
+        self.donation = DonationTracker(self, enabled="donation" in checkers)
+        self.drift = ShardingDriftChecker(
+            self, enabled="sharding" in checkers, interval=config.drift_interval
+        )
+        self.nanprobe = NanProbe(self, enabled="nonfinite" in checkers)
+
+    # -- finding sink ---------------------------------------------------
+    def _suppressed_at(self, rule: str, path: str, line: int) -> bool:
+        """Same pragma syntax as ds_lint, applied to the attributed line."""
+        if path not in self._suppressions:
+            src = ""
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    src = f.read()
+            except OSError:
+                pass
+            self._sources[path] = src
+            from deepspeed_tpu.analysis.context import parse_suppressions
+
+            self._suppressions[path] = parse_suppressions(src)
+        return self._suppressions[path].is_suppressed(rule, line)
+
+    def record(
+        self,
+        rule: str,
+        message: str,
+        site: Optional[Tuple[str, int, str]] = None,
+        severity: Optional[Severity] = None,
+    ) -> Optional[Finding]:
+        """Build + store one finding; returns None if an inline pragma on
+        the attributed line suppresses it."""
+        tier, _ = RULES[rule]
+        path, line, func = site if site is not None else caller_site()
+        if self._suppressed_at(rule, path, line):
+            self._suppressed += 1
+            return None
+        f = Finding(
+            rule=rule,
+            path=path,
+            line=line,
+            col=1,
+            message=f"{message} [in {func}]" if func not in ("<unknown>", "") else message,
+            severity=severity if severity is not None else tier,
+        )
+        self.findings.append(f)
+        from deepspeed_tpu.utils.logging import logger
+
+        logger.warning(f"ds_san: {f.format()}")
+        return f
+
+    # -- reporting ------------------------------------------------------
+    def assign_fingerprints(self, root: Optional[str] = None) -> None:
+        from deepspeed_tpu.analysis import baseline as baseline_mod
+
+        baseline_mod.assign_fingerprints(
+            self.findings, root or os.getcwd(), self._sources
+        )
+
+    def to_json(self) -> Dict[str, Any]:
+        self.assign_fingerprints()
+        return {
+            "tool": "ds_san",
+            "findings": [
+                {
+                    "rule": f.rule, "path": f.path, "line": f.line, "col": f.col,
+                    "severity": f.severity.name, "message": f.message,
+                    "fingerprint": f.fingerprint,
+                }
+                for f in self.findings
+            ],
+            "suppressed": self._suppressed,
+            "compiles": self.recompile.compile_counts(),
+        }
+
+    def write_report(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_json(), f, indent=1)
+            f.write("\n")
+
+    def summary(self) -> str:
+        tiers = ", ".join(
+            f"{sum(1 for f in self.findings if f.severity == t)} tier-{t.name}"
+            for t in (Severity.A, Severity.B, Severity.C)
+        )
+        bits = [f"{len(self.findings)} finding(s) ({tiers})"]
+        if self._suppressed:
+            bits.append(f"{self._suppressed} suppressed")
+        return f"ds_san: {', '.join(bits)}"
+
+    def print_report(self, stream=None) -> None:
+        stream = stream or sys.stderr
+        for f in self.findings:
+            print(f.format(), file=stream)
+        print(self.summary(), file=stream)
+
+
+# -- module-level activation (faults.py idiom) --------------------------
+_ACTIVE: Optional[Sanitizer] = None
+
+
+def get_active() -> Optional[Sanitizer]:
+    return _ACTIVE
+
+
+def install(san: Sanitizer) -> Sanitizer:
+    global _ACTIVE
+    _ACTIVE = san
+    return san
+
+
+def uninstall() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+_ATEXIT_ARMED = False
+
+
+def _atexit_report() -> None:
+    san = _ACTIVE
+    if san is None:
+        return
+    path = (
+        getattr(san.config, "report_path", None)
+        or os.environ.get("DS_SAN_REPORT")
+        or "ds_san_report.json"
+    )
+    try:
+        san.write_report(path)
+    except OSError as e:
+        print(f"ds_san: could not write report to {path}: {e}", file=sys.stderr)
+    san.print_report()
+
+
+def maybe_from_config(config: Any = None) -> Optional[Sanitizer]:
+    """The engine's activation point: return the already-installed
+    sanitizer (CLI/smoke installed one), or build+install one when the
+    config block or ``DS_SAN=1`` asks for it, else None.  Env-driven
+    runs get an atexit report writer (``DS_SAN_REPORT``, default
+    ``ds_san_report.json``) so ``sanitize -- <cmd>`` can collect
+    findings from the child process."""
+    global _ATEXIT_ARMED
+    cfg_on = config is not None and getattr(config, "enabled", False)
+    if config is not None and getattr(config, "_explicit", False) and not cfg_on:
+        # a config block that SAYS `enabled: false` opts this engine out
+        # even of a process-wide (env/CLI-installed) sanitizer
+        return None
+    if _ACTIVE is not None:
+        return _ACTIVE
+    env_on = os.environ.get("DS_SAN", "") == "1"
+    if not (env_on or cfg_on):
+        return None
+    if not cfg_on:
+        # env-armed: a knobs-only config block still supplies the tuning
+        from deepspeed_tpu.config.config import SanitizerConfig
+
+        config = SanitizerConfig.from_env(base=config)
+    san = install(Sanitizer(config))
+    if not _ATEXIT_ARMED:
+        atexit.register(_atexit_report)
+        _ATEXIT_ARMED = True
+    return san

@@ -1,0 +1,117 @@
+"""Donation checker: attribute use-after-donation.
+
+``donate_argnums`` frees an input buffer the moment the compiled call
+consumes it; a stale Python reference then raises JAX's bare
+``Array has been deleted`` with no hint of *who* donated it or *when*.
+The tracker registers every donated leaf (id -> donating site, step,
+aval) as the engine hands its state to a donated executable — JAX's
+deletion is the poison; the registry is what turns the poison into an
+attributed diagnosis:
+
+* :meth:`watch` — context manager that converts a deleted-array
+  ``RuntimeError`` into a ``san-donation`` finding naming the donating
+  call site and step, then re-raises (semantics are unchanged — the
+  value really is gone);
+* :meth:`check_live` — proactive sweep of a pytree for already-deleted
+  leaves (the engine runs it over checkpoint-save inputs, where feeding
+  a donated buffer would otherwise surface as a mid-save crash).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+from deepspeed_tpu.analysis.sanitizer.core import caller_site
+
+
+def _is_deleted_error(e: BaseException) -> bool:
+    return "deleted" in str(e).lower() and "array" in str(e).lower()
+
+
+class DonationTracker:
+    def __init__(self, san, enabled: bool = True, max_entries: int = 4096):
+        self.san = san
+        self.enabled = enabled
+        self.max_entries = max_entries
+        # id(arr) -> (site label, step, "dtype[shape]")
+        self._donated: Dict[int, Tuple[str, int, str]] = {}
+
+    def note(self, tree: Any, site: str, step: int = -1) -> None:
+        """Register the leaves of ``tree`` as donated at ``site``.  Call
+        with the *pre-call* references of a ``donate_argnums`` argument."""
+        if not self.enabled:
+            return
+        import jax
+
+        for leaf in jax.tree.leaves(tree):
+            if hasattr(leaf, "is_deleted"):
+                if len(self._donated) >= self.max_entries:
+                    self._donated.clear()  # bounded: ids recycle anyway
+                # jax's deleted-array message spells avals dtype[d0,d1]
+                shape = ",".join(str(d) for d in getattr(leaf, "shape", ()))
+                aval = f"{getattr(leaf, 'dtype', '?')}[{shape}]"
+                self._donated[id(leaf)] = (site, step, aval)
+
+    def lookup(self, arr: Any) -> Optional[Tuple[str, int, str]]:
+        return self._donated.get(id(arr))
+
+    def check_live(self, tree: Any, label: str) -> int:
+        """Report every already-deleted leaf in ``tree``; returns the
+        count (0 = all live)."""
+        if not self.enabled:
+            return 0
+        import jax
+
+        hits = 0
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+            if hasattr(leaf, "is_deleted") and leaf.is_deleted():
+                hits += 1
+                info = self.lookup(leaf)
+                donated = (
+                    f"donated to '{info[0]}' at step {info[1]} ({info[2]})"
+                    if info
+                    else "donated by an untracked call"
+                )
+                self.san.record(
+                    "san-donation",
+                    f"'{label}' leaf {jax.tree_util.keystr(path)} is deleted — {donated}",
+                    site=caller_site(skip_engine=True),
+                )
+        return hits
+
+    def watch(self, label: str = "use"):
+        """Context manager: a deleted-array error inside becomes an
+        attributed ``san-donation`` finding, then re-raises."""
+        return _Watch(self, label)
+
+
+class _Watch:
+    def __init__(self, tracker: DonationTracker, label: str):
+        self.tracker = tracker
+        self.label = label
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc is None or not self.tracker.enabled:
+            return False
+        if isinstance(exc, RuntimeError) and _is_deleted_error(exc):
+            # best-effort provenance: JAX's message names the aval; match
+            # it against the registry to recover the donating site
+            msg = str(exc).splitlines()[0]
+            compact = msg.replace(" ", "")
+            origin = None
+            for site, step, aval in self.tracker._donated.values():
+                if aval in compact:  # exact dtype[shape] token; latest wins
+                    origin = (site, step, aval)
+            donated = (
+                f"donated to '{origin[0]}' at step {origin[1]} ({origin[2]})"
+                if origin
+                else "donating call not in the registry"
+            )
+            self.tracker.san.record(
+                "san-donation",
+                f"use-after-donation in '{self.label}': {msg} — {donated}",
+                site=caller_site(tb=tb),
+            )
+        return False  # never swallow: the value really is gone

@@ -1,0 +1,91 @@
+"""Sharding-drift checker.
+
+The engine declares a partition spec for every state leaf
+(``engine._state_shardings``) and pins compiled outputs to it with
+``out_shardings`` — but host-side mutation (checkpoint restore through a
+different path, a user poking ``engine.state``, an elastic resize bug)
+can leave a leaf placed differently than declared.  GSPMD will happily
+keep running: it inserts resharding collectives at the next step, the
+program is *correct* and silently slower — exactly the class of
+regression arXiv:2004.13336 shows erases a sharded-update win.  The
+checker compares actual ``Array.sharding`` against the declared spec
+(``Sharding.is_equivalent_to``, which normalizes replicated-axis
+spellings) every N steps and after checkpoint load.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from deepspeed_tpu.analysis.sanitizer.core import caller_site
+
+
+class ShardingDriftChecker:
+    def __init__(self, san, enabled: bool = True, interval: int = 16):
+        self.san = san
+        self.enabled = enabled
+        self.interval = max(1, int(interval))
+        self._last_checked_step = -1
+
+    def due(self, step: int) -> bool:
+        """True when at least ``interval`` steps passed since the last
+        sweep (the engine calls this once per optimizer-step boundary).
+        Interval-crossing, not modulo: ``train_batches`` advances the
+        step count by whole runs and overflow skips shift it, so exact
+        multiples can be arbitrarily rare."""
+        if not self.enabled:
+            return False
+        return step - self._last_checked_step >= self.interval
+
+    def check(self, tree: Any, declared: Any, label: str, step: int = -1) -> int:
+        """Compare every array leaf's actual sharding against the
+        declared sharding tree (same structure).  Returns the number of
+        drifted leaves."""
+        if not self.enabled:
+            return 0
+        import jax
+
+        self._last_checked_step = step
+        site = caller_site(skip_engine=True)
+        drifted = 0
+        actual_leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+        declared_leaves = jax.tree_util.tree_leaves(declared)
+        if len(actual_leaves) != len(declared_leaves):
+            self.san.record(
+                "san-sharding-drift",
+                f"'{label}': state has {len(actual_leaves)} leaves but the declared "
+                f"sharding tree has {len(declared_leaves)} — structures diverged",
+                site=site,
+            )
+            return 1
+        for (path, leaf), want in zip(actual_leaves, declared_leaves):
+            have = getattr(leaf, "sharding", None)
+            if have is None or not hasattr(want, "is_equivalent_to"):
+                continue
+            try:
+                same = want.is_equivalent_to(have, getattr(leaf, "ndim", 0))
+            except (ValueError, TypeError):
+                same = want == have
+            if not same:
+                drifted += 1
+                self.san.record(
+                    "san-sharding-drift",
+                    f"'{label}' leaf {jax.tree_util.keystr(path)}: declared "
+                    f"{_spec_str(want)} but placed {_spec_str(have)}"
+                    + (f" at step {step}" if step >= 0 else ""),
+                    site=site,
+                )
+        return drifted
+
+    def check_state(self, engine, label: str = "engine.state", step: int = -1) -> int:
+        """Engine state vs its declared sharding tree (the per-N-steps
+        and post-checkpoint-load hook)."""
+        if not self.enabled:
+            return 0
+        if step < 0:
+            step = getattr(engine, "_host_global_step", -1)
+        return self.check(engine.state, engine._state_shardings, label, step=step)
+
+
+def _spec_str(sh: Any) -> str:
+    spec = getattr(sh, "spec", None)
+    return f"{spec}" if spec is not None else f"{sh}"

@@ -1,0 +1,144 @@
+"""Recompile-storm detector.
+
+A jit cache miss is fully determined by the abstract signature of the
+call — leaf shapes/dtypes/shardings, pytree structure, and the values of
+non-array ("static") leaves.  The detector fingerprints that signature
+per call site; when a site compiles a second time it diffs the new
+signature against the previous one and says *which* leaf changed (the
+information XLA's "compiling ..." log line never gives you), and when a
+site's compile count exceeds the budget it escalates to tier-A
+``san-recompile-storm`` — the silent storm that turns a 200ms step into
+a 2-minute one.
+
+Two entry points:
+
+* :meth:`RecompileDetector.note` — called by the engine exactly where it
+  builds an executable (``_get_compiled`` / ``train_batch`` /
+  ``train_batches``), with the argument trees it is compiling for;
+* :meth:`RecompileDetector.wrap` — wraps any jitted callable so each
+  call computes the signature and misses are detected without engine
+  cooperation (the CLI smoke loop and user code use this).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from deepspeed_tpu.analysis.sanitizer.core import caller_site
+
+
+def _leaf_sig(leaf: Any) -> Tuple:
+    """Hashable abstract signature of one pytree leaf."""
+    shape = getattr(leaf, "shape", None)
+    if shape is not None:
+        sharding = getattr(leaf, "sharding", None)
+        return ("array", tuple(shape), str(getattr(leaf, "dtype", "?")), str(sharding))
+    return ("static", repr(leaf)[:120])
+
+
+def signature(tree: Any) -> Tuple:
+    """Abstract signature of an argument pytree, with leaf paths so a
+    diff can name the guilty leaf."""
+    import jax
+
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return tuple(
+        (jax.tree_util.keystr(path), _leaf_sig(leaf)) for path, leaf in leaves
+    )
+
+
+def diff_signatures(old: Tuple, new: Tuple) -> str:
+    """Human explanation of the first difference between two signatures."""
+    if len(old) != len(new):
+        return f"pytree structure changed: {len(old)} -> {len(new)} leaves"
+    for (op, osig), (np_, nsig) in zip(old, new):
+        if op != np_:
+            return f"pytree keys changed: {op!r} -> {np_!r}"
+        if osig != nsig:
+            kind = osig[0]
+            if kind == "array" and nsig[0] == "array":
+                parts = []
+                for name, i in (("shape", 1), ("dtype", 2), ("sharding", 3)):
+                    if osig[i] != nsig[i]:
+                        parts.append(f"{name} {osig[i]} -> {nsig[i]}")
+                return f"arg '{op}' changed: {', '.join(parts)}"
+            return f"arg '{op}' changed: {osig} -> {nsig}"
+    return "signature change not in the argument list (donation/compiler options?)"
+
+
+class RecompileDetector:
+    def __init__(self, san, enabled: bool = True, budget: int = 8):
+        self.san = san
+        self.enabled = enabled
+        self.budget = max(1, int(budget))
+        # site -> [count, last_signature]
+        self._sites: Dict[str, List] = {}
+
+    def compile_counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for key, rec in self._sites.items():
+            name = key[1] if isinstance(key, tuple) else key
+            out[name] = out.get(name, 0) + rec[0]
+        return out
+
+    def note(
+        self,
+        site: str,
+        args: Any = None,
+        call_site: Optional[Tuple[str, int, str]] = None,
+        owner: Any = None,
+    ) -> None:
+        """Record one compile event for ``site``.  ``args``: the argument
+        pytree(s) the executable is being built for (None when the caller
+        has no useful tree — only budget counting then).  ``owner``
+        scopes the count: two engines in one sanitized process each get
+        their own first-compile grace for the same logical site name."""
+        if not self.enabled:
+            return
+        sig = signature(args) if args is not None else None
+        rec = self._sites.setdefault((owner, site) if owner is not None else site, [0, None])
+        rec[0] += 1
+        count, prev = rec[0], rec[1]
+        rec[1] = sig
+        if count == 1:
+            return  # first compile is the expected one
+        where = call_site if call_site is not None else caller_site(skip_engine=True)
+        why = diff_signatures(prev, sig) if (prev is not None and sig is not None) else "argument diff unavailable"
+        if count > self.budget:
+            self.san.record(
+                "san-recompile-storm",
+                f"'{site}' compiled {count}x (budget {self.budget}): {why}",
+                site=where,
+            )
+        else:
+            self.san.record(
+                "san-recompile",
+                f"'{site}' compiled {count}x: {why}",
+                site=where,
+            )
+
+    def wrap(self, fn, site: Optional[str] = None):
+        """Wrap a jitted callable: every call computes the abstract
+        signature of its arguments; signatures not seen before are cache
+        misses by construction and are reported through :meth:`note`
+        (attributed to the *calling* line, where the drifting shape comes
+        from).  ``.lower``/other jit attributes pass through."""
+        if not self.enabled:
+            return fn
+        detector = self
+        label = site or getattr(fn, "__name__", None) or repr(fn)
+
+        class _Wrapped:
+            def __init__(self):
+                self._seen = set()
+
+            def __call__(self, *a, **kw):
+                sig = signature((a, kw))
+                if sig not in self._seen:
+                    self._seen.add(sig)
+                    detector.note(label, (a, kw), call_site=caller_site())
+                return fn(*a, **kw)
+
+            def __getattr__(self, name):
+                return getattr(fn, name)
+
+        return _Wrapped()

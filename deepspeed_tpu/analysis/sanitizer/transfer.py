@@ -1,0 +1,95 @@
+"""Transfer guard: attribute implicit device↔host transfers.
+
+Wires ``jax.transfer_guard("disallow")`` around guarded regions (the
+engine step, the prefetcher's place stage, the CLI smoke loop).
+Explicit transfers — ``jax.device_put`` / ``jax.device_get`` — always
+pass; an *implicit* one (``float(loss)``, ``np.asarray(device_arr)``,
+mixing a host constant into device math, which re-stages bytes through
+the host every step) raises inside XLA.  The checker converts that into
+a ``san-transfer`` finding anchored at the deepest user frame of the
+traceback — the line that wrote the implicit transfer — then raises
+:class:`TransferViolation` so the caller decides whether to continue
+(fixtures, smoke loop) or die loudly (default sanitize runs).
+
+On CPU backends device→host reads are zero-copy and do not trip the
+guard; host→device staging (the common per-step cost on TPU) trips on
+every backend, which is what the CI fixtures exercise.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+from deepspeed_tpu.analysis.sanitizer.core import TransferViolation, caller_site
+
+
+def _is_guard_error(e: BaseException) -> bool:
+    s = str(e)
+    return "Disallowed" in s and "transfer" in s
+
+
+class TransferChecker:
+    def __init__(self, san, enabled: bool = True, level: str = "disallow"):
+        self.san = san
+        self.enabled = enabled
+        self.level = level
+        self._depth = 0  # nested guards: inner io_region must not re-arm
+
+    @contextlib.contextmanager
+    def guard(self, region: str = "region"):
+        """Guarded hot region: implicit transfers inside become
+        ``san-transfer`` findings + :class:`TransferViolation`."""
+        if not self.enabled:
+            yield
+            return
+        import jax
+
+        self._depth += 1
+        try:
+            with jax.transfer_guard(self.level):
+                yield
+        except Exception as e:  # XlaRuntimeError has no stable import path
+            if isinstance(e, TransferViolation) or not _is_guard_error(e):
+                # an inner nested guard already recorded + wrapped this
+                # violation; re-recording would double-count it
+                raise
+            site = caller_site(tb=e.__traceback__)
+            detail = str(e).splitlines()[0]
+            finding = self.san.record(
+                "san-transfer",
+                f"implicit transfer in guarded region '{region}': {detail}",
+                site=site,
+            )
+            raise TransferViolation(
+                f"ds_san: implicit transfer at {site[0]}:{site[1]} "
+                f"(region '{region}'): {detail}",
+                finding=finding,
+            ) from e
+        finally:
+            self._depth -= 1
+
+    @contextlib.contextmanager
+    def io_region(self):
+        """Checkpoint/host-I/O region: transfers are the *job* here, so
+        the guard is relaxed to 'allow' (still nested-safe inside an
+        armed ``guard``)."""
+        if not self.enabled or self._depth == 0:
+            yield
+            return
+        import jax
+
+        with jax.transfer_guard("allow"):
+            yield
+
+    def wrap_callable(self, fn, region: str):
+        """``fn`` executed under :meth:`guard` — used to instrument the
+        prefetcher's place stage without importing sanitizer types
+        there."""
+        if not self.enabled:
+            return fn
+
+        def wrapped(*a, **kw):
+            with self.guard(region):
+                return fn(*a, **kw)
+
+        return wrapped

@@ -598,6 +598,86 @@ class OverlapConfig:
 
 
 @dataclass
+class SanitizerConfig:
+    """``sanitizer`` block (ds_san; docs/ds_san.md).  Opt-in runtime
+    checkers around the engine step: recompile-storm detection, implicit
+    transfer attribution, use-after-donation, sharding drift, NaN
+    provenance.  ``DS_SAN=1`` activates the env defaults without a
+    config edit — the launch-time switch arms the sanitizer even when
+    this block is absent or says disabled."""
+
+    enabled: bool = C.SAN_ENABLED_DEFAULT
+    checkers: List[str] = field(default_factory=lambda: list(C.SAN_CHECKERS))
+    compile_budget: int = C.SAN_COMPILE_BUDGET_DEFAULT
+    drift_interval: int = C.SAN_DRIFT_INTERVAL_DEFAULT
+    report_path: Optional[str] = None
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "SanitizerConfig":
+        if d is None:
+            return cls()
+        d = dict(d)
+        explicit_enabled = "enabled" in d
+        raw = _pop(d, "checkers", None)
+        checkers = list(C.SAN_CHECKERS) if raw is None else [str(c).lower() for c in raw]
+        out = cls(
+            enabled=bool(_pop(d, "enabled", C.SAN_ENABLED_DEFAULT)),
+            checkers=checkers,
+            compile_budget=int(_pop(d, "compile_budget", C.SAN_COMPILE_BUDGET_DEFAULT)),
+            drift_interval=int(_pop(d, "drift_interval", C.SAN_DRIFT_INTERVAL_DEFAULT)),
+            report_path=_pop(d, "report_path", None),
+        )
+        _check_empty(d, C.SANITIZER, _known_keys(cls))
+        unknown = set(out.checkers) - set(C.SAN_CHECKERS)
+        if unknown:
+            raise DeepSpeedConfigError(
+                f"'{C.SANITIZER}.checkers' has unknown checker(s) "
+                f"{sorted(unknown)}; valid: {C.SAN_CHECKERS}"
+            )
+        if out.compile_budget < 1:
+            raise DeepSpeedConfigError(
+                f"'{C.SANITIZER}.compile_budget' must be >= 1, got {out.compile_budget}"
+            )
+        if out.drift_interval < 1:
+            raise DeepSpeedConfigError(
+                f"'{C.SANITIZER}.drift_interval' must be >= 1, got {out.drift_interval}"
+            )
+        # an `enabled` key written in the JSON is an explicit decision:
+        # `enabled: false` there opts the engine out even of a
+        # process-wide (env/CLI-installed) sanitizer — but a block that
+        # only tunes knobs must not disarm a DS_SAN=1 launch
+        out._explicit = explicit_enabled
+        return out
+
+    @classmethod
+    def from_env(cls, base: Optional["SanitizerConfig"] = None) -> "SanitizerConfig":
+        """``DS_SAN=1`` defaults, refined by ``DS_SAN_CHECKERS`` (comma
+        list), ``DS_SAN_BUDGET`` and ``DS_SAN_DRIFT_INTERVAL``.  ``base``
+        (a knobs-only config block from the JSON) supplies the starting
+        values so an env-armed launch keeps the block's tuning."""
+        import os
+
+        d: Dict[str, Any] = {"enabled": os.environ.get("DS_SAN", "") == "1"}
+        if base is not None:
+            d.update(
+                checkers=list(base.checkers),
+                compile_budget=base.compile_budget,
+                drift_interval=base.drift_interval,
+                report_path=base.report_path,
+            )
+        raw = os.environ.get("DS_SAN_CHECKERS")
+        if raw:
+            d["checkers"] = [c.strip() for c in raw.split(",") if c.strip()]
+        if os.environ.get("DS_SAN_BUDGET"):
+            d["compile_budget"] = int(os.environ["DS_SAN_BUDGET"])
+        if os.environ.get("DS_SAN_DRIFT_INTERVAL"):
+            d["drift_interval"] = int(os.environ["DS_SAN_DRIFT_INTERVAL"])
+        if os.environ.get("DS_SAN_REPORT"):
+            d["report_path"] = os.environ["DS_SAN_REPORT"]
+        return cls.from_dict(d)
+
+
+@dataclass
 class ActivationCheckpointingConfig:
     """Reference ``runtime/activation_checkpointing/config.py``.  On TPU,
     ``partition_activations`` maps to sharding saved residuals over the
@@ -847,6 +927,7 @@ _KNOWN_TOP_LEVEL = {
     C.MESH,
     C.RESILIENCE,
     C.OVERLAP,
+    C.SANITIZER,
     "activation_checkpointing",
     "flops_profiler",
     "aio",
@@ -908,6 +989,7 @@ class DeepSpeedConfig:
         self.sparse_attention = SparseAttentionConfig.from_dict(d.get("sparse_attention"))
         self.resilience = ResilienceConfig.from_dict(d.get(C.RESILIENCE))
         self.overlap = OverlapConfig.from_dict(d.get(C.OVERLAP))
+        self.sanitizer = SanitizerConfig.from_dict(d.get(C.SANITIZER))
         self.elasticity_dict = d.get("elasticity")
 
         self.gradient_clipping = float(d.get(C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT))

@@ -198,6 +198,15 @@ OVERLAP_TIMELINE = "timeline"
 TIMELINE_ENABLED_DEFAULT = True
 TIMELINE_WINDOW_DEFAULT = 512  # steps retained for summaries
 
+#############################################
+# Sanitizer (ds_san: trace-time & runtime checkers; docs/ds_san.md)
+#############################################
+SANITIZER = "sanitizer"
+SAN_ENABLED_DEFAULT = False
+SAN_CHECKERS = ["recompile", "transfer", "donation", "sharding", "nonfinite"]
+SAN_COMPILE_BUDGET_DEFAULT = 8  # compiles per call site before storm
+SAN_DRIFT_INTERVAL_DEFAULT = 16  # steps between sharding-drift sweeps
+
 RESILIENCE_DIVERGENCE = "divergence"
 DIVERGENCE_ENABLED_DEFAULT = True
 DIVERGENCE_THRESHOLD_DEFAULT = 20

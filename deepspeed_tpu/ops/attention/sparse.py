@@ -779,35 +779,55 @@ def _layout_dkv_edges(layout: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.nd
 
     Returns (qidx, kcol, flags), each (LH, E) int32 where LH = 1 for
     head-uniform layouts (SMEM: see `_splash_prep`) else H; flags bit0 =
-    edge valid, bit1 = first edge of its column run, bit2 = last."""
+    edge valid, bit1 = first edge of its column run, bit2 = last.
+
+    Runs at every backward trace, so it is fully vectorized (nonzero on
+    the transposed layout gives the column-major order directly) and
+    cached per layout fingerprint — the r5 pure-Python enumeration was
+    O(H·nb²) tuple churn (~65k allocations/head at 32k seq, block 128)."""
     if _head_uniform(layout):
-        layout = layout[:1]
+        layout = layout[:1]  # before the key: fingerprint 1/H of the bytes
+    return _layout_dkv_edges_cached(
+        layout.shape, str(layout.dtype), np.ascontiguousarray(layout).tobytes()
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _layout_dkv_edges_cached(
+    shape: Tuple[int, ...], dtype: str, data: bytes
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    layout = np.frombuffer(data, dtype=dtype).reshape(shape)
     H, nb, _ = layout.shape
     dense_mask = _dense_row_mask(layout, exempt_uniform_full=True)
-    per_head: List[List[Tuple[int, int, int]]] = []
+    per_head: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
     for h in range(H):
-        edges: List[Tuple[int, int, int]] = []
-        for c in range(nb):
-            rows = [int(r) for r in np.nonzero(layout[h, :, c])[0] if not dense_mask[h, r]]
-            if rows:
-                edges.extend((r, c, 1) for r in rows)
-            else:
-                edges.append((0, c, 0))
-        per_head.append(edges)
-    E = max(len(e) for e in per_head)
+        keep = (layout[h] != 0) & ~dense_mask[h][:, None]  # (row, col)
+        # nonzero on the transpose enumerates sorted by (col, row) — the
+        # exact column-major order the kernel's run detection needs
+        cols, rows = np.nonzero(keep.T)
+        empty = np.nonzero(~keep.any(axis=0))[0]  # columns with no edge
+        c = np.concatenate([cols, empty])
+        r = np.concatenate([rows, np.zeros(len(empty), np.intp)])
+        ok = np.concatenate([np.ones(len(cols), np.int32), np.zeros(len(empty), np.int32)])
+        # stable: preserves ascending-row order within each real column
+        # (empty columns contribute exactly one edge, so order is total)
+        order = np.argsort(c, kind="stable")
+        c, r, ok = c[order], r[order], ok[order]
+        boundary = np.diff(c) != 0  # column-run boundaries
+        first = np.concatenate([[True], boundary])
+        last = np.concatenate([boundary, [True]])
+        per_head.append((r, c, ok | (first << 1) | (last << 2)))
+    E = max(len(r) for r, _, _ in per_head)
     qidx = np.zeros((H, E), np.int32)
     # padding rides the FINAL column's run (flags 0): same output block
     # index as the last real edge, so the tail forces no extra writeback
     kcol = np.full((H, E), nb - 1, np.int32)
     flags = np.zeros((H, E), np.int32)
-    for h, edges in enumerate(per_head):
-        n = len(edges)
-        for i, (r, c, ok) in enumerate(edges):
-            qidx[h, i] = r
-            kcol[h, i] = c
-            first = i == 0 or edges[i - 1][1] != c
-            last = i == n - 1 or edges[i + 1][1] != c
-            flags[h, i] = ok | (int(first) << 1) | (int(last) << 2)
+    for h, (r, c, fl) in enumerate(per_head):
+        n = len(r)
+        qidx[h, :n] = r
+        kcol[h, :n] = c
+        flags[h, :n] = fl
     return qidx, kcol, flags
 
 

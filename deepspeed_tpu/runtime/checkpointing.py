@@ -28,6 +28,7 @@ from __future__ import annotations
 import json
 import os
 import time
+from contextlib import nullcontext
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -66,12 +67,16 @@ def _note_ckpt_dir(engine, directory: str) -> None:
         note(directory)
 
 
+def _sanitizer(engine):
+    return getattr(engine, "_sanitizer", None)
+
+
 def _build_meta(engine, tag: str, client_state: Optional[dict]) -> Dict[str, Any]:
     return {
         "tag": tag,
-        "global_step": int(engine.state["global_step"]),
-        "micro_step": int(engine.state["micro_step"]),
-        "global_samples": int(engine.state["global_samples"]),
+        "global_step": int(jax.device_get(engine.state["global_step"])),
+        "micro_step": int(jax.device_get(engine.state["micro_step"])),
+        "global_samples": int(jax.device_get(engine.state["global_samples"])),
         "skipped_steps": int(engine.skipped_steps),
         "world_size": engine.mesh_info.world_size,
         "dp_world_size": engine.mesh_info.dp_world_size,
@@ -103,8 +108,13 @@ def save_checkpoint(
     save request drains an in-flight async save first."""
     rcfg = _resilience_cfg(engine)
     ck = rcfg.checkpoint
+    san = _sanitizer(engine)
+    if san is not None:
+        # a donated (deleted) leaf fed into the snapshot would otherwise
+        # surface as a mid-save crash with no provenance
+        san.donation.check_live(engine.state, "checkpoint.save")
     if tag is None:
-        tag = f"global_step{int(engine.state['global_step'])}"
+        tag = f"global_step{int(jax.device_get(engine.state['global_step']))}"
     tag = str(tag)
     save_dir = os.path.abspath(save_dir)
     final_path = _ckpt_path(save_dir, tag)
@@ -142,7 +152,10 @@ def save_checkpoint(
         if timeline is not None:
             timeline.note("ckpt_stall", time.perf_counter() - t_stall)
         return path
-    path = _sync_save(engine, save_dir, tag, final_path, rcfg, client_state, save_latest)
+    # checkpoint I/O is deliberate host traffic: relax any armed
+    # sanitizer transfer guard for the duration of the sync write
+    with san.transfer.io_region() if san is not None else nullcontext():
+        path = _sync_save(engine, save_dir, tag, final_path, rcfg, client_state, save_latest)
     if timeline is not None:
         timeline.note("ckpt_stall", time.perf_counter() - t_stall)
     return path
@@ -432,13 +445,15 @@ def load_checkpoint(
             break
     chosen = _broadcast_tag(chosen)
     if chosen is not None:
-        return _restore_tag(
-            engine,
-            _ckpt_path(load_dir, chosen),
-            load_optimizer_states=load_optimizer_states,
-            load_lr_scheduler_states=load_lr_scheduler_states,
-            load_module_only=load_module_only,
-        )
+        san = _sanitizer(engine)
+        with san.transfer.io_region() if san is not None else nullcontext():
+            return _restore_tag(
+                engine,
+                _ckpt_path(load_dir, chosen),
+                load_optimizer_states=load_optimizer_states,
+                load_lr_scheduler_states=load_lr_scheduler_states,
+                load_module_only=load_module_only,
+            )
 
     detail = f" (requested tag '{requested}')" if requested else ""
     attempts = f"; tried: {', '.join(tried)}" if tried else ""
@@ -566,9 +581,16 @@ def _restore_tag(
             if sd:
                 engine.client_lr_scheduler.load_state_dict(sd)
     # reconcile the engine's host-side step mirrors with the restored state
-    engine._host_global_step = int(engine.state["global_step"])
-    engine._host_micro_step = int(engine.state["micro_step"])
+    engine._host_global_step = int(jax.device_get(engine.state["global_step"]))
+    engine._host_micro_step = int(jax.device_get(engine.state["micro_step"]))
     _note_ckpt_dir(engine, os.path.dirname(path))
+    san = _sanitizer(engine)
+    if san is not None:
+        # a restore is the classic sharding-drift injection point: orbax
+        # reshards to the abstract target, but any partial/fallback path
+        # that leaves a leaf placed differently than declared is caught
+        # here, not N steps later as a silent reshard collective
+        san.drift.check_state(engine, label="checkpoint.load", step=engine._host_global_step)
     log_dist(f"loaded checkpoint {path} (global_step={engine._host_global_step})")
     return path, client_state
 

@@ -26,6 +26,7 @@ from __future__ import annotations
 import functools
 import os
 import time
+from contextlib import nullcontext
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -383,6 +384,14 @@ class DeepSpeedEngine:
         # shape/static-arg drift shows up as a recount)
         self.compilation_count = 0
 
+        # -- ds_san runtime sanitizer (opt-in: `sanitizer` config block
+        # or DS_SAN=1; docs/ds_san.md).  None in production — every hook
+        # below is a near-free attribute check.
+        from deepspeed_tpu.analysis.sanitizer import maybe_from_config
+
+        self._sanitizer = maybe_from_config(getattr(config, "sanitizer", None))
+        self._san_last_batch = None  # last stacked batch, for the NaN probe
+
         # -- host-side bookkeeping ----------------------------------------
         from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
         from deepspeed_tpu.utils.monitor import TensorBoardMonitor
@@ -561,7 +570,8 @@ class DeepSpeedEngine:
 
     @property
     def loss_scale(self) -> float:
-        return float(self.state["loss_scale"].scale)
+        # explicit d2h read (sanitizer transfer-guard clean)
+        return float(jax.device_get(self.state["loss_scale"].scale))
 
     @property
     def module(self):
@@ -809,6 +819,8 @@ class DeepSpeedEngine:
         if name not in self._compiled:
             self._compiled[name] = jax.jit(self._scoped(fn), donate_argnums=(0,) if donate else ())
             self.compilation_count += 1
+            if self._sanitizer is not None:
+                self._sanitizer.recompile.note(f"engine.{name}", None, owner=id(self))
         return self._compiled[name]
 
     # ------------------------------------------------------------------
@@ -1132,6 +1144,30 @@ class DeepSpeedEngine:
         if isinstance(batch, _PlacedBatch):
             return batch.tree
         gas = self.gradient_accumulation_steps
+        leaves = jax.tree.leaves(batch)
+        if (
+            leaves
+            and np.ndim(leaves[0]) >= 1
+            and not getattr(self, "_batch_mismatch_warned", False)
+        ):
+            fed = np.shape(leaves[0])[0]
+            expect = gas * self.train_micro_batch_size_per_gpu * self.mesh_info.dp_world_size
+            if fed != expect:
+                # a config/batch mismatch silently changes the effective
+                # micro-batch (shape[0] // gas wins below) and every
+                # per-chip throughput normalization drifts with it —
+                # surface it once; callers that need the hard guarantee
+                # pin train_batch_size to the fed shape (see
+                # tools/bench_long_context.py)
+                self._batch_mismatch_warned = True
+                logger.warning(
+                    f"train_batch fed {fed} samples but the config triad says "
+                    f"train_batch_size = gas({gas}) × micro_bs("
+                    f"{self.train_micro_batch_size_per_gpu}) × dp("
+                    f"{self.mesh_info.dp_world_size}) = {expect}; proceeding with "
+                    f"effective global micro-batch {fed // gas} — per-chip "
+                    "throughput normalizations will not match the config"
+                )
 
         def one(x):
             x = np.asarray(x) if not isinstance(x, (jax.Array, np.ndarray)) else x
@@ -1156,9 +1192,14 @@ class DeepSpeedEngine:
 
         place = lambda b: _PlacedBatch(self._stack_and_place(b))  # noqa: E731
         if not self.overlap.prefetch.enabled and prefetch_depth is None:
-            return InlineLoader(loader, place, timeline=self.timeline)
+            return InlineLoader(
+                loader, place, timeline=self.timeline, sanitizer=self._sanitizer
+            )
         depth = self.overlap.prefetch.depth if prefetch_depth is None else int(prefetch_depth)
-        return DevicePrefetcher(loader, depth=depth, place_fn=place, timeline=self.timeline)
+        return DevicePrefetcher(
+            loader, depth=depth, place_fn=place, timeline=self.timeline,
+            sanitizer=self._sanitizer,
+        )
 
     def _prepare_batch(self, batch: Any) -> Any:
         def put(x):
@@ -1194,8 +1235,14 @@ class DeepSpeedEngine:
         with self.timeline.phase("data_wait"):
             batch = self._prepare_batch(batch)
         fn = self._get_compiled("micro_step", self._micro_step_impl)
+        san = self._sanitizer
+        donated = jax.tree.leaves(self.state) if san is not None else None
         t_compute = time.perf_counter()
-        self.state, loss = fn(self.state, batch)
+        with san.transfer.guard("engine.forward") if san is not None else nullcontext():
+            self.state, loss = fn(self.state, batch)
+        if san is not None:
+            san.donation.note(donated, "engine.forward", step=self._host_global_step)
+            self._san_last_batch = ("micro", batch)
         if self.timeline.enabled and self._timeline_fence:
             jax.block_until_ready(loss)
             self.timeline.note("compute", time.perf_counter() - t_compute)
@@ -1238,10 +1285,19 @@ class DeepSpeedEngine:
                 info = self._host_apply_step()
             else:
                 fn = self._get_compiled("apply_step", self._apply_step_impl)
-                self.state, info = fn(self.state)
+                san = self._sanitizer
+                donated = jax.tree.leaves(self.state) if san is not None else None
+                with san.transfer.guard("engine.step") if san is not None else nullcontext():
+                    self.state, info = fn(self.state)
+                if san is not None:
+                    san.donation.note(donated, "engine.step", step=self._host_global_step)
             overflowed = False
             if self.loss_scaler.dynamic:
-                overflowed = bool(info["overflow"])
+                # explicit d2h read: the deliberate once-per-step host
+                # sync must not look like an implicit transfer under the
+                # sanitizer's guard (and on remote backends device_get
+                # batches better than __bool__)
+                overflowed = bool(jax.device_get(info["overflow"]))
                 if overflowed:
                     self.skipped_steps += 1
                     log_dist(f"step skipped on overflow; loss scale -> {self.loss_scale}")
@@ -1274,9 +1330,11 @@ class DeepSpeedEngine:
             and self._host_global_step >= self.optimizer.freeze_step
         ):
             self._enter_onebit_frozen()
+        san = self._sanitizer
         was_placed = isinstance(batch, _PlacedBatch)
         t_place = time.perf_counter()
-        stacked = self._stack_and_place(batch)
+        with san.transfer.guard("engine.train_batch.place") if san is not None else nullcontext():
+            stacked = self._stack_and_place(batch)
         if not was_placed:
             # prefetched batches had their wait noted by the prefetcher
             self.timeline.note("data_wait", time.perf_counter() - t_place)
@@ -1311,6 +1369,11 @@ class DeepSpeedEngine:
                 )
             self._compiled[tb_key] = executable
             self.compilation_count += 1
+            if san is not None:
+                # signature of exactly what was lowered: a recount here
+                # names the state/batch leaf whose shape/dtype/sharding
+                # drifted since the last executable was built
+                san.recompile.note("engine.train_batch", (self.state, stacked), owner=id(self))
             try:
                 cost = executable.cost_analysis() or {}
                 if isinstance(cost, list):
@@ -1320,12 +1383,20 @@ class DeepSpeedEngine:
                 self._train_step_cost = {}
         profile_step = self._host_global_step + 1
         self.flops_profiler.start_step(profile_step)
+        donated = jax.tree.leaves(self.state) if san is not None else None
         t_compute = time.perf_counter()
         if self._offload:
-            self.state, loss = self._compiled[tb_key](self.state, stacked)
+            with san.transfer.guard("engine.train_batch") if san is not None else nullcontext():
+                self.state, loss = self._compiled[tb_key](self.state, stacked)
+            # the host optimizer step is a deliberate host-I/O region
+            # (grads device->host, masters host->device) — not guarded
             info = self._host_apply_step()
         else:
-            self.state, loss, info = self._compiled[tb_key](self.state, stacked)
+            with san.transfer.guard("engine.train_batch") if san is not None else nullcontext():
+                self.state, loss, info = self._compiled[tb_key](self.state, stacked)
+        if san is not None:
+            san.donation.note(donated, "engine.train_batch", step=self._host_global_step)
+            self._san_last_batch = ("stacked", stacked)
         if self.timeline.enabled and self._timeline_fence:
             # fence: XLA dispatch is async — an unfenced delta would only
             # measure Python overhead (ds_lint `unfenced-timing`).  Off
@@ -1338,9 +1409,11 @@ class DeepSpeedEngine:
         self._last_loss = loss
         self._last_info = info  # lr / grad_norm / overflow of this step
         # host sync on the overflow flag only when dynamic scaling is live
+        # (explicit device_get: a deliberate sync, not an implicit
+        # transfer — the sanitizer's guard budget stays honest)
         overflowed = False
         if self.loss_scaler.dynamic:
-            overflowed = bool(info["overflow"])
+            overflowed = bool(jax.device_get(info["overflow"]))
             if overflowed:
                 self.skipped_steps += 1
                 log_dist(f"step skipped on overflow; loss scale -> {self.loss_scale}")
@@ -1404,8 +1477,11 @@ class DeepSpeedEngine:
 
         ``unroll``: False = plain ``lax.scan`` (one XLA while loop,
         carry double-buffered per iteration); True = fully unrolled
-        (no loop, n× graph); an int k = k step bodies per while
-        iteration — carry copies amortize 1/k at k× graph size.
+        (no loop, n× graph); an int k >= 2 = partial unroll (k step
+        bodies per while iteration — carry copies amortize 1/k at k×
+        graph size); k == 1 is the plain scan, identical to False
+        (bench.py's ``DS_TB_UNROLL`` uses the same convention, with
+        ``full`` as the full-unroll sentinel).
         """
         batches = list(batches)
         n = len(batches)
@@ -1422,6 +1498,7 @@ class DeepSpeedEngine:
         with self.timeline.phase("data_wait"):
             stacked = [self._stack_and_place(b) for b in batches]
             run = jax.tree.map(lambda *xs: jnp.stack(xs), *stacked)
+        san = self._sanitizer
         unroll_k = n if unroll is True else max(1, min(int(unroll), n))
         key = (
             "train_batches", n, unroll_k, self._onebit_frozen, bool(self.state["grad_acc"]),
@@ -1453,11 +1530,19 @@ class DeepSpeedEngine:
                     .compile()
                 )
             self.compilation_count += 1
+            if san is not None:
+                san.recompile.note("engine.train_batches", (self.state, run), owner=id(self))
+        donated = jax.tree.leaves(self.state) if san is not None else None
         t_compute = time.perf_counter()
-        self.state, losses, ovf_count, last_lr, last_gn = self._compiled[key](self.state, run)
-        losses = np.asarray(losses)  # materializing = the compute fence
+        with san.transfer.guard("engine.train_batches") if san is not None else nullcontext():
+            self.state, losses, ovf_count, last_lr, last_gn = self._compiled[key](self.state, run)
+        if san is not None:
+            san.donation.note(donated, "engine.train_batches", step=self._host_global_step)
+            self._san_last_batch = ("stacked", stacked[-1])
+        # explicit d2h reads (materializing losses = the compute fence)
+        losses = np.asarray(jax.device_get(losses))
         self.timeline.note("compute", time.perf_counter() - t_compute)
-        skipped = int(ovf_count)
+        skipped = int(jax.device_get(ovf_count))
         if self.loss_scaler.dynamic:
             self.skipped_steps += skipped
             self._host_global_step += n - skipped
@@ -1524,13 +1609,15 @@ class DeepSpeedEngine:
                 log_dist(self.timeline.format_summary(self.config.steps_per_print))
             if self.monitor.enabled:
                 # reference tags (engine.py:1178-1188, :1356-1382)
-                samples = int(self.state["global_samples"])
+                samples = int(jax.device_get(self.state["global_samples"]))
                 events = [
                     (f"Train/Samples/lr", self.get_lr()[0]),
                     (f"Train/Samples/loss_scale", self.loss_scale),
                 ]
                 if self._last_loss is not None:
-                    events.append((f"Train/Samples/train_loss", float(self._last_loss)))
+                    events.append(
+                        (f"Train/Samples/train_loss", float(jax.device_get(self._last_loss)))
+                    )
                 self.monitor.write_events(events, samples)
                 self.monitor.flush()
 
@@ -1548,6 +1635,9 @@ class DeepSpeedEngine:
         wd = getattr(self, "_watchdog", None)
         if wd is not None and wd.preemption_requested:
             self._handle_preemption()
+        san = getattr(self, "_sanitizer", None)
+        if san is not None and san.drift.due(self._host_global_step):
+            san.drift.check_state(self, step=self._host_global_step)
         guard = getattr(self, "_divergence_guard", None)
         if guard is None:
             return
@@ -1560,6 +1650,10 @@ class DeepSpeedEngine:
             diverged = not bool(np.isfinite(np.asarray(jax.device_get(loss))))
         action = guard.record(diverged)
         if action is not None:
+            if san is not None:
+                # name the first non-finite op before the action mutates
+                # state (floor recompiles, rollback replaces params)
+                san.nanprobe.probe_engine_step(self, self._san_last_batch)
             self._apply_divergence_action(action)
 
     def _handle_preemption(self) -> None:

@@ -109,6 +109,12 @@ class DevicePrefetcher:
 
     ``timeline``: optional ``StepTimeline``; consumer-side queue waits
     are noted as ``data_wait``.
+
+    ``sanitizer``: optional ds_san :class:`Sanitizer`; the place stage
+    then runs under its transfer guard (region ``prefetch.place``), so a
+    loader that smuggles implicit host↔device transfers into the
+    pipeline is attributed instead of silently re-staging every batch.
+    Violations re-raise in the consumer like any other place error.
     """
 
     def __init__(
@@ -118,16 +124,18 @@ class DevicePrefetcher:
         place_fn: Optional[Callable[[Any], Any]] = None,
         sharding: Any = None,
         timeline: Any = None,
+        sanitizer: Any = None,
     ):
         self.loader = loader
         self.depth = max(1, int(depth))
         self.sharding = sharding
         self.place_fn = place_fn
         self.timeline = timeline
+        self.sanitizer = sanitizer
         self._stop: Optional[threading.Event] = None
         self._threads: List[threading.Thread] = []
 
-    def _place(self, batch: Any) -> Any:
+    def _place_inner(self, batch: Any) -> Any:
         if self.place_fn is not None:
             return self.place_fn(batch)
         import jax
@@ -135,6 +143,14 @@ class DevicePrefetcher:
         if self.sharding is not None:
             return jax.device_put(batch, self.sharding)
         return jax.device_put(batch)
+
+    def _place(self, batch: Any) -> Any:
+        if self.sanitizer is None:
+            return self._place_inner(batch)
+        # jax's transfer-guard context is thread-local, so arming it on
+        # the place worker cannot leak into the consumer's own guards
+        with self.sanitizer.transfer.guard("prefetch.place"):
+            return self._place_inner(batch)
 
     def __iter__(self):
         self.close()  # a fresh iteration owns fresh threads/queues
@@ -192,10 +208,18 @@ class InlineLoader:
     ``__len__`` — but synchronous load + place on the consumer thread,
     so swapping the knob never changes iteration semantics."""
 
-    def __init__(self, loader: Iterable, place_fn: Callable[[Any], Any], timeline: Any = None):
+    def __init__(
+        self,
+        loader: Iterable,
+        place_fn: Callable[[Any], Any],
+        timeline: Any = None,
+        sanitizer: Any = None,
+    ):
         self.loader = loader
         self.place_fn = place_fn
         self.timeline = timeline
+        if sanitizer is not None:
+            self.place_fn = sanitizer.transfer.wrap_callable(place_fn, "prefetch.place")
 
     def __iter__(self):
         it = iter(self.loader)

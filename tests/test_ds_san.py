@@ -1,0 +1,448 @@
+"""ds_san runtime sanitizer tests (docs/ds_san.md).
+
+One guilty + one clean fixture per checker — forced recompile storm,
+implicit transfer, use-after-donation, deliberate sharding drift,
+injected NaN — plus the regression gate: a full clean training loop
+(prefetch + train + checkpoint save/load) under an armed sanitizer
+reports ZERO findings, i.e. the engine's own hot path stays
+sanitizer-clean.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.analysis.core import Severity
+from deepspeed_tpu.analysis.sanitizer import core as san_core
+from deepspeed_tpu.analysis.sanitizer.core import Sanitizer, TransferViolation
+from deepspeed_tpu.analysis.sanitizer.recompile import diff_signatures, signature
+from deepspeed_tpu.config.config import DeepSpeedConfig, DeepSpeedConfigError, SanitizerConfig
+from tests.simple_model import base_config, random_batches, simple_model_init, simple_model_loss
+
+HIDDEN = 8
+
+
+@pytest.fixture
+def san():
+    """Installed sanitizer with small budgets; always uninstalled so no
+    other test's engine picks it up."""
+    cfg = SanitizerConfig.from_dict({"enabled": True, "compile_budget": 3, "drift_interval": 1})
+    s = san_core.install(Sanitizer(cfg))
+    try:
+        yield s
+    finally:
+        san_core.uninstall()
+
+
+def _engine(san_active=True, **extra):
+    config = base_config(stage=1, micro_bs=1, dtype="fp32", **extra)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_model_loss, model_parameters=simple_model_init(HIDDEN), config=config
+    )
+    assert (engine._sanitizer is not None) == san_active
+    return engine
+
+
+def _bs(engine):
+    return engine.train_micro_batch_size_per_gpu * engine.mesh_info.dp_world_size
+
+
+def rules(san):
+    return [f.rule for f in san.findings]
+
+
+# ---------------------------------------------------------------------------
+# activation plumbing
+# ---------------------------------------------------------------------------
+
+def test_engine_without_sanitizer_has_no_hooks():
+    engine = _engine(san_active=False)
+    assert engine._sanitizer is None
+
+
+def test_env_var_activates_sanitizer(monkeypatch):
+    monkeypatch.setenv("DS_SAN", "1")
+    monkeypatch.setenv("DS_SAN_BUDGET", "5")
+    try:
+        engine = _engine(san_active=True)
+        assert engine._sanitizer.config.compile_budget == 5
+    finally:
+        san_core.uninstall()
+
+
+def test_config_block_activates_sanitizer():
+    try:
+        engine = _engine(
+            san_active=True, sanitizer={"enabled": True, "checkers": ["recompile", "transfer"]}
+        )
+        s = engine._sanitizer
+        assert s.recompile.enabled and s.transfer.enabled
+        assert not s.donation.enabled and not s.drift.enabled and not s.nanprobe.enabled
+    finally:
+        san_core.uninstall()
+
+
+def test_explicit_config_disable_opts_out_of_installed_sanitizer(san):
+    """`"sanitizer": {"enabled": false}` in the JSON beats a process-wide
+    (env/CLI-installed) sanitizer; an absent block does not."""
+    engine = _engine(san_active=False, sanitizer={"enabled": False})
+    assert engine._sanitizer is None
+    engine2 = _engine(san_active=True)  # absent block: joins the installed one
+    assert engine2._sanitizer is san
+
+
+def test_knobs_only_block_does_not_disarm_env_launch(monkeypatch):
+    """A `sanitizer` block that only tunes knobs (no `enabled` key) must
+    neither disarm DS_SAN=1 nor lose its tuning."""
+    monkeypatch.setenv("DS_SAN", "1")
+    try:
+        engine = _engine(san_active=True, sanitizer={"compile_budget": 16})
+        assert engine._sanitizer.config.compile_budget == 16
+    finally:
+        san_core.uninstall()
+
+
+def test_drift_due_fires_on_interval_crossing():
+    """train_batches advances steps in run-sized jumps and skips shift
+    them off exact multiples; due() must fire on crossing, not modulo."""
+    cfg = SanitizerConfig.from_dict({"enabled": True, "drift_interval": 16})
+    s = Sanitizer(cfg)
+    fired = [step for step in range(10, 200, 10) if s.drift.due(step) and not s.drift.check({}, {}, "t", step=step)]
+    assert fired and all(b - a >= 16 for a, b in zip(fired, fired[1:]))
+
+
+def test_batch_triad_mismatch_warns_once_and_proceeds(san):
+    """A fed batch that disagrees with the config triad trains (the
+    derived micro-batch wins, as before this PR) but warns exactly once;
+    matching batches must not set the warned flag."""
+    engine = _engine()
+    engine.train_batch(random_batches(1, _bs(engine), HIDDEN)[0])
+    assert not getattr(engine, "_batch_mismatch_warned", False)
+    for b in random_batches(2, _bs(engine) * 2, HIDDEN):  # 2x the configured batch
+        engine.train_batch(b)
+    assert engine._batch_mismatch_warned
+
+
+def test_sanitizer_config_validation():
+    with pytest.raises(DeepSpeedConfigError, match="unknown checker"):
+        SanitizerConfig.from_dict({"checkers": ["recompile", "typo"]})
+    with pytest.raises(DeepSpeedConfigError, match="compile_budget"):
+        SanitizerConfig.from_dict({"compile_budget": 0})
+    with pytest.raises(DeepSpeedConfigError, match="Unknown config key"):
+        DeepSpeedConfig({"train_batch_size": 8, "sanitizer": {"budgett": 3}})
+
+
+# ---------------------------------------------------------------------------
+# recompile detector
+# ---------------------------------------------------------------------------
+
+def test_recompile_guilty_storm_names_changed_arg(san):
+    f = san.recompile.wrap(jax.jit(lambda x: x * 2), site="t.storm")
+    for i in range(san.config.compile_budget + 2):
+        f(jnp.zeros((i + 1,), jnp.float32))
+    assert "san-recompile" in rules(san)
+    assert "san-recompile-storm" in rules(san)
+    storm = next(f for f in san.findings if f.rule == "san-recompile-storm")
+    assert "shape" in storm.message and "t.storm" in storm.message
+    assert os.path.abspath(storm.path) == os.path.abspath(__file__)
+
+
+def test_recompile_clean_stable_shapes(san):
+    f = san.recompile.wrap(jax.jit(lambda x: x * 2), site="t.stable")
+    for _ in range(10):
+        f(jnp.zeros((4,), jnp.float32))
+    assert san.findings == []  # one compile is the expected one
+
+
+def test_recompile_dtype_change_named(san):
+    f = san.recompile.wrap(jax.jit(lambda x: x * 2), site="t.dtype")
+    f(jnp.zeros((4,), jnp.float32))
+    f(jnp.zeros((4,), jnp.int32))
+    assert any("dtype" in f.message for f in san.findings)
+
+
+def test_diff_signatures_static_value():
+    a = signature({"n": 3, "x": np.zeros((2,))})
+    b = signature({"n": 4, "x": np.zeros((2,))})
+    assert "'n'" in diff_signatures(a, b)
+
+
+def test_engine_steady_state_no_recompile_findings(san):
+    engine = _engine()
+    for b in random_batches(4, _bs(engine), HIDDEN):
+        engine.train_batch(b)
+    assert [f for f in san.findings if f.rule.startswith("san-recompile")] == []
+
+
+def test_two_engines_share_sanitizer_without_site_aliasing(san):
+    """A second engine's first compile of 'engine.micro_step' must not
+    count as a recompile of the first engine's site."""
+    engines = [_engine(), _engine()]
+    for e in engines:
+        loss = e.forward(random_batches(1, _bs(e), HIDDEN)[0])
+        e.backward(loss)
+        e.step()
+    assert [f for f in san.findings if f.rule.startswith("san-recompile")] == []
+
+
+# ---------------------------------------------------------------------------
+# transfer guard
+# ---------------------------------------------------------------------------
+
+def test_transfer_guilty_implicit_h2d_attributed(san):
+    dev = jnp.zeros((4,), jnp.float32) + 0
+    with pytest.raises(TransferViolation):
+        with san.transfer.guard("t.region"):
+            dev + np.ones((4,), np.float32)  # implicit host->device
+    assert rules(san) == ["san-transfer"]
+    f = san.findings[0]
+    assert os.path.abspath(f.path) == os.path.abspath(__file__)
+    assert "t.region" in f.message
+
+
+def test_transfer_clean_explicit_device_put(san):
+    dev = jnp.zeros((4,), jnp.float32) + 0
+    host = np.ones((4,), np.float32)
+    with san.transfer.guard("t.region"):
+        dev + jax.device_put(host)  # explicit: always allowed
+    assert san.findings == []
+
+
+def test_transfer_io_region_relaxes_guard(san):
+    with san.transfer.guard("t.region"):
+        with san.transfer.io_region():
+            jnp.ones((4,)) + np.ones((4,), np.float32)  # ckpt-style host I/O
+    assert san.findings == []
+
+
+def test_transfer_nested_guard_records_once(san):
+    dev = jnp.zeros((4,), jnp.float32) + 0
+    with pytest.raises(TransferViolation):
+        with san.transfer.guard("outer"):
+            with san.transfer.guard("inner"):
+                dev + np.ones((4,), np.float32)
+    assert rules(san) == ["san-transfer"]  # not double-counted by the outer guard
+
+
+def test_engine_training_loop_transfer_clean(san):
+    engine = _engine()
+    for b in engine.prefetch_loader(iter(random_batches(3, _bs(engine), HIDDEN))):
+        engine.train_batch(b)
+    assert [f for f in san.findings if f.rule == "san-transfer"] == []
+
+
+def test_prefetcher_place_stage_guarded(san):
+    """A loader whose place path smuggles implicit transfers is caught
+    and the violation surfaces in the consumer."""
+    from deepspeed_tpu.runtime.overlap import DevicePrefetcher
+
+    def bad_place(batch):
+        return jnp.asarray(batch["x"]) + np.float32(1.0)  # implicit h2d mix
+
+    pf = DevicePrefetcher(
+        iter([{"x": np.ones((2, 2), np.float32)}]), place_fn=bad_place, sanitizer=san
+    )
+    with pytest.raises(TransferViolation):
+        list(pf)
+    assert "san-transfer" in rules(san)
+
+
+# ---------------------------------------------------------------------------
+# donation checker
+# ---------------------------------------------------------------------------
+
+def test_donation_guilty_stale_state_leaf(san):
+    engine = _engine()
+    stale = engine.state["params"]["layer_0"]["w"]
+    engine.train_batch(random_batches(1, _bs(engine), HIDDEN)[0])  # donates
+    with pytest.raises(RuntimeError, match="deleted"):
+        with san.donation.watch("t.stale"):
+            np.asarray(stale)
+    dona = [f for f in san.findings if f.rule == "san-donation"]
+    assert len(dona) == 1
+    assert "engine.train_batch" in dona[0].message  # donating site named
+    assert os.path.abspath(dona[0].path) == os.path.abspath(__file__)
+
+
+def test_donation_clean_live_state(san):
+    engine = _engine()
+    engine.train_batch(random_batches(1, _bs(engine), HIDDEN)[0])
+    with san.donation.watch("t.live"):
+        np.asarray(jax.device_get(engine.state["params"]["layer_0"]["w"]))
+    assert san.donation.check_live(engine.state, "t.live") == 0
+    assert san.findings == []
+
+
+def test_donation_check_live_reports_deleted_leaf(san):
+    engine = _engine()
+    stale_tree = {"w": engine.state["params"]["layer_0"]["w"]}
+    engine.train_batch(random_batches(1, _bs(engine), HIDDEN)[0])
+    assert san.donation.check_live(stale_tree, "t.tree") == 1
+    assert rules(san) == ["san-donation"]
+
+
+# ---------------------------------------------------------------------------
+# sharding drift
+# ---------------------------------------------------------------------------
+
+def _wide_axis(engine):
+    for a in engine.mesh.axis_names:
+        if engine.mesh.shape[a] > 1:
+            return a
+    pytest.skip("needs a multi-device mesh axis")
+
+
+def test_drift_guilty_replaced_leaf(san):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    engine = _engine()
+    axis = _wide_axis(engine)
+    engine.state["params"]["layer_0"]["b"] = jax.device_put(
+        np.zeros((HIDDEN,), np.float32), NamedSharding(engine.mesh, P(axis))
+    )
+    assert san.drift.check_state(engine, label="t.drift") == 1
+    f = san.findings[0]
+    assert f.rule == "san-sharding-drift" and "['params']['layer_0']['b']" in f.message
+
+
+def test_drift_clean_untouched_engine(san):
+    engine = _engine()
+    for b in random_batches(2, _bs(engine), HIDDEN):
+        engine.train_batch(b)
+    assert san.drift.check_state(engine, label="t.clean") == 0
+    assert [f for f in san.findings if f.rule == "san-sharding-drift"] == []
+
+
+def test_drift_checked_after_checkpoint_load(san, tmp_path):
+    engine = _engine()
+    engine.train_batch(random_batches(1, _bs(engine), HIDDEN)[0])
+    engine.save_checkpoint(str(tmp_path))
+    engine.load_checkpoint(str(tmp_path))
+    # a clean restore must NOT report drift (the hook itself ran: the
+    # checker notes its last sweep step)
+    assert [f for f in san.findings if f.rule == "san-sharding-drift"] == []
+
+
+# ---------------------------------------------------------------------------
+# nonfinite probe
+# ---------------------------------------------------------------------------
+
+def _nan_config():
+    return dict(resilience={"divergence": {"threshold": 2, "action": "warn", "check_loss": True}})
+
+
+def test_nonfinite_guilty_poisoned_batch(san):
+    engine = _engine(**_nan_config())
+    batches = random_batches(2, _bs(engine), HIDDEN, seed=3)
+    for b in batches:
+        b["x"][0, 0] = np.nan
+        engine.train_batch(b)
+    hits = [f for f in san.findings if f.rule == "san-nonfinite"]
+    assert len(hits) == 1
+    assert "primitive" in hits[0].message  # checkify named the op
+    assert san.nanprobe.probes_run == 1  # once per guard trip, not per step
+
+
+def test_nonfinite_guilty_micro_step_api(san):
+    """The forward()/backward()/step() loop must feed the probe too."""
+    engine = _engine(**_nan_config())
+    for b in random_batches(2, _bs(engine), HIDDEN, seed=4):
+        b["x"][0, 0] = np.nan
+        loss = engine.forward(b)
+        engine.backward(loss)
+        engine.step()
+    assert [f for f in san.findings if f.rule == "san-nonfinite"]
+
+
+def test_nonfinite_clean_finite_run(san):
+    engine = _engine(**_nan_config())
+    for b in random_batches(3, _bs(engine), HIDDEN):
+        engine.train_batch(b)
+    assert [f for f in san.findings if f.rule == "san-nonfinite"] == []
+    assert san.nanprobe.probes_run == 0
+
+
+# ---------------------------------------------------------------------------
+# shared report machinery (one format, one suppression syntax, baseline)
+# ---------------------------------------------------------------------------
+
+def test_inline_pragma_suppresses_runtime_finding(san, tmp_path):
+    mod = tmp_path / "user_loop.py"
+    mod.write_text(
+        "import numpy as np, jax.numpy as jnp\n"
+        "def guilty(san):\n"
+        "    dev = jnp.zeros((4,), jnp.float32) + 0\n"
+        "    with san.transfer.guard('t.sup'):\n"
+        "        dev + np.ones((4,), np.float32)  # ds-lint: disable=san-transfer\n"
+    )
+    ns = {}
+    exec(compile(mod.read_text(), str(mod), "exec"), ns)
+    with pytest.raises(TransferViolation):  # still raises; just not reported
+        ns["guilty"](san)
+    assert san.findings == []
+    assert san._suppressed == 1
+
+
+def test_report_json_round_trip_and_fingerprints(san, tmp_path):
+    f = san.recompile.wrap(jax.jit(lambda x: x + 1), site="t.report")
+    f(jnp.zeros((1,)))
+    f(jnp.zeros((2,)))
+    out = tmp_path / "report.json"
+    san.write_report(str(out))
+    import json
+
+    data = json.loads(out.read_text())
+    assert data["tool"] == "ds_san"
+    assert data["findings"][0]["rule"] == "san-recompile"
+    assert data["findings"][0]["fingerprint"]
+    assert data["compiles"]["t.report"] == 2
+
+
+def test_findings_share_ds_lint_severity_model(san):
+    from deepspeed_tpu.analysis.sanitizer.core import RULES
+
+    assert RULES["san-recompile"][0] == Severity.B
+    for rule in ("san-recompile-storm", "san-transfer", "san-donation",
+                 "san-sharding-drift", "san-nonfinite"):
+        assert RULES[rule][0] == Severity.A
+
+
+# ---------------------------------------------------------------------------
+# regression: the full clean loop under DS_SAN reports ZERO findings
+# ---------------------------------------------------------------------------
+
+def test_clean_training_loop_under_ds_san_zero_findings(san, tmp_path):
+    """The tier-1 regression contract: prefetch + train_batch +
+    forward/backward/step + train_batches + checkpoint save/load under an
+    armed sanitizer produce no findings at any tier."""
+    engine = _engine()
+    bs = _bs(engine)
+    # train_batch path (prefetched)
+    for b in engine.prefetch_loader(iter(random_batches(3, bs, HIDDEN))):
+        engine.train_batch(b)
+    # micro API path
+    loss = engine.forward(random_batches(1, bs, HIDDEN, seed=5)[0])
+    engine.backward(loss)
+    engine.step()
+    # multi-step compiled run path
+    engine.train_batches(random_batches(2, bs, HIDDEN, seed=6))
+    # checkpoint roundtrip (donation check_live + drift-on-load hooks)
+    engine.save_checkpoint(str(tmp_path))
+    engine.load_checkpoint(str(tmp_path))
+    assert san.findings == [], [f.format() for f in san.findings]
+
+
+def test_smoke_loop_self_test_passes(san, tmp_path):
+    """The CLI's seeded self-test: every checker fires and the storm +
+    transfer findings attribute to smoke.py's guilty lines."""
+    from deepspeed_tpu.analysis.sanitizer.smoke import run_smoke
+
+    result = run_smoke(san, seed_violations=True, steps=2, ckpt_dir=str(tmp_path))
+    assert result["missing"] == []
+    assert result["misattributed"] == []
+    assert result["unexpected"] == []
+    assert len(result["verified"]) == 6

@@ -39,8 +39,14 @@ def run_mode(mode: str, seq: int, n_layer: int, steps: int):
         remat_save_names=("qkv", "attn_o", "attn_lse"),
     )
     model_fn, init_fn, tp_fn = gpt2.make_model(cfg)
+    # The fed global batch is (dp, seq).  Pin the config to it (mesh
+    # default data=-1 makes dp == device count) so the engine's batch
+    # triad check holds by construction and the per-chip tokens/s
+    # normalization below (seq/dt — the dp-sized batch cancels the dp
+    # chips) can't silently drift if either side changes.
+    dp_devices = jax.device_count()
     config = {
-        "train_micro_batch_size_per_gpu": 1,
+        "train_batch_size": dp_devices,
         "gradient_accumulation_steps": 1,
         "bf16": {"enabled": True},
         "zero_optimization": {"stage": 0},
@@ -56,11 +62,15 @@ def run_mode(mode: str, seq: int, n_layer: int, steps: int):
     # global batch = dp world (1 on the single TPU chip; the 8-CPU dev
     # mesh shards one sample per device — tokens/s stays per-chip)
     dp = engine.mesh_info.dp_world_size
+    assert dp == dp_devices, (
+        f"mesh dp world ({dp}) != device count ({dp_devices}); the config "
+        "batch above was pinned to the wrong dp"
+    )
     def batches(n):
         for _ in range(n):
             yield {"input_ids": rng.integers(0, cfg.vocab_size, (dp, seq), dtype=np.int32)}
 
-    dt = bench._timed_steps(engine, batches, steps, f"long-{mode}-{seq}")
+    dt, _phases = bench._timed_steps(engine, batches, steps, f"long-{mode}-{seq}")
     tok_s = seq / dt  # per-chip: the dp-sized global batch cancels the dp chips
     print(f"[long-context {mode}] seq={seq} L={n_layer}: step={dt*1e3:.1f}ms tokens/s={tok_s:,.0f}", flush=True)
     return dt, tok_s
