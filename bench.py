@@ -234,6 +234,7 @@ def bench_model(cfg, micro_bs, gas, seq, steps, zero_stage, label, opt_params=No
         "gas": gas,
         "seq": seq,
         **({"ds_san": True} if engine._sanitizer is not None else {}),
+        **({"supervision": True} if getattr(engine, "_supervision", None) is not None else {}),
     }
 
 
@@ -336,6 +337,7 @@ def bench_bert(seq: int, micro_bs: int, gas: int, steps: int):
         "gas": gas,
         "seq": seq,
         **({"ds_san": True} if engine._sanitizer is not None else {}),
+        **({"supervision": True} if getattr(engine, "_supervision", None) is not None else {}),
     }
 
 

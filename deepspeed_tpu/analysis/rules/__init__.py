@@ -2,6 +2,7 @@
 with the core registry (deepspeed_tpu.analysis.core)."""
 from deepspeed_tpu.analysis.rules import (  # noqa: F401
     atomic_write,
+    barrier_guard,
     config_drift,
     donation,
     dtype_rules,

@@ -1,0 +1,78 @@
+"""Rule: blocking cross-process syncs must sit inside a watchdog-armed
+region.
+
+A bare ``sync_global_devices`` / ``process_allgather`` /
+``broadcast_one_to_all`` at a checkpoint or step boundary is an eternal
+hang the moment one peer dies — the exact failure the supervision
+subsystem exists to bound (docs/resilience.md).  The sanctioned shapes:
+
+* ``with supervisor.armed("site"): multihost_utils.sync_global_devices(...)``
+  (any ``.armed(...)`` / ``._sup_region(...)`` context manager item);
+* routing through :func:`deepspeed_tpu.resilience.supervision.supervised_sync`
+  (the helper arms itself — its own body is exempt);
+* a function whose name starts with ``supervised_`` (wrapper modules).
+
+Everything else is a tier-B finding; pre-supervision sites live in the
+baseline.
+"""
+from __future__ import annotations
+
+import ast
+
+from deepspeed_tpu.analysis.core import Severity, make_finding, register
+
+_BLOCKING_SYNCS = {"sync_global_devices", "process_allgather", "broadcast_one_to_all"}
+_GUARD_ATTRS = {"armed", "_sup_region"}
+_EXEMPT_FUNC_PREFIX = "supervised_"
+
+
+def _call_name(node: ast.Call):
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _with_is_guard(node: ast.With) -> bool:
+    """Any item of the ``with`` whose expression mentions an armed-region
+    call — including conditional forms like
+    ``sup.armed(x) if sup else nullcontext()``."""
+    for item in node.items:
+        for sub in ast.walk(item.context_expr):
+            if isinstance(sub, ast.Call):
+                name = _call_name(sub)
+                if name in _GUARD_ATTRS:
+                    return True
+    return False
+
+
+@register(
+    "unguarded-collective-barrier",
+    Severity.B,
+    "blocking cross-process sync outside a watchdog-armed region; wrap in "
+    "supervisor.armed(...) or route through supervision.supervised_sync",
+)
+def check_barrier_guard(rule, ctx):
+    # walk with an explicit stack so each call site knows its enclosing
+    # With guards and function names
+    def visit(node, guarded: bool, func_exempt: bool):
+        if isinstance(node, ast.With):
+            guarded = guarded or _with_is_guard(node)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            func_exempt = node.name.startswith(_EXEMPT_FUNC_PREFIX)
+            guarded = False  # a guard outside the def does not cover calls at call time
+        elif isinstance(node, ast.Call):
+            name = _call_name(node)
+            if name in _BLOCKING_SYNCS and not guarded and not func_exempt:
+                yield make_finding(
+                    rule, ctx, node,
+                    f"'{name}' blocks on every peer with no armed deadline — one dead "
+                    "rank hangs this site forever; wrap it in supervisor.armed(...) "
+                    "or use supervision.supervised_sync",
+                )
+        for child in ast.iter_child_nodes(node):
+            yield from visit(child, guarded, func_exempt)
+
+    yield from visit(ctx.tree, guarded=False, func_exempt=False)

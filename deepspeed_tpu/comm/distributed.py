@@ -67,26 +67,69 @@ def init_distributed(
     # before rank 0's server listens); bounded retry with backoff instead
     # of dying on the first connection refusal.  DS_DIST_INIT_RETRIES
     # tunes the attempt budget (the config object doesn't exist yet here).
-    from deepspeed_tpu.resilience.policy import RetryPolicy, retry_call
+    #
+    # The retry ladder honors a WATCHDOG DEADLINE instead of running
+    # unbounded: DS_DIST_INIT_DEADLINE (seconds, default 300 — the
+    # supervision sync-deadline default) caps the whole ladder AND each
+    # individual initialize() attempt (via jax's initialization_timeout,
+    # where supported), so a bad coordinator address surfaces as a loud
+    # error naming the coordinator within the deadline instead of
+    # silently burning the full backoff ladder.
+    from deepspeed_tpu.resilience.policy import RetryError, RetryPolicy, retry_call
 
+    deadline = float(os.environ.get("DS_DIST_INIT_DEADLINE", "300"))
     policy = RetryPolicy(
         max_attempts=int(os.environ.get("DS_DIST_INIT_RETRIES", "3")),
         backoff_seconds=float(os.environ.get("DS_DIST_INIT_BACKOFF", "2.0")),
+        timeout_seconds=deadline if deadline > 0 else None,
         retry_on=(OSError, RuntimeError),
     )
-    retry_call(
-        policy,
-        jax.distributed.initialize,
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-        # per-process jitter seed: a shared seed would re-synchronize the
-        # whole pod's retries into the very storm the jitter breaks
-        seed=int(process_id or 0),
-        on_retry=lambda attempt, e, pause: logger.warning(
-            f"init_distributed attempt {attempt} failed ({e}); retrying in {pause:.1f}s"
-        ),
-    )
+    attempts = {"n": 0}
+
+    def _supports_init_timeout() -> bool:
+        # signature probe, NOT try/except TypeError around the call: a
+        # TypeError raised from INSIDE initialize (bad argument types)
+        # must not be misread as "older jax" and retried unbounded
+        import inspect
+
+        try:
+            return "initialization_timeout" in inspect.signature(
+                jax.distributed.initialize
+            ).parameters
+        except (TypeError, ValueError):
+            return False
+
+    def _initialize():
+        attempts["n"] += 1
+        kwargs = dict(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        if deadline > 0 and _supports_init_timeout():
+            # bound the in-call wait too: a wrong coordinator address
+            # otherwise blocks INSIDE initialize for jax's own default
+            kwargs["initialization_timeout"] = max(1, int(deadline))
+        return jax.distributed.initialize(**kwargs)
+
+    try:
+        retry_call(
+            policy,
+            _initialize,
+            # per-process jitter seed: a shared seed would re-synchronize the
+            # whole pod's retries into the very storm the jitter breaks
+            seed=int(process_id or 0),
+            on_retry=lambda attempt, e, pause: logger.warning(
+                f"init_distributed attempt {attempt} failed ({e}); retrying in {pause:.1f}s"
+            ),
+        )
+    except RetryError as e:
+        raise RetryError(
+            f"jax.distributed.initialize could not reach coordinator "
+            f"{coordinator_address} (process {process_id}/{num_processes}) after "
+            f"{attempts['n']} attempt(s) within the {deadline:g}s deadline "
+            f"(tune DS_DIST_INIT_RETRIES / DS_DIST_INIT_DEADLINE): {e}"
+        ) from e
     _initialized = True
     if verbose:
         logger.info(

@@ -451,6 +451,74 @@ class DivergenceConfig:
 
 
 @dataclass
+class SupervisionConfig:
+    """``resilience.supervision`` — the distributed failure domain:
+    heartbeat liveness plane, hung-collective watchdog and the exit-44
+    "peer-failed-and-saved" rescue contract (docs/resilience.md)."""
+
+    enabled: bool = C.SUPERVISION_ENABLED_DEFAULT
+    channel: str = C.SUPERVISION_CHANNEL_DEFAULT  # auto | tcp | file
+    beat_dir: Optional[str] = None  # file-channel directory
+    beat_interval_seconds: float = C.SUPERVISION_BEAT_INTERVAL_DEFAULT
+    beat_timeout_seconds: float = C.SUPERVISION_BEAT_TIMEOUT_DEFAULT
+    sync_timeout_seconds: float = C.SUPERVISION_SYNC_TIMEOUT_DEFAULT
+    rescue_grace_seconds: float = C.SUPERVISION_RESCUE_GRACE_DEFAULT
+    connect_grace_seconds: float = C.SUPERVISION_CONNECT_GRACE_DEFAULT
+    snapshot_interval_steps: int = C.SUPERVISION_SNAPSHOT_INTERVAL_DEFAULT
+    exit_code: int = C.SUPERVISION_EXIT_CODE_DEFAULT
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]], block: str) -> "SupervisionConfig":
+        if d is None:
+            return cls()
+        d = dict(d)
+        out = cls(
+            enabled=bool(_pop(d, "enabled", C.SUPERVISION_ENABLED_DEFAULT)),
+            channel=str(_pop(d, "channel", C.SUPERVISION_CHANNEL_DEFAULT)).lower(),
+            beat_dir=_pop(d, "beat_dir", None),
+            beat_interval_seconds=float(
+                _pop(d, "beat_interval_seconds", C.SUPERVISION_BEAT_INTERVAL_DEFAULT)
+            ),
+            beat_timeout_seconds=float(
+                _pop(d, "beat_timeout_seconds", C.SUPERVISION_BEAT_TIMEOUT_DEFAULT)
+            ),
+            sync_timeout_seconds=float(
+                _pop(d, "sync_timeout_seconds", C.SUPERVISION_SYNC_TIMEOUT_DEFAULT)
+            ),
+            rescue_grace_seconds=float(
+                _pop(d, "rescue_grace_seconds", C.SUPERVISION_RESCUE_GRACE_DEFAULT)
+            ),
+            connect_grace_seconds=float(
+                _pop(d, "connect_grace_seconds", C.SUPERVISION_CONNECT_GRACE_DEFAULT)
+            ),
+            snapshot_interval_steps=int(
+                _pop(d, "snapshot_interval_steps", C.SUPERVISION_SNAPSHOT_INTERVAL_DEFAULT)
+            ),
+            exit_code=int(_pop(d, "exit_code", C.SUPERVISION_EXIT_CODE_DEFAULT)),
+        )
+        _check_empty(d, block, _known_keys(cls))
+        if out.channel not in C.SUPERVISION_CHANNELS:
+            raise DeepSpeedConfigError(
+                f"'{block}.channel' must be one of {C.SUPERVISION_CHANNELS}, got '{out.channel}'"
+            )
+        if not (0 <= out.exit_code <= 255):
+            raise DeepSpeedConfigError(f"'{block}.exit_code' must be in [0, 255], got {out.exit_code}")
+        for name in ("beat_interval_seconds", "beat_timeout_seconds", "sync_timeout_seconds"):
+            if getattr(out, name) <= 0:
+                raise DeepSpeedConfigError(f"'{block}.{name}' must be > 0, got {getattr(out, name)}")
+        if out.beat_timeout_seconds <= out.beat_interval_seconds:
+            raise DeepSpeedConfigError(
+                f"'{block}.beat_timeout_seconds' ({out.beat_timeout_seconds}) must exceed "
+                f"beat_interval_seconds ({out.beat_interval_seconds}) or every beat gap reads as a death"
+            )
+        if out.snapshot_interval_steps < 1:
+            raise DeepSpeedConfigError(
+                f"'{block}.snapshot_interval_steps' must be >= 1, got {out.snapshot_interval_steps}"
+            )
+        return out
+
+
+@dataclass
 class ResilienceConfig:
     """``resilience`` block (TPU-native extension; docs/resilience.md)."""
 
@@ -458,6 +526,7 @@ class ResilienceConfig:
     watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
     retry: RetryConfig = field(default_factory=RetryConfig)
     divergence: DivergenceConfig = field(default_factory=DivergenceConfig)
+    supervision: SupervisionConfig = field(default_factory=SupervisionConfig)
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ResilienceConfig":
@@ -476,6 +545,9 @@ class ResilienceConfig:
             ),
             divergence=DivergenceConfig.from_dict(
                 _pop(d, C.RESILIENCE_DIVERGENCE, None), f"{C.RESILIENCE}.{C.RESILIENCE_DIVERGENCE}"
+            ),
+            supervision=SupervisionConfig.from_dict(
+                _pop(d, C.RESILIENCE_SUPERVISION, None), f"{C.RESILIENCE}.{C.RESILIENCE_SUPERVISION}"
             ),
         )
         _check_empty(d, C.RESILIENCE, _known_keys(cls))

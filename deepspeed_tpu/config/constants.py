@@ -181,6 +181,18 @@ RETRY_BACKOFF_SECONDS_DEFAULT = 0.5
 RETRY_BACKOFF_MAX_SECONDS_DEFAULT = 30.0
 RETRY_JITTER_DEFAULT = 0.25
 
+RESILIENCE_SUPERVISION = "supervision"
+SUPERVISION_ENABLED_DEFAULT = False
+SUPERVISION_CHANNEL_DEFAULT = "auto"  # auto | tcp | file
+SUPERVISION_CHANNELS = ["auto", "tcp", "file"]
+SUPERVISION_BEAT_INTERVAL_DEFAULT = 1.0  # seconds between liveness beats
+SUPERVISION_BEAT_TIMEOUT_DEFAULT = 5.0  # stale-beat death deadline
+SUPERVISION_SYNC_TIMEOUT_DEFAULT = 300.0  # armed blocking-sync deadline
+SUPERVISION_RESCUE_GRACE_DEFAULT = 5.0  # main-thread surface window
+SUPERVISION_CONNECT_GRACE_DEFAULT = 60.0  # tcp channel connect budget
+SUPERVISION_SNAPSHOT_INTERVAL_DEFAULT = 1  # step boundaries per snapshot
+SUPERVISION_EXIT_CODE_DEFAULT = 44  # "peer-failed-and-saved" (docs/resilience.md)
+
 #############################################
 # Overlap (input prefetch, async checkpointing, step-phase timeline)
 #############################################

@@ -10,4 +10,6 @@ from deepspeed_tpu.elasticity.elasticity import (
     get_candidate_batch_sizes,
     get_best_candidates,
     get_valid_gpus,
+    shrink_world_info,
+    world_rank_map,
 )

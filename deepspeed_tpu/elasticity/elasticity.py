@@ -21,7 +21,8 @@ The algorithm (re-derived from the documented behavior, not a port):
 """
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+import collections
+from typing import Dict, Iterable, List, Tuple
 
 from deepspeed_tpu.elasticity.config import (
     ElasticityConfig,
@@ -97,6 +98,42 @@ def _version_tuple(v: str) -> Tuple[int, ...]:
 
 def elasticity_enabled(ds_config: Dict) -> bool:
     return bool(ds_config.get("elasticity", {}).get("enabled", False))
+
+
+def world_rank_map(active: Dict[str, List[int]]) -> List[Tuple[str, int]]:
+    """Global rank -> (host, slot) in launcher order (hosts in dict
+    order, slots within a host) — the SAME ordering
+    ``launcher/launch.py`` assigns ``RANK`` with, so the two never
+    drift."""
+    out: List[Tuple[str, int]] = []
+    for host, slots in active.items():
+        for slot in slots:
+            out.append((host, slot))
+    return out
+
+
+def shrink_world_info(
+    active: Dict[str, List[int]], failed_ranks: Iterable[int]
+) -> Dict[str, List[int]]:
+    """The surviving active-resources map after dropping the slots of
+    ``failed_ranks`` (global ranks, launcher ordering).  Hosts with no
+    surviving slots disappear.  This is what the launcher's elastic
+    restart (``--restarts``) relaunches with; pair it with
+    :func:`compute_elastic_config` at the new world size to re-derive
+    the batch schedule."""
+    ranks = world_rank_map(active)
+    dead = set()
+    for r in failed_ranks:
+        r = int(r)
+        if not (0 <= r < len(ranks)):
+            raise ValueError(f"failed rank {r} outside world of {len(ranks)}")
+        dead.add(ranks[r])
+    out: Dict[str, List[int]] = collections.OrderedDict()
+    for host, slots in active.items():
+        keep = [s for s in slots if (host, s) not in dead]
+        if keep:
+            out[host] = keep
+    return out
 
 
 def compute_elastic_config(ds_config: Dict, target_deepspeed_version: str, world_size: int = 0):

@@ -103,7 +103,7 @@ def resilience_report(config=None) -> None:
     r = getattr(config, "resilience", config)
     if r is None:
         r = ResilienceConfig()
-    ck, wd, rt, dv = r.checkpoint, r.watchdog, r.retry, r.divergence
+    ck, wd, rt, dv, sv = r.checkpoint, r.watchdog, r.retry, r.divergence, r.supervision
     print()
     print("resilience configuration:")
     rows = [
@@ -135,6 +135,24 @@ def resilience_report(config=None) -> None:
         (
             "divergence guard",
             f"{dv.action} after {dv.threshold} skipped steps" if dv.enabled else "disabled",
+        ),
+        (
+            "supervision",
+            f"enabled ({sv.channel} channel, beat {sv.beat_interval_seconds:g}s)"
+            if sv.enabled
+            else "disabled (one dead rank hangs the collectives forever)",
+        ),
+        (
+            "supervision deadlines",
+            f"death after {sv.beat_timeout_seconds:g}s stale beat, hung sync after "
+            f"{sv.sync_timeout_seconds:g}s; exit {sv.exit_code} = peer-failed-and-saved",
+        ),
+        (
+            "elastic restarts",
+            (lambda n: f"{n} (launcher --restarts, resumes from newest verified tag)"
+             if n else "0 (launch with --restarts N to relaunch on exit 43/44)")(
+                int(os.environ.get("DS_RESTARTS", "0") or 0)
+            ),
         ),
     ]
     for name, value in rows:

@@ -41,6 +41,13 @@ def parse_args(args=None):
     parser.add_argument("--launcher", type=str, default="pdsh", help="pdsh|ssh|openmpi|mvapich")
     parser.add_argument("--launcher_args", type=str, default="")
     parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument(
+        "--restarts", type=int, default=0,
+        help="elastic restarts: when the job exits 43/44 (saved-and-exited, "
+             "docs/resilience.md), relaunch up to N times on the surviving "
+             "hosts/slots (shrunk world via elasticity.shrink_world_info); the "
+             "engine resumes from the newest verified tag",
+    )
     parser.add_argument("user_script", type=str)
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
     return parser.parse_args(args=args)
@@ -123,6 +130,86 @@ def encode_world_info(active_resources: Dict[str, List[int]]) -> str:
     return base64.urlsafe_b64encode(json.dumps(active_resources).encode()).decode()
 
 
+_SAVED_CODES = (43, 44)  # preempted-and-saved / peer-failed-and-saved
+
+
+def _read_failed_ranks(status_dir: str) -> List[int]:
+    """Global ranks whose exit codes in the per-node status files mark a
+    crash (anything but 0/43/44) — what the shrunk relaunch drops.
+    Ranks the launcher itself pack-killed at grace expiry sat on healthy
+    hardware and are NOT failures."""
+    failed: List[int] = []
+    try:
+        for name in os.listdir(status_dir):
+            if not (name.startswith("node") and name.endswith("_status.json")):
+                continue
+            with open(os.path.join(status_dir, name)) as f:
+                status = json.load(f)
+            pack_killed = {int(r) for r in status.get("pack_killed", [])}
+            for rank, code in status.get("codes", {}).items():
+                if int(code) not in (0,) + _SAVED_CODES and int(rank) not in pack_killed:
+                    failed.append(int(rank))
+    except (OSError, ValueError) as e:
+        logger.warning(f"runner: could not read supervision status from {status_dir}: {e}")
+    return sorted(set(failed))
+
+
+def _default_shrink(active: Dict[str, List[int]], status_dir: str) -> Dict[str, List[int]]:
+    """Rank-level shrink from the per-node status files."""
+    from deepspeed_tpu.elasticity.elasticity import shrink_world_info
+
+    failed = _read_failed_ranks(status_dir)
+    if not failed:
+        return active
+    try:
+        return shrink_world_info(active, failed)
+    except ValueError as e:
+        logger.warning(f"runner: rank-level shrink failed ({e}); restarting at the same world")
+        return active
+
+
+def _elastic_loop(args, active: Dict[str, List[int]], launch_once, shrink_fn=_default_shrink) -> int:
+    """Run ``launch_once(active, attempt)`` -> exit code, relaunching on
+    43/44 at the shrunk world up to ``--restarts`` times (the elastic
+    restart driver; docs/resilience.md).  ``shrink_fn(active,
+    status_dir)`` derives the surviving resources for the relaunch."""
+    import shutil
+    import tempfile
+
+    if args.restarts <= 0:
+        # plain run: no status plumbing, no env mutation, nothing leaked
+        return launch_once(active, 0)
+
+    attempt = 0
+    while True:
+        status_dir = tempfile.mkdtemp(prefix="ds_supervision_")
+        os.environ["DS_SUPERVISION_DIR"] = status_dir
+        os.environ["DS_RESTART_COUNT"] = str(attempt)
+        os.environ["DS_RESTARTS"] = str(args.restarts)
+        try:
+            code = launch_once(active, attempt)
+            if code not in _SAVED_CODES or attempt >= args.restarts:
+                if code in _SAVED_CODES:
+                    logger.error(
+                        f"runner: restart budget ({args.restarts}) exhausted; exiting {code}"
+                    )
+                return code
+            survivors = shrink_fn(active, status_dir)
+        finally:
+            # status files were consumed (or the run is over): clean up
+            shutil.rmtree(status_dir, ignore_errors=True)
+        if not survivors:
+            logger.error("runner: no surviving slots to restart on")
+            return code
+        attempt += 1
+        logger.warning(
+            f"runner: job exited {code} (saved); elastic restart {attempt}/{args.restarts} on "
+            f"{sum(len(v) for v in survivors.values())} slot(s) across "
+            f"{len(survivors)} host(s)"
+        )
+        active = survivors
+
+
 def main(args=None):
     args = parse_args(args)
     resource_pool = fetch_hostfile(args.hostfile)
@@ -131,24 +218,28 @@ def main(args=None):
         # single-node path (reference :314-324): localhost, all local chips
         procs = args.num_gpus if args.num_gpus > 0 else 1
         active = {"localhost": list(range(procs))}
-        cmd = [
-            sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
-            "--node_rank=0",
-            f"--master_addr={args.master_addr or '127.0.0.1'}",
-            f"--master_port={args.master_port}",
-            f"--world_info={encode_world_info(active)}",
-            f"--procs_per_node={procs}",
-            args.user_script, *args.user_args,
-        ]
-        logger.info(f"runner: single-node cmd: {' '.join(cmd)}")
-        result = subprocess.Popen(cmd)
-        result.wait()
-        sys.exit(result.returncode)
+
+        def launch_once(active_now, attempt):
+            procs_now = sum(len(v) for v in active_now.values())
+            cmd = [
+                sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
+                "--node_rank=0",
+                f"--master_addr={args.master_addr or '127.0.0.1'}",
+                f"--master_port={args.master_port}",
+                f"--world_info={encode_world_info(active_now)}",
+                f"--procs_per_node={procs_now}",
+                args.user_script, *args.user_args,
+            ]
+            logger.info(f"runner: single-node cmd: {' '.join(cmd)}")
+            result = subprocess.Popen(cmd)
+            result.wait()
+            return result.returncode
+
+        sys.exit(_elastic_loop(args, active, launch_once))
 
     active = parse_resource_filter(resource_pool, args.include, args.exclude)
     if args.num_nodes > 0:
         active = collections.OrderedDict(list(active.items())[: args.num_nodes])
-    world_info = encode_world_info(active)
     args.master_addr = args.master_addr or next(iter(active))
 
     from deepspeed_tpu.launcher.multinode_runner import (
@@ -158,28 +249,74 @@ def main(args=None):
     runners = {"pdsh": PDSHRunner, "ssh": SSHRunner, "openmpi": OpenMPIRunner, "mvapich": MVAPICHRunner}
     if args.launcher not in runners:
         raise ValueError(f"unknown launcher {args.launcher} (choose from {sorted(runners)})")
-    runner = runners[args.launcher](args, world_info)
-    if not runner.backend_exists():
-        raise RuntimeError(f"launcher backend '{runner.name}' not found on PATH")
-    env = os.environ.copy()
-    cmd = runner.get_cmd(env, active)
-    if isinstance(cmd[0], list):  # ssh runner: one command per host
+    if args.restarts and args.launcher not in ("ssh",):
+        logger.warning(
+            f"runner: --restarts with the '{args.launcher}' launcher relaunches at the SAME "
+            "world (the single fan-out process hides which host died); use the ssh launcher "
+            "for per-host shrink"
+        )
+
+    def launch_once(active_now, attempt):
+        launch_once.failed_hosts = []
+        world_info = encode_world_info(active_now)
+        runner = runners[args.launcher](args, world_info)
+        if not runner.backend_exists():
+            raise RuntimeError(f"launcher backend '{runner.name}' not found on PATH")
+        # supervision state must reach the REMOTE nodes too (ssh does
+        # not forward env): DS_SUPERVISION_DIR enables the rank-level
+        # shrink on shared filesystems, the rest keep restart counters
+        # and fault plans consistent across the pod
+        for key in ("DS_SUPERVISION_DIR", "DS_RESTART_COUNT", "DS_RESTARTS",
+                    "DS_PEER_GRACE", "DS_FAULT_PLAN"):
+            if os.environ.get(key):
+                runner.add_export(key, os.environ[key])
+        env = os.environ.copy()
+        cmd = runner.get_cmd(env, active_now)
+        if not isinstance(cmd[0], list):
+            logger.info(f"runner: {' '.join(map(str, cmd))}")
+            result = subprocess.Popen(cmd, env=env)
+            result.wait()
+            return result.returncode
+
+        # ssh runner: one command per host.  Cross-node pack-kill
+        # mirrors launch.py's per-node contract, refined for the
+        # supervision exit codes: a node exiting 43/44 saved and left
+        # (no pack-kill); any other non-zero code opens a peer-grace
+        # window for the remaining hosts to emergency-save first.
         import time
 
         procs = [subprocess.Popen(c, env=env) for c in cmd]
-        code = 0
+        hosts = list(active_now)
+        codes = {}
+        crash = 0
+        grace_deadline = None
+        peer_grace = float(os.environ.get("DS_PEER_GRACE", "30"))
         alive = set(range(len(procs)))
-        # cross-node pack-kill (mirrors launch.py's per-node contract):
-        # first non-zero exit terminates the remaining hosts
-        while alive and code == 0:
+        while alive:
             for i in list(alive):
                 rc = procs[i].poll()
-                if rc is not None:
-                    alive.discard(i)
-                    if rc != 0:
-                        logger.error(f"runner: node {i} exited with {rc}; terminating remaining hosts")
-                        code = rc
-            if alive and code == 0:
+                if rc is None:
+                    continue
+                alive.discard(i)
+                codes[i] = rc
+                if rc == 0:
+                    continue
+                if rc in _SAVED_CODES:
+                    # a saved-and-exited node means the others are (or
+                    # are about to be) wedged on the missing peer: bound
+                    # the wait like launch.py's per-node loop does
+                    logger.warning(f"runner: node {i} ({hosts[i]}) exited {rc} (saved)")
+                    if alive and grace_deadline is None:
+                        grace_deadline = time.monotonic() + peer_grace
+                    continue
+                logger.error(f"runner: node {i} ({hosts[i]}) exited with {rc}")
+                crash = crash or rc
+                if grace_deadline is None:
+                    grace_deadline = time.monotonic() + peer_grace
+            if alive and grace_deadline is not None and time.monotonic() >= grace_deadline:
+                logger.error(f"runner: terminating {len(alive)} remaining host(s)")
+                break
+            if alive:
                 time.sleep(0.5)
         for i in alive:
             procs[i].terminate()
@@ -189,11 +326,33 @@ def main(args=None):
                     p.wait(timeout=15)
                 except subprocess.TimeoutExpired:
                     p.kill()
-        sys.exit(code)
-    logger.info(f"runner: {' '.join(map(str, cmd))}")
-    result = subprocess.Popen(cmd, env=env)
-    result.wait()
-    sys.exit(result.returncode)
+        # a crashed node's surviving slots cannot be re-derived from
+        # here (its status file is on its local disk): drop the WHOLE
+        # crashed host on restart
+        bad_hosts = [hosts[i] for i, rc in codes.items() if rc not in (0,) + _SAVED_CODES]
+        launch_once.failed_hosts = bad_hosts
+        all_codes = list(codes.values())
+        if any(c == 44 for c in all_codes):
+            return 44
+        if any(c == 43 for c in all_codes):
+            return 43
+        return crash
+
+    def shrink_multinode(active_now, status_dir):
+        # rank-level shrink from status files (reachable on a shared
+        # filesystem — DS_SUPERVISION_DIR is exported to the nodes),
+        # then drop WHOLE crashed hosts (a node whose launcher died has
+        # no readable status; tracked on launch_once by exit code)
+        survivors = _default_shrink(active_now, status_dir)
+        failed_hosts = set(getattr(launch_once, "failed_hosts", []))
+        survivors = collections.OrderedDict(
+            (h, s) for h, s in survivors.items() if h not in failed_hosts
+        )
+        if survivors:
+            args.master_addr = next(iter(survivors))
+        return survivors
+
+    sys.exit(_elastic_loop(args, active, launch_once, shrink_fn=shrink_multinode))
 
 
 if __name__ == "__main__":

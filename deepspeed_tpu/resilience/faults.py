@@ -16,12 +16,31 @@ Two failure shapes:
   at that instruction.  Deliberately NOT an ``Exception`` so no
   ``except Exception`` cleanup handler in the code under test can "survive"
   a death the real process would not.
+
+Multi-process plans (the supervision harness): a plan serializes to
+JSON, rides to launcher-spawned children in the ``DS_FAULT_PLAN`` env
+var, and installs itself at engine init (:func:`install_from_env`).
+Each plan entry may carry a ``rank`` filter, so a single env var arms
+"SIGKILL rank 1 at its 4th step boundary" across a real 2-process job.
+Two plan kinds exist only for real processes:
+
+* ``sigkill`` — ``os.kill(getpid(), SIGKILL)``: the real thing, no
+  Python unwinding, no atexit — exactly what a hardware loss looks like
+  to the surviving ranks;
+* ``stall`` — :func:`check_stall` sleeps ``seconds`` inside a blocking
+  sync (site ``collective.stall``), modelling a wedged-but-alive peer
+  for the hung-collective watchdog.
 """
 from __future__ import annotations
 
+import json
 import os
 import random
+import signal
+import time
 from typing import Dict, List, Optional, Tuple
+
+DS_FAULT_PLAN_ENV = "DS_FAULT_PLAN"
 
 
 class InjectedFault(OSError):
@@ -49,6 +68,18 @@ def check_flag(site: str) -> bool:
     return _ACTIVE.fire_flag(site)
 
 
+def check_stall(site: str) -> float:
+    """Sleep for the planned stall duration at ``site`` (0 when no stall
+    is armed).  Returns the seconds slept — the hung-collective tests
+    assert attribution against it."""
+    if _ACTIVE is None:
+        return 0.0
+    seconds = _ACTIVE.fire_stall(site)
+    if seconds > 0:
+        time.sleep(seconds)
+    return seconds
+
+
 class FaultInjector:
     """Seeded, per-site fault plans.  Use as a context manager::
 
@@ -65,10 +96,13 @@ class FaultInjector:
         self.log: List[Tuple[str, str]] = []  # (site, event)
 
     # -- plan registration ------------------------------------------------
-    def _plan(self, site: str, exc, times: int, after: int, probability: Optional[float]) -> None:
+    def _plan(self, site: str, exc, times: int, after: int, probability: Optional[float],
+              kind: Optional[str] = None, seconds: float = 0.0) -> None:
         self._plans[site] = {
             "exc": exc, "times": times, "after": after,
             "probability": probability, "calls": 0, "fired": 0,
+            "kind": kind or ("flag" if exc is None else "raise"),
+            "seconds": float(seconds),
         }
 
     def fail(self, site: str, times: int = 1, after: int = 0, exc=InjectedFault,
@@ -88,6 +122,19 @@ class FaultInjector:
         self._plan(site, None, times, after, None)
         return self
 
+    def sigkill(self, site: str, after: int = 0) -> "FaultInjector":
+        """Arm a REAL ``SIGKILL`` of this process at ``site`` — no Python
+        unwinding, no atexit.  Only meaningful in subprocess tests; the
+        in-process analog is :meth:`kill`."""
+        self._plan(site, None, 1, after, None, kind="sigkill")
+        return self
+
+    def stall(self, site: str, seconds: float, times: int = 1, after: int = 0) -> "FaultInjector":
+        """Arm a ``seconds``-long sleep at ``site`` (``check_stall``) —
+        a wedged-but-alive collective."""
+        self._plan(site, None, times, after, None, kind="stall", seconds=seconds)
+        return self
+
     # -- firing -----------------------------------------------------------
     def _triggers(self, plan: dict) -> bool:
         plan["calls"] += 1
@@ -100,7 +147,14 @@ class FaultInjector:
 
     def fire(self, site: str, path: Optional[str] = None) -> None:
         plan = self._plans.get(site)
-        if plan is None or plan["exc"] is None:
+        if plan is None:
+            return
+        if plan["kind"] == "sigkill":
+            if self._triggers(plan):
+                self.log.append((site, "sigkill"))
+                os.kill(os.getpid(), signal.SIGKILL)
+            return
+        if plan["exc"] is None:
             return
         if self._triggers(plan):
             self.log.append((site, plan["exc"].__name__))
@@ -108,12 +162,21 @@ class FaultInjector:
 
     def fire_flag(self, site: str) -> bool:
         plan = self._plans.get(site)
-        if plan is None or plan["exc"] is not None:
+        if plan is None or plan["kind"] != "flag":
             return False
         if self._triggers(plan):
             self.log.append((site, "flag"))
             return True
         return False
+
+    def fire_stall(self, site: str) -> float:
+        plan = self._plans.get(site)
+        if plan is None or plan["kind"] != "stall":
+            return 0.0
+        if self._triggers(plan):
+            self.log.append((site, "stall"))
+            return plan["seconds"]
+        return 0.0
 
     def calls(self, site: str) -> int:
         plan = self._plans.get(site)
@@ -137,6 +200,57 @@ class FaultInjector:
             f.seek(pos)
             f.write(bytes([b[0] ^ 0xFF]))
 
+    # -- multi-process plan propagation (DS_FAULT_PLAN) -------------------
+    _EXC_NAMES = {"InjectedFault": InjectedFault, "InjectedKill": InjectedKill,
+                  "OSError": OSError, "RuntimeError": RuntimeError}
+
+    def to_plan(self) -> str:
+        """Serialize the armed plans to the ``DS_FAULT_PLAN`` JSON form
+        (rank filters are added by the caller — see :func:`plan_json`)."""
+        entries = []
+        for site, p in self._plans.items():
+            entries.append({
+                "site": site,
+                "action": {"raise": "fail", "flag": "flag", "sigkill": "sigkill",
+                           "stall": "stall"}[p["kind"]],
+                "times": p["times"], "after": p["after"], "seconds": p["seconds"],
+                **({"exc": p["exc"].__name__} if p["exc"] is not None and p["kind"] == "raise" else {}),
+                **({"probability": p["probability"]} if p["probability"] is not None else {}),
+            })
+        return json.dumps({"seed": 0, "plans": entries})
+
+    @classmethod
+    def from_plan(cls, spec: str, rank: Optional[int] = None) -> "FaultInjector":
+        """Build an injector from the JSON plan, keeping only entries
+        whose ``rank`` filter matches (absent filter = every rank)."""
+        d = json.loads(spec)
+        inj = cls(seed=int(d.get("seed", 0)))
+        for e in d.get("plans", []):
+            r = e.get("rank")
+            if r is not None and rank is not None:
+                ranks = r if isinstance(r, list) else [r]
+                if rank not in [int(x) for x in ranks]:
+                    continue
+            site = e["site"]
+            action = e.get("action", "fail")
+            times = int(e.get("times", 1))
+            after = int(e.get("after", 0))
+            if action == "fail":
+                exc = cls._EXC_NAMES.get(e.get("exc", "InjectedFault"), InjectedFault)
+                inj.fail(site, times=times, after=after, exc=exc,
+                         probability=e.get("probability"))
+            elif action == "kill":
+                inj.kill(site, after=after)
+            elif action == "sigkill":
+                inj.sigkill(site, after=after)
+            elif action == "flag":
+                inj.flag(site, times=times, after=after)
+            elif action == "stall":
+                inj.stall(site, float(e.get("seconds", 1.0)), times=times, after=after)
+            else:
+                raise ValueError(f"unknown fault action '{action}' for site '{site}'")
+        return inj
+
     # -- installation -----------------------------------------------------
     def __enter__(self) -> "FaultInjector":
         global _ACTIVE
@@ -148,3 +262,31 @@ class FaultInjector:
     def __exit__(self, *exc_info) -> None:
         global _ACTIVE
         _ACTIVE = None
+
+
+def plan_json(plans: List[dict], seed: int = 0) -> str:
+    """Compose a ``DS_FAULT_PLAN`` value from raw entries, e.g.::
+
+        plan_json([{"site": "step.boundary", "action": "sigkill",
+                    "rank": 1, "after": 3}])
+    """
+    return json.dumps({"seed": seed, "plans": plans})
+
+
+def install_from_env(rank: Optional[int] = None) -> Optional[FaultInjector]:
+    """Install the injector described by ``DS_FAULT_PLAN`` for the rest
+    of this process's life (no context manager: launcher-spawned
+    children die with their plan).  ``rank`` defaults to the launcher's
+    ``RANK`` env.  No-op (returns None) without the env var, with an
+    empty filtered plan, or when an injector is already active."""
+    global _ACTIVE
+    spec = os.environ.get(DS_FAULT_PLAN_ENV)
+    if not spec or _ACTIVE is not None:
+        return None
+    if rank is None:
+        rank = int(os.environ.get("RANK", "0"))
+    inj = FaultInjector.from_plan(spec, rank=rank)
+    if not inj._plans:
+        return None
+    _ACTIVE = inj
+    return inj

@@ -1,0 +1,47 @@
+"""Distributed supervision: rank-failure detection, hung-collective
+watchdog, elastic restart support (docs/resilience.md §supervision).
+
+PR 2's resilience layer makes a *single process* die safely; this
+package gives a multi-host job a failure domain:
+
+* :mod:`~deepspeed_tpu.resilience.supervision.heartbeat` — each rank's
+  supervisor thread publishes liveness beats over a side channel that is
+  independent of the ICI collectives (launcher-distributed TCP to the
+  rank-0 supervisor, with a shared-filesystem beat-file fallback), so a
+  SIGKILL'd or wedged rank is *detected* (socket EOF, stale beat)
+  rather than inferred from a hang;
+* :mod:`~deepspeed_tpu.resilience.supervision.supervisor` —
+  :class:`Supervisor`: armed-deadline regions around every blocking
+  sync (step boundary, flag-allgather, checkpoint barriers), peer-death
+  notices, and the rescue orchestration that turns either into a
+  verified emergency tag + exit ``44`` ("peer-failed-and-saved");
+* :mod:`~deepspeed_tpu.resilience.supervision.rescue` — the host-only
+  emergency save: rank-local state shards to an atomic, manifest-
+  verified ``local_npz`` tag with NO collectives, so a survivor can
+  still commit after its peers are gone.
+
+Exit-code contract (extends PR 2's):
+
+* ``43`` — preempted (SIGTERM) and saved;
+* ``44`` — a peer died / a collective hung, and this rank committed a
+  verified emergency tag first.  The launcher's ``--restarts N``
+  relaunches on 43/44 at the shrunk world
+  (``elasticity.shrink_world_info``) and the engine resumes from the
+  newest verified tag through orbax's DP-resize reshard.
+"""
+from deepspeed_tpu.resilience.supervision.heartbeat import (  # noqa: F401
+    FileBeatChannel,
+    PeerEvent,
+    TcpBeatChannel,
+)
+from deepspeed_tpu.resilience.supervision.rescue import (  # noqa: F401
+    LOCAL_STATE_FILE,
+    emergency_local_save,
+    load_local_state,
+)
+from deepspeed_tpu.resilience.supervision.supervisor import (  # noqa: F401
+    EXIT_PEER_FAILED_SAVED,
+    PeerFailure,
+    Supervisor,
+    supervised_sync,
+)

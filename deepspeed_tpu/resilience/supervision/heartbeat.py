@@ -1,0 +1,383 @@
+"""Liveness side channels, independent of the ICI collectives.
+
+Both channels carry the same tiny protocol: each rank periodically
+publishes a beat (monotonically increasing sequence number); a clean
+shutdown publishes a goodbye so departing ranks are never mistaken for
+dead ones.  The consumer (:class:`~.supervisor.Supervisor`) polls
+:meth:`events` for :class:`PeerEvent` records.
+
+* :class:`TcpBeatChannel` — the launcher-distributed channel: the
+  rank-0 supervisor runs a small line-protocol server
+  (``DS_SUPERVISION_PORT``, set by ``launcher/launch.py``); every other
+  rank keeps one client connection open and writes beats to it.  A
+  SIGKILL'd rank's kernel closes the socket, so death is *detected* by
+  EOF within one poll cycle — no timeout inference needed.  The server
+  broadcasts ``dead <rank>`` notices to the surviving clients, and a
+  client treats loss of the server connection as rank-0 death.
+
+* :class:`FileBeatChannel` — shared-filesystem fallback (tests,
+  single-node): each rank atomically rewrites ``<dir>/rank<i>.beat``;
+  staleness beyond the beat timeout means death.  Strictly weaker
+  (timeout-only detection) but needs no network and survives any
+  launcher.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.resilience import atomic
+
+# env var the launcher sets so every rank agrees on the side-channel
+# endpoint without a config edit (launch.py derives it from master_port)
+SUPERVISION_PORT_ENV = "DS_SUPERVISION_PORT"
+SUPERVISION_ADDR_ENV = "DS_SUPERVISION_ADDR"
+
+
+@dataclass
+class PeerEvent:
+    """One liveness transition observed on the channel."""
+
+    rank: int
+    kind: str  # "dead" | "bye" (clean departure)
+    reason: str = ""
+    at: float = field(default_factory=time.monotonic)
+
+
+class _EventSink:
+    """Thread-safe accumulator both channels feed."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events: List[PeerEvent] = []
+        self._seen: set = set()  # (rank, kind) dedup
+
+    def push(self, ev: PeerEvent) -> None:
+        with self._lock:
+            key = (ev.rank, ev.kind)
+            if key in self._seen:
+                return
+            self._seen.add(key)
+            self._events.append(ev)
+
+    def drain(self) -> List[PeerEvent]:
+        with self._lock:
+            out, self._events = self._events, []
+            return out
+
+    def departed(self, rank: int) -> bool:
+        with self._lock:
+            return (rank, "bye") in self._seen or (rank, "dead") in self._seen
+
+
+class FileBeatChannel:
+    """Beat files on a shared filesystem.  Symmetric: every rank both
+    publishes its own file and scans the others'.
+
+    Staleness is judged by the beat SEQUENCE not advancing against the
+    observer's own monotonic clock — never by comparing file mtimes to
+    the local wall clock, which cross-host clock skew on a shared
+    filesystem would defeat."""
+
+    name = "file"
+
+    def __init__(self, beat_dir: str, rank: int, world_size: int, beat_timeout: float = 5.0):
+        self.beat_dir = os.path.abspath(beat_dir)
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self.beat_timeout = float(beat_timeout)
+        self._sink = _EventSink()
+        self._first_seen: Dict[int, float] = {}
+        # rank -> (last observed seq, local-monotonic time it changed)
+        self._last_change: Dict[int, tuple] = {}
+        os.makedirs(self.beat_dir, exist_ok=True)
+
+    def _path(self, rank: int) -> str:
+        return os.path.join(self.beat_dir, f"rank{rank}.beat")
+
+    def start(self) -> None:  # nothing to spin up
+        pass
+
+    def beat(self, seq: int) -> None:
+        atomic.atomic_write_text(
+            self._path(self.rank), json.dumps({"rank": self.rank, "seq": int(seq)})
+        )
+
+    def goodbye(self) -> None:
+        atomic.atomic_write_text(
+            self._path(self.rank), json.dumps({"rank": self.rank, "bye": True})
+        )
+
+    def events(self) -> List[PeerEvent]:
+        now = time.monotonic()
+        for r in range(self.world_size):
+            if r == self.rank or self._sink.departed(r):
+                continue
+            path = self._path(r)
+            try:
+                with open(path) as f:
+                    data = json.loads(f.read() or "{}")
+            except (OSError, ValueError):
+                # not written yet — give the rank the full timeout from
+                # the moment WE first looked for it
+                self._first_seen.setdefault(r, now)
+                if now - self._first_seen[r] > self.beat_timeout * 3:
+                    self._sink.push(PeerEvent(r, "dead", "no beat file ever appeared"))
+                continue
+            if data.get("bye"):
+                self._sink.push(PeerEvent(r, "bye", "clean departure"))
+                continue
+            seq = data.get("seq")
+            last = self._last_change.get(r)
+            if last is None or last[0] != seq:
+                self._last_change[r] = (seq, now)
+            elif now - last[1] > self.beat_timeout:
+                self._sink.push(
+                    PeerEvent(r, "dead",
+                              f"beat stale for >{self.beat_timeout:g}s (beat-timeout)")
+                )
+        return self._sink.drain()
+
+    def stop(self) -> None:
+        pass
+
+
+class TcpBeatChannel:
+    """Rank-0 server + per-rank client over one TCP line protocol.
+
+    Lines: ``hello <rank>``, ``beat <rank> <seq>``, ``bye <rank>`` from
+    clients; ``dead <rank>`` / ``bye <rank>`` notices from the server.
+    """
+
+    name = "tcp"
+
+    def __init__(
+        self,
+        rank: int,
+        world_size: int,
+        address: str = "127.0.0.1",
+        port: int = 0,
+        beat_timeout: float = 5.0,
+        connect_grace: float = 30.0,
+    ):
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self.address = address
+        self.port = int(port)
+        self.beat_timeout = float(beat_timeout)
+        self.connect_grace = float(connect_grace)
+        self._sink = _EventSink()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._server: Optional[socket.socket] = None
+        self._client: Optional[socket.socket] = None
+        self._client_lock = threading.Lock()
+        # server state
+        self._conns: Dict[int, socket.socket] = {}
+        self._all_conns: List[socket.socket] = []  # accepted, incl. pre-hello
+        self._conns_lock = threading.Lock()
+        self._last_beat: Dict[int, float] = {}
+        self._started_at = 0.0
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> None:
+        self._started_at = time.monotonic()
+        if self.rank == 0:
+            srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            srv.bind(("", self.port))
+            srv.listen(self.world_size + 4)
+            srv.settimeout(0.25)
+            self.port = srv.getsockname()[1]
+            self._server = srv
+            t = threading.Thread(target=self._accept_loop, name="ds-sup-accept", daemon=True)
+            t.start()
+            self._threads.append(t)
+        else:
+            t = threading.Thread(target=self._client_loop, name="ds-sup-client", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._conns_lock:
+            conns = list(self._all_conns)
+        for s in ([self._server] + conns + [self._client]):
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    # -- publishing -------------------------------------------------------
+    def beat(self, seq: int) -> None:
+        if self.rank == 0:
+            self._last_beat[0] = time.monotonic()  # server beats locally
+            return
+        self._send(f"beat {self.rank} {int(seq)}\n")
+
+    def goodbye(self) -> None:
+        if self.rank == 0:
+            self._broadcast(f"bye 0\n")
+            return
+        self._send(f"bye {self.rank}\n")
+
+    def _send(self, line: str) -> None:
+        with self._client_lock:
+            c = self._client
+        if c is None:
+            return
+        try:
+            c.sendall(line.encode())
+        except OSError:
+            # server unreachable: the reader loop raises the event
+            pass
+
+    # -- consuming --------------------------------------------------------
+    def events(self) -> List[PeerEvent]:
+        if self.rank == 0:
+            now = time.monotonic()
+            with self._conns_lock:
+                connected = set(self._conns)
+            for r in range(1, self.world_size):
+                if self._sink.departed(r):
+                    continue
+                last = self._last_beat.get(r)
+                if last is None:
+                    if r not in connected and now - self._started_at > self.connect_grace:
+                        self._notice_dead(r, "never connected to the supervision channel")
+                elif now - last > self.beat_timeout:
+                    self._notice_dead(r, f"beat stale for >{self.beat_timeout:g}s (beat-timeout)")
+        return self._sink.drain()
+
+    # -- server internals -------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._server.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            with self._conns_lock:
+                self._all_conns.append(conn)
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,), name="ds-sup-conn", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        peer_rank: Optional[int] = None
+        buf = b""
+        try:
+            # inside the try: stop() may close the socket between the
+            # accept and here, and that must read as a quiet EOF
+            conn.settimeout(0.5)
+            while not self._stop.is_set():
+                try:
+                    chunk = conn.recv(4096)
+                except socket.timeout:
+                    continue
+                if not chunk:
+                    break
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    parts = line.decode(errors="ignore").split()
+                    if not parts:
+                        continue
+                    if parts[0] == "hello" and len(parts) >= 2:
+                        peer_rank = int(parts[1])
+                        with self._conns_lock:
+                            self._conns[peer_rank] = conn
+                        self._last_beat[peer_rank] = time.monotonic()
+                    elif parts[0] == "beat" and len(parts) >= 2:
+                        self._last_beat[int(parts[1])] = time.monotonic()
+                    elif parts[0] == "bye" and len(parts) >= 2:
+                        r = int(parts[1])
+                        self._sink.push(PeerEvent(r, "bye", "clean departure"))
+                        self._broadcast(f"bye {r}\n", skip=r)
+                        return
+        except OSError:
+            pass
+        # EOF/error without a bye: the kernel closed a dead rank's socket
+        if peer_rank is not None and not self._sink.departed(peer_rank):
+            self._notice_dead(peer_rank, "supervision socket EOF (rank process died)")
+
+    def _notice_dead(self, rank: int, reason: str) -> None:
+        self._sink.push(PeerEvent(rank, "dead", reason))
+        self._broadcast(f"dead {rank}\n", skip=rank)
+
+    def _broadcast(self, line: str, skip: Optional[int] = None) -> None:
+        with self._conns_lock:
+            conns = dict(self._conns)
+        for r, c in conns.items():
+            if r == skip:
+                continue
+            try:
+                c.sendall(line.encode())
+            except OSError:
+                pass
+
+    # -- client internals -------------------------------------------------
+    def _client_loop(self) -> None:
+        deadline = time.monotonic() + self.connect_grace
+        sock: Optional[socket.socket] = None
+        while not self._stop.is_set() and time.monotonic() < deadline:
+            try:
+                sock = socket.create_connection((self.address, self.port), timeout=2.0)
+                break
+            except OSError:
+                time.sleep(0.2)
+        if sock is None:
+            if not self._stop.is_set():
+                self._sink.push(
+                    PeerEvent(0, "dead", f"could not reach rank-0 supervisor at "
+                                         f"{self.address}:{self.port} within {self.connect_grace:g}s")
+                )
+            return
+        sock.settimeout(0.5)
+        with self._client_lock:
+            self._client = sock
+        try:
+            sock.sendall(f"hello {self.rank}\n".encode())
+        except OSError:
+            pass
+        buf = b""
+        while not self._stop.is_set():
+            try:
+                chunk = sock.recv(4096)
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            if not chunk:
+                break
+            buf += chunk
+            while b"\n" in buf:
+                line, buf = buf.split(b"\n", 1)
+                parts = line.decode(errors="ignore").split()
+                if len(parts) >= 2 and parts[0] in ("dead", "bye"):
+                    self._sink.push(
+                        PeerEvent(int(parts[1]), parts[0],
+                                  "notice from rank-0 supervisor" if parts[0] == "dead"
+                                  else "clean departure")
+                    )
+        if not self._stop.is_set() and not self._sink.departed(0):
+            # lost the server: rank 0 itself died
+            self._sink.push(PeerEvent(0, "dead", "supervision socket to rank 0 lost (EOF)"))
+
+
+def resolve_endpoint(default_port: int = 0) -> tuple:
+    """(address, port) for the TCP channel from the launcher env:
+    ``DS_SUPERVISION_ADDR`` (default ``MASTER_ADDR`` or localhost) and
+    ``DS_SUPERVISION_PORT``."""
+    addr = os.environ.get(SUPERVISION_ADDR_ENV) or os.environ.get("MASTER_ADDR") or "127.0.0.1"
+    port = int(os.environ.get(SUPERVISION_PORT_ENV, default_port) or default_port)
+    return addr, port

@@ -1,0 +1,158 @@
+"""Host-only emergency checkpoint: commit a verified tag with NO
+collectives, so a survivor can still save after its peers are gone.
+
+A normal save is a collective (orbax sharded writes + staging/commit
+barriers) — impossible once a peer is dead.  The rescue path instead
+writes the rank's *host snapshot* of the portable state (taken at the
+last step boundary, where in pure-DP topologies every rank holds the
+full logical arrays) as one ``state_local.npz``, then runs the exact
+PR 2 durability protocol: stage into ``<tag>.tmp``, ``meta.json``,
+size+checksum ``manifest.json`` last, one rename, atomic ``latest``.
+The tag is therefore verifiable and quarantine-able like any other, and
+``load_checkpoint`` restores it through the same candidate scan
+(``meta["format"] == "local_npz"`` routes the restore through
+:func:`load_local_state`; orbax's DP-resize reshard is subsumed because
+the npz holds full logical arrays that ``device_put`` re-shards for
+whatever mesh the restoring job uses).
+
+Non-native dtypes (bfloat16 & friends) are bit-cast to a same-width
+integer view for ``np.savez`` and recorded in a dtype sidecar inside
+the npz, so the round-trip is exact.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.resilience import atomic, manager
+from deepspeed_tpu.utils.logging import logger
+
+LOCAL_STATE_FILE = "state_local.npz"
+_DTYPES_KEY = "__dtypes__"
+# np.savez handles these natively; anything else ships as a bit-cast
+_NATIVE_KINDS = set("biufc?")
+
+
+def _flatten_with_keystr(tree: Any):
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(jax.tree_util.keystr(path), leaf) for path, leaf in flat]
+
+
+def _bitcast_for_save(arr: np.ndarray) -> Tuple[np.ndarray, Optional[str]]:
+    if arr.dtype.kind in _NATIVE_KINDS:
+        return arr, None
+    width = arr.dtype.itemsize
+    view = arr.view({1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}[width])
+    return view, str(arr.dtype)
+
+
+def _bitcast_for_load(arr: np.ndarray, dtype_name: Optional[str]) -> np.ndarray:
+    if not dtype_name:
+        return arr
+    import jax.numpy as jnp
+
+    return arr.view(jnp.dtype(dtype_name))
+
+
+def emergency_local_save(
+    root: str,
+    tag: str,
+    snapshot: Any,
+    meta: Dict[str, Any],
+    checksum: str = "sha256",
+    save_latest: bool = True,
+) -> str:
+    """Commit ``snapshot`` (a host pytree of numpy arrays) as a verified
+    ``local_npz`` tag under ``root``.  Pure host I/O — safe to call from
+    the supervisor thread while the main thread is wedged in a dead
+    collective."""
+    root = os.path.abspath(root)
+    os.makedirs(root, exist_ok=True)
+    tag = str(tag)
+    meta = dict(meta)
+    meta["format"] = "local_npz"
+    target = manager.begin_stage(root, tag)
+    try:
+        arrays: Dict[str, np.ndarray] = {}
+        dtypes: Dict[str, str] = {}
+        for key, leaf in _flatten_with_keystr(snapshot):
+            arr = np.asarray(leaf)
+            view, dtype_name = _bitcast_for_save(arr)
+            arrays[key] = view
+            if dtype_name:
+                dtypes[key] = dtype_name
+        arrays[_DTYPES_KEY] = np.frombuffer(json.dumps(dtypes).encode(), dtype=np.uint8)
+        np.savez(os.path.join(target, LOCAL_STATE_FILE), **arrays)
+        atomic.atomic_write_text(os.path.join(target, "meta.json"), json.dumps(meta, indent=2))
+        # manifest last: its presence certifies completeness
+        atomic.write_manifest(target, algorithm=checksum)
+        final = manager.commit_tag(root, tag)
+        if save_latest:
+            manager.write_latest(root, tag)
+        return final
+    except BaseException:
+        manager.abort_stage(root, tag)
+        raise
+    finally:
+        manager.release_stage(root, tag)
+
+
+def load_local_state(path: str, target: Any) -> Any:
+    """Restore a ``local_npz`` tag into the structure of ``target``
+    (keys matched by pytree key-path).  Leaves of ``target`` with no
+    saved counterpart come back as zeros of the target shape/dtype
+    (logged) — the ``grad_acc``-layout analog of the orbax partial
+    restore (at any saved step boundary the accumulator is zeros, so no
+    information is lost)."""
+    import jax
+
+    npz_path = os.path.join(path, LOCAL_STATE_FILE)
+    with np.load(npz_path) as z:
+        dtypes = json.loads(bytes(z[_DTYPES_KEY]).decode()) if _DTYPES_KEY in z.files else {}
+        data = {k: z[k] for k in z.files if k != _DTYPES_KEY}
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(target)
+    out, missing = [], []
+    for kpath, leaf in flat:
+        key = jax.tree_util.keystr(kpath)
+        if key in data:
+            out.append(_bitcast_for_load(data[key], dtypes.get(key)))
+        else:
+            missing.append(key)
+            shape = getattr(leaf, "shape", None)
+            dtype = getattr(leaf, "dtype", None)
+            out.append(np.zeros(shape, dtype) if shape is not None and dtype is not None else leaf)
+    if missing:
+        logger.warning(
+            f"local_npz restore: {len(missing)} leaf(s) absent from the emergency tag "
+            f"(restored as zeros): {missing[:5]}{'...' if len(missing) > 5 else ''}"
+        )
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+class SnapshotBox:
+    """Latest host snapshot + its metadata, swapped atomically under a
+    lock so the supervisor thread always sees a consistent pair."""
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self._snapshot: Optional[Any] = None
+        self._meta: Optional[Dict[str, Any]] = None
+        self.step: int = -1
+
+    def update(self, snapshot: Any, meta: Dict[str, Any]) -> None:
+        with self._lock:
+            self._snapshot = snapshot
+            self._meta = meta
+            self.step = int(meta.get("global_step", -1))
+
+    def get(self) -> Tuple[Optional[Any], Optional[Dict[str, Any]]]:
+        with self._lock:
+            return self._snapshot, self._meta

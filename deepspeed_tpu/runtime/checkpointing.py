@@ -71,6 +71,48 @@ def _sanitizer(engine):
     return getattr(engine, "_sanitizer", None)
 
 
+def _supervisor(engine):
+    return getattr(engine, "_supervision", None)
+
+
+def _loader_state(engine) -> Optional[dict]:
+    """The registered dataloader's resume cursor, or None (loaders
+    without the state protocol never break a save)."""
+    loader = getattr(engine, "_train_loader", None)
+    if loader is None or not hasattr(loader, "state_dict"):
+        return None
+    try:
+        return loader.state_dict()
+    except Exception as e:  # noqa: BLE001 — cursors are best-effort
+        logger.warning(f"dataloader state_dict failed ({e!r}); checkpoint has no resume cursor")
+        return None
+
+
+def _merge_loader_state(engine, client_state: Optional[dict]) -> Optional[dict]:
+    """Fold the registered loader's cursor into the client state (an
+    explicit caller-provided '__dataloader__' wins)."""
+    sd = _loader_state(engine)
+    if sd is None:
+        return client_state
+    out = dict(client_state or {})
+    out.setdefault("__dataloader__", sd)
+    return out
+
+
+def _restore_loader_state(engine, client_state: Dict[str, Any]) -> None:
+    sd = client_state.get("__dataloader__")
+    loader = getattr(engine, "_train_loader", None)
+    if not sd or loader is None or not hasattr(loader, "load_state_dict"):
+        return
+    try:
+        loader.load_state_dict(sd)
+        log_dist(
+            f"dataloader cursor restored (epoch {sd.get('epoch')}, batch {sd.get('cursor')})"
+        )
+    except Exception as e:  # noqa: BLE001
+        logger.warning(f"dataloader cursor restore failed ({e!r}); loader starts fresh")
+
+
 def _build_meta(engine, tag: str, client_state: Optional[dict]) -> Dict[str, Any]:
     return {
         "tag": tag,
@@ -116,6 +158,7 @@ def save_checkpoint(
     if tag is None:
         tag = f"global_step{int(jax.device_get(engine.state['global_step']))}"
     tag = str(tag)
+    client_state = _merge_loader_state(engine, client_state)
     save_dir = os.path.abspath(save_dir)
     final_path = _ckpt_path(save_dir, tag)
     os.makedirs(save_dir, exist_ok=True)
@@ -175,9 +218,11 @@ def _sync_save(
 
     def _barrier(name: str) -> None:
         if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
+            # watchdog-armed: a peer dying mid-save must surface as a
+            # supervised deadline/rescue, not an eternal barrier
+            from deepspeed_tpu.resilience.supervision import supervised_sync
 
-            multihost_utils.sync_global_devices(f"ckpt_{name}_{tag}")
+            supervised_sync(f"ckpt_{name}_{tag}", supervisor=_supervisor(engine))
 
     def _write_tag() -> None:
         faults.check("ckpt.save.state", path=final_path)
@@ -352,18 +397,21 @@ def _submit_async_save(
     return final_path
 
 
-def _broadcast_tag(tag: Optional[str]) -> Optional[str]:
+def _broadcast_tag(tag: Optional[str], supervisor=None) -> Optional[str]:
     """Share rank 0's resolved tag with every process (no-op
     single-process).  Fixed-width uint8 buffer; empty means None."""
     if jax.process_count() <= 1:
         return tag
+    from contextlib import nullcontext
+
     from jax.experimental import multihost_utils
 
     buf = np.zeros(256, np.uint8)
     if tag:
         raw = str(tag).encode()[:256]
         buf[: len(raw)] = np.frombuffer(raw, np.uint8)
-    out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+    with supervisor.armed("ckpt.tag_broadcast") if supervisor is not None else nullcontext():
+        out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
     decoded = bytes(out[: int(np.max(np.nonzero(out)[0], initial=-1)) + 1]).decode(errors="ignore")
     return decoded or None
 
@@ -443,7 +491,7 @@ def load_checkpoint(
                 )
             chosen = cand
             break
-    chosen = _broadcast_tag(chosen)
+    chosen = _broadcast_tag(chosen, supervisor=_supervisor(engine))
     if chosen is not None:
         san = _sanitizer(engine)
         with san.transfer.io_region() if san is not None else nullcontext():
@@ -493,6 +541,22 @@ def _restore_tag(
     # re-pads them for its own mesh below.)
     target = engine._portable_target()
 
+    if meta.get("format") == "local_npz":
+        # supervision emergency tag (docs/resilience.md): a survivor's
+        # rank-local host snapshot, committed with no collectives.  The
+        # npz holds full logical arrays, so the device_put below
+        # reshards for whatever mesh THIS job runs — the emergency
+        # analog of orbax's elastic DP-resize restore.
+        from deepspeed_tpu.resilience.supervision import load_local_state
+
+        restored = load_local_state(path, target)
+        return _finish_restore(
+            engine, path, meta, restored, from_partial=True, skip=set(),
+            load_optimizer_states=load_optimizer_states,
+            load_lr_scheduler_states=load_lr_scheduler_states,
+            load_module_only=load_module_only, full_put=True,
+        )
+
     def _partial_restore(skip_keys):
         import orbax.checkpoint as ocp
 
@@ -537,6 +601,26 @@ def _restore_tag(
         restored = _partial_restore(skip | {"opt_state"})
         from_partial = True
 
+    return _finish_restore(
+        engine, path, meta, restored, from_partial=from_partial, skip=skip,
+        load_optimizer_states=load_optimizer_states,
+        load_lr_scheduler_states=load_lr_scheduler_states,
+        load_module_only=load_module_only,
+    )
+
+
+def _finish_restore(
+    engine,
+    path: str,
+    meta: Dict[str, Any],
+    restored: Dict[str, Any],
+    from_partial: bool,
+    skip: set,
+    load_optimizer_states: bool,
+    load_lr_scheduler_states: bool,
+    load_module_only: bool,
+    full_put: bool = False,
+) -> Tuple[str, Dict[str, Any]]:
     # checkpoint layout -> this engine's state layout (re-pad flat
     # leaves for the current mesh), then pin the state shardings
     restored = engine._from_portable_state(restored)
@@ -552,7 +636,7 @@ def _restore_tag(
             if engine.state["grad_acc"]
             else {}
         )
-    if engine._flat_plan:
+    if engine._flat_plan or full_put:
         restored = jax.device_put(restored, engine._state_shardings)
     elif from_partial:
         restored["params"] = jax.device_put(restored["params"], engine._state_shardings["params"])
@@ -580,6 +664,9 @@ def _restore_tag(
             sd = client_state.get("__lr_scheduler__")
             if sd:
                 engine.client_lr_scheduler.load_state_dict(sd)
+    # resume-cursor: hand the loader its saved epoch/batch position so a
+    # restarted job neither replays nor skips batches
+    _restore_loader_state(engine, client_state)
     # reconcile the engine's host-side step mirrors with the restored state
     engine._host_global_step = int(jax.device_get(engine.state["global_step"]))
     engine._host_micro_step = int(jax.device_get(engine.state["micro_step"]))

@@ -67,6 +67,13 @@ class DeepSpeedDataLoader:
         self.process_index = process_index if process_index is not None else jax.process_index()
         self.process_count = process_count if process_count is not None else jax.process_count()
         self.epoch = 0
+        # resumable-cursor state (docs/resilience.md): the checkpoint
+        # client_state records (epoch, cursor, seed) so a restarted job
+        # neither replays nor skips batches.  The cursor counts batches
+        # YIELDED in the current iteration; load_state_dict arms a skip
+        # for the next __iter__.
+        self._cursor = 0
+        self._start = 0
 
         # Columnar = dict (or tuple) of equal-length arrays, one row per
         # example.  A *list* is always treated as a sequence of per-example
@@ -85,6 +92,21 @@ class DeepSpeedDataLoader:
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
 
+    def state_dict(self) -> dict:
+        """Resume cursor: epoch + batches yielded this iteration + the
+        shuffle seed (the epoch-derived RNG key is ``seed + epoch``, so
+        (seed, epoch) IS the shuffle RNG state)."""
+        return {"epoch": int(self.epoch), "cursor": int(self._cursor), "seed": int(self.seed)}
+
+    def load_state_dict(self, sd: dict) -> None:
+        """Restore a cursor saved by :meth:`state_dict`: the next
+        ``__iter__`` recreates the same permutation and skips the
+        already-consumed batches — no replays, no skips."""
+        self.epoch = int(sd.get("epoch", 0))
+        self.seed = int(sd.get("seed", self.seed))
+        self._start = int(sd.get("cursor", 0))
+        self._cursor = self._start
+
     def __len__(self) -> int:
         per_proc = self._n // self.process_count
         if self.drop_last:
@@ -92,6 +114,10 @@ class DeepSpeedDataLoader:
         return math.ceil(per_proc / self.batch_size)
 
     def __iter__(self) -> Iterator[Any]:
+        # eager prologue (the cursor reset must happen at iter() time,
+        # not first next(): wrappers read the cursor between the two)
+        start, self._start = self._start, 0
+        self._cursor = start
         idx = np.arange(self._n)
         if self.shuffle:
             rng = np.random.default_rng(self.seed + self.epoch)
@@ -99,8 +125,11 @@ class DeepSpeedDataLoader:
         # contiguous per-process shard (DistributedSampler semantics)
         per_proc = self._n // self.process_count
         idx = idx[self.process_index * per_proc : (self.process_index + 1) * per_proc]
+        return self._generate(idx, start)
+
+    def _generate(self, idx: np.ndarray, start: int) -> Iterator[Any]:
         n_batches = len(self)
-        for b in range(n_batches):
+        for b in range(start, n_batches):
             sel = idx[b * self.batch_size : (b + 1) * self.batch_size]
             if len(sel) == 0:
                 return
@@ -112,10 +141,51 @@ class DeepSpeedDataLoader:
                     batch = self.collate_fn(examples)
                 else:
                     batch = jax.tree.map(lambda *xs: np.stack(xs), *examples)
+            # cursor advances at hand-off: a checkpoint taken after this
+            # batch's step must resume at b + 1
+            self._cursor = b + 1
             yield batch
 
 
-class DevicePrefetchLoader:
+class ResumableWrapperMixin:
+    """Consumed-cursor bookkeeping shared by loader wrappers that pull
+    AHEAD of training (``DevicePrefetchLoader`` here, the overlap
+    ``DevicePrefetcher``): the checkpointable cursor is the inner
+    loader's cursor at iteration start plus the batches actually handed
+    to training — never the inner loader's own cursor, which runs ahead
+    by up to the prefetch depth.  Wrappers call :meth:`_capture_base`
+    right after ``iter(self.loader)`` (the inner loader's eager
+    prologue has applied any resume skip by then) and bump ``_served``
+    at each yield."""
+
+    _served = 0
+    _base_state: Optional[dict] = None
+
+    def _capture_base(self) -> None:
+        fn = getattr(self.loader, "state_dict", None)
+        self._base_state = dict(fn()) if fn is not None else None
+        self._served = 0
+
+    def state_dict(self) -> Optional[dict]:
+        """None when the wrapped loader has no state protocol (the
+        checkpoint then simply carries no resume cursor)."""
+        if self._base_state is not None:
+            base = dict(self._base_state)
+            base["cursor"] = int(base.get("cursor", 0)) + self._served
+            return base
+        fn = getattr(self.loader, "state_dict", None)
+        return dict(fn()) if fn is not None else None
+
+    def load_state_dict(self, sd: dict) -> None:
+        fn = getattr(self.loader, "load_state_dict", None)
+        if fn is None:
+            return
+        fn(sd)
+        self._served = 0
+        self._base_state = None
+
+
+class DevicePrefetchLoader(ResumableWrapperMixin):
     """Wraps any batch iterator with ahead-of-time ``jax.device_put``.
 
     NOTE: ``engine.prefetch_loader`` now routes through the two-stage
@@ -145,6 +215,11 @@ class DevicePrefetchLoader:
         self.transform = transform
 
     def __iter__(self):
+        it = iter(self.loader)
+        self._capture_base()
+        return self._pipeline(it)
+
+    def _pipeline(self, it):
         import collections
         from concurrent.futures import ThreadPoolExecutor
 
@@ -161,7 +236,6 @@ class DevicePrefetchLoader:
         # backends — run it in a worker thread so transfers overlap the
         # compiled step instead of serializing with it
         queue = collections.deque()
-        it = iter(self.loader)
         with ThreadPoolExecutor(max_workers=1) as pool:
             try:
                 for _ in range(self.prefetch_depth):
@@ -174,7 +248,9 @@ class DevicePrefetchLoader:
                     queue.append(pool.submit(put, next(it)))
                 except StopIteration:
                     pass
-                yield out.result()
+                result = out.result()
+                self._served += 1
+                yield result
 
     def __len__(self):
         try:

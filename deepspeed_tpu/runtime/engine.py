@@ -357,6 +357,18 @@ class DeepSpeedEngine:
                 exit_code=self.resilience.watchdog.exit_code,
             ).install()
 
+        # -- distributed supervision (heartbeat plane + hung-collective
+        # watchdog + exit-44 rescue; docs/resilience.md).  Launcher-
+        # spawned children also pick up their DS_FAULT_PLAN here, so
+        # kill/stall sites fire inside real multi-process tests.
+        from deepspeed_tpu.resilience import faults as _faults
+
+        _faults.install_from_env()
+        self._supervision = None
+        self._train_loader = None  # registered resumable dataloader
+        if self.resilience.supervision.enabled:
+            self._supervision = self._build_supervisor(self.resilience.supervision)
+
         # -- overlap: input prefetch / async checkpointing / step timeline
         # (docs/performance.md; runtime/overlap/)
         from deepspeed_tpu.config.config import OverlapConfig
@@ -1122,7 +1134,10 @@ class DeepSpeedEngine:
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
 
-            stacked = np.asarray(multihost_utils.process_allgather(slices[self._host_shard_ids[0]]))
+            with self._sup_region("offload.masters_allgather"):
+                stacked = np.asarray(
+                    multihost_utils.process_allgather(slices[self._host_shard_ids[0]])
+                )
             full = stacked.reshape(-1)
         else:
             full = np.concatenate([slices[i] for i in sorted(slices)])
@@ -1192,14 +1207,14 @@ class DeepSpeedEngine:
 
         place = lambda b: _PlacedBatch(self._stack_and_place(b))  # noqa: E731
         if not self.overlap.prefetch.enabled and prefetch_depth is None:
-            return InlineLoader(
+            return self.register_dataloader(InlineLoader(
                 loader, place, timeline=self.timeline, sanitizer=self._sanitizer
-            )
+            ))
         depth = self.overlap.prefetch.depth if prefetch_depth is None else int(prefetch_depth)
-        return DevicePrefetcher(
+        return self.register_dataloader(DevicePrefetcher(
             loader, depth=depth, place_fn=place, timeline=self.timeline,
             sanitizer=self._sanitizer,
-        )
+        ))
 
     def _prepare_batch(self, batch: Any) -> Any:
         def put(x):
@@ -1287,8 +1302,9 @@ class DeepSpeedEngine:
                 fn = self._get_compiled("apply_step", self._apply_step_impl)
                 san = self._sanitizer
                 donated = jax.tree.leaves(self.state) if san is not None else None
-                with san.transfer.guard("engine.step") if san is not None else nullcontext():
-                    self.state, info = fn(self.state)
+                with self._sup_region("engine.step"):
+                    with san.transfer.guard("engine.step") if san is not None else nullcontext():
+                        self.state, info = fn(self.state)
                 if san is not None:
                     san.donation.note(donated, "engine.step", step=self._host_global_step)
             overflowed = False
@@ -1297,7 +1313,8 @@ class DeepSpeedEngine:
                 # sync must not look like an implicit transfer under the
                 # sanitizer's guard (and on remote backends device_get
                 # batches better than __bool__)
-                overflowed = bool(jax.device_get(info["overflow"]))
+                with self._sup_region("engine.overflow_sync"):
+                    overflowed = bool(jax.device_get(info["overflow"]))
                 if overflowed:
                     self.skipped_steps += 1
                     log_dist(f"step skipped on overflow; loss scale -> {self.loss_scale}")
@@ -1385,15 +1402,19 @@ class DeepSpeedEngine:
         self.flops_profiler.start_step(profile_step)
         donated = jax.tree.leaves(self.state) if san is not None else None
         t_compute = time.perf_counter()
-        if self._offload:
-            with san.transfer.guard("engine.train_batch") if san is not None else nullcontext():
-                self.state, loss = self._compiled[tb_key](self.state, stacked)
-            # the host optimizer step is a deliberate host-I/O region
-            # (grads device->host, masters host->device) — not guarded
-            info = self._host_apply_step()
-        else:
-            with san.transfer.guard("engine.train_batch") if san is not None else nullcontext():
-                self.state, loss, info = self._compiled[tb_key](self.state, stacked)
+        # supervision: the compiled step is the step-boundary collective
+        # (grad psum over the data axis) — the armed deadline plus the
+        # peer-death escalation live here (docs/resilience.md)
+        with self._sup_region("engine.train_batch"):
+            if self._offload:
+                with san.transfer.guard("engine.train_batch") if san is not None else nullcontext():
+                    self.state, loss = self._compiled[tb_key](self.state, stacked)
+                # the host optimizer step is a deliberate host-I/O region
+                # (grads device->host, masters host->device) — not guarded
+                info = self._host_apply_step()
+            else:
+                with san.transfer.guard("engine.train_batch") if san is not None else nullcontext():
+                    self.state, loss, info = self._compiled[tb_key](self.state, stacked)
         if san is not None:
             san.donation.note(donated, "engine.train_batch", step=self._host_global_step)
             self._san_last_batch = ("stacked", stacked)
@@ -1413,7 +1434,10 @@ class DeepSpeedEngine:
         # transfer — the sanitizer's guard budget stays honest)
         overflowed = False
         if self.loss_scaler.dynamic:
-            overflowed = bool(jax.device_get(info["overflow"]))
+            # the overflow read is where the host actually BLOCKS on the
+            # cross-process step (dispatch above is async) — armed too
+            with self._sup_region("engine.overflow_sync"):
+                overflowed = bool(jax.device_get(info["overflow"]))
             if overflowed:
                 self.skipped_steps += 1
                 log_dist(f"step skipped on overflow; loss scale -> {self.loss_scale}")
@@ -1622,16 +1646,180 @@ class DeepSpeedEngine:
                 self.monitor.flush()
 
     # ------------------------------------------------------------------
-    # resilience: preemption + divergence handling (docs/resilience.md)
+    # resilience: preemption + divergence + supervision handling
+    # (docs/resilience.md)
     # ------------------------------------------------------------------
     def _note_checkpoint_dir(self, directory: str) -> None:
         """Remember where this run checkpoints (emergency saves and
         divergence rollback target it)."""
         self._resilience_ckpt_dir = os.path.abspath(directory)
 
+    def register_dataloader(self, loader):
+        """Register the training loader for resume-cursor round-trips:
+        checkpoint saves record its ``state_dict()`` in the client
+        state, loads restore it — a restarted job neither replays nor
+        skips batches (docs/resilience.md).  ``prefetch_loader`` calls
+        this automatically."""
+        self._train_loader = loader
+        return loader
+
+    def _build_supervisor(self, sv):
+        """Construct + start the rank supervisor for the configured side
+        channel; None (with a warning) when no channel is reachable."""
+        from deepspeed_tpu.resilience.supervision import Supervisor
+        from deepspeed_tpu.resilience.supervision import heartbeat as hb
+
+        # the supervision plane is LAUNCHER-scoped, not jax-scoped: a
+        # job whose ranks run per-process replicas (no jax.distributed)
+        # still has a failure domain, so fall back to the launcher's
+        # RANK/WORLD_SIZE env when jax sees a single process
+        rank, world = jax.process_index(), jax.process_count()
+        if world <= 1:
+            rank = int(os.environ.get("RANK", rank))
+            world = int(os.environ.get("WORLD_SIZE", world))
+        kind = sv.channel
+        addr, port = hb.resolve_endpoint()
+        if kind == "auto":
+            if world > 1 and port:
+                kind = "tcp"
+            elif sv.beat_dir:
+                kind = "file"
+            else:
+                logger.warning(
+                    "resilience.supervision enabled but no side channel is available "
+                    "(no DS_SUPERVISION_PORT from the launcher, no supervision.beat_dir); "
+                    "supervision stays OFF"
+                )
+                return None
+        if kind == "tcp":
+            if not port:
+                logger.warning(
+                    "resilience.supervision channel 'tcp' needs DS_SUPERVISION_PORT "
+                    "(set by launcher/launch.py); supervision stays OFF"
+                )
+                return None
+            channel = hb.TcpBeatChannel(
+                rank, world, address=addr, port=port,
+                beat_timeout=sv.beat_timeout_seconds,
+                connect_grace=sv.connect_grace_seconds,
+            )
+        else:
+            if not sv.beat_dir:
+                logger.warning(
+                    "resilience.supervision channel 'file' needs supervision.beat_dir; "
+                    "supervision stays OFF"
+                )
+                return None
+            channel = hb.FileBeatChannel(
+                sv.beat_dir, rank, world, beat_timeout=sv.beat_timeout_seconds
+            )
+        sup = Supervisor(
+            rank=rank,
+            world_size=world,
+            channel=channel,
+            beat_interval=sv.beat_interval_seconds,
+            sync_timeout=sv.sync_timeout_seconds,
+            rescue_grace=sv.rescue_grace_seconds,
+            exit_code=sv.exit_code,
+            save_dir_fn=lambda: self._resilience_ckpt_dir,
+            checksum=self.resilience.checkpoint.checksum,
+        ).start()
+        log_dist(
+            f"supervision: rank {rank}/{world} armed on the {channel.name} channel "
+            f"(beat {sv.beat_interval_seconds:g}s, death deadline "
+            f"{sv.beat_timeout_seconds:g}s, sync deadline {sv.sync_timeout_seconds:g}s)"
+        )
+        return sup
+
+    def _sup_region(self, site: str):
+        """Armed-deadline region around one blocking sync.  An exception
+        inside the region while a peer is (or is about to be declared)
+        dead routes into the rescue path — the collective usually errors
+        out milliseconds after the peer dies, before the beat deadline."""
+        from contextlib import nullcontext
+
+        sup = getattr(self, "_supervision", None)
+        if sup is None:
+            return nullcontext()
+        return _SupervisedRegion(self, sup, site)
+
+    def _supervision_snapshot(self) -> None:
+        """Host snapshot of the portable state + its checkpoint meta at
+        a step boundary — what the supervisor commits (pure host I/O)
+        if this process must rescue while the main thread is wedged."""
+        from deepspeed_tpu.runtime import checkpointing as _ckpt
+
+        sup = self._supervision
+        step = self._host_global_step
+        client_state = {}
+        loader_sd = _ckpt._loader_state(self)
+        if loader_sd is not None:
+            client_state["__dataloader__"] = loader_sd
+        meta = _ckpt._build_meta(self, f"emergency_step{step}", client_state)
+        sup.snapshot.update(_ckpt._snapshot_state_to_host(self), meta)
+
+    def _handle_peer_failure(self, pf, fresh_snapshot: bool = True):
+        """A peer died: commit a verified emergency tag (rank-local
+        ``local_npz`` — no collectives; in DP topologies this rank's
+        host snapshot holds the full logical state) and exit with the
+        supervision contract code (default 44, "peer-failed-and-saved")
+        so the launcher's ``--restarts`` can relaunch-and-resume.  Exits
+        1 when no save could be certified."""
+        sup = self._supervision
+        sup.main_handling = True
+        if not sup.claim_rescue("main"):
+            # the supervisor thread won the race and is mid-commit; it
+            # will os._exit with the right code — don't double-stage the
+            # same tag (the loser's StageInFlightError would read as a
+            # failed save).  The sleep only ends if the supervisor hangs.
+            logger.error("supervision: supervisor thread owns the rescue; waiting for its exit")
+            time.sleep(max(30.0, sup.rescue_grace * 4))
+            raise SystemExit(1)
+        logger.error(
+            f"supervision: peer rank {pf.rank} failed ({pf.reason}); committing an "
+            f"emergency checkpoint before exiting"
+        )
+        if fresh_snapshot:
+            # we are at a clean step boundary: snapshot the LIVE state
+            # (fresher than the last boundary snapshot)
+            try:
+                self._supervision_snapshot()
+            except BaseException as e:  # noqa: BLE001 — fall back to the last one
+                logger.warning(f"fresh emergency snapshot failed ({e!r}); using the last boundary snapshot")
+        code = sup.rescue_save(reason=f"peer rank {pf.rank} failed: {pf.reason}")
+        sup.stop()
+        raise SystemExit(code)
+
     def _on_step_boundary(self, overflowed: bool, loss=None) -> None:
-        """Host-side hook after every optimizer-step boundary: first honor
-        a pending preemption request, then feed the divergence guard."""
+        """Host-side hook after every optimizer-step boundary: fault
+        sites and supervision first (a peer death or injected kill at a
+        boundary must win over progress reporting), then a pending
+        preemption request, then the divergence guard."""
+        from deepspeed_tpu.resilience import faults as _faults
+
+        _faults.check("step.boundary")
+        sup = getattr(self, "_supervision", None)
+        if sup is not None:
+            pf = sup.peer_failure
+            if pf is not None:
+                self._handle_peer_failure(pf)
+            if not getattr(self, "_supervision_snapshot_broken", False) and sup.snapshot_due(
+                self._host_global_step, self.resilience.supervision.snapshot_interval_steps
+            ):
+                try:
+                    self._supervision_snapshot()
+                except Exception as e:  # noqa: BLE001 — e.g. non-addressable shards
+                    # state spanning non-addressable devices (multi-host
+                    # sharded topologies) cannot be host-snapshotted from
+                    # one rank; degrade to no boundary snapshots (rescue
+                    # then certifies exit 1, the crash contract) instead
+                    # of killing the training loop every step
+                    self._supervision_snapshot_broken = True
+                    logger.warning(
+                        f"supervision: step-boundary snapshot failed ({e!r}); disabling "
+                        "boundary snapshots — a rescue on this rank will exit 1 "
+                        "(resume from the previous verified tag)"
+                    )
         wd = getattr(self, "_watchdog", None)
         if wd is not None and wd.preemption_requested:
             self._handle_preemption()
@@ -1747,3 +1935,42 @@ class DeepSpeedEngine:
         from deepspeed_tpu.runtime.checkpointing import load_checkpoint as _load
 
         return _load(self, load_dir, tag=tag, **kw)
+
+
+class _SupervisedRegion:
+    """Armed-deadline region around one of the engine's blocking syncs.
+
+    On a normal exit the deadline disarms.  On an exception, a pending
+    (or imminent — the channel gets one beat-timeout to confirm) peer
+    death converts the error into the engine's peer-failure rescue:
+    commit a verified emergency tag, exit with the supervision contract
+    code.  Anything else re-raises untouched.
+    """
+
+    def __init__(self, engine, sup, site: str):
+        self.engine = engine
+        self.sup = sup
+        self.site = site
+        self._armed = sup.armed(site)
+
+    def __enter__(self):
+        self._armed.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self._armed.__exit__(exc_type, exc, tb)
+        if exc is None or isinstance(exc, SystemExit):
+            return False
+        if self.sup.main_handling:
+            return False
+        wait = getattr(self.sup.channel, "beat_timeout", 2.0)
+        pf = self.sup.confirm_peer_failure(wait=wait)
+        if pf is not None:
+            logger.error(
+                f"supervision: blocking sync '{self.site}' raised "
+                f"{exc_type.__name__} with peer rank {pf.rank} dead; entering rescue"
+            )
+            # state buffers may be donated into the failed computation:
+            # rescue from the last boundary snapshot, not live state
+            self.engine._handle_peer_failure(pf, fresh_snapshot=False)
+        return False

@@ -28,6 +28,8 @@ import threading
 import time
 from typing import Any, Callable, Iterable, List, Optional
 
+from deepspeed_tpu.runtime.dataloader import ResumableWrapperMixin
+
 
 class _End:
     """Sentinel: the upstream stage is exhausted."""
@@ -97,7 +99,7 @@ def _place_worker(place: Callable[[Any], Any], in_q: "queue.Queue", out_q: "queu
             return
 
 
-class DevicePrefetcher:
+class DevicePrefetcher(ResumableWrapperMixin):
     """Wraps a host batch iterator with pipelined load + device placement.
 
     ``place_fn``: host batch -> device-resident batch (the engine passes
@@ -154,13 +156,15 @@ class DevicePrefetcher:
 
     def __iter__(self):
         self.close()  # a fresh iteration owns fresh threads/queues
+        it = iter(self.loader)
+        self._capture_base()
         stop = threading.Event()
         self._stop = stop
         loaded: "queue.Queue" = queue.Queue(maxsize=self.depth)
         placed: "queue.Queue" = queue.Queue(maxsize=self.depth)
         threads = [
             threading.Thread(
-                target=_load_worker, args=(iter(self.loader), loaded, stop),
+                target=_load_worker, args=(it, loaded, stop),
                 daemon=True, name="ds-prefetch-load",
             ),
             threading.Thread(
@@ -171,6 +175,9 @@ class DevicePrefetcher:
         self._threads = threads
         for t in threads:
             t.start()
+        return self._consume(placed)
+
+    def _consume(self, placed: "queue.Queue"):
         try:
             while True:
                 t0 = time.perf_counter()
@@ -181,6 +188,7 @@ class DevicePrefetcher:
                     return
                 if isinstance(item, _Raised):
                     raise item.exc
+                self._served += 1
                 yield item
         finally:
             self.close()
@@ -220,6 +228,16 @@ class InlineLoader:
         self.timeline = timeline
         if sanitizer is not None:
             self.place_fn = sanitizer.transfer.wrap_callable(place_fn, "prefetch.place")
+
+    def state_dict(self) -> Optional[dict]:
+        # synchronous wrap: the inner cursor tracks consumption exactly
+        fn = getattr(self.loader, "state_dict", None)
+        return dict(fn()) if fn is not None else None
+
+    def load_state_dict(self, sd: dict) -> None:
+        fn = getattr(self.loader, "load_state_dict", None)
+        if fn is not None:
+            fn(sd)
 
     def __iter__(self):
         it = iter(self.loader)

@@ -175,8 +175,20 @@ class HostOffloadOptimizer:
 
     def load(self, path: str) -> None:
         with np.load(path) as z:
-            data = {k.replace("::", "/"): z[k] for k in z.files}
+            self.load_state_dict({k.replace("::", "/"): z[k] for k in z.files})
+
+    def load_state_dict(self, data: Dict[str, np.ndarray]) -> None:
+        """Install ``master/ m/ v/``-keyed arrays (the :meth:`state_dict`
+        layout) — the entry point the resharding-compatible restore
+        feeds reassembled-and-resliced state through."""
         for i, k in enumerate(self.keys):
+            want = self.masters[i].shape
+            got = np.shape(data[f"master/{k}"])
+            if tuple(got) != tuple(want):
+                raise ValueError(
+                    f"host optimizer leaf '{k}': checkpoint shape {tuple(got)} != "
+                    f"engine shape {tuple(want)}"
+                )
             self.masters[i] = np.ascontiguousarray(data[f"master/{k}"], np.float32)
             m, v = data[f"m/{k}"], data[f"v/{k}"]
             if self.swapper is not None:

@@ -844,6 +844,59 @@ class ZeroInfinityEngine:
         log_dist(f"saved ZeRO-Infinity checkpoint {path}")
         return path
 
+    def _reassemble_host_state(self, path: str, meta: dict):
+        """Reassemble the FULL host masters/moments from every saved
+        rank's npz and re-slice for THIS engine's fsdp partition — the
+        "resharding-compatible" topology relaxation: a sharded-master
+        checkpoint restores at any process count, as long as all of the
+        saving job's per-rank files are reachable (shared filesystem).
+        Returns None when some rank file is missing (the caller raises
+        the strict topology error then).
+
+        Assumes the saving mesh gave each rank a contiguous, ascending
+        fsdp range (the only layout ``_setup_host_partition`` accepts),
+        so rank-order concatenation along each leaf's sharded dim
+        recovers the full axis."""
+        S = int(meta.get("process_count", 1))
+        saved_sharded = bool(meta.get("masters_sharded", False))
+        # replicated-masters saves: every rank file holds the SAME full
+        # state, so rank 0's alone suffices (and avoids loading S
+        # identical copies into host RAM)
+        need = S if (saved_sharded and S > 1) else 1
+        files = [os.path.join(path, f"host_optimizer_rank{r}.npz") for r in range(need)]
+        if not all(os.path.exists(f) for f in files):
+            return None
+        datas = []
+        for f in files:
+            with np.load(f) as z:
+                datas.append({k.replace("::", "/"): z[k] for k in z.files})
+        plo, phi = self._part_local
+        P = self.mesh_info.fsdp_world_size
+        # _flat_leaf_kinds is aligned with the host optimizer's flat key
+        # order (both come from _flatten_with_paths of the same tree)
+        kinds = dict(zip(self._host_opt.keys, self._flat_leaf_kinds))
+        out = {}
+        for k in self._host_opt.keys:
+            kind, d = kinds[k]
+            for pfx in ("master", "m", "v"):
+                key = f"{pfx}/{k}"
+                if kind != "block" or d is None or not saved_sharded or S == 1:
+                    full = datas[0][key]
+                else:
+                    full = np.concatenate([dd[key] for dd in datas], axis=d)
+                if kind == "block" and d is not None and self._masters_sharded:
+                    if full.shape[d] % P:
+                        raise ValueError(
+                            f"resharding-compatible restore: leaf '{k}' dim {d} "
+                            f"({full.shape[d]}) is not divisible by fsdp={P}"
+                        )
+                    per = full.shape[d] // P
+                    sl = [slice(None)] * full.ndim
+                    sl[d] = slice(plo * per, phi * per)
+                    full = np.ascontiguousarray(full[tuple(sl)])
+                out[key] = full
+        return out
+
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None, **_kw):
         load_dir = os.path.abspath(load_dir)
         if tag is None:
@@ -853,23 +906,6 @@ class ZeroInfinityEngine:
             with open(latest) as f:
                 tag = f.read().strip()
         path = os.path.join(load_dir, str(tag))
-        # prefer this process's own file (per-host local disks); the
-        # rank-0 file is equivalent on a shared filesystem ONLY when
-        # masters are replicated — a sharded-master checkpoint holds a
-        # different 1/H slice per rank
-        opt_path = os.path.join(path, f"host_optimizer_rank{jax.process_index()}.npz")
-        if not os.path.exists(opt_path):
-            if self._masters_sharded:
-                raise FileNotFoundError(
-                    f"ZeRO-Infinity checkpoint {path} has no file for rank "
-                    f"{jax.process_index()} and masters are host-sharded "
-                    "(each rank's slice differs; the rank-0 file is not a "
-                    "substitute). Restore with the same process topology."
-                )
-            opt_path = os.path.join(path, "host_optimizer_rank0.npz")
-        if not os.path.exists(opt_path):
-            logger.warning(f"ZeRO-Infinity checkpoint {path} not found")
-            return None, {}
         # topology validation BEFORE any state is replaced: loading a
         # mismatched slice layout would corrupt the masters and only
         # raise afterwards (review finding r5)
@@ -878,19 +914,51 @@ class ZeroInfinityEngine:
         if os.path.exists(meta_path):
             with open(meta_path) as f:
                 meta = json.load(f)
-        if "masters_sharded" in meta and (
+        topo_mismatch = "masters_sharded" in meta and (
             bool(meta["masters_sharded"]) != self._masters_sharded
             or (self._masters_sharded and int(meta.get("process_count", 1)) != jax.process_count())
-        ):
-            raise ValueError(
-                f"ZeRO-Infinity checkpoint {path} was saved with "
-                f"masters_sharded={meta['masters_sharded']} over "
-                f"{meta.get('process_count', 1)} processes; this engine has "
-                f"masters_sharded={self._masters_sharded} over "
-                f"{jax.process_count()} — the per-rank master files would "
-                "mis-slice the fsdp axis. Restore with a matching topology."
+        )
+        if topo_mismatch:
+            # resharding-compatible (not identical) topology contract:
+            # with every saved rank's file present, reassemble the full
+            # masters and re-slice for this engine's partition
+            data = self._reassemble_host_state(path, meta)
+            if data is None:
+                raise ValueError(
+                    f"ZeRO-Infinity checkpoint {path} was saved with "
+                    f"masters_sharded={meta['masters_sharded']} over "
+                    f"{meta.get('process_count', 1)} processes; this engine has "
+                    f"masters_sharded={self._masters_sharded} over "
+                    f"{jax.process_count()} — and not all "
+                    f"{meta.get('process_count', 1)} per-rank files are reachable, "
+                    "so the fsdp axis cannot be resharded. Restore with a "
+                    "matching topology or from a shared filesystem."
+                )
+            log_dist(
+                f"ZeRO-Infinity: resharding host masters from "
+                f"{meta.get('process_count', 1)} saved rank file(s) to this "
+                f"topology (fsdp parts [{self._part_local[0]}, {self._part_local[1]}))"
             )
-        self._host_opt.load(opt_path)
+            self._host_opt.load_state_dict(data)
+        else:
+            # prefer this process's own file (per-host local disks); the
+            # rank-0 file is equivalent on a shared filesystem ONLY when
+            # masters are replicated — a sharded-master checkpoint holds a
+            # different 1/H slice per rank
+            opt_path = os.path.join(path, f"host_optimizer_rank{jax.process_index()}.npz")
+            if not os.path.exists(opt_path):
+                if self._masters_sharded:
+                    raise FileNotFoundError(
+                        f"ZeRO-Infinity checkpoint {path} has no file for rank "
+                        f"{jax.process_index()} and masters are host-sharded "
+                        "(each rank's slice differs; the rank-0 file is not a "
+                        "substitute). Restore with the same process topology."
+                    )
+                opt_path = os.path.join(path, "host_optimizer_rank0.npz")
+            if not os.path.exists(opt_path):
+                logger.warning(f"ZeRO-Infinity checkpoint {path} not found")
+                return None, {}
+            self._host_opt.load(opt_path)
         masters = self._host_opt.masters_tree()
         self._params_host = masters
         self._blocks_host = masters[self.spec.blocks_key]

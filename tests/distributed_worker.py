@@ -25,7 +25,8 @@ def main():
     ap.add_argument("--out", required=True)
     ap.add_argument(
         "--mode", default="dp",
-        choices=["dp", "offload", "streaming", "streaming_fsdp", "streaming_fsdp_nvme"],
+        choices=["dp", "offload", "streaming", "streaming_fsdp", "streaming_fsdp_nvme",
+                 "supervised"],
     )
     ap.add_argument("--local_devices", type=int, default=4)
     ap.add_argument("--steps", type=int, default=3)
@@ -112,6 +113,94 @@ def main():
         resumed = float(engine.train_batch(probe))
         np.testing.assert_allclose(cont, resumed, rtol=1e-5, atol=1e-6)
         losses.append(resumed)
+    elif a.mode == "supervised":
+        # Supervision end-to-end (docs/resilience.md §Supervision): the
+        # heartbeat plane armed across REAL launcher-spawned processes,
+        # a resumable shuffled loader, and per-step records so the test
+        # can prove batch-sequence parity across a kill-one-rank +
+        # elastic restart.  The SAME mode serves every life: the batch
+        # schedule comes from the elasticity menu, resume comes from
+        # whatever verified tag (emergency or normal) exists.
+        #
+        # Every rank trains an identical replica over its OWN local
+        # devices (same global batch, same seed — identical math), so
+        # the scenario runs even where the CPU backend lacks cross-
+        # process XLA computations (this container; the pre-existing
+        # tests/test_distributed.py collectives suite has the same
+        # limit).  The supervision plane is launcher-scoped (RANK/
+        # WORLD_SIZE env), so failure detection, rescue and elastic
+        # restart are exercised for real regardless.
+        import hashlib
+        import time as _time
+
+        from deepspeed_tpu.elasticity.elasticity import compute_elastic_config
+        from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+
+        total = a.local_devices  # per-process replica mesh
+        B = 8  # fixed GLOBAL batch: training math identical at any world size
+        _, _, micro = compute_elastic_config(
+            {"elasticity": {"enabled": True, "micro_batch_sizes": [1, 2, 4, 8],
+                            "max_train_batch_size": B, "min_gpus": 1,
+                            "max_gpus": 16, "version": 0.1}},
+            "0.4.5", world_size=total,
+        )
+        ckpt = os.path.join(a.out, "ckpt")
+        cfg = base_config(stage=0, micro_bs=micro, gas=1, mesh={"data": total})
+        cfg["resilience"] = {
+            "watchdog": {"enabled": False, "save_dir": ckpt},
+            "supervision": {
+                "enabled": True, "channel": "tcp",
+                "beat_interval_seconds": 0.1, "beat_timeout_seconds": 0.6,
+                "rescue_grace_seconds": 1.0, "sync_timeout_seconds": 120.0,
+                "snapshot_interval_steps": 1,
+            },
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=simple_model_loss, model_parameters=simple_model_init(32), config=cfg,
+            dist_init_required=False,
+        )
+        from tests.simple_model import random_dataset
+
+        data = random_dataset(16, B, 32, seed=7)  # 16 global batches
+        loader = DeepSpeedDataLoader(
+            data, batch_size=B, shuffle=True, seed=0, process_index=0, process_count=1
+        )
+        engine.register_dataloader(loader)
+        engine.load_checkpoint(ckpt, strict=False)  # fresh start on life 0
+
+        life = int(os.environ.get("DS_RESTART_COUNT", "0"))
+        world = int(os.environ.get("WORLD_SIZE", "1"))
+        rank = int(os.environ.get("RANK", "0"))
+        os.makedirs(a.out, exist_ok=True)
+        rec_path = os.path.join(a.out, f"life{life}_rank{rank}.jsonl")
+        records = []
+        for batch in loader:
+            if engine._host_global_step >= a.steps:
+                break
+            h = hashlib.sha1(np.ascontiguousarray(batch["x"]).tobytes()).hexdigest()[:12]
+            try:
+                loss = float(engine.train_batch(batch))
+            except SystemExit:
+                raise
+            except BaseException:
+                # the blocking loss read sits outside the engine's armed
+                # regions: route a peer-death error into the rescue path
+                # instead of dying 1 before the supervisor can act
+                sup = engine._supervision
+                pf = sup.confirm_peer_failure(wait=1.5) if sup is not None else None
+                if pf is not None:
+                    engine._handle_peer_failure(pf, fresh_snapshot=False)
+                raise
+            records.append({"step": engine._host_global_step, "batch": h, "loss": loss})
+            with open(rec_path, "w") as f:  # rewritten per step: survives a kill
+                json.dump(records, f)
+            _time.sleep(0.15)  # simulated step time: death detection lands mid-run
+        with open(os.path.join(a.out, f"final_life{life}_rank{rank}.json"), "w") as f:
+            json.dump({"world": world, "micro": micro,
+                       "steps": engine._host_global_step, "records": records}, f)
+        print(f"supervised worker life {life} rank {rank}: "
+              f"{[r['step'] for r in records]}")
+        return  # per-life files are the contract; skip the generic tail
     elif a.mode == "streaming":
         # ZeRO-Infinity streaming executor across REAL processes:
         # every rank feeds the same global batch, group programs psum

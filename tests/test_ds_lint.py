@@ -49,6 +49,7 @@ def test_rule_catalog_shape():
         "bare-jit", "missing-sharding-constraint",
         "non-atomic-checkpoint-write",  # PR 2 resilience tier-B rule
         "unfenced-timing",  # PR 3 overlap tier-C rule
+        "unguarded-collective-barrier",  # PR 5 supervision tier-B rule
     ):
         assert rid in rules, rid
 
@@ -735,6 +736,78 @@ class TestAtomicCheckpointWrite:
             "non-atomic-checkpoint-write",
         )
         assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# unguarded-collective-barrier (tier B, PR 5 supervision subsystem)
+# ---------------------------------------------------------------------------
+
+
+class TestBarrierGuard:
+    def test_flags_bare_blocking_syncs(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            def barrier(tag):
+                multihost_utils.sync_global_devices(f"ckpt_{tag}")
+
+            def join(x):
+                return np.asarray(multihost_utils.process_allgather(x))
+            """,
+            "unguarded-collective-barrier",
+        )
+        assert rule_ids(res) == ["unguarded-collective-barrier"] * 2
+        assert all(f.severity == Severity.B for f in res.findings)
+        assert "armed" in res.findings[0].message
+
+    def test_clean_armed_region_and_helper(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            from contextlib import nullcontext
+
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            from deepspeed_tpu.resilience.supervision import supervised_sync
+
+            def barrier(tag, sup):
+                with sup.armed(f"barrier:{tag}"):
+                    multihost_utils.sync_global_devices(tag)
+
+            def conditional(tag, sup):
+                # the engine's `armed-if-supervised` conditional form
+                with sup.armed(tag) if sup is not None else nullcontext():
+                    return np.asarray(multihost_utils.process_allgather(tag))
+
+            def supervised_join(x):
+                # wrapper modules: supervised_* functions arm themselves
+                return multihost_utils.process_allgather(x)
+
+            def sanctioned(tag, sup):
+                supervised_sync(tag, supervisor=sup)
+            """,
+            "unguarded-collective-barrier",
+        )
+        assert res.findings == []
+
+    def test_guard_outside_def_does_not_cover_the_def(self, tmp_path):
+        # arming at import time is not arming at call time
+        res = lint_src(
+            tmp_path,
+            """
+            from jax.experimental import multihost_utils
+
+            with SUP.armed("module-setup"):
+                def later():
+                    multihost_utils.sync_global_devices("x")
+            """,
+            "unguarded-collective-barrier",
+        )
+        assert rule_ids(res) == ["unguarded-collective-barrier"]
 
 
 # ---------------------------------------------------------------------------
