@@ -210,6 +210,7 @@ def bench_model(cfg, micro_bs, gas, seq, steps, zero_stage, label, opt_params=No
         # never let a sanitized number look like a clean record
         log(f"[{label}] WARNING: ds_san is armed — timings include sanitizer overhead")
 
+    comm = engine.comm_summary()
     tokens_per_sec_chip = global_bs * seq / dt / n_dev
     # Training FLOPs/token ≈ 6*N + 12*L*D*seq (attention term)
     n_params = cfg.num_params()
@@ -230,6 +231,10 @@ def bench_model(cfg, micro_bs, gas, seq, steps, zero_stage, label, opt_params=No
         "steps_per_s": round(1.0 / dt, 3),
         "data_wait_ms": phases.get("data_wait_ms", 0.0),
         "ckpt_stall_ms": phases.get("ckpt_stall_ms", 0.0),
+        # comm layer (docs/comm.md): active grad-exchange strategy + the
+        # per-step comm-bytes model
+        "comm_strategy": comm["strategy"],
+        "comm_bytes_per_step": comm["grad_exchange_bytes"],
         "micro_bs": micro_bs,
         "gas": gas,
         "seq": seq,
@@ -317,6 +322,7 @@ def bench_bert(seq: int, micro_bs: int, gas: int, steps: int):
             }
 
     dt, phases = _timed_steps(engine, batches, steps, label)
+    comm = engine.comm_summary()
     samples_s = global_bs / dt / n_dev
     n_params = cfg.num_params()
     flops_per_token = 6 * n_params + 12 * cfg.num_hidden_layers * cfg.hidden_size * seq
@@ -333,6 +339,8 @@ def bench_bert(seq: int, micro_bs: int, gas: int, steps: int):
         "steps_per_s": round(1.0 / dt, 3),
         "data_wait_ms": phases.get("data_wait_ms", 0.0),
         "ckpt_stall_ms": phases.get("ckpt_stall_ms", 0.0),
+        "comm_strategy": comm["strategy"],
+        "comm_bytes_per_step": comm["grad_exchange_bytes"],
         "micro_bs": micro_bs,
         "gas": gas,
         "seq": seq,
@@ -486,6 +494,25 @@ def run_rung(name: str):
         # same sparse step measures ~11.9x (see the record note)
         rec["vs_baseline"] = round(rec["sparse_over_dense"] / 6.3, 3)
         emit(rec)
+    elif name == "comm-strategies":
+        # dense vs int8 vs 1-bit grad exchange + 1-bit LAMB, on the 124M
+        # and bert-s512 configs (docs/comm.md).  Runs in a grandchild so
+        # the CPU case can force the 8-device dryrun mesh (XLA_FLAGS must
+        # be set before ITS jax import; this child's jax is already up).
+        import subprocess as sp
+
+        cmd = [sys.executable, os.path.join(HERE, "tools", "bench_comm.py")]
+        if not on_tpu:
+            cmd.append("--dryrun")
+        proc = sp.run(cmd, stdout=sp.PIPE, cwd=HERE)
+        recs = _parse_records(proc.stdout.decode(errors="replace"))
+        if proc.returncode != 0 and not recs:
+            # same contract as the parent's _run_child: a dead sweep must
+            # leave a failure record, not a silently empty rung
+            emit({"metric": "comm-strategies", "skipped": True,
+                  "reason": f"bench_comm child rc={proc.returncode}"})
+        for rec in recs:
+            emit(rec)
     else:
         raise SystemExit(f"unknown rung '{name}'")
 
@@ -515,6 +542,10 @@ RUNGS = [
     # 16k sparse-vs-dense TRAINING (two engine builds; dense 16k steps
     # are ~2.2s each, so the measurement itself is ~30s warm)
     ("longctx-train", 240, 480),
+    # comm-strategy sweep: dense vs int8 vs 1-bit grad exchange + 1-bit
+    # LAMB on the 124M / bert-s512 pair (docs/comm.md); ~7 engine builds
+    # in one grandchild, so it runs last
+    ("comm-strategies", 240, 480),
 ]
 
 # Plausibility floors for each rung's PRIMARY record on REAL TPU —

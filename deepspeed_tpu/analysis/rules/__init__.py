@@ -9,6 +9,7 @@ from deepspeed_tpu.analysis.rules import (  # noqa: F401
     host_sync,
     jit_hygiene,
     prng,
+    raw_collective,
     sharding,
     side_effects,
     static_args,

@@ -21,7 +21,15 @@ import ast
 
 from deepspeed_tpu.analysis.core import Severity, make_finding, register
 
-_BLOCKING_SYNCS = {"sync_global_devices", "process_allgather", "broadcast_one_to_all"}
+_BLOCKING_SYNCS = {
+    "sync_global_devices",
+    "process_allgather",
+    "broadcast_one_to_all",
+    # the comm layer's host-side allgather wrapper blocks exactly like
+    # the process_allgather it wraps — routing through comm/collectives
+    # must not hide the site from this rule
+    "host_allgather",
+}
 _GUARD_ATTRS = {"armed", "_sup_region"}
 _EXEMPT_FUNC_PREFIX = "supervised_"
 

@@ -1,0 +1,61 @@
+"""Rule: raw ``lax`` collectives belong in the comm layer.
+
+Every collective exchange is a wire-strategy decision (dense vs
+int8-quantized vs error-feedback compressed; docs/comm.md) and a
+comm-bytes accounting site.  A bare ``jax.lax.psum`` /
+``psum_scatter`` / ``all_gather`` / ``all_to_all`` / ``ppermute`` call
+outside ``deepspeed_tpu/comm/`` bypasses both: it hard-codes the dense
+path and is invisible to the strategy table and the per-step byte
+model.  Route through :mod:`deepspeed_tpu.comm.collectives` (same
+primitives, one import away) or :class:`deepspeed_tpu.comm.strategy.CommLayer`.
+
+Grandfathered call sites (the ring-attention internals in
+``parallel/sequence.py``, whose ppermute schedule IS the algorithm)
+live in the baseline; new sites are tier-B findings.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from deepspeed_tpu.analysis.core import Severity, make_finding, register
+
+_RAW_COLLECTIVES = {"psum", "pmean", "psum_scatter", "all_gather", "all_to_all", "ppermute"}
+# the comm package is the sanctioned home of raw collective call sites
+_EXEMPT_DIR = "deepspeed_tpu/comm/"
+
+
+def _is_lax_collective(node: ast.Call):
+    """Match ``lax.X(...)`` / ``jax.lax.X(...)`` for X in the raw set."""
+    f = node.func
+    if not isinstance(f, ast.Attribute) or f.attr not in _RAW_COLLECTIVES:
+        return None
+    v = f.value
+    if isinstance(v, ast.Name) and v.id == "lax":
+        return f.attr
+    if isinstance(v, ast.Attribute) and v.attr == "lax":
+        return f.attr
+    return None
+
+
+@register(
+    "raw-collective-outside-comm-layer",
+    Severity.B,
+    "direct lax.psum/psum_scatter/all_gather/all_to_all/ppermute call site "
+    "outside deepspeed_tpu/comm/ — route through comm.collectives / "
+    "comm.strategy.CommLayer for strategy selection and byte accounting",
+)
+def check_raw_collective(rule, ctx):
+    path = os.path.normpath(ctx.path).replace(os.sep, "/")
+    if _EXEMPT_DIR in path:
+        return
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            name = _is_lax_collective(node)
+            if name is not None:
+                yield make_finding(
+                    rule, ctx, node,
+                    f"raw 'lax.{name}' outside the comm layer — this exchange is "
+                    "invisible to the strategy table and the comm-bytes model; use "
+                    "deepspeed_tpu.comm.collectives (or CommLayer) instead",
+                )

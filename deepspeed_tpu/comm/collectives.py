@@ -1,0 +1,238 @@
+"""Collective primitives — the one sanctioned home of raw ``lax.*``
+collective call sites outside shard-level libraries.
+
+Every engine-level gradient/activation exchange routes through this
+module (or :mod:`deepspeed_tpu.comm.strategy`, which picks between the
+implementations here); the ds_lint tier-B rule
+``raw-collective-outside-comm-layer`` flags new direct
+``lax.psum/psum_scatter/all_gather/...`` call sites elsewhere.  This is
+the seam the reference's ``runtime/comm/{nccl,mpi}.py`` compressed
+collectives occupied — here it also hosts the EQuARX-style quantized
+allreduce (*EQuARX: Efficient Quantized AllReduce in XLA*, PAPERS.md):
+int8 per-chunk scales with stochastic rounding, quantized at BOTH the
+reduce-scatter and all-gather phases, so a ring exchange moves ~2
+bytes/element instead of the dense fp32 allreduce's ~8.
+
+Three wire tiers (see docs/comm.md for the byte model):
+
+* ``dense``  — plain ``psum``/``psum_scatter``/``all_gather`` (GSPMD or
+  explicit); ~8 B/param for a ring fp32 allreduce.
+* ``int8``   — :func:`quantized_allreduce_replicated`; ~2 B/param, no
+  state, unbiased under stochastic rounding.
+* ``onebit`` — the error-feedback sign+L1-scale exchange
+  (:mod:`deepspeed_tpu.comm.compressed`, re-exported here); ~2 B/param
+  on TPU (int8 is the densest ICI-native format) with a persistent
+  residual that bounds the long-run bias.
+"""
+# The primitives below run INSIDE shard_map bodies (or build them):
+# layouts are pinned by the callers' in_specs/out_specs, not here.
+# ds-lint: disable-file=missing-sharding-constraint
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.comm.compressed import (  # noqa: F401  (re-exports: the 1-bit tier)
+    _shard_map,
+    _sm_flags,
+    compress_chunks,
+    compressed_allreduce,
+    compressed_allreduce_compressed_out,
+    compressed_allreduce_replicated,
+    decompress_chunks,
+)
+
+AxisName = Union[str, Tuple[str, ...]]
+
+
+def shard_map_manual(fn, mesh, in_specs, out_specs, manual_axes):
+    """Version-compat ``shard_map`` with only ``manual_axes`` mapped
+    manually (every other mesh axis stays automatic/GSPMD) and the
+    replication check off.  Newer jax spells this ``axis_names=...`` +
+    ``check_vma``; older jax spells it ``auto=<complement>`` +
+    ``check_rep`` — the pipeline engine's per-stage bodies need it to
+    run on both."""
+    import inspect
+
+    sm = _shard_map()
+    params = inspect.signature(sm).parameters
+    kw = dict(_sm_flags())
+    if "axis_names" in params:
+        kw["axis_names"] = set(manual_axes)
+    elif "auto" in params:
+        kw["auto"] = frozenset(a for a in mesh.axis_names if a not in manual_axes)
+    return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
+# ---------------------------------------------------------------------------
+# in-axis primitives (usable inside shard_map bodies)
+# ---------------------------------------------------------------------------
+
+def axis_size(axis_name: AxisName):
+    """Traced size of one (or a tuple of) mapped mesh axes."""
+    return jax.lax.psum(1, axis_name)
+
+
+def static_axis_size(axis_name: AxisName) -> int:
+    """STATIC size of a mapped axis, usable to build ppermute perm
+    lists inside a shard_map body.  Newer jax has ``lax.axis_size``;
+    older jax constant-folds ``psum(1, axis)`` to the same value."""
+    if hasattr(jax.lax, "axis_size"):
+        return int(jax.lax.axis_size(axis_name))
+    return int(jax.lax.psum(1, axis_name))
+
+
+def flat_axis_index(axis_name: AxisName):
+    """Flat mesh-major rank index over one axis or a tuple of axes —
+    row ``i`` of an ``(n, M)`` exchange grid sharded ``P(axes)`` lives on
+    the rank whose flat index is ``i``."""
+    if isinstance(axis_name, (tuple, list)):
+        idx = jnp.int32(0)
+        for a in axis_name:
+            idx = idx * jax.lax.psum(1, a) + jax.lax.axis_index(a)
+        return idx
+    return jax.lax.axis_index(axis_name)
+
+
+def all_reduce(x, axis_name: AxisName):
+    """Sum over the mapped axis (``lax.psum``)."""
+    return jax.lax.psum(x, axis_name)
+
+
+def all_reduce_mean(x, axis_name: AxisName):
+    return jax.lax.pmean(x, axis_name)
+
+
+def reduce_scatter(x, axis_name: AxisName, scatter_dimension: int = 0, tiled: bool = True):
+    return jax.lax.psum_scatter(
+        x, axis_name, scatter_dimension=scatter_dimension, tiled=tiled
+    )
+
+
+def all_gather(x, axis_name: AxisName, **kw):
+    return jax.lax.all_gather(x, axis_name, **kw)
+
+
+def all_to_all(x, axis_name: AxisName, split_axis: int, concat_axis: int, tiled: bool = False):
+    return jax.lax.all_to_all(
+        x, axis_name, split_axis=split_axis, concat_axis=concat_axis, tiled=tiled
+    )
+
+
+def p2p_shift(x, axis_name: str, n: int, shift: int = 1):
+    """Ring point-to-point: every rank sends ``x`` to ``(i + shift) % n``
+    (``lax.ppermute`` = XLA collective-permute riding ICI) — the pipeline
+    engine's activation/cotangent rotation."""
+    return jax.lax.ppermute(x, axis_name, [(i, (i + shift) % n) for i in range(n)])
+
+
+def host_allgather(x):
+    """Host-side cross-process allgather (the ZeRO-Offload masters
+    reassembly / checkpoint flag-sync site).  Blocking on every process:
+    keep call sites inside a supervision-armed region (the ds_lint
+    ``unguarded-collective-barrier`` rule counts this wrapper as a
+    blocking sync)."""
+    from jax.experimental import multihost_utils
+
+    # definition site of the wrapper itself — the barrier rule tracks
+    # 'host_allgather' at CALL sites, where the armed region must live
+    return multihost_utils.process_allgather(x)  # ds-lint: disable=unguarded-collective-barrier
+
+
+# ---------------------------------------------------------------------------
+# EQuARX-style int8 quantized allreduce
+# ---------------------------------------------------------------------------
+
+def _quantize_chunks_int8(xc: jnp.ndarray, key) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric int8 quantization with one fp32 scale per leading chunk
+    (``xc``: (k, chunk)).  ``key`` enables unbiased stochastic rounding
+    (``floor(y + u)``, u ~ U[0,1)); None rounds to nearest."""
+    amax = jnp.max(jnp.abs(xc), axis=1)
+    scale = jnp.where(amax > 0, amax / 127.0, jnp.float32(1.0))
+    y = xc / scale[:, None]
+    if key is not None:
+        q = jnp.floor(y + jax.random.uniform(key, y.shape, jnp.float32))
+    else:
+        q = jnp.rint(y)
+    return jnp.clip(q, -127, 127).astype(jnp.int8), scale
+
+
+def _int8_body(x, key, *, axis_name: AxisName, stochastic: bool):
+    """Per-rank body under shard_map: two-phase quantized allreduce-mean.
+
+    Phase 1 (reduce-scatter shaped): quantize each destination chunk
+    int8 with its own scale, exchange chunks via all_to_all; rank j
+    dequantizes and averages the j-th chunk from every source.  Phase 2
+    (all-gather shaped): re-quantize the served partial int8 and
+    all-gather it back.  Wire: ~2 int8 bytes/element + 2 fp32
+    scales/chunk — vs ~8 bytes/element for a dense fp32 ring allreduce.
+    """
+    n = jax.lax.psum(1, axis_name)
+    xv = x[0]
+    chunk = xv.shape[0] // n
+    k1 = k2 = None
+    if stochastic:
+        kr = jax.random.fold_in(key, flat_axis_index(axis_name))
+        k1, k2 = jax.random.split(kr)
+    q, scale = _quantize_chunks_int8(xv.reshape(n, chunk), k1)
+    served = jax.lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    served_scales = jax.lax.all_to_all(
+        scale.reshape(n, 1), axis_name, split_axis=0, concat_axis=0, tiled=False
+    )  # (n, 1): source i's scale for THIS rank's chunk
+    partial = jnp.mean(served.astype(jnp.float32) * served_scales, axis=0)  # (chunk,)
+    q2, scale2 = _quantize_chunks_int8(partial[None, :], k2)
+    all_q = jax.lax.all_gather(q2[0], axis_name)  # (n, chunk)
+    all_s = jax.lax.all_gather(scale2[0], axis_name)  # (n,)
+    return (all_q.astype(jnp.float32) * all_s[:, None]).reshape(-1)
+
+
+def quantized_allreduce_replicated(
+    x_rows, mesh, axis_name: AxisName = "data", key=None, stochastic: bool = True
+):
+    """EQuARX-style int8 allreduce-mean over exchange rows.
+
+    ``x_rows``: (n, M) — row i is rank i's local tensor, sharded
+    ``P(axis_name)`` (M divisible by n).  Returns the replicated (M,)
+    mean.  ``axis_name`` may be a tuple of mesh axes (the ZeRO-composed
+    exchange over the whole dp grid, like
+    :func:`~deepspeed_tpu.comm.compressed.compressed_allreduce`).
+    ``stochastic`` + ``key``: unbiased stochastic rounding — required
+    for convergence parity over many steps (nearest rounding carries a
+    systematic sub-LSB bias).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    n, m = x_rows.shape
+    if m % n:
+        raise ValueError(f"tensor length {m} not divisible by axis size {n}")
+    stoch = bool(stochastic) and key is not None
+    if key is None:
+        key = jax.random.PRNGKey(0)  # unused when stoch is False
+
+    def body(x, k):
+        return _int8_body(x, k, axis_name=axis_name, stochastic=stoch)
+
+    mapped = _shard_map()(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis_name), P()),
+        out_specs=P(),
+        **_sm_flags(),
+    )
+    return mapped(x_rows, key)
+
+
+def dense_allreduce_replicated(x_rows, mesh, axis_name: AxisName = "data"):
+    """Full-precision allreduce-mean over exchange rows — the dense
+    rung of the same (n, M)-rows interface, for A/B measurement."""
+    from jax.sharding import PartitionSpec as P
+
+    def body(x):
+        return jax.lax.pmean(x[0], axis_name)
+
+    mapped = _shard_map()(
+        body, mesh=mesh, in_specs=(P(axis_name),), out_specs=P(), **_sm_flags()
+    )
+    return mapped(x_rows)

@@ -29,6 +29,19 @@ def _shard_map():
     return sm
 
 
+def _sm_flags() -> dict:
+    """Replication-check opt-out kwarg across jax versions: newer
+    shard_map spells it ``check_vma``, older ``check_rep``."""
+    import inspect
+
+    params = inspect.signature(_shard_map()).parameters
+    if "check_vma" in params:
+        return {"check_vma": False}
+    if "check_rep" in params:
+        return {"check_rep": False}
+    return {}
+
+
 def _sign_compress(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Compress to {-1,+1} int8 signs + scalar L1 scale (reference
     nccl.py:76-86: scale = |x|.mean(); sign with 0→+1)."""
@@ -91,7 +104,7 @@ def _exchange(x_per_rank, worker_error, server_error, mesh, axis_name, replicate
         mesh=mesh,
         in_specs=(P(axis_name), P(axis_name), P(axis_name)),
         out_specs=(P() if replicated_out else P(axis_name), P(axis_name), P(axis_name)),
-        check_vma=False,
+        **_sm_flags(),
     )
     return mapped(x_per_rank, worker_error, server_error)
 
@@ -167,7 +180,7 @@ def compressed_allreduce_compressed_out(
         mesh=mesh,
         in_specs=(P(axis_name), P(axis_name), P(axis_name)),
         out_specs=(P(), P(), P(axis_name), P(axis_name)),
-        check_vma=False,
+        **_sm_flags(),
     )
     return mapped(x_per_rank, worker_error, server_error)
 

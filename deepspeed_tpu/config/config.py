@@ -670,6 +670,57 @@ class OverlapConfig:
 
 
 @dataclass
+class CommConfig:
+    """``comm`` block (TPU-native extension; docs/comm.md): the wire
+    strategy for gradient exchange — ``dense`` (full precision, the
+    default), ``int8`` (EQuARX-style quantized allreduce: per-chunk
+    scale + stochastic rounding), ``onebit`` (error-feedback sign +
+    L1-scale compression, generalized from 1-bit Adam's exchange), or
+    ``auto`` (policy-selected per tensor size/dtype/topology)."""
+
+    strategy: str = C.COMM_STRATEGY_DEFAULT
+    threshold_bytes: int = C.COMM_THRESHOLD_BYTES_DEFAULT
+    quantize_bits: int = C.COMM_QUANTIZE_BITS_DEFAULT
+    error_feedback: bool = C.COMM_ERROR_FEEDBACK_DEFAULT
+    stochastic_rounding: bool = C.COMM_STOCHASTIC_ROUNDING_DEFAULT
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "CommConfig":
+        if d is None:
+            return cls()
+        d = dict(d)
+        out = cls(
+            strategy=str(_pop(d, "strategy", C.COMM_STRATEGY_DEFAULT)).lower(),
+            threshold_bytes=int(_pop(d, "threshold_bytes", C.COMM_THRESHOLD_BYTES_DEFAULT)),
+            quantize_bits=int(_pop(d, "quantize_bits", C.COMM_QUANTIZE_BITS_DEFAULT)),
+            error_feedback=bool(_pop(d, "error_feedback", C.COMM_ERROR_FEEDBACK_DEFAULT)),
+            stochastic_rounding=bool(
+                _pop(d, "stochastic_rounding", C.COMM_STOCHASTIC_ROUNDING_DEFAULT)
+            ),
+        )
+        _check_empty(d, C.COMM, _known_keys(cls))
+        if out.strategy not in C.COMM_STRATEGIES:
+            raise DeepSpeedConfigError(
+                f"'{C.COMM}.strategy' must be one of {C.COMM_STRATEGIES}, got '{out.strategy}'"
+            )
+        if out.threshold_bytes < 0:
+            raise DeepSpeedConfigError(
+                f"'{C.COMM}.threshold_bytes' must be >= 0, got {out.threshold_bytes}"
+            )
+        if out.quantize_bits != C.COMM_QUANTIZE_BITS_DEFAULT:
+            # XLA has no bit-packed dtype: int8 is the densest exchange
+            # format ICI moves natively (comm/compressed.py module note);
+            # the 1-bit TIER is the `onebit` strategy, whose signs also
+            # ride as int8
+            raise DeepSpeedConfigError(
+                f"'{C.COMM}.quantize_bits' supports only {C.COMM_QUANTIZE_BITS_DEFAULT} "
+                f"(int8 is the densest ICI-native exchange format; use strategy "
+                f"'{C.COMM_STRATEGY_ONEBIT}' for the sign+scale tier), got {out.quantize_bits}"
+            )
+        return out
+
+
+@dataclass
 class SanitizerConfig:
     """``sanitizer`` block (ds_san; docs/ds_san.md).  Opt-in runtime
     checkers around the engine step: recompile-storm detection, implicit
@@ -1000,6 +1051,7 @@ _KNOWN_TOP_LEVEL = {
     C.RESILIENCE,
     C.OVERLAP,
     C.SANITIZER,
+    C.COMM,
     "activation_checkpointing",
     "flops_profiler",
     "aio",
@@ -1062,6 +1114,7 @@ class DeepSpeedConfig:
         self.resilience = ResilienceConfig.from_dict(d.get(C.RESILIENCE))
         self.overlap = OverlapConfig.from_dict(d.get(C.OVERLAP))
         self.sanitizer = SanitizerConfig.from_dict(d.get(C.SANITIZER))
+        self.comm = CommConfig.from_dict(d.get(C.COMM))
         self.elasticity_dict = d.get("elasticity")
 
         self.gradient_clipping = float(d.get(C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT))

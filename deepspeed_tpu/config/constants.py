@@ -211,6 +211,28 @@ TIMELINE_ENABLED_DEFAULT = True
 TIMELINE_WINDOW_DEFAULT = 512  # steps retained for summaries
 
 #############################################
+# Comm (strategy-selected quantized collectives; docs/comm.md)
+#############################################
+COMM = "comm"
+COMM_STRATEGY_AUTO = "auto"
+COMM_STRATEGY_DENSE = "dense"
+COMM_STRATEGY_INT8 = "int8"
+COMM_STRATEGY_ONEBIT = "onebit"
+COMM_STRATEGIES = [
+    COMM_STRATEGY_AUTO,
+    COMM_STRATEGY_DENSE,
+    COMM_STRATEGY_INT8,
+    COMM_STRATEGY_ONEBIT,
+]
+# dense by default: compressed gradient exchange changes numerics and
+# must be an explicit opt-in ("auto" enables the size/dtype policy)
+COMM_STRATEGY_DEFAULT = COMM_STRATEGY_DENSE
+COMM_THRESHOLD_BYTES_DEFAULT = 65536  # below this, dense always wins
+COMM_QUANTIZE_BITS_DEFAULT = 8  # int8 is the densest ICI-native format
+COMM_ERROR_FEEDBACK_DEFAULT = True  # onebit strategy's residual carry
+COMM_STOCHASTIC_ROUNDING_DEFAULT = True  # int8 strategy's unbiased rounding
+
+#############################################
 # Sanitizer (ds_san: trace-time & runtime checkers; docs/ds_san.md)
 #############################################
 SANITIZER = "sanitizer"

@@ -246,12 +246,54 @@ def sanitizer_report(config=None) -> None:
         print(f"{name} " + "." * (30 - len(name)) + f" {value}")
 
 
+def comm_report(config=None) -> None:
+    """Comm-layer strategy table (docs/comm.md).  ``config`` may be a
+    DeepSpeedConfig, a CommConfig, or None (defaults).  Prints the
+    config knobs plus the policy table — which strategy a few
+    representative fp32 tensor sizes get over an 8-rank dp grid — and
+    the per-exchange wire bytes/param of each strategy."""
+    from deepspeed_tpu.comm.strategy import (
+        select_strategy,
+        strategy_wire_bytes_per_param,
+    )
+    from deepspeed_tpu.config.config import CommConfig
+
+    c = getattr(config, "comm", config)
+    if c is None or not hasattr(c, "threshold_bytes"):
+        c = CommConfig()
+    print()
+    print("comm layer configuration:")
+    rows = [
+        ("strategy (config)", c.strategy),
+        ("threshold_bytes", f"{c.threshold_bytes} (below: always dense)"),
+        ("quantize_bits", c.quantize_bits),
+        ("error_feedback", "on" if c.error_feedback else "off"),
+        ("stochastic_rounding", "on" if c.stochastic_rounding else "off"),
+    ]
+    import numpy as np
+
+    for label, nbytes in (
+        ("16 KB fp32 @ dp=8", 16 << 10),
+        ("4 MB fp32 @ dp=8", 4 << 20),
+        ("500 MB fp32 @ dp=8", 500 << 20),
+    ):
+        d = select_strategy(c, nbytes, np.float32, 8)
+        rows.append((label, f"{d.strategy} ({d.reason})"))
+    for s in ("dense", "int8", "onebit"):
+        rows.append(
+            (f"wire B/param ({s})", f"{strategy_wire_bytes_per_param(s):g}")
+        )
+    for name, value in rows:
+        print(f"{name} " + "." * (30 - len(name)) + f" {value}")
+
+
 def cli_main() -> int:
     ok = op_report()
     debug_report()
     resilience_report()
     overlap_report()
     sanitizer_report()
+    comm_report()
     return 0 if ok else 1
 
 

@@ -128,9 +128,22 @@ def _build_meta(engine, tag: str, client_state: Optional[dict]) -> Dict[str, Any
         # engines skip the persistent buffer; a restoring job with a
         # different gas must know to partial-restore)
         "has_grad_acc": bool(engine.state.get("grad_acc")),
+        # comm-layer error-feedback residual rows (docs/comm.md): their
+        # (n, Mp) shape keys on the dp grid, so a job restoring under a
+        # different mesh/strategy must skip-and-reset them
+        "comm_state": _comm_state_shape(engine.state.get("comm")),
         "client_state": client_state or {},
         "ds_tpu_version": _version(),
     }
+
+
+def _comm_state_shape(comm) -> Optional[list]:
+    """``[rows, padded_len]`` of the error-feedback residuals, or None
+    when the engine runs a stateless comm strategy."""
+    if not comm:
+        return None
+    we = comm.get("worker_error") if isinstance(comm, dict) else None
+    return [int(we.shape[0]), int(we.shape[1])] if we is not None else None
 
 
 def save_checkpoint(
@@ -561,15 +574,51 @@ def _restore_tag(
         import orbax.checkpoint as ocp
 
         partial_target = {k: v for k, v in target.items() if k not in skip_keys}
-        out = dict(
-            ocp.PyTreeCheckpointer().restore(
-                os.path.join(path, "state"),
-                args=ocp.args.PyTreeRestore(
-                    item=jax.tree.map(lambda a: np.zeros(a.shape, a.dtype), partial_target),
-                    partial_restore=True,
-                ),
+        try:
+            out = dict(
+                ocp.PyTreeCheckpointer().restore(
+                    os.path.join(path, "state"),
+                    args=ocp.args.PyTreeRestore(
+                        item=jax.tree.map(lambda a: np.zeros(a.shape, a.dtype), partial_target),
+                        partial_restore=True,
+                    ),
+                )
             )
-        )
+        except TypeError:
+            # older orbax has no partial_restore kwarg: rebuild a
+            # DISK-shaped target for the reconstructible skipped keys,
+            # read everything, and discard the skipped values below
+            from jax.sharding import NamedSharding, PartitionSpec as _P
+
+            repl = NamedSharding(engine.mesh, _P())
+            full_target = dict(partial_target)
+            for k in skip_keys:
+                if k == "grad_acc":
+                    # the tag's accumulator is a params-shaped fp32 tree
+                    # (or the empty node a gas==1/explicit-comm engine saved)
+                    full_target[k] = (
+                        jax.tree.map(
+                            lambda a: jax.ShapeDtypeStruct(a.shape, np.float32, sharding=repl),
+                            target["params"],
+                        )
+                        if meta.get("has_grad_acc", True)
+                        else {}
+                    )
+                elif k == "comm" and "comm_state" not in meta:
+                    pass  # pre-comm-layer tag: no subtree on disk
+                elif k == "comm":
+                    dc = meta.get("comm_state")
+                    if dc:
+                        n_, mp_ = int(dc[0]), int(dc[1])
+                        full_target[k] = {
+                            "worker_error": jax.ShapeDtypeStruct((n_, mp_), np.float32, sharding=repl),
+                            "server_error": jax.ShapeDtypeStruct((n_, mp_ // n_), np.float32, sharding=repl),
+                        }
+                    else:
+                        full_target[k] = {}
+                # other keys (e.g. opt_state with an unknown schema)
+                # stay omitted — works only when the tag lacks them too
+            out = dict(ckptr.restore(os.path.join(path, "state"), full_target))
         for k in skip_keys:
             out[k] = {}
         return out
@@ -584,6 +633,24 @@ def _restore_tag(
     skip = set()
     if disk_has_acc != bool(target.get("grad_acc")) and getattr(engine, "_use_grad_acc", True):
         skip.add("grad_acc")
+    # comm EF residuals: restore only when the tag's rows layout matches
+    # this engine's exactly (same dp grid, same strategy/EF setting) —
+    # anything else skips the subtree through the partial-restore path
+    # (modern orbax never reads the bytes; the old-orbax fallback inside
+    # _partial_restore rebuilds the DISK layout from meta and discards)
+    reset_comm = False
+    if "comm" in target:
+        eng_comm = _comm_state_shape(target.get("comm"))
+        if "comm_state" not in meta or meta.get("comm_state") != eng_comm:
+            skip.add("comm")
+            reset_comm = True
+        if reset_comm and eng_comm is not None:
+            logger.warning(
+                "comm: error-feedback residuals in the tag do not match this "
+                f"engine's layout (tag {meta.get('comm_state', 'absent')}, engine "
+                f"{eng_comm}); residuals RESET to zero — the error-feedback bias "
+                "restarts from scratch (bounded; convergence unaffected)"
+            )
 
     from_partial = False
     try:
@@ -634,6 +701,18 @@ def _finish_restore(
                 out_shardings=engine._state_shardings["grad_acc"],
             )(engine.state["grad_acc"])
             if engine.state["grad_acc"]
+            else {}
+        )
+    if "comm" in skip:
+        # keep this engine's EF-residual SHAPE but start from zero (the
+        # residual is a bias corrector, not training state — resetting
+        # it is always safe)
+        restored["comm"] = (
+            jax.jit(
+                lambda t: jax.tree.map(jnp.zeros_like, t),
+                out_shardings=engine._state_shardings["comm"],
+            )(engine.state["comm"])
+            if engine.state.get("comm")
             else {}
         )
     if engine._flat_plan or full_put:

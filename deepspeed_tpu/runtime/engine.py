@@ -275,15 +275,17 @@ class DeepSpeedEngine:
                 theta=config.progressive_layer_drop.theta, gamma=config.progressive_layer_drop.gamma
             )
 
-        # -- 1-bit Adam compressed-exchange phase --------------------------
+        # -- 1-bit Adam/LAMB compressed-exchange phase ---------------------
         # After freeze_step the engine switches to a SECOND compiled
         # train step that keeps per-rank gradients UNREDUCED (vmap over
         # data-axis slices) and exchanges the momentum through the
-        # error-feedback 1-bit collective (comm/compressed.py) — the
+        # error-feedback 1-bit collective (comm/collectives.py) — the
         # reference's comm-volume saving (onebit/adam.py:110-220 over
-        # nccl.py:47-186), realized as two executables because a single
-        # program would pay for both exchange paths every step.
+        # nccl.py:47-186; onebit/lamb.py for the large-batch rung),
+        # realized as two executables because a single program would pay
+        # for both exchange paths every step.
         from deepspeed_tpu.runtime.fp16.onebit.adam import OnebitAdam
+        from deepspeed_tpu.runtime.fp16.onebit.lamb import OnebitLamb
 
         self._onebit_frozen = False
         # fsdp>1 composes via the two-level exchange (flat dim sharded over
@@ -297,9 +299,9 @@ class DeepSpeedEngine:
             "quantize_training (MoQ) unsupported": self.quantizer is None,
             "progressive_layer_drop unsupported": self.progressive_layer_drop is None,
         }
-        self._onebit_exchange_ok = isinstance(self.optimizer, OnebitAdam) and all(
-            onebit_blockers.values()
-        )
+        self._onebit_exchange_ok = isinstance(
+            self.optimizer, (OnebitAdam, OnebitLamb)
+        ) and all(onebit_blockers.values())
         if (
             self._onebit_exchange_ok
             and self.mesh_info.fsdp_world_size > 1
@@ -314,9 +316,9 @@ class DeepSpeedEngine:
             # the freeze step, not at init.
             n_p = sum(int(np.prod(np.shape(p))) for p in jax.tree.leaves(params))
             logger.warning(
-                "1-bit Adam + ZeRO(fsdp>1): the compressed phase replicates "
-                "the momentum signs (int8) + flat fp32 variance/params and "
-                "keeps a per-chip fp32 worker-error row "
+                "1-bit optimizer + ZeRO(fsdp>1): the compressed phase "
+                "replicates the momentum signs (int8) + flat fp32 "
+                "variance/params and keeps a per-chip fp32 worker-error row "
                 f"(~{13 * n_p / 2**30:.1f}GiB static per chip, plus fp32 "
                 "momentum/grad transients during the step) — ZeRO's state "
                 "sharding does not apply after freeze_step; ensure HBM "
@@ -324,12 +326,13 @@ class DeepSpeedEngine:
                 "(layout trade-off measured in tests/test_onebit.py::"
                 "test_frozen_variance_layout_wire_bytes)"
             )
-        if isinstance(self.optimizer, OnebitAdam) and not self._onebit_exchange_ok:
+        if isinstance(self.optimizer, (OnebitAdam, OnebitLamb)) and not self._onebit_exchange_ok:
             failed = [k for k, ok in onebit_blockers.items() if not ok]
             logger.warning(
-                "1-bit Adam: compressed gradient exchange DISABLED — the "
-                "optimizer will fall back to local momentum quantization "
-                f"with full-precision allreduce ({'; '.join(failed)})"
+                f"1-bit {type(self.optimizer).__name__}: compressed gradient "
+                "exchange DISABLED — the optimizer will fall back to local "
+                "momentum quantization with full-precision allreduce "
+                f"({'; '.join(failed)})"
             )
 
         # -- resilience (watchdog / divergence guard / checkpoint dirs) ----
@@ -395,6 +398,13 @@ class DeepSpeedEngine:
         # tests pin this to 1 over a steady-state training loop (any
         # shape/static-arg drift shows up as a recount)
         self.compilation_count = 0
+
+        # -- unified comm layer (docs/comm.md) -----------------------------
+        # Strategy-selected collectives: the gradient exchange routes
+        # through self.comm, which picks dense / int8-quantized (EQuARX)
+        # / error-feedback-compressed per (size, dtype, topology) at
+        # TRACE time — no recompile per strategy, one executable each.
+        self._init_comm_layer(config)
 
         # -- ds_san runtime sanitizer (opt-in: `sanitizer` config block
         # or DS_SAN=1; docs/ds_san.md).  None in production — every hook
@@ -738,7 +748,9 @@ class DeepSpeedEngine:
         (scaled_loss, loss), grads = jax.value_and_grad(
             lambda p: self._compute_loss(p, batch, rng, state["loss_scale"]), has_aux=True
         )(state["params"])
-        grads = jax.lax.with_sharding_constraint(
+        # dense grad-exchange site: the comm layer's sharding constraint
+        # is what GSPMD lowers to the grad psum / psum_scatter
+        grads = self.comm.constrain_grads(
             grads, jax.tree.map(self._sh, self._grad_specs, is_leaf=lambda x: isinstance(x, P))
         )
         state = dict(state)
@@ -767,6 +779,13 @@ class DeepSpeedEngine:
         """Unscale/clip/update given already-averaged grads (shared by the
         grad-accumulation path and the pipeline engine's fused batch)."""
         grads, overflow = self.loss_scaler.unscale_and_check(grads, state["loss_scale"])
+        return self._apply_update_unscaled(state, grads, overflow)
+
+    def _apply_update_unscaled(self, state, grads, overflow):
+        """Clip + optimizer update for ALREADY-unscaled averaged grads
+        with the overflow decision made by the caller (the explicit
+        comm-exchange path checks finiteness on the pre-quantization
+        rows, where an inf is still visible)."""
         grad_norm = jnp.zeros((), jnp.float32)
         if self.config.gradient_clipping > 0.0:
             grads, grad_norm = _clip_by_global_norm(grads, self.config.gradient_clipping)
@@ -910,50 +929,47 @@ class DeepSpeedEngine:
         elif self._onebit_frozen and global_step <= self.optimizer.freeze_step:
             self._exit_onebit_frozen()
 
-    def _onebit_exchange_axes(self):
-        """The frozen exchange runs flat across the WHOLE dp grid —
-        (data × fsdp) when ZeRO shards state, so the 1-bit wire saving
-        covers every data-parallel rank (the reference never composes
-        1-bit with ZeRO; here the ring is just wider)."""
+    def _dp_exchange_axes(self):
+        """The explicit (1-bit frozen / quantized-grad) exchange runs
+        flat across the WHOLE dp grid — (data × fsdp) when ZeRO shards
+        state, so the compressed wire saving covers every data-parallel
+        rank (the reference never composes 1-bit with ZeRO; here the
+        ring is just wider)."""
         if "fsdp" in self.mesh.axis_names and self.mesh_info.fsdp_world_size > 1:
             return ("data", "fsdp")
         return "data"
 
-    def _enter_onebit_frozen(self) -> None:
-        from deepspeed_tpu.runtime.fp16.onebit.adam import FrozenOnebitAdamState
+    _onebit_exchange_axes = _dp_exchange_axes  # historical name
 
+    def _enter_onebit_frozen(self) -> None:
         n = self.mesh_info.dp_world_size  # exchange rows = full dp grid
-        row_spec = P(self._onebit_exchange_axes())
+        row_spec = P(self._dp_exchange_axes())
         # NOTE: the frozen layout replicates the momentum (in its int8
         # compressed exchange form — 1 byte/param) and the fp32 variance
         # (the exchange needs the full momentum on every rank to
         # compress it) — ZeRO-1's moment sharding is traded for the
         # 1-bit wire in this phase
-        sh = FrozenOnebitAdamState(
-            step=self._sh(P()),
-            m_signs=self._sh(P()),
-            m_scales=self._sh(P()),
-            v_flat=self._sh(P()),
-            worker_error=self._sh(row_spec),
-            server_error=self._sh(row_spec),
-        )
+        specs = self.optimizer.frozen_specs(row_spec)
+        sh = jax.tree.map(self._sh, specs, is_leaf=lambda x: isinstance(x, P))
         self.state["opt_state"] = jax.jit(
             lambda s: self.optimizer.make_frozen_state(s, n), out_shardings=sh
         )(self.state["opt_state"])
         self._state_shardings["opt_state"] = sh
-        self._opt_specs = FrozenOnebitAdamState(
-            step=P(), m_signs=P(), m_scales=P(), v_flat=P(),
-            worker_error=row_spec, server_error=row_spec,
-        )
+        self._opt_specs = specs
         # the frozen path accumulates into its own (n, Mp) rows buffer —
         # free the params-sized fp32 accumulator
         self.state["grad_acc"] = {}
         self._state_shardings["grad_acc"] = {}
         self._purge_train_executables()
         self._onebit_frozen = True
+        self.comm.note(
+            "momentum-exchange", "onebit",
+            f"1-bit {type(self.optimizer).__name__} compressed-exchange phase",
+        )
         log_dist(
-            f"1-bit Adam: entering compressed-exchange phase at step "
-            f"{self._host_global_step} (freeze_step={self.optimizer.freeze_step}, dp_ranks={n})"
+            f"1-bit {type(self.optimizer).__name__}: entering compressed-exchange "
+            f"phase at step {self._host_global_step} "
+            f"(freeze_step={self.optimizer.freeze_step}, dp_ranks={n})"
         )
 
     def _exit_onebit_frozen(self) -> None:
@@ -975,7 +991,10 @@ class DeepSpeedEngine:
             self._state_shardings["grad_acc"] = grad_sh
         self._purge_train_executables()
         self._onebit_frozen = False
-        log_dist("1-bit Adam: rolled back to warmup (pre-freeze) state layout")
+        log_dist(
+            f"1-bit {type(self.optimizer).__name__}: rolled back to warmup "
+            "(pre-freeze) state layout"
+        )
 
     def _purge_train_executables(self) -> None:
         """Drop compiled steps that close over opt-state layout or
@@ -1132,16 +1151,205 @@ class DeepSpeedEngine:
             mt = self._host_opts[j].step({"flat": flat_g[i * L : (i + 1) * L]}, lr, step_count)
             slices[i] = mt["flat"]
         if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
-
+            # masters reassembly routes through the comm layer (dense
+            # host allgather of fp32 slices; supervision-armed)
             with self._sup_region("offload.masters_allgather"):
                 stacked = np.asarray(
-                    multihost_utils.process_allgather(slices[self._host_shard_ids[0]])
+                    self.comm.host_allgather(slices[self._host_shard_ids[0]])
                 )
             full = stacked.reshape(-1)
         else:
             full = np.concatenate([slices[i] for i in sorted(slices)])
         return unpack_flat(full, self.state["params"])
+
+    # ------------------------------------------------------------------
+    # unified comm layer (docs/comm.md)
+    # ------------------------------------------------------------------
+    def _init_comm_layer(self, config) -> None:
+        """Build the strategy-selected comm layer and resolve the
+        gradient-exchange strategy ONCE, at trace-decision time: dense
+        keeps the GSPMD constraint path untouched; int8 / onebit switch
+        ``train_batch`` to the explicit per-rank-rows step
+        (:meth:`_comm_full_step`).  The onebit strategy's error-feedback
+        residual rows live in ``state['comm']`` and ride checkpoints
+        with the rest of the state."""
+        from deepspeed_tpu.comm.strategy import STRATEGY_DENSE, STRATEGY_ONEBIT, CommLayer
+        from deepspeed_tpu.config.config import CommConfig
+
+        self.comm = CommLayer(
+            self.mesh, self.mesh_info, getattr(config, "comm", None) or CommConfig(),
+            zero_config=config.zero_config,
+        )
+        # satellite: the previously-unwired reduce_scatter flag is now
+        # honored by ZeroShardingRules.grad_spec; warn once when it
+        # forces the dense all-reduce path (reference stage2 fallback)
+        if (
+            config.zero_config.stage >= 2
+            and self.mesh_info.fsdp_world_size > 1
+            and not config.zero_config.reduce_scatter
+        ):
+            self.comm.note(
+                "zero-grad-reduce", STRATEGY_DENSE,
+                "zero_optimization.reduce_scatter=false forces the dense all-reduce path",
+            )
+            logger.warning(
+                "zero_optimization.reduce_scatter=false: gradient reduction stays a "
+                "full all-reduce (grads replicated over fsdp) — ~2x the wire bytes "
+                "and a params-sized grad buffer per chip (the reference's stage2 "
+                "allreduce fallback); drop the flag to restore the psum_scatter path"
+            )
+        n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(self.state["params"]))
+        self._comm_n_params = n_params
+        n = max(1, self.mesh_info.dp_world_size)
+        self._comm_flat_len = -(-n_params // n) * n
+        axes = self._dp_exchange_axes()
+        want = self.comm.select(4 * n_params, jnp.float32, axes, site="grad-exchange")
+        explicit = want != STRATEGY_DENSE
+        if explicit:
+            blockers = {
+                "data-parallel grid must be > 1": self.mesh_info.dp_world_size > 1,
+                "pipeline engine unsupported": getattr(self, "_use_grad_acc", True),
+                "offload_optimizer unsupported": not self._offload,
+                "1-bit optimizer owns its own exchange": not self._onebit_exchange_ok,
+            }
+            failed = [k for k, ok in blockers.items() if not ok]
+            if failed:
+                logger.warning(
+                    f"comm: '{want}' gradient exchange requested but DISABLED "
+                    f"({'; '.join(failed)}); falling back to dense"
+                )
+                self.comm.note("grad-exchange", STRATEGY_DENSE, f"forced dense: {'; '.join(failed)}")
+                want, explicit = STRATEGY_DENSE, False
+        self._comm_grad_strategy = want
+        self._comm_explicit = explicit
+        self.state["comm"] = {}
+        self._state_shardings["comm"] = {}
+        if explicit:
+            # the explicit path accumulates into its own (n, Mp) rows
+            # buffer inside the compiled step — free the params-sized
+            # fp32 accumulator (as the 1-bit frozen phase does)
+            self.state["grad_acc"] = {}
+            self._state_shardings["grad_acc"] = {}
+            mp = self._comm_flat_len
+            if want == STRATEGY_ONEBIT and self.comm.config.error_feedback:
+                row_sh = self._sh(P(axes))
+                comm_sh = {"worker_error": row_sh, "server_error": row_sh}
+                self.state["comm"] = jax.jit(
+                    lambda: {
+                        "worker_error": jnp.zeros((n, mp), jnp.float32),
+                        "server_error": jnp.zeros((n, mp // n), jnp.float32),
+                    },
+                    out_shardings=comm_sh,
+                )()
+                self._state_shardings["comm"] = comm_sh
+            log_dist(
+                f"comm: '{want}' gradient exchange over {axes} "
+                f"(n={n} ranks, {mp} padded coords, "
+                f"{'EF residuals in state' if self.state['comm'] else 'stateless'})"
+            )
+        summ = self.comm_summary()
+        self.timeline.set_comm(summ["strategy"], summ["grad_exchange_bytes"])
+
+    def comm_summary(self) -> Dict[str, Any]:
+        """Active comm-strategy table + the per-step comm-bytes model
+        (docs/comm.md) — surfaced by ds_report and bench.py records."""
+        from deepspeed_tpu.comm.strategy import step_comm_bytes
+
+        model = step_comm_bytes(
+            self._comm_n_params,
+            self.mesh_info.sizes,
+            stage=self.zero_stage,
+            gas=self.gradient_accumulation_steps,
+            strategy=self._comm_grad_strategy,
+            reduce_scatter=self.config.zero_config.reduce_scatter,
+        )
+        return {
+            "strategy": self._comm_grad_strategy,
+            "grad_exchange_bytes": model["grad-exchange"],
+            "model": model,
+            "table": self.comm.table(),
+        }
+
+    def _comm_full_step(self, state, stacked):
+        """Compiled train step for the explicit compressed gradient
+        exchange (comm.strategy int8 / onebit): per-rank gradients stay
+        UNREDUCED as (n, Mp) rows accumulated across micro batches; ONE
+        strategy-compressed exchange per step replaces the per-micro
+        dense psum, then the dense-identical unscaled update applies
+        (clipping on the exchanged average — dense semantics, so the
+        loss trajectory stays comparable)."""
+        from deepspeed_tpu.runtime.fp16.onebit.adam import pack_rows, unpack_flat
+
+        n = self.mesh_info.dp_world_size
+        axes = self._dp_exchange_axes()
+        gas = self.gradient_accumulation_steps
+        mp = self._comm_flat_len
+        row_sh = self._sh(P(axes))
+        acc0 = jax.lax.with_sharding_constraint(jnp.zeros((n, mp), jnp.float32), row_sh)
+
+        def body(carry, mb):
+            st, acc = carry
+            if self.progressive_layer_drop is not None and isinstance(mb, dict):
+                from deepspeed_tpu.runtime.progressive_layer_drop import PLD_THETA_KEY
+
+                mb = dict(mb)
+                mb[PLD_THETA_KEY] = self.progressive_layer_drop.get_theta(st["global_step"])
+            rng = jax.random.fold_in(st["rng"], st["micro_step"])
+
+            def rows_of(x):
+                return x.reshape((n, x.shape[0] // n) + x.shape[1:])
+
+            b_rows = jax.tree.map(rows_of, mb)
+
+            def slice_loss(p, b, r):
+                return self._compute_loss(p, b, r, st["loss_scale"])
+
+            # independent rng per DP slice (dropout must differ per slice)
+            (_, loss), g = jax.vmap(
+                jax.value_and_grad(slice_loss, has_aux=True), in_axes=(None, 0, 0)
+            )(st["params"], b_rows, jax.random.split(rng, n))
+            g_rows = jax.lax.with_sharding_constraint(pack_rows(g, n, n), row_sh)
+            st = dict(st)
+            st["micro_step"] = st["micro_step"] + 1
+            st["global_samples"] = (
+                st["global_samples"]
+                + self.train_micro_batch_size_per_gpu * self.mesh_info.dp_world_size
+            )
+            return (st, acc + g_rows), jnp.mean(loss)
+
+        (state, acc), losses = jax.lax.scan(body, (state, acc0), stacked)
+        scale = self.loss_scaler.scale_loss(jnp.float32(1.0), state["loss_scale"])
+        g_rows = acc / (gas * scale)
+        overflow = ~jnp.isfinite(jnp.sum(g_rows))
+        # quantizing an inf row would poison every rank's output AND the
+        # EF residuals; the overflow flag above already discards the step
+        g_rows = jnp.where(jnp.isfinite(g_rows), g_rows, 0.0)
+        state = dict(state)
+        if self._comm_grad_strategy == "onebit" and self.state["comm"]:
+            werr = state["comm"]["worker_error"]
+            serr = state["comm"]["server_error"]
+            g_mean, new_res = self.comm.exchange_rows(
+                g_rows, axes, "onebit", residuals=(werr, serr)
+            )
+            state["comm"] = {
+                "worker_error": jnp.where(overflow, werr, new_res[0]),
+                "server_error": jnp.where(overflow, serr, new_res[1]),
+            }
+        else:
+            # int8 stochastic rounding (or EF-less onebit) needs fresh
+            # bits each step; fold the step counter so replays differ
+            rng = jax.random.fold_in(state["rng"], state["global_step"] + 777_001)
+            g_mean, _ = self.comm.exchange_rows(
+                g_rows, axes, self._comm_grad_strategy, rng=rng
+            )
+        grads = unpack_flat(g_mean, state["params"])  # params are fp32 masters
+        grads = self.comm.constrain_grads(
+            grads,
+            jax.tree.map(self._sh, self._grad_specs, is_leaf=lambda x: isinstance(x, P)),
+            site="grad-specs",
+        )
+        state, info = self._apply_update_unscaled(state, grads, overflow)
+        return state, jnp.mean(losses), info
 
     # ------------------------------------------------------------------
     # public training API
@@ -1235,6 +1443,12 @@ class DeepSpeedEngine:
             raise RuntimeError(
                 "the 1-bit compressed phase runs whole batches (its gradient "
                 "accumulator lives inside the compiled step); use train_batch()"
+            )
+        if self._comm_explicit:
+            raise RuntimeError(
+                f"comm.strategy '{self._comm_grad_strategy}' runs whole batches "
+                "(the per-rank gradient rows live inside the compiled step); "
+                "use train_batch()"
             )
         if self._lazy_grad_acc and not self.state["grad_acc"]:
             # the micro API needs the accumulator train_batch's gas==1
@@ -1461,6 +1675,8 @@ class DeepSpeedEngine:
         apply_in_graph = not self._offload
         if self._onebit_frozen:
             return self._frozen_full_step
+        if self._comm_explicit:
+            return self._comm_full_step
         if apply_in_graph and self._use_grad_acc and not self.state["grad_acc"]:
             # gas==1 fused path (no persistent accumulator was
             # allocated): grads flow straight into the update

@@ -165,11 +165,22 @@ class OnebitAdam:
     # compressed-exchange (frozen) phase — used by the engine's frozen
     # train executable (reference onebit/adam.py:110-220 + nccl.py:47)
     # ------------------------------------------------------------------
+    def frozen_specs(self, row_spec) -> FrozenOnebitAdamState:
+        """PartitionSpecs for the frozen-state layout (the engine maps
+        these to NamedShardings): error-feedback rows sharded over the
+        exchange grid, everything else replicated."""
+        from jax.sharding import PartitionSpec as P
+
+        return FrozenOnebitAdamState(
+            step=P(), m_signs=P(), m_scales=P(), v_flat=P(),
+            worker_error=row_spec, server_error=row_spec,
+        )
+
     def make_frozen_state(self, state: OnebitAdamState, n_ranks: int) -> FrozenOnebitAdamState:
         """One-time warmup→frozen layout conversion at the freeze step.
         ``n_ranks``: number of exchange rows — the full data-parallel
         world (data × fsdp when ZeRO-composed)."""
-        from deepspeed_tpu.comm.compressed import compress_chunks, decompress_chunks
+        from deepspeed_tpu.comm.collectives import compress_chunks, decompress_chunks
 
         m_flat = pack_flat(state.exp_avg, n_ranks)
         v_flat = pack_flat(state.exp_avg_sq, n_ranks)
@@ -207,7 +218,7 @@ class OnebitAdam:
         stored/loaded in its compressed exchange form (see
         :class:`FrozenOnebitAdamState`); it is decompressed transiently
         here (fp32 HBM only for the step's lifetime)."""
-        from deepspeed_tpu.comm.compressed import (
+        from deepspeed_tpu.comm.collectives import (
             compressed_allreduce_compressed_out,
             decompress_chunks,
         )
@@ -265,7 +276,7 @@ class OnebitAdam:
         the engine keeps the replicated layout and warns about the HBM
         floor at init (runtime/engine.py).
         """
-        from deepspeed_tpu.comm.compressed import (
+        from deepspeed_tpu.comm.collectives import (
             compressed_allreduce_compressed_out,
             decompress_chunks,
         )

@@ -6,6 +6,19 @@ phase, then frozen variance + compressed momentum exchange, with the
 trust ratio computed from *frozen-phase* statistics — the reference
 tracks per-layer ``scaling_coeff`` from the warmup so the compressed
 phase keeps LAMB's layerwise adaptivity without communicating norms.
+
+Two tiers, mirroring ``onebit/adam.py``:
+
+* ``update()`` — single-program fallback: momentum quantized locally
+  with error feedback, full-precision allreduce (used when the engine
+  cannot run the explicit exchange).
+* ``make_frozen_state()`` / ``frozen_apply()`` — the engine's
+  compressed-exchange phase: per-rank gradients stay unreduced, only
+  1-bit momentum crosses the wire through the comm layer
+  (``comm/collectives.py``), and the trust ratio is the warmup-frozen
+  per-param ``scaling_coeff`` expanded to a flat coordinate vector —
+  LAMB's layerwise adaptivity with zero extra norm traffic.  This is
+  the large-batch rung (bert-s512) the 1-bit LAMB paper targets.
 """
 from __future__ import annotations
 
@@ -13,6 +26,7 @@ from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from deepspeed_tpu.ops.adam.fused_adam import _map_multi
 
@@ -23,6 +37,21 @@ class OnebitLambState(NamedTuple):
     exp_avg_sq: Any
     worker_error: Any
     scaling_coeff: Any  # per-param frozen trust ratio (lamb_coeff)
+
+
+class FrozenOnebitLambState(NamedTuple):
+    """Compressed-exchange phase state (see FrozenOnebitAdamState for
+    the layout rationale).  ``coeff_flat`` carries the warmup-frozen
+    per-param trust ratios expanded per coordinate (padding coords get
+    1.0; they are masked by ``v_flat > 0`` anyway)."""
+
+    step: jnp.ndarray
+    m_signs: jnp.ndarray  # (Mp,) int8 replicated — synced momentum signs
+    m_scales: jnp.ndarray  # (n,) fp32 replicated — per-chunk scales
+    v_flat: jnp.ndarray  # (Mp,) replicated — frozen variance
+    coeff_flat: jnp.ndarray  # (Mp,) replicated — frozen trust ratios
+    worker_error: jnp.ndarray  # (n, Mp) sharded over the exchange grid
+    server_error: jnp.ndarray  # (n, Mp // n) sharded over the exchange grid
 
 
 class OnebitLamb:
@@ -103,3 +132,91 @@ class OnebitLamb:
             one, 5, grads, state.exp_avg, state.exp_avg_sq, state.worker_error, state.scaling_coeff, params
         )
         return updates, OnebitLambState(step=step, exp_avg=m, exp_avg_sq=v, worker_error=werr, scaling_coeff=coeff)
+
+    # ------------------------------------------------------------------
+    # compressed-exchange (frozen) phase — engine frozen train executable
+    # (reference onebit/lamb.py compressed path + comm/nccl.py exchange)
+    # ------------------------------------------------------------------
+    def frozen_specs(self, row_spec) -> FrozenOnebitLambState:
+        """PartitionSpecs for the frozen-state layout (the engine maps
+        these to NamedShardings)."""
+        from jax.sharding import PartitionSpec as P
+
+        return FrozenOnebitLambState(
+            step=P(), m_signs=P(), m_scales=P(), v_flat=P(), coeff_flat=P(),
+            worker_error=row_spec, server_error=row_spec,
+        )
+
+    def make_frozen_state(self, state: OnebitLambState, n_ranks: int) -> FrozenOnebitLambState:
+        """Warmup→frozen layout conversion at the freeze step: momentum
+        stored in its compressed exchange form with the representation
+        error folded into every worker-error row (scaled by β1 — see
+        OnebitAdam.make_frozen_state), variance flat-packed, and the
+        per-param EMA trust ratios expanded to one fp32 coordinate
+        vector so the frozen update needs no per-layer bookkeeping."""
+        from deepspeed_tpu.comm.collectives import compress_chunks, decompress_chunks
+        from deepspeed_tpu.runtime.fp16.onebit.adam import pack_flat
+
+        m_flat = pack_flat(state.exp_avg, n_ranks)
+        v_flat = pack_flat(state.exp_avg_sq, n_ranks)
+        mp = m_flat.shape[0]
+        leaves = jax.tree.leaves(state.exp_avg)
+        coeffs = jax.tree.leaves(state.scaling_coeff)  # same treedef as exp_avg
+        parts = [
+            jnp.broadcast_to(c.astype(jnp.float32), (int(np.prod(np.shape(l))) or 1,))
+            for c, l in zip(coeffs, leaves)
+        ]
+        coeff_flat = jnp.concatenate(parts)
+        coeff_flat = jnp.pad(
+            coeff_flat, (0, mp - coeff_flat.shape[0]), constant_values=1.0
+        )
+        m_signs, m_scales = compress_chunks(m_flat, n_ranks)
+        delta = self.b1 * (m_flat - decompress_chunks(m_signs, m_scales))
+        return FrozenOnebitLambState(
+            step=state.step,
+            m_signs=m_signs,
+            m_scales=m_scales,
+            v_flat=v_flat,
+            coeff_flat=coeff_flat,
+            worker_error=jnp.broadcast_to(delta[None, :], (n_ranks, mp)),
+            server_error=jnp.zeros((n_ranks, mp // n_ranks), jnp.float32),
+        )
+
+    def frozen_apply(
+        self,
+        g_rows: jnp.ndarray,  # (n, Mp) per-rank UNREDUCED averaged grads
+        fstate: FrozenOnebitLambState,
+        p_flat: jnp.ndarray,  # (Mp,) fp32 packed params
+        lr,
+        mesh,
+        axis_name="data",
+    ):
+        """One compressed-momentum LAMB step: local gradient folds into
+        the synced momentum, the momenta exchange 1-bit with error
+        feedback (comm layer), and the update direction is scaled by the
+        frozen per-coordinate trust ratio — no norm collectives."""
+        from deepspeed_tpu.comm.collectives import (
+            compressed_allreduce_compressed_out,
+            decompress_chunks,
+        )
+
+        step = fstate.step + 1
+        m_flat = decompress_chunks(fstate.m_signs, fstate.m_scales)
+        m_rows = self.b1 * m_flat[None, :] + (1.0 - self.b1) * g_rows
+        m_signs, m_scales, werr, serr = compressed_allreduce_compressed_out(
+            m_rows, fstate.worker_error, fstate.server_error, mesh, axis_name
+        )
+        m_synced = decompress_chunks(m_signs, m_scales)
+        c2 = 1.0 - self.b2 ** jnp.float32(self.freeze_step)
+        denom = jnp.sqrt(fstate.v_flat / c2) + self.eps
+        # v == 0 ⇒ never-gradded coordinate (incl. pack padding): mask
+        # the sign noise (the OnebitAdam momentum-mask rationale)
+        update_dir = (m_synced * (fstate.v_flat > 0)) / denom
+        if self.weight_decay > 0.0:
+            update_dir = update_dir + self.weight_decay * p_flat
+        upd = -lr * fstate.coeff_flat * update_dir
+        new_state = FrozenOnebitLambState(
+            step=step, m_signs=m_signs, m_scales=m_scales, v_flat=fstate.v_flat,
+            coeff_flat=fstate.coeff_flat, worker_error=werr, server_error=serr,
+        )
+        return upd, new_state

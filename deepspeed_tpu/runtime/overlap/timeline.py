@@ -47,6 +47,18 @@ class StepTimeline:
         self.total_steps = 0
         self._pending: Dict[str, float] = {}
         self._last_boundary: Optional[float] = None
+        # comm metadata (docs/comm.md): the active gradient-exchange
+        # strategy and its modeled bytes/step — static per engine, set
+        # once by the comm layer, carried into every summary/record
+        self.comm_strategy: Optional[str] = None
+        self.comm_bytes: Optional[int] = None
+
+    def set_comm(self, strategy: str, bytes_per_step: int) -> None:
+        """Record the engine's active comm strategy + per-step
+        grad-exchange bytes model (not gated on ``enabled`` — metadata,
+        not a timed phase)."""
+        self.comm_strategy = str(strategy)
+        self.comm_bytes = int(bytes_per_step)
 
     # -- recording --------------------------------------------------------
     def note(self, phase: str, seconds: float) -> None:
@@ -110,6 +122,9 @@ class StepTimeline:
         out["wall_ms"] = 0.0
         out["steps"] = len(recs)
         out["steps_per_s"] = 0.0
+        if self.comm_strategy is not None:
+            out["comm_strategy"] = self.comm_strategy
+            out["comm_bytes_per_step"] = self.comm_bytes
         if not recs:
             return out
         n = len(recs)
@@ -131,7 +146,13 @@ class StepTimeline:
             for p in PHASES
             if s[f"{p}_ms"] > 0.0 or p in ("data_wait", "compute")
         ]
+        comm = ""
+        if s.get("comm_strategy"):
+            comm = (
+                f" | comm: {s['comm_strategy']}"
+                f" ({s.get('comm_bytes_per_step', 0) / 1e6:.1f} MB/step grad exchange)"
+            )
         return (
             f"step timeline over {s['steps']} step(s): wall {s['wall_ms']:.1f}ms "
-            f"({s['steps_per_s']:.2f} steps/s) | " + " | ".join(parts)
+            f"({s['steps_per_s']:.2f} steps/s) | " + " | ".join(parts) + comm
         )

@@ -54,6 +54,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from deepspeed_tpu.comm import collectives
 from deepspeed_tpu.config.config import DeepSpeedConfig
 from deepspeed_tpu.runtime.engine import DeepSpeedEngine
 from deepspeed_tpu.runtime.pipe.module import PipelineModule
@@ -233,12 +234,14 @@ class PipelineEngine(DeepSpeedEngine):
                 out = jax.lax.dynamic_update_index_in_dim(
                     out, jnp.where(is_done, y, cur), out_idx, 0
                 )
-                recv = jax.lax.ppermute(y, "pipe", [(i, (i + 1) % S) for i in range(S)])
+                recv = collectives.p2p_shift(y, "pipe", S, 1)
                 return (recv, out), None
 
             (recv, out), _ = jax.lax.scan(tick, (recv0, out0), jnp.arange(T))
-            # only the last stage holds real outputs; psum = broadcast
-            out = jax.lax.psum(jnp.where(stage == S - 1, out, jnp.zeros_like(out)), "pipe")
+            # only the last stage holds real outputs; all_reduce = broadcast
+            out = collectives.all_reduce(
+                jnp.where(stage == S - 1, out, jnp.zeros_like(out)), "pipe"
+            )
             return out
 
         in_specs = (
@@ -248,14 +251,12 @@ class PipelineEngine(DeepSpeedEngine):
         )
         if rng is None:
             fn = lambda bp, x: pipelined(bp, x, None)
-            return jax.shard_map(
-                fn, mesh=self.mesh, in_specs=in_specs[:2], out_specs=P(),
-                axis_names={"pipe"}, check_vma=False,
+            return collectives.shard_map_manual(
+                fn, self.mesh, in_specs[:2], P(), manual_axes=("pipe",)
             )(block_params, x_mb)
-        return jax.shard_map(
+        return collectives.shard_map_manual(
             lambda bp, x, r: pipelined(bp, x, r),
-            mesh=self.mesh, in_specs=in_specs, out_specs=P(),
-            axis_names={"pipe"}, check_vma=False,
+            self.mesh, in_specs, P(), manual_axes=("pipe",),
         )(block_params, x_mb, rng)
 
     # ------------------------------------------------------------------
@@ -382,8 +383,8 @@ class PipelineEngine(DeepSpeedEngine):
                 dpre = jax.tree.map(lambda a, d: a + d.astype(jnp.float32), dpre, dpre_d)
 
                 # ---- rotate --------------------------------------------
-                recv_f = jax.lax.ppermute(y, "pipe", [(i, (i + 1) % S) for i in range(S)])
-                recv_b = jax.lax.ppermute(dx, "pipe", [(i, (i - 1) % S) for i in range(S)])
+                recv_f = collectives.p2p_shift(y, "pipe", S, 1)
+                recv_b = collectives.p2p_shift(dx, "pipe", S, -1)
                 return (ring, recv_f, recv_b, dblocks, dpre, dpost, loss_sum), None
 
             carry0 = (
@@ -399,9 +400,9 @@ class PipelineEngine(DeepSpeedEngine):
                 tick, carry0, jnp.arange(T)
             )
             # only one stage contributed to each of these: psum = select+broadcast
-            loss_sum = jax.lax.psum(loss_sum, "pipe")
-            dpre = jax.lax.psum(dpre, "pipe")
-            dpost = jax.lax.psum(dpost, "pipe")
+            loss_sum = collectives.all_reduce(loss_sum, "pipe")
+            dpre = collectives.all_reduce(dpre, "pipe")
+            dpost = collectives.all_reduce(dpost, "pipe")
             return loss_sum / M, dblocks, dpre, dpost
 
         in_specs = [
@@ -424,9 +425,8 @@ class PipelineEngine(DeepSpeedEngine):
             fn = pipelined
         else:
             fn = lambda b_, i_, l_, pr_, po_: pipelined(b_, i_, l_, pr_, po_, None)
-        loss, dblocks, dpre, dpost = jax.shard_map(
-            fn, mesh=self.mesh, in_specs=tuple(in_specs), out_specs=out_specs,
-            axis_names={"pipe"}, check_vma=False,
+        loss, dblocks, dpre, dpost = collectives.shard_map_manual(
+            fn, self.mesh, tuple(in_specs), out_specs, manual_axes=("pipe",)
         )(*args)
 
         grads = {
@@ -477,7 +477,7 @@ class PipelineEngine(DeepSpeedEngine):
                     (scaled_loss, loss), grads = jax.value_and_grad(
                         lambda p: self._compute_loss(p, b, rng, state["loss_scale"]), has_aux=True
                     )(state["params"])
-                grads = jax.lax.with_sharding_constraint(
+                grads = self.comm.constrain_grads(
                     grads, jax.tree.map(self._sh, self._grad_specs, is_leaf=lambda s: isinstance(s, P))
                 )
                 grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)

@@ -151,6 +151,34 @@ class ZeroInfinityEngine:
             )
         self.compute_dtype = jnp.bfloat16 if config.bf16.enabled else jnp.float32
 
+        # -- comm layer (docs/comm.md): the streaming engine's exchanges
+        # are GSPMD reduce-scatters (group_bwd out_shardings) and the
+        # host-side flag/partial allgathers; quantized strategies do not
+        # apply to the host-resident optimizer path, so everything here
+        # is recorded dense
+        from deepspeed_tpu.comm.strategy import STRATEGY_DENSE, CommLayer
+        from deepspeed_tpu.config.config import CommConfig
+
+        self.comm = CommLayer(
+            mesh, self.mesh_info, getattr(config, "comm", None) or CommConfig(),
+            zero_config=config.zero_config,
+        )
+        self.comm.note(
+            "group-grad-reduce", STRATEGY_DENSE,
+            "GSPMD reduce-scatter over fsdp (+ psum over data) from group_bwd out_shardings",
+        )
+        self.comm.note(
+            "offload-host-sync", STRATEGY_DENSE,
+            "host process_allgather for grad-norm partials and checkpoint flags",
+        )
+        if getattr(config, "comm", None) is not None and config.comm.strategy not in ("dense", "auto"):
+            from deepspeed_tpu.utils.logging import logger as _logger
+
+            _logger.warning(
+                f"comm.strategy '{config.comm.strategy}' is not supported by the "
+                "streaming ZeRO-Infinity engine (host-resident optimizer); staying dense"
+            )
+
         zc = config.zero_config
         # layers per HBM-resident group: offload_param.buffer_count, or
         # the largest divisor of n_layer below it (so any model depth
@@ -426,12 +454,10 @@ class ZeroInfinityEngine:
                 sl = [slice(None)] * g.ndim
                 sl[d] = slice((olo - plo) * per, (ohi - plo) * per)
                 sq += float(np.sum(np.square(g[tuple(sl)], dtype=np.float64)))
-        from jax.experimental import multihost_utils
+        from deepspeed_tpu.comm.collectives import host_allgather
 
         vec = np.asarray(
-            multihost_utils.process_allgather(
-                np.asarray([sq, 1.0 if overflow else 0.0], np.float32)
-            )
+            host_allgather(np.asarray([sq, 1.0 if overflow else 0.0], np.float32))
         ).reshape(jax.process_count(), 2)
         norm = float(np.sqrt(vec[:, 0].sum()))
         overflow = bool(vec[:, 1].max() > 0)
@@ -800,10 +826,10 @@ class ZeroInfinityEngine:
         # as a raised error on ALL ranks instead of a deadlock.
         def _sync_ok(ok: bool, what: str, cause=None) -> None:
             if jax.process_count() > 1:
-                from jax.experimental import multihost_utils
+                from deepspeed_tpu.comm.collectives import host_allgather
 
                 flags = np.asarray(
-                    multihost_utils.process_allgather(np.float32(0.0 if ok else 1.0))
+                    host_allgather(np.float32(0.0 if ok else 1.0))
                 ).reshape(-1)
                 if flags.max() > 0:
                     raise RuntimeError(

@@ -145,10 +145,15 @@ class ZeroShardingRules:
 
     # -- grads -------------------------------------------------------------
     def grad_spec(self, path, shape) -> P:
+        # zero_optimization.reduce_scatter = false (reference stage2.py's
+        # allreduce fallback): grads stay replicated over fsdp, so GSPMD
+        # emits a full all-reduce instead of a psum_scatter — ~2x the
+        # wire and a params-sized grad buffer per chip; the engine warns
+        # once and the comm layer records the forced-dense decision
         if path in self.flat_paths:
-            return P("fsdp") if self.stage >= 2 else P()
+            return P("fsdp") if self.stage >= 2 and self.config.reduce_scatter else P()
         base = self.tp_spec_fn(path, shape)
-        if self.stage >= 2 and self.fsdp_size > 1:
+        if self.stage >= 2 and self.fsdp_size > 1 and self.config.reduce_scatter:
             # stage 3 grads are sharded the same way as the param so the
             # reduce-scatter lands at the owner (partition_parameters.py:934)
             min_size = self.config.param_persistence_threshold if self.stage >= 3 else 0
@@ -246,6 +251,7 @@ def zero_step_comm_model(
     gas: int = 1,
     param_bytes: int = 2,
     grad_bytes: int = 4,
+    reduce_scatter: bool = True,
 ) -> dict:
     """First-order per-train-step collective-byte model for a ZeRO step
     over the ``fsdp`` axis (the reference's perf-critical allgather tail,
@@ -256,12 +262,15 @@ def zero_step_comm_model(
     counts its (sharded) result bytes once.  Stage 3 gathers the bf16
     params once in forward and once in the (remat) backward per micro
     batch; grads reduce-scatter once per micro batch at stage >= 2,
-    all-reduce (2x) at stage <= 1.  Validated against compiled-HLO byte
-    counts in tests/test_zero_comm.py.
+    all-reduce (2x) at stage <= 1 — or always, when the
+    ``zero_optimization.reduce_scatter`` flag forces the dense
+    all-reduce fallback.  Validated against compiled-HLO byte counts in
+    tests/test_zero_comm.py; the strategy-dependent grad-exchange
+    extension lives in comm/strategy.py:step_comm_bytes.
     """
     if fsdp <= 1:
         return {"all-gather": 0, "reduce-scatter": 0, "all-reduce": 0, "total": 0}
     ag = 2 * n_params * param_bytes * gas if stage >= 3 else 0
-    rs = n_params // fsdp * grad_bytes * gas if stage >= 2 else 0
-    ar = 2 * n_params * grad_bytes * gas if stage < 2 else 0
+    rs = n_params // fsdp * grad_bytes * gas if stage >= 2 and reduce_scatter else 0
+    ar = 2 * n_params * grad_bytes * gas if (stage < 2 or not reduce_scatter) else 0
     return {"all-gather": ag, "reduce-scatter": rs, "all-reduce": ar, "total": ag + rs + ar}

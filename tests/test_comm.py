@@ -1,0 +1,460 @@
+"""Unified comm layer tests (docs/comm.md): strategy policy, quantized
+allreduce numerics, dense/int8/onebit convergence parity on the
+8-device dryrun, wire-byte reductions pinned against compiled HLO,
+compile stability (one executable per strategy, ds_san clean),
+error-feedback residual checkpoint round-trips (normal tags AND the
+exit-43/44 emergency paths), the reduce_scatter config flag, and the
+1-bit LAMB frozen-exchange phase."""
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.comm.collectives import quantized_allreduce_replicated
+from deepspeed_tpu.comm.mesh import make_mesh
+from deepspeed_tpu.config.config import CommConfig, DeepSpeedConfigError, MeshConfig
+from deepspeed_tpu.comm.strategy import select_strategy, step_comm_bytes
+from deepspeed_tpu.utils.hlo import collective_bytes
+from tests.simple_model import base_config, random_batches, simple_model_init, simple_model_loss
+
+HIDDEN = 64
+
+
+# ---------------------------------------------------------------------------
+# primitives
+# ---------------------------------------------------------------------------
+
+
+def test_quantized_allreduce_close_to_mean():
+    mesh = make_mesh(MeshConfig(data=8))
+    n, m = 8, 4096
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((n, m)).astype(np.float32)
+    out = np.asarray(
+        quantized_allreduce_replicated(jnp.asarray(x), mesh, "data", key=jax.random.PRNGKey(0))
+    )
+    true_mean = x.mean(axis=0)
+    # int8 per-chunk quantization: elementwise error bounded by ~2 LSBs
+    # of the per-chunk scale at each phase
+    lsb = np.abs(x).max() / 127.0
+    assert np.max(np.abs(out - true_mean)) < 4 * lsb
+    assert np.corrcoef(out, true_mean)[0, 1] > 0.999
+
+
+def test_quantized_allreduce_stochastic_rounding_is_unbiased():
+    """Averaging many stochastic-rounded exchanges of the SAME input
+    converges on the true mean far below the single-shot error — the
+    unbiasedness that keeps long trainings on the dense trajectory."""
+    mesh = make_mesh(MeshConfig(data=8))
+    n, m = 8, 512
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((n, m)).astype(np.float32))
+    true_mean = np.asarray(x).mean(axis=0)
+    fn = jax.jit(lambda k: quantized_allreduce_replicated(x, mesh, "data", key=k))
+    reps = 64
+    acc = np.zeros(m, np.float64)
+    single_errs = []
+    for i in range(reps):
+        out = np.asarray(fn(jax.random.PRNGKey(i)))
+        acc += out
+        single_errs.append(np.abs(out - true_mean).mean())
+    avg_err = np.abs(acc / reps - true_mean).mean()
+    assert avg_err < 0.25 * np.mean(single_errs), (avg_err, np.mean(single_errs))
+
+
+def test_quantized_allreduce_composed_axes():
+    """Tuple axes (the ZeRO-composed dp grid) give the same mean."""
+    mesh = make_mesh(MeshConfig(data=4, fsdp=2))
+    n, m = 8, 1024
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((n, m)).astype(np.float32)
+    out = np.asarray(
+        quantized_allreduce_replicated(
+            jnp.asarray(x), mesh, ("data", "fsdp"), key=jax.random.PRNGKey(0)
+        )
+    )
+    lsb = np.abs(x).max() / 127.0
+    assert np.max(np.abs(out - x.mean(axis=0))) < 4 * lsb
+
+
+# ---------------------------------------------------------------------------
+# policy + bytes model
+# ---------------------------------------------------------------------------
+
+
+def test_select_strategy_policy_table():
+    cfg = CommConfig(strategy="auto", threshold_bytes=65536)
+    assert select_strategy(cfg, 4 << 20, np.float32, 8).strategy == "int8"
+    assert select_strategy(cfg, 1024, np.float32, 8).strategy == "dense"  # sub-threshold
+    assert select_strategy(cfg, 4 << 20, np.int32, 8).strategy == "dense"  # not a float
+    assert select_strategy(cfg, 4 << 20, np.float32, 1).strategy == "dense"  # one rank
+    assert select_strategy(CommConfig(strategy="onebit", threshold_bytes=0), 4 << 20, np.float32, 8).strategy == "onebit"
+    assert select_strategy(CommConfig(strategy="dense"), 4 << 20, np.float32, 8).strategy == "dense"
+
+
+def test_comm_config_validation():
+    with pytest.raises(DeepSpeedConfigError):
+        CommConfig.from_dict({"strategy": "fp4"})
+    with pytest.raises(DeepSpeedConfigError):
+        CommConfig.from_dict({"quantize_bits": 4})
+    with pytest.raises(DeepSpeedConfigError):
+        CommConfig.from_dict({"thresold_bytes": 1})  # unknown key (typo)
+    c = CommConfig.from_dict({"strategy": "INT8", "threshold_bytes": 0})
+    assert c.strategy == "int8"
+
+
+def test_step_comm_bytes_model_ratios():
+    n_params = 1_000_000
+    sizes = {"data": 8, "fsdp": 1}
+    dense = step_comm_bytes(n_params, sizes, stage=0, gas=4, strategy="dense")
+    int8 = step_comm_bytes(n_params, sizes, stage=0, gas=4, strategy="int8")
+    # dense: 2*4 B/param per micro; int8: 2 B/param once per step
+    assert dense["grad-exchange"] == 2 * 4 * n_params * 4
+    assert int8["grad-exchange"] == 2 * n_params + 8 * 8
+    assert dense["grad-exchange"] >= 4 * int8["grad-exchange"]
+    # reduce_scatter=false converts the fsdp rs term into a 2x allreduce
+    rs_on = step_comm_bytes(n_params, {"data": 1, "fsdp": 8}, stage=2, strategy="dense")
+    rs_off = step_comm_bytes(
+        n_params, {"data": 1, "fsdp": 8}, stage=2, strategy="dense", reduce_scatter=False
+    )
+    assert rs_off["all-reduce"] > 0 and rs_on["all-reduce"] == 0
+    assert rs_off["total"] > rs_on["total"]
+    # explicit strategies replace GSPMD grad reduction entirely: the
+    # base model's rs/ar grad terms must not double-count
+    exp = step_comm_bytes(n_params, {"data": 2, "fsdp": 4}, stage=2, gas=2, strategy="int8")
+    assert exp["reduce-scatter"] == 0 and exp["all-reduce"] == 0
+    assert exp["total"] == exp["all-gather"] + exp["grad-exchange"]
+
+
+# ---------------------------------------------------------------------------
+# engine integration: parity / bytes / compile stability
+# ---------------------------------------------------------------------------
+
+
+def _comm_engine(strategy, gas=2, steps=0, seed_batch=None, **extra):
+    cfg = base_config(stage=0, mesh={"data": 8}, gas=gas, **extra)
+    cfg["comm"] = {"strategy": strategy, "threshold_bytes": 0}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_model_loss, model_parameters=simple_model_init(HIDDEN), config=cfg
+    )
+    losses = []
+    if steps:
+        bs = engine.train_micro_batch_size_per_gpu * gas * engine.mesh_info.dp_world_size
+        batch = seed_batch or random_batches(1, bs, HIDDEN)[0]
+        losses = [float(engine.train_batch(batch)) for _ in range(steps)]
+    return engine, losses
+
+
+def _tb_text(engine):
+    key = next(k for k in engine._compiled if isinstance(k, tuple) and k[0] == "train_batch")
+    return engine._compiled[key].as_text()
+
+
+def test_strategy_convergence_parity_on_dryrun():
+    """ISSUE-6 acceptance: N steps under each strategy track the dense
+    loss trajectory within tolerance (int8 tightly — stochastic
+    rounding is unbiased; onebit more loosely — sign compression with
+    EF converges but wobbles early)."""
+    _, dense = _comm_engine("dense", steps=10)
+    _, int8 = _comm_engine("int8", steps=10)
+    _, onebit = _comm_engine("onebit", steps=10)
+    assert all(np.isfinite(l) for l in dense + int8 + onebit)
+    assert int8[-1] < int8[0] and onebit[-1] < onebit[0]
+    int8_dev = np.mean([abs(a - b) / abs(b) for a, b in zip(int8, dense)])
+    onebit_dev = np.mean([abs(a - b) / abs(b) for a, b in zip(onebit, dense)])
+    assert int8_dev < 0.02, (int8_dev, int8, dense)
+    assert onebit_dev < 0.30, (onebit_dev, onebit, dense)
+
+
+def test_compressed_strategies_cut_grad_exchange_bytes_4x():
+    """ISSUE-6 acceptance: >= 4x grad-exchange-bytes reduction vs dense.
+    Dense reduces per micro batch INSIDE the accumulation scan (HLO text
+    shows it once; runtime pays it gas times); the explicit strategies
+    exchange once per step — so runtime bytes = text x gas for dense,
+    text x 1 for int8/onebit."""
+    gas = 2
+    eng_d, _ = _comm_engine("dense", gas=gas, steps=1)
+    eng_i, _ = _comm_engine("int8", gas=gas, steps=1)
+    eng_o, _ = _comm_engine("onebit", gas=gas, steps=1)
+    dense = collective_bytes(_tb_text(eng_d)) * gas
+    int8 = collective_bytes(_tb_text(eng_i))
+    onebit = collective_bytes(_tb_text(eng_o))
+    assert dense > 0 and int8 > 0 and onebit > 0
+    assert dense >= 4 * int8, (dense, int8)
+    assert dense >= 4 * onebit, (dense, onebit)
+    # and the analytic model agrees with the HLO measurement within 10%
+    model_bytes = eng_i.comm_summary()["grad_exchange_bytes"]
+    assert abs(model_bytes - int8) / int8 < 0.1, (model_bytes, int8)
+
+
+@pytest.mark.parametrize("strategy", ["int8", "onebit"])
+def test_one_executable_per_strategy_and_ds_san_clean(strategy):
+    """ISSUE-6 acceptance: zero new recompiles — exactly one executable
+    across N same-shape steps, proven under an armed ds_san run."""
+    try:
+        engine, losses = _comm_engine(strategy, steps=5, sanitizer={"enabled": True})
+        assert engine.compilation_count == 1
+        tb_keys = [k for k in engine._compiled if isinstance(k, tuple) and k[0] == "train_batch"]
+        assert len(tb_keys) == 1
+        assert engine._sanitizer is not None
+        assert engine._sanitizer.findings == [], [f.format() for f in engine._sanitizer.findings]
+        assert losses[-1] < losses[0]
+    finally:
+        # the config-armed sanitizer installs process-globally; don't
+        # let its recompile notes bleed into later tests' engines
+        from deepspeed_tpu.analysis.sanitizer import core as _san_core
+
+        _san_core.uninstall()
+
+
+def test_explicit_strategy_rejects_micro_api():
+    engine, _ = _comm_engine("int8")
+    bs = engine.train_micro_batch_size_per_gpu * engine.mesh_info.dp_world_size
+    with pytest.raises(RuntimeError, match="train_batch"):
+        engine.forward(random_batches(1, bs, HIDDEN)[0])
+
+
+def test_train_batches_runs_explicit_strategy():
+    """The multi-step scanned driver composes with the explicit
+    exchange (residuals thread through the step scan)."""
+    engine, _ = _comm_engine("onebit")
+    bs = engine.train_micro_batch_size_per_gpu * 2 * engine.mesh_info.dp_world_size
+    losses = engine.train_batches(random_batches(4, bs, HIDDEN))
+    assert losses.shape == (4,) and np.isfinite(losses).all()
+    assert float(jnp.abs(engine.state["comm"]["worker_error"]).mean()) > 0
+
+
+def test_small_grads_fall_back_dense_below_threshold():
+    """The policy's dense floor: this tiny model's grads sit under the
+    default 64 KiB threshold, so even an explicit int8 request stays
+    dense (recorded in the decision table)."""
+    cfg = base_config(stage=0, mesh={"data": 8})
+    cfg["comm"] = {"strategy": "int8"}  # default threshold_bytes
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_model_loss, model_parameters=simple_model_init(HIDDEN), config=cfg
+    )
+    assert engine._comm_grad_strategy == "dense" and not engine._comm_explicit
+    strat, reason = engine.comm.table()["grad-exchange"]
+    assert strat == "dense" and "threshold" in reason
+
+
+def test_timeline_and_summary_carry_comm_fields():
+    engine, _ = _comm_engine("int8", steps=2)
+    s = engine.timeline.summary()
+    assert s["comm_strategy"] == "int8"
+    assert s["comm_bytes_per_step"] == engine.comm_summary()["grad_exchange_bytes"]
+    assert "grad-exchange" in engine.comm_summary()["table"]
+    assert "int8" in engine.timeline.format_summary()
+
+
+# ---------------------------------------------------------------------------
+# reduce_scatter config flag
+# ---------------------------------------------------------------------------
+
+
+def test_reduce_scatter_flag_forces_dense_allreduce_path():
+    cfg = base_config(stage=2, mesh={"data": 1, "fsdp": 8})
+    cfg["zero_optimization"]["reduce_scatter"] = False
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_model_loss, model_parameters=simple_model_init(HIDDEN), config=cfg
+    )
+    # grads stay replicated over fsdp (no "fsdp" in any grad spec)
+    from jax.sharding import PartitionSpec as P
+
+    def axes_of(spec):
+        out = set()
+        for entry in spec:
+            if entry is None:
+                continue
+            out.update(entry if isinstance(entry, tuple) else (entry,))
+        return out
+
+    specs = jax.tree.leaves(
+        jax.tree.map(lambda s: s, engine._grad_specs, is_leaf=lambda x: isinstance(x, P))
+    )
+    assert all("fsdp" not in axes_of(s) for s in specs), specs
+    assert engine.comm.table()["zero-grad-reduce"][0] == "dense"
+    # and the default (reduce_scatter on) shards the grads
+    engine_on, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_model_loss, model_parameters=simple_model_init(HIDDEN),
+        config=base_config(stage=2, mesh={"data": 1, "fsdp": 8}),
+    )
+    specs_on = jax.tree.leaves(
+        jax.tree.map(lambda s: s, engine_on._grad_specs, is_leaf=lambda x: isinstance(x, P))
+    )
+    assert any("fsdp" in axes_of(s) for s in specs_on), specs_on
+
+
+# ---------------------------------------------------------------------------
+# EF residual checkpoint round-trips (normal + emergency tags)
+# ---------------------------------------------------------------------------
+
+
+def test_onebit_residuals_roundtrip_through_checkpoint(tmp_path):
+    ck = str(tmp_path / "ck")
+    bs = 8 * 2 * 8
+    batch = random_batches(1, bs, HIDDEN)[0]
+    engine, _ = _comm_engine("onebit", steps=4, seed_batch=batch)
+    werr_before = np.asarray(engine.state["comm"]["worker_error"])
+    assert np.abs(werr_before).mean() > 0  # EF is live
+    engine.save_checkpoint(ck)
+    ref = [float(engine.train_batch(batch)) for _ in range(2)]
+
+    engine2, _ = _comm_engine("onebit")
+    path, _ = engine2.load_checkpoint(ck)
+    assert path is not None
+    np.testing.assert_array_equal(
+        np.asarray(engine2.state["comm"]["worker_error"]), werr_before
+    )
+    got = [float(engine2.train_batch(batch)) for _ in range(2)]
+    np.testing.assert_allclose(ref, got, rtol=1e-5, atol=1e-6)
+
+
+def test_cross_strategy_restore_resets_residuals(tmp_path):
+    """A dense tag restored into an onebit engine (and vice versa)
+    partial-restores around the residuals and resets them to zero."""
+    ck = str(tmp_path / "ck")
+    dense_engine, _ = _comm_engine("dense", steps=2)
+    dense_engine.save_checkpoint(ck)
+
+    onebit_engine, _ = _comm_engine("onebit", steps=2)
+    assert np.abs(np.asarray(onebit_engine.state["comm"]["worker_error"])).mean() > 0
+    path, _ = onebit_engine.load_checkpoint(ck)
+    assert path is not None
+    assert float(jnp.abs(onebit_engine.state["comm"]["worker_error"]).sum()) == 0.0
+    # and it keeps training
+    bs = 8 * 2 * 8
+    assert np.isfinite(float(onebit_engine.train_batch(random_batches(1, bs, HIDDEN)[0])))
+
+    # reverse direction: onebit tag into a dense engine
+    ck2 = str(tmp_path / "ck2")
+    onebit_engine.save_checkpoint(ck2)
+    dense2, _ = _comm_engine("dense")
+    path, _ = dense2.load_checkpoint(ck2)
+    assert path is not None and dense2.state["comm"] == {}
+
+
+def test_residuals_survive_exit43_emergency_tag(tmp_path):
+    """The preemption watchdog's exit-43 emergency save certifies a tag
+    whose EF residuals restore bit-exact (docs/resilience.md contract,
+    extended to the comm state)."""
+    bs = 8 * 2 * 8
+    batch = random_batches(1, bs, HIDDEN)[0]
+    engine, _ = _comm_engine(
+        "onebit", steps=3, seed_batch=batch,
+        resilience={"watchdog": {"enabled": True, "grace_seconds": 120, "save_dir": str(tmp_path)}},
+    )
+    werr = np.asarray(engine.state["comm"]["worker_error"])
+    try:
+        os.kill(os.getpid(), signal.SIGTERM)
+        with pytest.raises(SystemExit) as e:
+            engine.train_batch(batch)
+        assert e.value.code == 43
+    finally:
+        engine._watchdog.uninstall()
+    engine2, _ = _comm_engine("onebit")
+    path, _ = engine2.load_checkpoint(str(tmp_path))
+    assert path is not None
+    # the emergency save ran at the NEXT step boundary: residuals there
+    # are the post-step-4 ones; just assert they restored non-trivially
+    # and match a fresh read of the saved engine's state
+    np.testing.assert_array_equal(
+        np.asarray(engine2.state["comm"]["worker_error"]),
+        np.asarray(engine.state["comm"]["worker_error"]),
+    )
+    assert np.abs(np.asarray(engine2.state["comm"]["worker_error"])).mean() > 0
+    del werr
+
+
+def test_residuals_survive_local_npz_rescue_tag(tmp_path):
+    """The exit-44 rescue format (rank-local state_local.npz, committed
+    with no collectives) round-trips the comm residuals into a fresh
+    engine — the supervision emergency-tag path."""
+    from deepspeed_tpu.resilience.supervision.rescue import emergency_local_save
+    from deepspeed_tpu.runtime import checkpointing as ck
+
+    bs = 8 * 2 * 8
+    batch = random_batches(1, bs, HIDDEN)[0]
+    engine, _ = _comm_engine("onebit", steps=3, seed_batch=batch)
+    snap = ck._snapshot_state_to_host(engine)
+    meta = ck._build_meta(engine, "emergency_step3", {})
+    emergency_local_save(str(tmp_path), "emergency_step3", snap, meta)
+
+    engine2, _ = _comm_engine("onebit")
+    path, _ = engine2.load_checkpoint(str(tmp_path), tag="emergency_step3")
+    assert path is not None
+    np.testing.assert_array_equal(
+        np.asarray(engine2.state["comm"]["worker_error"]),
+        np.asarray(engine.state["comm"]["worker_error"]),
+    )
+    ref = float(engine.train_batch(batch))
+    got = float(engine2.train_batch(batch))
+    np.testing.assert_allclose(ref, got, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# 1-bit LAMB frozen-exchange phase
+# ---------------------------------------------------------------------------
+
+
+def test_onebit_lamb_enters_frozen_phase_and_trains():
+    from deepspeed_tpu.runtime.fp16.onebit.lamb import FrozenOnebitLambState
+
+    cfg = base_config(stage=0, mesh={"data": 8}, gas=2)
+    cfg["optimizer"] = {"type": "OneBitLamb", "params": {"lr": 1e-2, "freeze_step": 3}}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_model_loss, model_parameters=simple_model_init(HIDDEN), config=cfg
+    )
+    batch = random_batches(1, 8 * 2 * 8, HIDDEN)[0]
+    losses = [float(engine.train_batch(batch)) for _ in range(10)]
+    assert engine._onebit_exchange_ok and engine._onebit_frozen
+    assert isinstance(engine.state["opt_state"], FrozenOnebitLambState)
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    # frozen trust ratios are per-coordinate and live (EMA'd from warmup)
+    coeff = np.asarray(engine.state["opt_state"].coeff_flat)
+    assert coeff.shape == engine.state["opt_state"].m_signs.shape
+    assert np.all(coeff > 0)
+    # the frozen step's wire is compressed: vs a dense-LAMB engine on
+    # the same mesh/gas, collective bytes drop >= 3.8x (the 1-bit point)
+    # and the fp32 grad traffic all but disappears
+    cfg_d = base_config(stage=0, mesh={"data": 8}, gas=2)
+    cfg_d["optimizer"] = {"type": "Lamb", "params": {"lr": 1e-2}}
+    dense_lamb, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_model_loss, model_parameters=simple_model_init(HIDDEN), config=cfg_d
+    )
+    dense_lamb.train_batch(batch)
+    frozen_key = next(k for k in engine._compiled if isinstance(k, tuple) and k[0] == "train_batch" and k[1])
+    frozen_txt = engine._compiled[frozen_key].as_text()
+    dense_txt = _tb_text(dense_lamb)
+    assert collective_bytes(frozen_txt) * 3.8 <= collective_bytes(dense_txt) * 2  # dense pays per micro (gas=2)
+    assert collective_bytes(frozen_txt, "f32") * 20 <= collective_bytes(dense_txt, "f32") * 2
+
+
+def test_onebit_lamb_frozen_checkpoint_roundtrip(tmp_path):
+    ck = str(tmp_path / "ck")
+    cfg = base_config(stage=0, mesh={"data": 8}, gas=2)
+    cfg["optimizer"] = {"type": "OneBitLamb", "params": {"lr": 1e-2, "freeze_step": 2}}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_model_loss, model_parameters=simple_model_init(HIDDEN), config=cfg
+    )
+    batch = random_batches(1, 8 * 2 * 8, HIDDEN)[0]
+    for _ in range(5):
+        engine.train_batch(batch)
+    assert engine._onebit_frozen
+    engine.save_checkpoint(ck)
+    ref = [float(engine.train_batch(batch)) for _ in range(2)]
+
+    engine2, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_model_loss, model_parameters=simple_model_init(HIDDEN),
+        config=base_config(stage=0, mesh={"data": 8}, gas=2) | {
+            "optimizer": {"type": "OneBitLamb", "params": {"lr": 1e-2, "freeze_step": 2}}
+        },
+    )
+    path, _ = engine2.load_checkpoint(ck)
+    assert path is not None and engine2._onebit_frozen
+    got = [float(engine2.train_batch(batch)) for _ in range(2)]
+    np.testing.assert_allclose(ref, got, rtol=1e-5, atol=1e-6)

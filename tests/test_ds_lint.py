@@ -50,6 +50,7 @@ def test_rule_catalog_shape():
         "non-atomic-checkpoint-write",  # PR 2 resilience tier-B rule
         "unfenced-timing",  # PR 3 overlap tier-C rule
         "unguarded-collective-barrier",  # PR 5 supervision tier-B rule
+        "raw-collective-outside-comm-layer",  # PR 6 comm-layer tier-B rule
     ):
         assert rid in rules, rid
 
@@ -1132,6 +1133,60 @@ class TestCli:
         (tmp_path / "broken.py").write_text("def f(:\n")
         assert cli_main([str(tmp_path), "--no-baseline"]) == 1
         assert "parse-error" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# raw-collective-outside-comm-layer (tier B, PR 6 comm subsystem)
+# ---------------------------------------------------------------------------
+
+
+class TestRawCollective:
+    def test_flags_raw_lax_collectives(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import jax
+            from jax import lax
+
+            def exchange(g, dx):
+                g = jax.lax.psum(g, "data")
+                part = lax.psum_scatter(g, "fsdp", scatter_dimension=0, tiled=True)
+                nxt = lax.ppermute(dx, "pipe", [(0, 1), (1, 0)])
+                return part, nxt
+            """,
+            "raw-collective-outside-comm-layer",
+        )
+        assert rule_ids(res) == ["raw-collective-outside-comm-layer"] * 3
+        assert all(f.severity == Severity.B for f in res.findings)
+        assert "comm" in res.findings[0].message
+
+    def test_comm_package_and_wrappers_are_clean(self, tmp_path):
+        # the comm package itself is the sanctioned home; call sites
+        # routed through comm.collectives don't match the rule
+        res = lint_src(
+            tmp_path,
+            """
+            import jax
+
+            def body(x):
+                return jax.lax.psum(x, "data")
+            """,
+            "raw-collective-outside-comm-layer",
+            name="deepspeed_tpu/comm/mymod.py",
+        )
+        assert rule_ids(res) == []
+        res2 = lint_src(
+            tmp_path,
+            """
+            from deepspeed_tpu.comm import collectives
+
+            def exchange(g, dx, S):
+                g = collectives.all_reduce(g, "data")
+                return collectives.p2p_shift(dx, "pipe", S, 1)
+            """,
+            "raw-collective-outside-comm-layer",
+        )
+        assert rule_ids(res2) == []
 
 
 # ---------------------------------------------------------------------------
