@@ -494,6 +494,24 @@ def run_rung(name: str):
         # same sparse step measures ~11.9x (see the record note)
         rec["vs_baseline"] = round(rec["sparse_over_dense"] / 6.3, 3)
         emit(rec)
+    elif name == "serving":
+        # request-level SLO rung (docs/serving.md): seeded Poisson
+        # arrivals against the continuous-batching engine — p50/p99
+        # TTFT, per-token latency and tokens/s at several offered loads,
+        # bf16-KV and int8-KV slot pools.  Grandchild process like
+        # comm-strategies (its own engine builds + HBM lifetime).
+        import subprocess as sp
+
+        cmd = [sys.executable, os.path.join(HERE, "tools", "bench_serving.py")]
+        if not on_tpu:
+            cmd.append("--dryrun")
+        proc = sp.run(cmd, stdout=sp.PIPE, cwd=HERE)
+        recs = _parse_records(proc.stdout.decode(errors="replace"))
+        if proc.returncode != 0 and not recs:
+            emit({"metric": "serving", "skipped": True,
+                  "reason": f"bench_serving child rc={proc.returncode}"})
+        for rec in recs:
+            emit(rec)
     elif name == "comm-strategies":
         # dense vs int8 vs 1-bit grad exchange + 1-bit LAMB, on the 124M
         # and bert-s512 configs (docs/comm.md).  Runs in a grandchild so
@@ -546,6 +564,11 @@ RUNGS = [
     # LAMB on the 124M / bert-s512 pair (docs/comm.md); ~7 engine builds
     # in one grandchild, so it runs last
     ("comm-strategies", 240, 480),
+    # request-level serving SLO sweep (docs/serving.md): one gpt2-xl
+    # int8-weight engine reused across 2 kv dtypes x 3 offered loads in
+    # a grandchild; measured dryrun ~60s, TPU budget dominated by the
+    # engine build + one prefill/decode compile pair per pool
+    ("serving", 240, 480),
 ]
 
 # Plausibility floors for each rung's PRIMARY record on REAL TPU —

@@ -173,6 +173,16 @@ def init_inference(model=None, **kwargs):
     return InferenceEngine(model=model, **kwargs)
 
 
+def init_serving(model=None, serving=None, **kwargs):
+    """TPU-native extension: a continuous-batching ServingEngine over an
+    :func:`init_inference` engine (docs/serving.md).  ``serving`` is the
+    ``serving`` config block (dict or ServingConfig); remaining kwargs go
+    to ``init_inference``."""
+    from deepspeed_tpu.serving import ServingEngine
+
+    return ServingEngine(init_inference(model=model, **kwargs), config=serving)
+
+
 def add_config_arguments(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     """Reference ``add_config_arguments`` (:211): the standard argparse
     group so recipes keep their ``--deepspeed --deepspeed_config x.json``

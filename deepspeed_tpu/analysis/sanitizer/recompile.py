@@ -116,12 +116,14 @@ class RecompileDetector:
                 site=where,
             )
 
-    def wrap(self, fn, site: Optional[str] = None):
+    def wrap(self, fn, site: Optional[str] = None, owner: Any = None):
         """Wrap a jitted callable: every call computes the abstract
         signature of its arguments; signatures not seen before are cache
         misses by construction and are reported through :meth:`note`
         (attributed to the *calling* line, where the drifting shape comes
-        from).  ``.lower``/other jit attributes pass through."""
+        from).  ``owner`` scopes the count like :meth:`note`'s — two
+        wrapped engines sharing a site name each keep their first-compile
+        grace.  ``.lower``/other jit attributes pass through."""
         if not self.enabled:
             return fn
         detector = self
@@ -135,7 +137,7 @@ class RecompileDetector:
                 sig = signature((a, kw))
                 if sig not in self._seen:
                     self._seen.add(sig)
-                    detector.note(label, (a, kw), call_site=caller_site())
+                    detector.note(label, (a, kw), call_site=caller_site(), owner=owner)
                 return fn(*a, **kw)
 
             def __getattr__(self, name):

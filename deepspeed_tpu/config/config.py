@@ -304,6 +304,9 @@ class MeshConfig:
         out = cls(
             data=int(_pop(d, "data", -1)),
             fsdp=int(_pop(d, "fsdp", 1)),
+            # the mesh AXIS named "model" (tensor parallel), unrelated to
+            # serving's kv_cache_dtype="model" sentinel that shares the
+            # spelling  # ds-lint: disable=config-key-drift
             model=int(_pop(d, "model", 1)),
             pipe=int(_pop(d, "pipe", 1)),
             seq=int(_pop(d, "seq", 1)),
@@ -721,6 +724,93 @@ class CommConfig:
 
 
 @dataclass
+class ServingConfig:
+    """``serving`` block (TPU-native extension; docs/serving.md): the
+    continuous-batching slot-pool engine.  ``num_slots`` concurrent
+    sequences share one fixed-shape KV pool; prompts prefill in
+    ``prefill_chunk``-token chunks interleaved with decode steps;
+    ``max_queue`` bounds admission (submit() rejects past it) and
+    ``deadline_seconds`` expires requests that wait too long for a
+    slot."""
+
+    num_slots: int = C.SERVING_NUM_SLOTS_DEFAULT
+    max_len: int = C.SERVING_MAX_LEN_DEFAULT  # 0 = derive from the engine
+    kv_cache_dtype: str = C.SERVING_KV_CACHE_DTYPE_DEFAULT
+    prefill_chunk: int = C.SERVING_PREFILL_CHUNK_DEFAULT
+    prefill_chunks_per_step: int = C.SERVING_PREFILL_CHUNKS_PER_STEP_DEFAULT
+    max_queue: int = C.SERVING_MAX_QUEUE_DEFAULT
+    max_new_tokens: int = C.SERVING_MAX_NEW_TOKENS_DEFAULT
+    deadline_seconds: float = C.SERVING_DEADLINE_SECONDS_DEFAULT
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ServingConfig":
+        if d is None:
+            return cls()
+        d = dict(d)
+        out = cls(
+            num_slots=int(_pop(d, "num_slots", C.SERVING_NUM_SLOTS_DEFAULT)),
+            max_len=int(_pop(d, "max_len", C.SERVING_MAX_LEN_DEFAULT)),
+            kv_cache_dtype=str(
+                _pop(d, "kv_cache_dtype", C.SERVING_KV_CACHE_DTYPE_DEFAULT)
+            ).lower(),
+            prefill_chunk=int(_pop(d, "prefill_chunk", C.SERVING_PREFILL_CHUNK_DEFAULT)),
+            prefill_chunks_per_step=int(
+                _pop(d, "prefill_chunks_per_step", C.SERVING_PREFILL_CHUNKS_PER_STEP_DEFAULT)
+            ),
+            max_queue=int(_pop(d, "max_queue", C.SERVING_MAX_QUEUE_DEFAULT)),
+            max_new_tokens=int(_pop(d, "max_new_tokens", C.SERVING_MAX_NEW_TOKENS_DEFAULT)),
+            deadline_seconds=float(
+                _pop(d, "deadline_seconds", C.SERVING_DEADLINE_SECONDS_DEFAULT)
+            ),
+        )
+        _check_empty(d, C.SERVING, _known_keys(cls))
+        if out.num_slots < 1:
+            raise DeepSpeedConfigError(
+                f"'{C.SERVING}.num_slots' must be >= 1, got {out.num_slots}"
+            )
+        if out.kv_cache_dtype not in C.SERVING_KV_CACHE_DTYPES:
+            raise DeepSpeedConfigError(
+                f"'{C.SERVING}.kv_cache_dtype' must be one of "
+                f"{C.SERVING_KV_CACHE_DTYPES}, got '{out.kv_cache_dtype}'"
+            )
+        if out.prefill_chunk < 1:
+            raise DeepSpeedConfigError(
+                f"'{C.SERVING}.prefill_chunk' must be >= 1, got {out.prefill_chunk}"
+            )
+        if out.prefill_chunks_per_step < 1:
+            raise DeepSpeedConfigError(
+                f"'{C.SERVING}.prefill_chunks_per_step' must be >= 1, "
+                f"got {out.prefill_chunks_per_step}"
+            )
+        if out.max_len < 0:
+            raise DeepSpeedConfigError(
+                f"'{C.SERVING}.max_len' must be >= 0 (0 derives it from the "
+                f"engine's capacity), got {out.max_len}"
+            )
+        if out.max_len and out.max_len % out.prefill_chunk:
+            # chunk writes land via dynamic_update_slice, whose start
+            # clamps near the cache end — a chunk-multiple capacity is
+            # what guarantees the last chunk never clamps (docs/serving.md)
+            raise DeepSpeedConfigError(
+                f"'{C.SERVING}.max_len' ({out.max_len}) must be a multiple of "
+                f"prefill_chunk ({out.prefill_chunk})"
+            )
+        if out.max_queue < 0:
+            raise DeepSpeedConfigError(
+                f"'{C.SERVING}.max_queue' must be >= 0, got {out.max_queue}"
+            )
+        if out.max_new_tokens < 1:
+            raise DeepSpeedConfigError(
+                f"'{C.SERVING}.max_new_tokens' must be >= 1, got {out.max_new_tokens}"
+            )
+        if out.deadline_seconds < 0:
+            raise DeepSpeedConfigError(
+                f"'{C.SERVING}.deadline_seconds' must be >= 0, got {out.deadline_seconds}"
+            )
+        return out
+
+
+@dataclass
 class SanitizerConfig:
     """``sanitizer`` block (ds_san; docs/ds_san.md).  Opt-in runtime
     checkers around the engine step: recompile-storm detection, implicit
@@ -1052,6 +1142,7 @@ _KNOWN_TOP_LEVEL = {
     C.OVERLAP,
     C.SANITIZER,
     C.COMM,
+    C.SERVING,
     "activation_checkpointing",
     "flops_profiler",
     "aio",
@@ -1115,6 +1206,7 @@ class DeepSpeedConfig:
         self.overlap = OverlapConfig.from_dict(d.get(C.OVERLAP))
         self.sanitizer = SanitizerConfig.from_dict(d.get(C.SANITIZER))
         self.comm = CommConfig.from_dict(d.get(C.COMM))
+        self.serving = ServingConfig.from_dict(d.get(C.SERVING))
         self.elasticity_dict = d.get("elasticity")
 
         self.gradient_clipping = float(d.get(C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT))

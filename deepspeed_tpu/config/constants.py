@@ -233,6 +233,20 @@ COMM_ERROR_FEEDBACK_DEFAULT = True  # onebit strategy's residual carry
 COMM_STOCHASTIC_ROUNDING_DEFAULT = True  # int8 strategy's unbiased rounding
 
 #############################################
+# Serving (continuous-batching slot-pool engine; docs/serving.md)
+#############################################
+SERVING = "serving"
+SERVING_NUM_SLOTS_DEFAULT = 8  # concurrent sequences in the slot pool
+SERVING_MAX_LEN_DEFAULT = 0  # 0 = derive from min(max_out_tokens, n_positions)
+SERVING_KV_CACHE_DTYPE_DEFAULT = "model"  # model | int8
+SERVING_KV_CACHE_DTYPES = ["model", "int8"]
+SERVING_PREFILL_CHUNK_DEFAULT = 64  # prompt tokens per prefill chunk
+SERVING_PREFILL_CHUNKS_PER_STEP_DEFAULT = 1  # chunks interleaved per decode step
+SERVING_MAX_QUEUE_DEFAULT = 64  # waiting requests before submit() rejects
+SERVING_MAX_NEW_TOKENS_DEFAULT = 128  # per-request default generation budget
+SERVING_DEADLINE_SECONDS_DEFAULT = 0.0  # 0 = no queue-wait deadline
+
+#############################################
 # Sanitizer (ds_san: trace-time & runtime checkers; docs/ds_san.md)
 #############################################
 SANITIZER = "sanitizer"

@@ -287,6 +287,53 @@ def comm_report(config=None) -> None:
         print(f"{name} " + "." * (30 - len(name)) + f" {value}")
 
 
+def serving_report(config=None) -> None:
+    """Serving-layer summary rows (docs/serving.md).  ``config`` may be
+    a DeepSpeedConfig, a ServingConfig, or None (defaults).  Prints the
+    slot-pool sizing knobs, the KV dtype, the scheduler policy knobs and
+    the per-slot cache-byte formula (model dims are engine-time
+    knowledge, so the formula is shown with the knobs filled in)."""
+    from deepspeed_tpu.config.config import ServingConfig
+
+    s = getattr(config, "serving", config)
+    if s is None or not hasattr(s, "num_slots"):
+        s = ServingConfig()
+    print()
+    print("serving configuration:")
+    max_len = s.max_len if s.max_len else "derived (engine capacity // chunk * chunk)"
+    rows = [
+        ("slot pool", f"{s.num_slots} slots x {max_len} positions"),
+        (
+            "kv cache dtype",
+            "int8 (codes + f32 scales, ~2x less HBM/slot)"
+            if s.kv_cache_dtype == "int8"
+            else "model (engine dtype; int8 if the engine's kv cache is)",
+        ),
+        (
+            "pool bytes/slot",
+            "2 x layers x heads x max_len x head_dim x itemsize"
+            + (" x ~0.53 (int8+scales)" if s.kv_cache_dtype == "int8" else ""),
+        ),
+        (
+            "chunked prefill",
+            f"{s.prefill_chunk} tokens/chunk, "
+            f"{s.prefill_chunks_per_step} chunk(s) interleaved per decode step",
+        ),
+        (
+            "admission",
+            f"max_queue={s.max_queue} (submit() rejects past it), "
+            + (
+                f"queue-wait deadline {s.deadline_seconds:g}s"
+                if s.deadline_seconds
+                else "no queue-wait deadline"
+            ),
+        ),
+        ("default generation budget", f"{s.max_new_tokens} tokens/request"),
+    ]
+    for name, value in rows:
+        print(f"{name} " + "." * (30 - len(name)) + f" {value}")
+
+
 def cli_main() -> int:
     ok = op_report()
     debug_report()
@@ -294,6 +341,7 @@ def cli_main() -> int:
     overlap_report()
     sanitizer_report()
     comm_report()
+    serving_report()
     return 0 if ok else 1
 
 

@@ -88,6 +88,11 @@ class InferenceEngine:
         self.mp_world_size = int(mp_size)
         self.dtype = dtype if dtype is not None else jnp.bfloat16
         self.max_out_tokens = int(max_out_tokens)
+        if self.max_out_tokens < 1:
+            raise ValueError(
+                f"max_out_tokens must be >= 1 (it bounds prompt+generated "
+                f"length and sizes the KV cache), got {self.max_out_tokens}"
+            )
         # "model" -> cache in self.dtype; "int8" -> quantized cache (the
         # cache read rivals the weight read at long contexts; int8
         # halves that roofline term — see ops/transformer/inference)
@@ -181,6 +186,16 @@ class InferenceEngine:
     def module(self):
         """Reference parity: the 'injected model' is (config, params)."""
         return (self.model_config, self.params)
+
+    @property
+    def generation_capacity(self) -> int:
+        """Hard bound on prompt + generated length: ``max_out_tokens``
+        clamped by the model's positional table — the number every
+        length check (generate, init_cache, serving admission) derives
+        from."""
+        if self._is_gpt:
+            return min(self.max_out_tokens, self.model_config.n_positions)
+        return self.max_out_tokens
 
     def _tp_spec(self, path: str, shape) -> P:
         if self.mp_world_size <= 1:
@@ -330,6 +345,13 @@ class InferenceEngine:
                 "model (token_type_ids/attention_mask are BERT-only)"
             )
         input_ids = jnp.asarray(np.asarray(input_ids), jnp.int32)
+        if self._is_gpt and input_ids.shape[1] > self.model_config.n_positions:
+            # past n_positions the position lookup would clamp and return
+            # garbage logits — raise with the derived numbers instead
+            raise ValueError(
+                f"forward() sequence length {input_ids.shape[1]} exceeds the "
+                f"model's n_positions={self.model_config.n_positions}"
+            )
         key = ("fwd", input_ids.shape, tuple(sorted(kw)))
         if key not in self._compiled:
             cfg = self.model_config
@@ -364,26 +386,106 @@ class InferenceEngine:
     __call__ = forward
 
     # ----------------------------------------------------------------------
-    # generation (GPT family)
+    # external-cache prefill/decode surface (the serving/ subsystem and
+    # custom decode loops build on this instead of the closed generate())
     # ----------------------------------------------------------------------
-    def _build_generate(self, B: int, T: int, N: int, do_sample: bool, temperature: float, top_k: int, eos_token_id, masked: bool = False):
-        from deepspeed_tpu.ops.transformer.inference import (
-            DeepSpeedInferenceConfig,
-            forward_with_cache,
-            init_kv_cache,
-        )
+    def inference_config(self, max_len: int):
+        """The fused-block config for a cache of capacity ``max_len``."""
+        from deepspeed_tpu.ops.transformer.inference import DeepSpeedInferenceConfig
 
         cfg = self.model_config
-        icfg = DeepSpeedInferenceConfig(
+        return DeepSpeedInferenceConfig(
             hidden_size=cfg.n_embd,
             heads=cfg.n_head,
             layer_norm_eps=cfg.layer_norm_epsilon,
             mp_size=self.mp_world_size,
             dtype=self.dtype,
-            max_out_tokens=T + N,
+            max_out_tokens=int(max_len),
             use_flash_attention=cfg.use_flash_attention,
             moe_top_k=getattr(cfg, "moe_top_k", 2),
         )
+
+    def init_cache(self, batch: int, max_len: int):
+        """Externally-owned KV cache ``(layers, batch, heads, max_len,
+        head_dim)`` in the engine's cache dtype (bf16/f32 or the int8
+        code+scale pair).  ``max_len`` is validated against
+        :attr:`generation_capacity` so a cache that silently wraps past
+        ``max_out_tokens`` cannot be built."""
+        from deepspeed_tpu.ops.transformer.inference import init_kv_cache
+
+        if not self._is_gpt:
+            raise ValueError("init_cache() requires a causal-LM (GPT-family) model")
+        if max_len > self.generation_capacity:
+            raise ValueError(
+                f"cache max_len={max_len} exceeds the generation capacity "
+                f"min(max_out_tokens={self.max_out_tokens}, "
+                f"n_positions={self.model_config.n_positions}) = "
+                f"{self.generation_capacity}"
+            )
+        cfg = self.model_config
+        return init_kv_cache(cfg.n_layer, int(batch), cfg.n_head, int(max_len), cfg.head_dim, self._kv_dtype)
+
+    def _cache_step_fn(self, T: int, max_len: int, static_prefill: bool, per_slot: bool):
+        """Compiled ``forward_with_cache`` wrapper, cached per (token
+        shape, cache capacity, pos form) — the caller owns the cache."""
+        key = ("cstep", T, max_len, static_prefill, per_slot)
+        if key not in self._compiled:
+            from deepspeed_tpu.ops.transformer.inference import forward_with_cache
+
+            icfg = self.inference_config(max_len)
+
+            if static_prefill:
+                fn = lambda p, t, k, v: forward_with_cache(p, t, k, v, 0, icfg)
+            else:
+                fn = lambda p, t, k, v, pos: forward_with_cache(p, t, k, v, pos, icfg)
+            self._compiled[key] = jax.jit(self._scoped(fn))
+        return self._compiled[key]
+
+    def prefill(self, tokens, k_cache, v_cache):
+        """Initial prefill (write offset 0, causal fast path) into an
+        externally-owned cache.  Returns ``(logits, k_cache, v_cache)``."""
+        tokens = jnp.asarray(np.asarray(tokens), jnp.int32)
+        B, T = tokens.shape
+        S = jax.tree.leaves(k_cache)[0].shape[3]
+        if T > S:
+            raise ValueError(f"prefill length {T} exceeds the cache capacity {S}")
+        fn = self._cache_step_fn(T, S, static_prefill=True, per_slot=False)
+        return fn(self.params, tokens, k_cache, v_cache)
+
+    def decode_step(self, tokens, k_cache, v_cache, pos):
+        """One decode/continuation step at write offset ``pos`` (scalar,
+        or a per-row (B,) vector for slot-pool continuous batching).
+        ``pos`` is traced — every position reuses one executable.
+        Returns ``(logits, k_cache, v_cache)``."""
+        tokens = jnp.asarray(np.asarray(tokens), jnp.int32)
+        B, T = tokens.shape
+        S = jax.tree.leaves(k_cache)[0].shape[3]
+        # pos is concrete host-side here: bound it BEFORE tracing — past
+        # capacity the cache write would clamp and silently overwrite the
+        # last position forever (the wrap the max_out_tokens satellite
+        # exists to forbid)
+        pos_host = np.asarray(pos)
+        if int(pos_host.max()) + T > S:
+            raise ValueError(
+                f"decode_step write offset pos={int(pos_host.max())} + T={T} "
+                f"exceeds the cache capacity {S}; the sequence is out of "
+                f"room (grow the cache via init_cache, or stop generating)"
+            )
+        pos = jnp.asarray(pos, jnp.int32)
+        fn = self._cache_step_fn(T, S, static_prefill=False, per_slot=pos.ndim == 1)
+        return fn(self.params, tokens, k_cache, v_cache, pos)
+
+    # ----------------------------------------------------------------------
+    # generation (GPT family)
+    # ----------------------------------------------------------------------
+    def _build_generate(self, B: int, T: int, N: int, do_sample: bool, temperature: float, top_k: int, eos_token_id, masked: bool = False):
+        from deepspeed_tpu.ops.transformer.inference import (
+            forward_with_cache,
+            init_kv_cache,
+        )
+
+        cfg = self.model_config
+        icfg = self.inference_config(T + N)
         eos = -1 if eos_token_id is None else int(eos_token_id)
 
         def sample_token(logits32, r):
@@ -475,12 +577,14 @@ class InferenceEngine:
             raise ValueError("generate() requires a causal-LM (GPT-family) model")
         input_ids = jnp.asarray(np.asarray(input_ids), jnp.int32)
         B, T = input_ids.shape
-        if T + max_new_tokens > self.model_config.n_positions:
-            raise ValueError(f"T+max_new_tokens={T + max_new_tokens} exceeds n_positions={self.model_config.n_positions}")
-        if T + max_new_tokens > self.max_out_tokens:
+        if T + max_new_tokens > self.generation_capacity:
             raise ValueError(
-                f"T+max_new_tokens={T + max_new_tokens} exceeds the engine's "
-                f"max_out_tokens={self.max_out_tokens} (raise it in init_inference)"
+                f"T+max_new_tokens = {T}+{max_new_tokens} = {T + max_new_tokens} "
+                f"exceeds the generation capacity "
+                f"min(max_out_tokens={self.max_out_tokens}, "
+                f"n_positions={self.model_config.n_positions}) = "
+                f"{self.generation_capacity} (raise max_out_tokens in "
+                f"init_inference, or shorten the prompt)"
             )
         masked = attention_mask is not None
         if masked:

@@ -103,12 +103,31 @@ def _kv_quant(t: jnp.ndarray):
     return q, s
 
 
+def _per_slot(pos) -> bool:
+    """True when ``pos`` is a per-example (B,) write-offset vector — the
+    continuous-batching slot-pool form (serving/) where every batch row
+    is an independent sequence at its own position."""
+    return getattr(pos, "ndim", 0) == 1
+
+
+def slot_cache_write(cache, t, pos):
+    """Per-slot cache write: row ``b`` of ``t`` (B, H, T, d) lands at
+    ``[b, :, pos[b]:pos[b]+T, :]`` of ``cache`` (B, H, S, d).  The write
+    start clamps like ``dynamic_update_slice`` — callers (the serving
+    pool) must keep ``pos[b] + T <= S``."""
+    return jax.vmap(
+        lambda c, x, p: jax.lax.dynamic_update_slice(c, x, (0, p, 0))
+    )(cache, t, pos)
+
+
 def cache_attention(q, k_cache, v_cache, pos, sm_scale: Optional[float] = None, key_padding_mask=None):
     """Attend queries (B,H,T,d) against a static cache (B,H,S,d).
 
     Allowed keys for query i: cache index j <= pos + i (``pos`` = write
     offset of the first query).  Covers both prefill (pos=0 → causal) and
-    decode (T=1, pos=n → full-prefix attention).
+    decode (T=1, pos=n → full-prefix attention).  ``pos`` may also be a
+    per-example (B,) vector — the slot-pool form where each batch row is
+    an independent sequence at its own position (serving/).
     ``key_padding_mask`` (B, S) True=attendable additionally masks
     left-padded prompt slots.  Reference decode softmax:
     ``csrc/transformer/inference/csrc/softmax.cu``.
@@ -135,7 +154,8 @@ def cache_attention(q, k_cache, v_cache, pos, sm_scale: Optional[float] = None, 
     if quant:
         s = s * k_scale
     key_idx = jnp.arange(S)[None, None, None, :]
-    q_idx = pos + jnp.arange(T)[None, None, :, None]
+    pos_off = pos[:, None, None, None] if _per_slot(pos) else pos
+    q_idx = pos_off + jnp.arange(T)[None, None, :, None]
     allowed = key_idx <= q_idx
     if key_padding_mask is not None:
         allowed = allowed & key_padding_mask[:, None, None, :].astype(bool)
@@ -162,7 +182,9 @@ def inference_block(
     Python int) to get the flash/causal fast path over the prompt block;
     any traced or non-zero ``pos`` (single-token decode, chunked
     continuation, speculative multi-token steps) attends against the
-    whole cache with the position mask.  Returns
+    whole cache with the position mask.  A per-example (B,) ``pos``
+    vector selects the slot-pool form: each row reads/writes its own
+    position (continuous batching, serving/).  Returns
     (y, new_k_cache, new_v_cache).  Mirrors the reference's fused
     attention+MLP inference module (``transformer_inference.py``
     DeepSpeedTransformerInference.forward).
@@ -178,10 +200,17 @@ def inference_block(
         return t.reshape(B, T, H, hd).transpose(0, 2, 1, 3)
 
     q, k, v = heads(q), heads(k), heads(v)
-    # in-place cache write at [.., pos:pos+T, ..]
+    # in-place cache write at [.., pos:pos+T, ..] (per-row positions in
+    # the slot-pool form)
+    slotted = _per_slot(pos)
     if isinstance(k_cache, dict):
         def _write(cache, t):
             cq, cs = _kv_quant(t)
+            if slotted:
+                return {
+                    "q": slot_cache_write(cache["q"], cq, pos),
+                    "s": slot_cache_write(cache["s"], cs, pos),
+                }
             return {
                 "q": jax.lax.dynamic_update_slice(cache["q"], cq, (0, 0, pos, 0)),
                 "s": jax.lax.dynamic_update_slice(cache["s"], cs, (0, 0, pos, 0)),
@@ -189,6 +218,9 @@ def inference_block(
 
         k_cache = _write(k_cache, k)
         v_cache = _write(v_cache, v)
+    elif slotted:
+        k_cache = slot_cache_write(k_cache, k.astype(k_cache.dtype), pos)
+        v_cache = slot_cache_write(v_cache, v.astype(v_cache.dtype), pos)
     else:
         k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, 0, pos, 0))
         v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, 0, pos, 0))
@@ -249,13 +281,24 @@ def forward_with_cache(
     cached blocks → final LN → tied-embedding logits.
 
     ``tokens``: (B, T) int32 (T static).  ``pos``: scalar int32 write
-    offset.  ``key_padding_mask`` (B, cache_len) True=attendable masks
+    offset, or a per-example (B,) vector (slot-pool continuous batching:
+    each row is an independent sequence at its own position).
+    ``key_padding_mask`` (B, cache_len) True=attendable masks
     left-padded prompt slots; ``position_ids`` (B, T) overrides the
     default ``pos + arange(T)`` positions (per-example real positions
     under left padding).  Returns (logits (B,T,V), new_k, new_v).
     """
     B, T = tokens.shape
     d = params["wte"].shape[1]
+    if position_ids is None and _per_slot(pos):
+        # per-slot positions: derive per-row ids, clipped so the garbage
+        # rows a fixed-shape serving step carries (idle slots, padded
+        # prefill tails) cannot gather out of range — real rows are kept
+        # in range by admission control
+        position_ids = jnp.clip(
+            pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :],
+            0, params["wpe"].shape[0] - 1,
+        )
     if position_ids is not None:
         pos_emb = jnp.take(params["wpe"], position_ids, axis=0)  # (B, T, d)
     else:
@@ -312,4 +355,5 @@ def _load_transformer_inference():
         "forward_with_cache": forward_with_cache,
         "cache_attention": cache_attention,
         "init_kv_cache": init_kv_cache,
+        "slot_cache_write": slot_cache_write,
     }

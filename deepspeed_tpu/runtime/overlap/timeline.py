@@ -38,14 +38,25 @@ PHASES = ("data_wait", "compute", "ckpt_stall", "compile", "other")
 
 
 class StepTimeline:
-    """Rolling per-step phase attribution over the last ``window`` steps."""
+    """Rolling per-step phase attribution over the last ``window`` steps.
 
-    def __init__(self, enabled: bool = True, window: int = 512):
+    ``phases`` customizes the attributed phase names (the serving engine
+    uses ``prefill/decode/sched``); ``other`` is always present as the
+    unattributed remainder.  :meth:`set_gauge` records per-step levels
+    (e.g. queue depth) that are averaged — not ms-scaled — in
+    :meth:`summary`."""
+
+    def __init__(self, enabled: bool = True, window: int = 512, phases=None):
         self.enabled = bool(enabled)
         self.window = max(1, int(window))
+        self.phases = tuple(phases) if phases is not None else PHASES
+        if "other" not in self.phases:
+            self.phases = self.phases + ("other",)
         self.records: Deque[Dict[str, float]] = deque(maxlen=self.window)
         self.total_steps = 0
         self._pending: Dict[str, float] = {}
+        self._pending_gauges: Dict[str, float] = {}
+        self._gauge_names: set = set()
         self._last_boundary: Optional[float] = None
         # comm metadata (docs/comm.md): the active gradient-exchange
         # strategy and its modeled bytes/step — static per engine, set
@@ -79,6 +90,15 @@ class StepTimeline:
         finally:
             self.note(name, time.perf_counter() - t0)
 
+    def set_gauge(self, name: str, value: float) -> None:
+        """Record a per-step level (queue depth, live slots, ...): kept
+        as-is in the step record and reported as a window mean, not a
+        millisecond phase."""
+        if not self.enabled:
+            return
+        self._pending_gauges[name] = float(value)
+        self._gauge_names.add(name)
+
     def end_step(self, count: int = 1) -> None:
         """Close the pending record against the wall clock.  ``count > 1``
         spreads the window evenly over ``count`` steps (one compiled
@@ -97,13 +117,15 @@ class StepTimeline:
         noted = sum(self._pending.values())
         other = max(0.0, wall - noted)
         count = max(1, int(count))
-        rec = {p: self._pending.get(p, 0.0) / count for p in PHASES if p != "other"}
+        rec = {p: self._pending.get(p, 0.0) / count for p in self.phases if p != "other"}
         rec["other"] = (self._pending.get("other", 0.0) + other) / count
         rec["wall"] = max(wall, noted) / count
+        rec.update(self._pending_gauges)
         for _ in range(count):
             self.records.append(dict(rec))
         self.total_steps += count
         self._pending = {}
+        self._pending_gauges = {}
 
     def reset_window(self) -> None:
         """Drop recorded steps (keep the wall anchor); the next
@@ -118,18 +140,22 @@ class StepTimeline:
         recs: List[Dict[str, float]] = list(self.records)
         if last_n is not None:
             recs = recs[-int(last_n):]
-        out = {f"{p}_ms": 0.0 for p in PHASES}
+        out = {f"{p}_ms": 0.0 for p in self.phases}
         out["wall_ms"] = 0.0
         out["steps"] = len(recs)
         out["steps_per_s"] = 0.0
+        for g in sorted(self._gauge_names):
+            out[g] = 0.0
         if self.comm_strategy is not None:
             out["comm_strategy"] = self.comm_strategy
             out["comm_bytes_per_step"] = self.comm_bytes
         if not recs:
             return out
         n = len(recs)
-        for p in PHASES:
+        for p in self.phases:
             out[f"{p}_ms"] = round(sum(r.get(p, 0.0) for r in recs) * 1000.0 / n, 3)
+        for g in sorted(self._gauge_names):
+            out[g] = round(sum(r.get(g, 0.0) for r in recs) / n, 3)
         wall = sum(r.get("wall", 0.0) for r in recs) / n
         out["wall_ms"] = round(wall * 1000.0, 3)
         out["steps_per_s"] = round(1.0 / wall, 3) if wall > 0 else 0.0
@@ -143,9 +169,10 @@ class StepTimeline:
         wall = max(s["wall_ms"], 1e-9)
         parts = [
             f"{p}: {s[f'{p}_ms']:.1f}ms ({100.0 * s[f'{p}_ms'] / wall:.0f}%)"
-            for p in PHASES
+            for p in self.phases
             if s[f"{p}_ms"] > 0.0 or p in ("data_wait", "compute")
         ]
+        parts += [f"{g}: {s[g]:.1f}" for g in sorted(self._gauge_names)]
         comm = ""
         if s.get("comm_strategy"):
             comm = (

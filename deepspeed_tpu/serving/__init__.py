@@ -1,0 +1,32 @@
+"""serving/ — continuous-batching serving engine (docs/serving.md).
+
+The traffic-shaped rebuild of the reference's inference layer: a
+fixed-shape slot-pool KV cache (``pool.py``), a token-granularity
+admission/retirement scheduler with chunked prefill (``scheduler.py``),
+and a ``submit()/step()/drain()`` engine that serves any churning
+request stream against exactly one compiled decode executable
+(``engine.py``).
+
+    eng = deepspeed_tpu.init_inference(model="gpt2-xl", ...)
+    srv = ServingEngine(eng, num_slots=8, prefill_chunk=128)
+    rid = srv.submit(prompt_tokens, max_new_tokens=64)
+    while srv.step():
+        pass
+    print(srv.result(rid).tokens())
+"""
+from deepspeed_tpu.serving.engine import ServingEngine
+from deepspeed_tpu.serving.pool import SlotKVPool, SlotPoolError
+from deepspeed_tpu.serving.scheduler import (
+    ContinuousScheduler,
+    Request,
+    ServingQueueFull,
+)
+
+__all__ = [
+    "ServingEngine",
+    "SlotKVPool",
+    "SlotPoolError",
+    "ContinuousScheduler",
+    "Request",
+    "ServingQueueFull",
+]

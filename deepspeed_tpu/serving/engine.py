@@ -1,0 +1,328 @@
+"""ServingEngine: continuous-batching decode against one compiled
+executable.
+
+Sits on top of an :class:`~deepspeed_tpu.inference.engine.InferenceEngine`
+(whose params/mesh/dtype it reuses) and replaces the closed
+``generate()`` loop with a request stream:
+
+* ``submit()`` — admission-controlled (queue bound, per-request
+  queue-wait deadlines, capacity validation with the derived numbers);
+* ``step()`` — one scheduler tick: expire/admit, up to
+  ``prefill_chunks_per_step`` prompt chunks, then ONE decode step over
+  the whole slot pool;
+* ``drain()`` — run until every request finishes, return the results.
+
+Exactly **two** executables serve any churning live set: a prefill-chunk
+step (fixed ``(1, prefill_chunk)`` tokens, traced slot + position
+scalars) and a decode step (fixed ``(num_slots, 1)`` tokens, traced
+per-slot position vector).  Admitting, retiring, or chunk-advancing
+sequences only changes *values*, never abstract signatures — proven
+under an armed ds_san run (tests/test_serving.py) rather than asserted.
+Both executables donate the cache pool, so the slot cache is updated
+in place; decoding is greedy (``generate(do_sample=False)`` parity).
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from contextlib import nullcontext
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.config.config import ServingConfig
+from deepspeed_tpu.serving.pool import SlotKVPool
+from deepspeed_tpu.serving.scheduler import (
+    ContinuousScheduler,
+    PrefillJob,
+    Request,
+    ServingQueueFull,
+)
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class ServingEngine:
+    def __init__(self, engine, config: Any = None, **overrides):
+        """``engine``: a built InferenceEngine (GPT family).  ``config``:
+        a :class:`ServingConfig`, a raw ``serving`` config dict, or None;
+        ``overrides`` replace individual fields (``num_slots=2, ...``)."""
+        if config is None:
+            config = ServingConfig()
+        elif isinstance(config, dict):
+            config = ServingConfig.from_dict(config)
+        if overrides:
+            config = dataclasses.replace(config, **overrides)
+        # re-validate unconditionally: a directly-constructed
+        # ServingConfig (or replace()d fields) never went through
+        # from_dict's chunk-multiple / dtype checks
+        config = ServingConfig.from_dict(dataclasses.asdict(config))
+        if not engine._is_gpt:
+            raise ValueError("ServingEngine requires a causal-LM (GPT-family) InferenceEngine")
+        self.engine = engine
+        self.config = config
+        mcfg = engine.model_config
+
+        capacity = engine.generation_capacity
+        if config.max_len:
+            if config.max_len > capacity:
+                raise ValueError(
+                    f"serving.max_len={config.max_len} exceeds the engine's "
+                    f"generation capacity min(max_out_tokens={engine.max_out_tokens}, "
+                    f"n_positions={mcfg.n_positions}) = {capacity}"
+                )
+            max_len = config.max_len
+        else:
+            # derive: the engine capacity floored to a chunk multiple
+            # (chunk-multiple capacity guarantees the last prefill
+            # chunk's write never clamps — docs/serving.md)
+            max_len = (capacity // config.prefill_chunk) * config.prefill_chunk
+            if max_len < 1:
+                raise ValueError(
+                    f"serving.prefill_chunk={config.prefill_chunk} exceeds the "
+                    f"engine's generation capacity {capacity}; lower the chunk "
+                    f"or raise max_out_tokens"
+                )
+        kv_dtype = "int8" if config.kv_cache_dtype == "int8" else engine._kv_dtype
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self._replicated = NamedSharding(engine.mesh, P())
+        self.pool = SlotKVPool(
+            mcfg.n_layer, config.num_slots, mcfg.n_head, max_len, mcfg.head_dim,
+            kv_dtype, sharding=self._replicated,
+        )
+        self.scheduler = ContinuousScheduler(
+            self.pool,
+            prefill_chunk=config.prefill_chunk,
+            prefill_chunks_per_step=config.prefill_chunks_per_step,
+            max_queue=config.max_queue,
+            deadline_seconds=config.deadline_seconds,
+            capacity=min(max_len, capacity),
+        )
+
+        from deepspeed_tpu.runtime.overlap.timeline import StepTimeline
+
+        self.timeline = StepTimeline(enabled=True, phases=("sched", "prefill", "decode"))
+
+        from deepspeed_tpu.analysis.sanitizer import maybe_from_config
+
+        self._sanitizer = maybe_from_config(None)
+        self._prefill_fn = None
+        self._decode_fn = None
+        self.prefill_compiles = 0
+        self.decode_compiles = 0
+        self._step_count = 0
+        log_dist(
+            f"serving engine: {config.num_slots} slots x {max_len} positions "
+            f"(kv={'int8' if kv_dtype == 'int8' else jnp.dtype(kv_dtype).name}, "
+            f"chunk={config.prefill_chunk}, pool {self.pool.cache_bytes() / 1e6:.1f} MB)"
+        )
+
+    # ------------------------------------------------------------------
+    # compiled steps (built once; churn only changes traced values)
+    # ------------------------------------------------------------------
+    def _wrap(self, fn, site: str):
+        """Sanitizer recompile proof: when armed, every call's abstract
+        signature is checked — a second signature at either site is a
+        recorded recompile (the compile-stability tests gate on this).
+        Owner-scoped so several serving engines in one armed process
+        (the bench sweeps builds 8) each keep their first-compile grace."""
+        san = self._sanitizer
+        if san is not None:
+            return san.recompile.wrap(fn, site=site, owner=id(self))
+        return fn
+
+    def _get_prefill(self):
+        if self._prefill_fn is None:
+            from deepspeed_tpu.ops.transformer.inference import forward_with_cache
+
+            icfg = self.engine.inference_config(self.pool.max_len)
+            n_pos = self.engine.model_config.n_positions
+            chunk = self.config.prefill_chunk
+
+            def _take_slot(c, slot):
+                return jax.tree.map(
+                    lambda a: jax.lax.dynamic_slice(
+                        a, (0, slot, 0, 0, 0), (a.shape[0], 1) + a.shape[2:]
+                    ),
+                    c,
+                )
+
+            def _put_slot(c, cs, slot):
+                return jax.tree.map(
+                    lambda a, b: jax.lax.dynamic_update_slice(a, b, (0, slot, 0, 0, 0)),
+                    c, cs,
+                )
+
+            def fn(params, toks, slot, pos, take_idx, k_pool, v_pool):
+                ks, vs = _take_slot(k_pool, slot), _take_slot(v_pool, slot)
+                # explicit clipped position ids: the zero-padded chunk
+                # tail must not clamp the wpe slice and shift real rows
+                position_ids = jnp.clip(
+                    pos + jnp.arange(chunk, dtype=jnp.int32), 0, n_pos - 1
+                )[None, :]
+                logits, ks, vs = forward_with_cache(
+                    params, toks, ks, vs, pos, icfg, position_ids=position_ids
+                )
+                first = jnp.argmax(
+                    logits[0, take_idx].astype(jnp.float32), axis=-1
+                ).astype(jnp.int32)
+                return first, _put_slot(k_pool, ks, slot), _put_slot(v_pool, vs, slot)
+
+            self._prefill_fn = self._wrap(
+                jax.jit(self.engine._scoped(fn), donate_argnums=(5, 6)),
+                "serving.prefill",
+            )
+            self.prefill_compiles += 1
+        return self._prefill_fn
+
+    def _get_decode(self):
+        if self._decode_fn is None:
+            from deepspeed_tpu.ops.transformer.inference import forward_with_cache
+
+            icfg = self.engine.inference_config(self.pool.max_len)
+
+            def fn(params, toks, pos, k_pool, v_pool):
+                # per-slot pos: slot-indexed cache write + position mask
+                # (ops/transformer/inference.py), auto-clipped position ids
+                logits, k_pool, v_pool = forward_with_cache(
+                    params, toks[:, None], k_pool, v_pool, pos, icfg
+                )
+                nxt = jnp.argmax(
+                    logits[:, -1].astype(jnp.float32), axis=-1
+                ).astype(jnp.int32)
+                return nxt, k_pool, v_pool
+
+            self._decode_fn = self._wrap(
+                jax.jit(self.engine._scoped(fn), donate_argnums=(3, 4)),
+                "serving.decode",
+            )
+            self.decode_compiles += 1
+        return self._decode_fn
+
+    # ------------------------------------------------------------------
+    # request API
+    # ------------------------------------------------------------------
+    def submit(
+        self,
+        prompt,
+        max_new_tokens: Optional[int] = None,
+        eos_token_id: Optional[int] = None,
+        deadline_seconds: Optional[float] = None,
+    ) -> int:
+        """Enqueue one request; returns its id.  Raises
+        :class:`ServingQueueFull` when the queue is at its bound and
+        ``ValueError`` when the request cannot ever fit the pool."""
+        req = self.scheduler.submit(
+            prompt,
+            max_new_tokens=(
+                max_new_tokens if max_new_tokens is not None else self.config.max_new_tokens
+            ),
+            eos_token_id=eos_token_id,
+            deadline_seconds=deadline_seconds,
+            now=time.monotonic(),
+            step=self._step_count,
+        )
+        return req.request_id
+
+    def step(self) -> bool:
+        """One serving step: tick the scheduler, land this step's prefill
+        chunks, then one decode step over the pool.  Returns whether any
+        work remains."""
+        tl = self.timeline
+        self._step_count += 1
+        with tl.phase("sched"):
+            plan = self.scheduler.tick(time.monotonic(), self._step_count)
+        with tl.phase("prefill"):
+            for job in plan.prefill_jobs:
+                self._run_prefill(job)
+        with tl.phase("decode"):
+            toks, pos, decoding = self.scheduler.decode_inputs()
+            if decoding:
+                self._run_decode(toks, pos, decoding)
+        tl.set_gauge("queue_depth", self.scheduler.queue_depth)
+        tl.set_gauge("live_slots", self.pool.live_slots)
+        tl.end_step()
+        return self.scheduler.has_work()
+
+    def drain(self, max_steps: Optional[int] = None) -> Dict[int, Request]:
+        """Step until every submitted request finishes (or ``max_steps``
+        elapses); returns and clears the finished-request map."""
+        steps = 0
+        while self.scheduler.has_work():
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return self.scheduler.pop_finished()
+
+    def result(self, request_id: int) -> Optional[Request]:
+        return self.scheduler.request(request_id)
+
+    def pop_results(self) -> Dict[int, Request]:
+        return self.scheduler.pop_finished()
+
+    # ------------------------------------------------------------------
+    def _run_prefill(self, job: PrefillJob) -> None:
+        san = self._sanitizer
+        fn = self._get_prefill()
+        # explicit staging of the host-side chunk + scalars onto the
+        # serving mesh (transfer-guard clean: device_put is sanctioned,
+        # and pre-placing on the mesh means the jit has nothing to move)
+        toks, slot, pos, take = jax.device_put(
+            (job.tokens[None, :], np.int32(job.req.slot), np.int32(job.start),
+             np.int32(job.take_idx)),
+            self._replicated,
+        )
+        guard = san.transfer.guard("serving.prefill") if san is not None else nullcontext()
+        with guard:
+            first, k, v = fn(self.engine.params, toks, slot, pos, take, self.pool.k, self.pool.v)
+        self.pool.swap(k, v)
+        # explicit d2h read doubles as the fence that keeps prefill_ms
+        # honest; the value is the first generated token on final chunks
+        tok = int(jax.device_get(first))
+        self.scheduler.note_prefill(job, tok, now=time.monotonic(), step=self._step_count)
+
+    def _run_decode(self, toks: np.ndarray, pos: np.ndarray, decoding) -> None:
+        san = self._sanitizer
+        fn = self._get_decode()
+        toks_d, pos_d = jax.device_put((toks, pos), self._replicated)
+        guard = san.transfer.guard("serving.decode") if san is not None else nullcontext()
+        with guard:
+            nxt, k, v = fn(self.engine.params, toks_d, pos_d, self.pool.k, self.pool.v)
+        self.pool.swap(k, v)
+        out = np.asarray(jax.device_get(nxt))
+        now = time.monotonic()
+        self.scheduler.note_decode(
+            {r.slot: int(out[r.slot]) for r in decoding}, now, self._step_count
+        )
+
+    # ------------------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        """Counters + per-step phase attribution (prefill_ms/decode_ms/
+        sched_ms, mean queue_depth/live_slots) for logs and bench
+        records."""
+        s = self.scheduler
+        out = {
+            "submitted": s.submitted,
+            "finished": s.finished_count,
+            "rejected": s.rejected,
+            "expired": s.expired,
+            # instantaneous levels; the window MEANS arrive from the
+            # timeline summary below as queue_depth / live_slots
+            "queue_depth_now": s.queue_depth,
+            "live_slots_now": self.pool.live_slots,
+            "serving_steps": self._step_count,
+            "prefill_compiles": self.prefill_compiles,
+            "decode_compiles": self.decode_compiles,
+            "pool_bytes": self.pool.cache_bytes(),
+            "kv_dtype": "int8" if isinstance(self.pool.k, dict) else str(
+                np.dtype(jax.tree.leaves(self.pool.k)[0].dtype)
+            ),
+        }
+        out.update(self.timeline.summary())
+        return out
+
+
+__all__ = ["ServingEngine", "ServingQueueFull", "Request"]

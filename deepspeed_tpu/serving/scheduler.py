@@ -1,0 +1,321 @@
+"""Continuous-batching scheduler: admission, chunked prefill, and
+token-granularity retirement — pure host bookkeeping, no jax.
+
+Requests flow ``QUEUED -> PREFILL -> DECODE -> DONE`` (or ``EXPIRED``
+when the queue-wait deadline passes before a slot frees; ``submit``
+itself rejects with :class:`ServingQueueFull` past the queue bound).
+Every :meth:`tick` produces a :class:`StepPlan` the serving engine
+executes against its two fixed-shape executables:
+
+* up to ``prefill_chunks_per_step`` prompt chunks (FIFO across the
+  slots mid-prefill) — long prompts are *split*, so an in-flight decode
+  is never stalled behind a 384-token prefill;
+* one decode step over the whole slot pool whenever any slot is
+  decoding.
+
+The scheduler also owns the **safe-position invariant** the fixed-shape
+decode step relies on: :meth:`decode_inputs` gives every non-decoding
+slot a write position whose contents are overwritten before they are
+ever attendable (a mid-prefill slot's next chunk start; position 0 for
+free slots, which the next occupant's first chunk overwrites).
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.serving.pool import SlotKVPool
+from deepspeed_tpu.utils.logging import logger
+
+QUEUED = "queued"
+PREFILL = "prefill"
+DECODE = "decode"
+DONE = "done"
+EXPIRED = "expired"
+
+
+class ServingQueueFull(RuntimeError):
+    """Graceful admission rejection: the waiting queue is at its bound.
+    Callers back off / shed load; nothing in flight is affected."""
+
+
+@dataclasses.dataclass
+class Request:
+    """One sequence through the pool.  ``prompt`` is a 1-D int32 array;
+    timings are host wall-clock stamps the SLO bench aggregates."""
+
+    request_id: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    eos_token_id: Optional[int] = None
+    deadline_seconds: Optional[float] = None  # queue-wait bound; None = scheduler default
+
+    status: str = QUEUED
+    slot: Optional[int] = None
+    prefill_pos: int = 0  # prompt tokens written to the cache so far
+    generated: List[int] = dataclasses.field(default_factory=list)
+    finish_reason: Optional[str] = None  # eos | length | expired
+    submit_time: float = 0.0
+    first_token_time: Optional[float] = None
+    finish_time: Optional[float] = None
+    submit_step: int = 0
+    first_token_step: Optional[int] = None
+    finish_step: Optional[int] = None
+
+    @property
+    def prompt_len(self) -> int:
+        return int(self.prompt.shape[0])
+
+    def tokens(self) -> np.ndarray:
+        """prompt + generated (the solo-``generate()``-comparable view)."""
+        return np.concatenate([self.prompt, np.asarray(self.generated, np.int32)])
+
+
+@dataclasses.dataclass
+class PrefillJob:
+    """One prompt chunk: write ``tokens`` (padded to the chunk size) at
+    cache position ``start`` of the request's slot.  ``take_idx`` is the
+    within-chunk index of the last real token — where the first
+    generated token is sampled when ``final``."""
+
+    req: Request
+    start: int
+    tokens: np.ndarray  # (prefill_chunk,) int32, zero-padded past `length`
+    length: int
+    final: bool
+    take_idx: int
+
+
+@dataclasses.dataclass
+class StepPlan:
+    """This tick's prefill chunks.  The decode set is NOT planned here:
+    the engine derives it from :meth:`ContinuousScheduler.decode_inputs`
+    *after* the chunks land, so a request whose final chunk completed
+    this very step decodes this step too."""
+
+    prefill_jobs: List[PrefillJob]
+
+
+class ContinuousScheduler:
+    def __init__(
+        self,
+        pool: SlotKVPool,
+        prefill_chunk: int,
+        prefill_chunks_per_step: int = 1,
+        max_queue: int = 64,
+        deadline_seconds: float = 0.0,
+        capacity: Optional[int] = None,
+    ):
+        self.pool = pool
+        self.prefill_chunk = int(prefill_chunk)
+        self.prefill_chunks_per_step = max(1, int(prefill_chunks_per_step))
+        self.max_queue = int(max_queue)
+        self.deadline_seconds = float(deadline_seconds)
+        # admission bound on prompt+generated length (pool capacity
+        # clamped by the engine's generation capacity)
+        self.capacity = int(capacity) if capacity is not None else pool.max_len
+        self._queue: Deque[Request] = deque()
+        self._active: Dict[int, Request] = {}  # slot -> request
+        self._finished: Dict[int, Request] = {}  # request_id -> request
+        self._ids = itertools.count()
+        self.submitted = 0
+        self.rejected = 0
+        self.expired = 0
+        self.finished_count = 0
+
+    # -- introspection ----------------------------------------------------
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    @property
+    def live(self) -> int:
+        return len(self._active)
+
+    def has_work(self) -> bool:
+        return bool(self._queue or self._active)
+
+    def request(self, request_id: int) -> Optional[Request]:
+        if request_id in self._finished:
+            return self._finished[request_id]
+        for r in self._active.values():
+            if r.request_id == request_id:
+                return r
+        for r in self._queue:
+            if r.request_id == request_id:
+                return r
+        return None
+
+    def pop_finished(self) -> Dict[int, Request]:
+        out, self._finished = self._finished, {}
+        return out
+
+    # -- admission --------------------------------------------------------
+    def submit(
+        self,
+        prompt: np.ndarray,
+        max_new_tokens: int,
+        eos_token_id: Optional[int] = None,
+        deadline_seconds: Optional[float] = None,
+        now: float = 0.0,
+        step: int = 0,
+    ) -> Request:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.shape[0] < 1:
+            raise ValueError("prompt must contain at least one token")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        total = prompt.shape[0] + int(max_new_tokens)
+        if total > self.capacity:
+            raise ValueError(
+                f"prompt_len + max_new_tokens = {prompt.shape[0]}+{max_new_tokens} "
+                f"= {total} exceeds the serving capacity {self.capacity} "
+                f"(pool max_len={self.pool.max_len})"
+            )
+        if len(self._queue) >= self.max_queue:
+            self.rejected += 1
+            raise ServingQueueFull(
+                f"serving queue is full ({len(self._queue)} waiting >= "
+                f"max_queue={self.max_queue}); retry later or raise serving.max_queue"
+            )
+        req = Request(
+            request_id=next(self._ids),
+            prompt=prompt,
+            max_new_tokens=int(max_new_tokens),
+            eos_token_id=eos_token_id,
+            deadline_seconds=deadline_seconds,
+            submit_time=now,
+            submit_step=step,
+        )
+        self._queue.append(req)
+        self.submitted += 1
+        return req
+
+    # -- per-step policy --------------------------------------------------
+    def tick(self, now: float, step: int) -> StepPlan:
+        """Expire over-deadline waiters, admit queued requests into free
+        slots, and pick this step's prefill chunks."""
+        # 1) queue-wait deadlines
+        if self._queue:
+            kept: Deque[Request] = deque()
+            for r in self._queue:
+                deadline = (
+                    r.deadline_seconds
+                    if r.deadline_seconds is not None
+                    else self.deadline_seconds
+                )
+                if deadline and (now - r.submit_time) > deadline:
+                    r.status = EXPIRED
+                    r.finish_reason = "expired"
+                    r.finish_time = now
+                    r.finish_step = step
+                    self._finished[r.request_id] = r
+                    self.expired += 1
+                    logger.warning(
+                        f"serving: request {r.request_id} expired after "
+                        f"{now - r.submit_time:.3f}s in queue (deadline {deadline:g}s)"
+                    )
+                else:
+                    kept.append(r)
+            self._queue = kept
+        # 2) admission: queued -> free slots (FIFO)
+        while self._queue and self.pool.free_slots:
+            r = self._queue.popleft()
+            r.slot = self.pool.alloc(r.request_id)
+            r.status = PREFILL
+            r.prefill_pos = 0
+            self._active[r.slot] = r
+        # 3) prefill chunk budget, FIFO over mid-prefill slots
+        jobs: List[PrefillJob] = []
+        budget = self.prefill_chunks_per_step
+        prefilling = sorted(
+            (r for r in self._active.values() if r.status == PREFILL),
+            key=lambda r: r.request_id,
+        )
+        for r in prefilling:
+            pos = r.prefill_pos
+            while budget > 0 and pos < r.prompt_len:
+                length = min(self.prefill_chunk, r.prompt_len - pos)
+                chunk = np.zeros((self.prefill_chunk,), np.int32)
+                chunk[:length] = r.prompt[pos : pos + length]
+                jobs.append(
+                    PrefillJob(
+                        req=r,
+                        start=pos,
+                        tokens=chunk,
+                        length=length,
+                        final=pos + length >= r.prompt_len,
+                        take_idx=length - 1,
+                    )
+                )
+                pos += length
+                budget -= 1
+            if budget == 0:
+                break
+        return StepPlan(prefill_jobs=jobs)
+
+    def note_prefill(self, job: PrefillJob, first_token: int, now: float, step: int) -> None:
+        """A chunk landed; on the final chunk the sampled first token
+        arrives (the TTFT moment) and the request joins the decode set —
+        or retires immediately when its budget is a single token / the
+        first token is EOS."""
+        r = job.req
+        r.prefill_pos = job.start + job.length
+        if not job.final:
+            return
+        r.status = DECODE
+        r.generated = [int(first_token)]
+        r.first_token_time = now
+        r.first_token_step = step
+        if len(r.generated) >= r.max_new_tokens or (
+            r.eos_token_id is not None and first_token == r.eos_token_id
+        ):
+            self._finish(r, now, step)
+
+    def decode_inputs(self) -> Tuple[np.ndarray, np.ndarray, List[Request]]:
+        """Fixed-shape decode-step inputs over the whole pool.
+
+        Decoding slots feed their latest token at its true position;
+        every other slot gets a *safe* garbage position — one whose
+        write is overwritten before it can ever be attended (the next
+        chunk start for mid-prefill slots, 0 for free slots)."""
+        toks = np.zeros((self.pool.num_slots,), np.int32)
+        pos = np.zeros((self.pool.num_slots,), np.int32)
+        decoding: List[Request] = []
+        for slot, r in self._active.items():
+            if r.status == DECODE:
+                toks[slot] = r.generated[-1]
+                pos[slot] = r.prompt_len + len(r.generated) - 1
+                decoding.append(r)
+            else:  # mid-prefill: next chunk overwrites this position
+                pos[slot] = r.prefill_pos
+        return toks, pos, decoding
+
+    def note_decode(self, tokens_by_slot: Dict[int, int], now: float, step: int) -> None:
+        """Append this step's token per decoding slot; retire at EOS or
+        budget — the slot frees *this* token, not at batch end."""
+        for slot, tok in tokens_by_slot.items():
+            r = self._active[slot]
+            r.generated.append(int(tok))
+            if (r.eos_token_id is not None and tok == r.eos_token_id) or len(
+                r.generated
+            ) >= r.max_new_tokens:
+                self._finish(r, now, step)
+
+    def _finish(self, r: Request, now: float, step: int) -> None:
+        r.status = DONE
+        r.finish_reason = (
+            "eos"
+            if (r.eos_token_id is not None and r.generated and r.generated[-1] == r.eos_token_id)
+            else "length"
+        )
+        r.finish_time = now
+        r.finish_step = step
+        del self._active[r.slot]
+        self.pool.free(r.slot)
+        self._finished[r.request_id] = r
+        self.finished_count += 1

@@ -1,0 +1,366 @@
+"""Continuous-batching serving engine tests (docs/serving.md).
+
+Coverage per ISSUE 7: slot alloc/free/reuse, admit/evict mid-decode with
+per-request output parity vs solo ``generate()`` runs, chunked-prefill
+parity, pool-full/queue-full rejection, the int8-KV slot pool, the
+compile-stability proof (churning live set -> exactly one decode
+executable, ds_san clean), queue-wait deadlines, phase-attribution
+stats, and the ``max_out_tokens`` bounding satellite."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.analysis.sanitizer import core as san_core
+from deepspeed_tpu.analysis.sanitizer.core import Sanitizer
+from deepspeed_tpu.config.config import DeepSpeedConfigError, SanitizerConfig, ServingConfig
+from deepspeed_tpu.models import gpt2
+from deepspeed_tpu.serving import ServingEngine, ServingQueueFull, SlotKVPool, SlotPoolError
+
+TINY = dataclasses.replace(gpt2.GPT2_TINY, remat=False)
+
+
+def _engine(cfg=TINY, seed=7, **kw):
+    """Position-sensitive engine (wpe scaled up) so slot/position
+    bookkeeping bugs change generations instead of hiding."""
+    params = gpt2.init_params(cfg, seed=seed)
+    params["wpe"] = params["wpe"] * 40.0
+    kw.setdefault("max_out_tokens", cfg.n_positions)
+    return deepspeed_tpu.init_inference(model_config=cfg, params=params, dtype=jnp.float32, **kw)
+
+
+def _prompts(n, lo, hi, seed=0, vocab=TINY.vocab_size):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, vocab, rng.integers(lo, hi + 1), dtype=np.int32) for _ in range(n)]
+
+
+def _solo(eng, prompt, max_new):
+    return np.asarray(eng.generate(prompt[None, :], max_new_tokens=max_new))[0]
+
+
+# ---------------------------------------------------------------------------
+# slot pool
+# ---------------------------------------------------------------------------
+
+def test_slot_pool_alloc_free_reuse():
+    pool = SlotKVPool(2, 3, 4, 32, 16, jnp.float32)
+    assert pool.free_slots == 3 and pool.live_slots == 0
+    a, b, c = pool.alloc("ra"), pool.alloc("rb"), pool.alloc("rc")
+    assert sorted((a, b, c)) == [0, 1, 2]
+    assert pool.alloc("rd") is None  # pool full: graceful None
+    assert pool.owner(a) == "ra"
+    pool.free(b)
+    assert pool.free_slots == 1
+    # FIFO reuse: the freed slot comes back
+    assert pool.alloc("re") == b
+    pool.free(a)
+    pool.free(b)
+    pool.free(c)
+    with pytest.raises(SlotPoolError):
+        pool.free(b)  # double free
+
+
+def test_slot_pool_int8_bytes_halved():
+    f32 = SlotKVPool(2, 4, 4, 64, 16, jnp.float32)
+    q = SlotKVPool(2, 4, 4, 64, 16, "int8")
+    assert isinstance(q.k, dict) and q.k["q"].dtype == jnp.int8
+    assert q.cache_bytes() < 0.4 * f32.cache_bytes()
+    assert "int8" in q.shape_math()
+
+
+# ---------------------------------------------------------------------------
+# continuous batching: churn parity vs solo generate()
+# ---------------------------------------------------------------------------
+
+def test_churn_parity_vs_solo_generate():
+    """Requests admitted and retired mid-decode (2 slots, 5 ragged
+    requests incl. multi-chunk prompts) must each reproduce their own
+    solo generate() run token for token."""
+    eng = _engine()
+    srv = ServingEngine(eng, num_slots=2, prefill_chunk=8, max_len=64, max_new_tokens=6)
+    prompts = _prompts(5, 3, 20, seed=1)
+    budgets = [6, 3, 5, 2, 4]
+    rids = [srv.submit(p, max_new_tokens=n) for p, n in zip(prompts[:3], budgets[:3])]
+    srv.step()
+    srv.step()
+    # late arrivals land while earlier requests are mid-decode
+    rids += [srv.submit(p, max_new_tokens=n) for p, n in zip(prompts[3:], budgets[3:])]
+    res = srv.drain(max_steps=200)
+    assert sorted(res) == sorted(rids)
+    for rid, p, n in zip(rids, prompts, budgets):
+        got = res[rid].tokens()
+        np.testing.assert_array_equal(got, _solo(eng, p, n))
+        assert res[rid].finish_reason == "length"
+    # 5 requests over 2 slots: slots were reused
+    assert srv.stats()["finished"] == 5
+    assert srv.pool.free_slots == 2
+
+
+def test_chunked_prefill_parity():
+    """A prompt spanning several chunks (with an unaligned tail) must
+    match solo generate(), and mid-prefill chunks must never stall or
+    corrupt an in-flight decode."""
+    eng = _engine(seed=9)
+    srv = ServingEngine(eng, num_slots=2, prefill_chunk=8, max_len=64, max_new_tokens=4)
+    rng = np.random.default_rng(3)
+    short = rng.integers(1, TINY.vocab_size, 4, dtype=np.int32)
+    long_ = rng.integers(1, TINY.vocab_size, 27, dtype=np.int32)  # 4 chunks, tail=3
+    r_short = srv.submit(short, max_new_tokens=8)
+    srv.step()  # short prefills + starts decoding
+    r_long = srv.submit(long_, max_new_tokens=4)
+    res = srv.drain(max_steps=200)
+    np.testing.assert_array_equal(res[r_short].tokens(), _solo(eng, short, 8))
+    np.testing.assert_array_equal(res[r_long].tokens(), _solo(eng, long_, 4))
+
+
+def test_eos_retires_at_token_granularity():
+    """Declaring a known generated token as EOS must retire the request
+    the step that token appears, freeing its slot for the queue."""
+    eng = _engine()
+    prompt = _prompts(1, 6, 6, seed=5)[0]
+    solo = _solo(eng, prompt, 6)
+    eos = int(solo[prompt.shape[0] + 2])  # third generated token
+    srv = ServingEngine(eng, num_slots=1, prefill_chunk=8, max_len=64)
+    rid = srv.submit(prompt, max_new_tokens=6, eos_token_id=eos)
+    res = srv.drain(max_steps=100)
+    r = res[rid]
+    got = r.tokens()
+    # stops AT the eos token; prefix matches the solo run
+    assert got[-1] == eos
+    np.testing.assert_array_equal(got, solo[: got.shape[0]])
+    assert r.finish_reason == "eos"
+
+
+def test_first_token_eos_and_single_token_budget():
+    eng = _engine()
+    prompt = _prompts(1, 5, 5, seed=6)[0]
+    solo = _solo(eng, prompt, 1)
+    first = int(solo[-1])
+    srv = ServingEngine(eng, num_slots=1, prefill_chunk=8, max_len=64)
+    # budget of one: retires straight out of prefill
+    r1 = srv.submit(prompt, max_new_tokens=1)
+    # first token == eos: same
+    r2 = srv.submit(prompt, max_new_tokens=4, eos_token_id=first)
+    res = srv.drain(max_steps=50)
+    np.testing.assert_array_equal(res[r1].tokens(), solo)
+    np.testing.assert_array_equal(res[r2].tokens(), solo)
+    assert res[r1].finish_reason == "length"
+    assert res[r2].finish_reason == "eos"
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+def test_queue_full_rejection_and_capacity_validation():
+    eng = _engine()
+    srv = ServingEngine(eng, num_slots=1, prefill_chunk=8, max_len=32, max_queue=1)
+    p = _prompts(3, 4, 4, seed=2)
+    srv.submit(p[0], max_new_tokens=4)
+    srv.step()  # p0 takes the slot
+    srv.submit(p[1], max_new_tokens=4)  # waits (1 queued == max_queue)
+    with pytest.raises(ServingQueueFull, match="max_queue=1"):
+        srv.submit(p[2], max_new_tokens=4)
+    assert srv.stats()["rejected"] == 1
+    # requests that can never fit the pool are rejected with the numbers
+    with pytest.raises(ValueError, match=r"31\+4 = 35 exceeds the serving capacity 32"):
+        srv.submit(np.ones(31, np.int32), max_new_tokens=4)
+    srv.drain(max_steps=100)
+
+
+def test_queue_deadline_expires_waiters():
+    eng = _engine()
+    srv = ServingEngine(eng, num_slots=1, prefill_chunk=8, max_len=32)
+    p = _prompts(2, 4, 4, seed=3)
+    r1 = srv.submit(p[0], max_new_tokens=6)
+    srv.step()  # r1 occupies the only slot
+    # deadline 0s from submit: expired at the next tick, never admitted
+    r2 = srv.submit(p[1], max_new_tokens=4, deadline_seconds=1e-9)
+    res = srv.drain(max_steps=100)
+    assert res[r2].status == "expired"
+    assert res[r2].finish_reason == "expired"
+    assert res[r2].generated == []
+    assert res[r1].finish_reason == "length"
+    assert srv.stats()["expired"] == 1
+
+
+def test_serving_config_validation():
+    with pytest.raises(DeepSpeedConfigError, match="multiple of"):
+        ServingConfig.from_dict({"max_len": 100, "prefill_chunk": 64})
+    with pytest.raises(DeepSpeedConfigError, match="num_slots"):
+        ServingConfig.from_dict({"num_slots": 0})
+    with pytest.raises(DeepSpeedConfigError, match="kv_cache_dtype"):
+        ServingConfig.from_dict({"kv_cache_dtype": "fp8"})
+    with pytest.raises(DeepSpeedConfigError, match="Unknown config key"):
+        ServingConfig.from_dict({"num_slot": 4})
+    # serving block parses inside the full config surface
+    from deepspeed_tpu.config.config import DeepSpeedConfig
+
+    c = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1,
+                         "serving": {"num_slots": 4, "prefill_chunk": 16, "max_len": 64}})
+    assert c.serving.num_slots == 4 and c.serving.max_len == 64
+    # pool max_len above the engine capacity is refused with the numbers
+    eng = _engine()
+    with pytest.raises(ValueError, match="generation capacity"):
+        ServingEngine(eng, num_slots=1, prefill_chunk=8, max_len=TINY.n_positions + 8)
+
+
+# ---------------------------------------------------------------------------
+# int8 KV slot pool
+# ---------------------------------------------------------------------------
+
+def test_int8_kv_slot_pool():
+    """kv_cache_dtype='int8' serves through the quantized pool: tokens
+    agree with the f32-pool serve in bulk (cache rounding can flip
+    near-ties), shapes/retirement identical, pool bytes halved."""
+    eng = _engine(seed=11)
+    kw = dict(num_slots=2, prefill_chunk=8, max_len=64)
+    prompts = _prompts(3, 5, 14, seed=4)
+    srv_f = ServingEngine(eng, **kw)
+    srv_q = ServingEngine(eng, kv_cache_dtype="int8", **kw)
+    assert isinstance(srv_q.pool.k, dict)
+    assert srv_q.pool.cache_bytes() < 0.4 * srv_f.pool.cache_bytes()
+    outs = {}
+    for tag, srv in (("f", srv_f), ("q", srv_q)):
+        rids = [srv.submit(p, max_new_tokens=6) for p in prompts]
+        res = srv.drain(max_steps=200)
+        outs[tag] = [res[r].tokens() for r in rids]
+        assert srv.stats()["decode_compiles"] == 1
+    agree = np.mean([
+        (a == b).mean() for a, b in zip(outs["f"], outs["q"])
+    ])
+    assert agree > 0.85, (agree, outs)
+
+
+# ---------------------------------------------------------------------------
+# compile stability under an armed ds_san run
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def san():
+    cfg = SanitizerConfig.from_dict(
+        {"enabled": True, "checkers": ["recompile", "transfer"], "compile_budget": 2}
+    )
+    s = san_core.install(Sanitizer(cfg))
+    try:
+        yield s
+    finally:
+        san_core.uninstall()
+
+
+def test_compile_stability_churn_ds_san_clean(san):
+    """The acceptance proof: a churning live set — admits/retires at
+    token granularity including chunked prefill of a >= 384-token prompt
+    — runs against exactly ONE compiled decode executable (and one
+    prefill executable), with zero sanitizer findings."""
+    cfg = dataclasses.replace(TINY, n_positions=512)
+    eng = _engine(cfg=cfg)
+    srv = ServingEngine(eng, num_slots=2, prefill_chunk=128, max_len=512,
+                        max_new_tokens=4)
+    assert srv._sanitizer is san
+    rng = np.random.default_rng(8)
+    long_prompt = rng.integers(1, cfg.vocab_size, 384, dtype=np.int32)  # 3 chunks
+    shorts = _prompts(4, 3, 40, seed=9, vocab=cfg.vocab_size)
+    rids = [srv.submit(long_prompt, max_new_tokens=4)]
+    rids.append(srv.submit(shorts[0], max_new_tokens=3))
+    srv.step()
+    srv.step()
+    rids += [srv.submit(p, max_new_tokens=3) for p in shorts[1:]]
+    res = srv.drain(max_steps=300)
+    assert sorted(res) == sorted(rids)
+    # exactly one executable per serving site across the whole churn
+    assert srv.decode_compiles == 1
+    assert srv.prefill_compiles == 1
+    counts = san.recompile.compile_counts()
+    assert counts.get("serving.decode") == 1, counts
+    assert counts.get("serving.prefill") == 1, counts
+    # ds_san clean: no recompiles, no implicit transfers
+    assert san.findings == [], [f.format() for f in san.findings]
+    # and the long prompt still decodes correctly under the armed run
+    np.testing.assert_array_equal(res[rids[0]].tokens(), _solo(eng, long_prompt, 4))
+
+
+# ---------------------------------------------------------------------------
+# phase attribution / stats
+# ---------------------------------------------------------------------------
+
+def test_serving_stats_and_phase_attribution():
+    eng = _engine()
+    srv = ServingEngine(eng, num_slots=2, prefill_chunk=8, max_len=64)
+    for p in _prompts(3, 4, 12, seed=12):
+        srv.submit(p, max_new_tokens=4)
+    srv.drain(max_steps=100)
+    s = srv.stats()
+    for key in ("prefill_ms", "decode_ms", "sched_ms", "queue_depth", "live_slots",
+                "steps_per_s", "submitted", "finished", "rejected", "expired",
+                "pool_bytes", "kv_dtype", "decode_compiles"):
+        assert key in s, key
+    assert s["submitted"] == s["finished"] == 3
+    assert s["decode_ms"] > 0.0  # fenced: decode really is attributed
+    assert s["prefill_ms"] > 0.0
+    assert s["live_slots"] > 0.0
+    assert s["kv_dtype"] == "float32"
+
+
+# ---------------------------------------------------------------------------
+# satellite: max_out_tokens actually bounds/validates
+# ---------------------------------------------------------------------------
+
+def test_max_out_tokens_validated_at_init():
+    with pytest.raises(ValueError, match="max_out_tokens must be >= 1"):
+        deepspeed_tpu.init_inference(model_config=TINY, dtype=jnp.float32, max_out_tokens=0)
+
+
+def test_generate_overflow_raises_with_derived_numbers():
+    eng = deepspeed_tpu.init_inference(model_config=TINY, dtype=jnp.float32, max_out_tokens=16)
+    toks = np.ones((1, 10), np.int32)
+    with pytest.raises(ValueError, match=r"10\+8 = 18 exceeds the generation capacity"):
+        eng.generate(toks, max_new_tokens=8)
+    # n_positions is the binding constraint when max_out_tokens is larger
+    eng2 = deepspeed_tpu.init_inference(model_config=TINY, dtype=jnp.float32,
+                                        max_out_tokens=4096)
+    assert eng2.generation_capacity == TINY.n_positions
+    with pytest.raises(ValueError, match=rf"n_positions={TINY.n_positions}"):
+        eng2.generate(np.ones((1, TINY.n_positions), np.int32), max_new_tokens=1)
+
+
+def test_forward_beyond_n_positions_raises():
+    eng = deepspeed_tpu.init_inference(model_config=TINY, dtype=jnp.float32)
+    bad = np.ones((1, TINY.n_positions + 4), np.int32)
+    with pytest.raises(ValueError, match="exceeds the model's n_positions"):
+        eng.forward(bad)
+
+
+# ---------------------------------------------------------------------------
+# external-cache prefill/decode entry points
+# ---------------------------------------------------------------------------
+
+def test_external_cache_entry_points_match_generate():
+    """The engine's externally-owned-cache surface (init_cache/prefill/
+    decode_step) must reproduce generate() greedy token for token."""
+    eng = _engine()
+    prompt = _prompts(1, 6, 6, seed=13)[0]
+    N = 5
+    T = prompt.shape[0]
+    solo = _solo(eng, prompt, N)
+    k, v = eng.init_cache(batch=1, max_len=T + N)
+    logits, k, v = eng.prefill(prompt[None, :], k, v)
+    tok = int(np.asarray(jnp.argmax(logits[0, -1])))
+    got = [tok]
+    for s in range(N - 1):
+        logits, k, v = eng.decode_step(np.asarray([[tok]], np.int32), k, v, T + s)
+        tok = int(np.asarray(jnp.argmax(logits[0, -1])))
+        got.append(tok)
+    np.testing.assert_array_equal(np.asarray(got), solo[T:])
+    # capacity validation carries the derived numbers
+    with pytest.raises(ValueError, match="generation capacity"):
+        eng.init_cache(batch=1, max_len=TINY.n_positions + 1)
+    with pytest.raises(ValueError, match="exceeds the cache capacity"):
+        eng.prefill(np.ones((1, T + N + 1), np.int32), k, v)
+    # decoding past the cache end must raise, not silently clamp the
+    # write to the last position forever
+    with pytest.raises(ValueError, match=rf"pos={T + N} \+ T=1 exceeds"):
+        eng.decode_step(np.asarray([[tok]], np.int32), k, v, T + N)

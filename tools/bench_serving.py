@@ -1,0 +1,241 @@
+"""Request-level serving SLO bench: seeded Poisson arrivals against the
+continuous-batching engine (docs/serving.md).
+
+Drives the `serving` bench rung (bench.py) and runs standalone:
+
+    python tools/bench_serving.py --dryrun        # tiny model, CPU
+    python tools/bench_serving.py                 # gpt2-xl on the chip
+
+Per (kv dtype, offered load) it emits ONE record in the bench schema:
+
+* ``value`` — end-to-end generated tokens/s over the run's makespan;
+* ``ttft_p50_ms / ttft_p99_ms`` — time-to-first-token from the request's
+  *scheduled* arrival (queue wait + chunked prefill included);
+* ``tpot_p50_ms / tpot_p99_ms`` — per-output-token decode latency
+  ((finish - first token) / (generated - 1));
+* ``prefill_ms / decode_ms / sched_ms / queue_depth`` — the serving
+  timeline's per-step phase attribution and mean queue depth.
+
+Arrivals are a seeded Poisson process (exponential inter-arrivals);
+offered loads are fractions of the measured closed-loop service rate, so
+0.5x is comfortably under capacity and 2.0x is a sustained overload that
+exercises queueing (and, with ``--max-queue``, rejection).  All timing
+is host wall-clock around ``step()`` — nothing wall-clock-dependent is
+traced (the compiled steps see only token/position values).
+
+NB p99 over a few dozen requests is a tail *estimate*; the record
+carries ``completed`` so readers can judge the sample size.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# --dryrun must win before jax initializes (same recipe as tests/conftest.py)
+if "--dryrun" in sys.argv:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def log(msg):
+    print(f"[bench_serving] {msg}", file=sys.stderr, flush=True)
+
+
+def emit(rec):
+    print(json.dumps(rec), flush=True)
+
+
+def build_workload(n, prompt_lo, prompt_hi, max_new, seed, vocab):
+    """Seeded request set: ragged prompts + per-request generation
+    budgets (arrival times are drawn per load in run_load)."""
+    rng = np.random.default_rng(seed)
+    return [
+        {
+            "prompt": rng.integers(1, vocab, int(rng.integers(prompt_lo, prompt_hi + 1)),
+                                   dtype=np.int32),
+            "max_new": int(max_new),
+        }
+        for _ in range(n)
+    ]
+
+
+def warm(srv, workload):
+    """Compile both serving executables before the measured window (a
+    fresh ServingEngine's first chunk/decode otherwise charges the jit
+    trace to the first request's latency)."""
+    w = workload[0]
+    srv.submit(w["prompt"], max_new_tokens=min(2, w["max_new"]))
+    srv.drain(max_steps=10_000)
+    srv.timeline.reset_window()
+    return srv
+
+
+def run_closed_loop(make_serving, workload):
+    """Everything submitted at t=0 → drain: the capacity measurement the
+    offered loads are scaled from."""
+    from deepspeed_tpu.serving import ServingQueueFull
+
+    srv = warm(make_serving(), workload)
+    t0 = time.monotonic()
+    for w in workload:
+        while True:
+            try:
+                srv.submit(w["prompt"], max_new_tokens=w["max_new"])
+                break
+            except ServingQueueFull:  # bounded queue: drain a step, retry
+                srv.step()
+    res = srv.drain(max_steps=100_000)
+    dt = time.monotonic() - t0
+    toks = sum(len(r.generated) for r in res.values())
+    return toks / max(dt, 1e-9), len(res) / max(dt, 1e-9), dt
+
+
+def run_load(make_serving, workload, offered_rps, seed):
+    """Open-loop seeded Poisson run at ``offered_rps`` requests/s."""
+    from deepspeed_tpu.serving import ServingQueueFull
+
+    srv = warm(make_serving(), workload)
+    rng = np.random.default_rng(seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / offered_rps, size=len(workload)))
+    t0 = time.monotonic()
+    pending = list(zip(arrivals, workload))
+    ids = {}  # request_id -> scheduled arrival offset
+    finished = {}
+    while pending or srv.scheduler.has_work():
+        now = time.monotonic() - t0
+        while pending and pending[0][0] <= now:
+            arr, w = pending.pop(0)
+            try:
+                rid = srv.submit(w["prompt"], max_new_tokens=w["max_new"])
+                ids[rid] = arr
+            except ServingQueueFull:
+                pass  # shed load under overload; scheduler counts the rejection
+        if srv.scheduler.has_work():
+            srv.step()
+        elif pending:
+            # idle until the next arrival (host sleep, nothing traced)
+            time.sleep(min(0.005, max(0.0, pending[0][0] - now)))
+        finished.update(srv.pop_results())
+    makespan = time.monotonic() - t0
+    ttft, tpot, toks = [], [], 0
+    for rid, arr in ids.items():
+        r = finished.get(rid)
+        if r is None or r.first_token_time is None:
+            continue
+        toks += len(r.generated)
+        ttft.append((r.first_token_time - t0 - arr) * 1e3)
+        if len(r.generated) > 1 and r.finish_time is not None:
+            tpot.append(
+                (r.finish_time - r.first_token_time) * 1e3 / (len(r.generated) - 1)
+            )
+    pct = lambda a, q: round(float(np.percentile(a, q)), 2) if a else None
+    stats = srv.stats()
+    return {
+        "tokens_per_s": round(toks / max(makespan, 1e-9), 1),
+        "ttft_p50_ms": pct(ttft, 50),
+        "ttft_p99_ms": pct(ttft, 99),
+        "tpot_p50_ms": pct(tpot, 50),
+        "tpot_p99_ms": pct(tpot, 99),
+        "completed": len(ttft),
+        "rejected": stats["rejected"],
+        "expired": stats["expired"],
+        "offered_rps": round(offered_rps, 3),
+        "prefill_ms": stats["prefill_ms"],
+        "decode_ms": stats["decode_ms"],
+        "sched_ms": stats["sched_ms"],
+        "queue_depth": stats["queue_depth"],
+        "decode_compiles": stats["decode_compiles"],
+        **({"ds_san": True} if srv._sanitizer is not None else {}),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dryrun", action="store_true", help="tiny model on CPU")
+    ap.add_argument("--model", default=None)
+    ap.add_argument("--loads", default="0.5,1.0,2.0",
+                    help="offered loads as fractions of measured capacity")
+    ap.add_argument("--requests", type=int, default=None)
+    ap.add_argument("--max-new", type=int, default=None)
+    ap.add_argument("--kv", default="both", choices=("both", "model", "int8"))
+    ap.add_argument("--num-slots", type=int, default=None)
+    ap.add_argument("--prefill-chunk", type=int, default=None)
+    ap.add_argument("--max-queue", type=int, default=256)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.serving import ServingEngine
+
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    if args.dryrun or not on_tpu:
+        model, slots, chunk, max_len = "tiny", 4, 16, 128
+        n_req, max_new, lo, hi = 12, 8, 4, 48
+        quantize_bits = 0
+    else:
+        model, slots, chunk, max_len = (args.model or "gpt2-xl"), 8, 128, 512
+        n_req, max_new, lo, hi = 32, 64, 32, 384
+        quantize_bits = 8  # int8 weights: the serving-optimized decode path
+    n_req = args.requests or n_req
+    max_new = args.max_new or max_new
+    slots = args.num_slots or slots
+    chunk = args.prefill_chunk or chunk
+    loads = [float(x) for x in args.loads.split(",") if x]
+
+    t0 = time.monotonic()
+    engine = deepspeed_tpu.init_inference(
+        model=model, quantize_bits=quantize_bits, max_out_tokens=max_len,
+        init_on_device=on_tpu and not args.dryrun,
+    )
+    log(f"engine ready in {time.monotonic()-t0:.1f}s (model={model})")
+    workload = build_workload(
+        n_req, lo, hi, max_new, args.seed, engine.model_config.vocab_size
+    )
+
+    kvs = ("model", "int8") if args.kv == "both" else (args.kv,)
+    for kv in kvs:
+        # dryrun engines are f32 but keep the "bf16" tag so the rung's
+        # metric names stay stable across dev and TPU runs
+        tag = "int8" if kv == "int8" else "bf16"
+
+        def make_serving():
+            return ServingEngine(
+                engine, num_slots=slots, prefill_chunk=chunk, max_len=max_len,
+                kv_cache_dtype=kv, max_queue=args.max_queue, max_new_tokens=max_new,
+            )
+
+        tok_s, req_s, dt = run_closed_loop(make_serving, workload)
+        log(f"[{tag}] closed-loop capacity: {tok_s:,.0f} tok/s, "
+            f"{req_s:.2f} req/s over {dt:.1f}s")
+        for load in loads:
+            rec = run_load(make_serving, workload, max(req_s * load, 1e-3),
+                           seed=args.seed + int(load * 1000))
+            rec = {
+                "metric": f"serving_{model.replace('-', '_')}_{tag}kv_load{load:g}",
+                "value": rec.pop("tokens_per_s"),
+                "unit": "tokens/s",
+                "kv_cache_dtype": tag,
+                "load_fraction": load,
+                "num_slots": slots,
+                "prefill_chunk": chunk,
+                "max_len": max_len,
+                "requests": n_req,
+                **rec,
+            }
+            emit(rec)
+            log(f"[{tag}] load {load:g}x: {rec['value']} tok/s, "
+                f"ttft p50/p99 {rec['ttft_p50_ms']}/{rec['ttft_p99_ms']} ms, "
+                f"tpot p50/p99 {rec['tpot_p50_ms']}/{rec['tpot_p99_ms']} ms, "
+                f"queue {rec['queue_depth']}")
+
+
+if __name__ == "__main__":
+    main()
