@@ -512,6 +512,25 @@ def run_rung(name: str):
                   "reason": f"bench_serving child rc={proc.returncode}"})
         for rec in recs:
             emit(rec)
+    elif name == "sharding":
+        # weight-update-sharding sweep (docs/sharding.md): replicated vs
+        # cross-replica ZeRO-1 (vs the composed data x fsdp grid) —
+        # update-phase FLOPs/bytes per replica from compiled cost
+        # analysis, opt-state bytes, the one params-sized all-gather,
+        # loss parity.  Grandchild like comm-strategies (the CPU case
+        # forces the 8-device dryrun mesh before ITS jax import).
+        import subprocess as sp
+
+        cmd = [sys.executable, os.path.join(HERE, "tools", "bench_sharding.py")]
+        if not on_tpu:
+            cmd.append("--dryrun")
+        proc = sp.run(cmd, stdout=sp.PIPE, cwd=HERE)
+        recs = _parse_records(proc.stdout.decode(errors="replace"))
+        if proc.returncode != 0 and not recs:
+            emit({"metric": "sharding", "skipped": True,
+                  "reason": f"bench_sharding child rc={proc.returncode}"})
+        for rec in recs:
+            emit(rec)
     elif name == "comm-strategies":
         # dense vs int8 vs 1-bit grad exchange + 1-bit LAMB, on the 124M
         # and bert-s512 configs (docs/comm.md).  Runs in a grandchild so
@@ -560,6 +579,10 @@ RUNGS = [
     # 16k sparse-vs-dense TRAINING (two engine builds; dense 16k steps
     # are ~2.2s each, so the measurement itself is ~30s warm)
     ("longctx-train", 240, 480),
+    # weight-update-sharding sweep: replicated vs cross-replica ZeRO-1
+    # update-phase FLOPs/bytes per strategy (docs/sharding.md); 3
+    # engine builds in one grandchild
+    ("sharding", 180, 420),
     # comm-strategy sweep: dense vs int8 vs 1-bit grad exchange + 1-bit
     # LAMB on the 124M / bert-s512 pair (docs/comm.md); ~7 engine builds
     # in one grandchild, so it runs last

@@ -31,6 +31,7 @@ def initialize(
     lr_scheduler: Any = None,
     mesh=None,
     tp_spec_fn=None,
+    partition_rules=None,
     loss_fn: Optional[Callable] = None,
     dist_init_required: Optional[bool] = None,
     collate_fn: Optional[Callable] = None,
@@ -135,6 +136,7 @@ def initialize(
             optimizer=optimizer,
             lr_scheduler=lr_scheduler,
             tp_spec_fn=tp_spec_fn,
+            partition_rules=partition_rules,
         )
     else:
         engine = DeepSpeedEngine(
@@ -145,6 +147,7 @@ def initialize(
             lr_scheduler=lr_scheduler,
             mesh=mesh,
             tp_spec_fn=tp_spec_fn,
+            partition_rules=partition_rules,
             loss_fn=loss_fn,
             dist_init_required=dist_init_required,
         )

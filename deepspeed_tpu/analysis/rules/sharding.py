@@ -1,12 +1,23 @@
-"""Rule: missing-sharding-constraint — unpinned collective outputs.
+"""Sharding rules.
 
-In ``comm/`` and ``runtime/zero/``, a function that issues collectives
+``missing-sharding-constraint`` — unpinned collective outputs.  In
+``comm/`` and ``runtime/zero/``, a function that issues collectives
 (psum / all_gather / ppermute ...) but never mentions a sharding
 construct leaves the result layout to XLA's propagation pass; under
 GSPMD that is exactly where weight-update sharding (arXiv:2004.13336)
 silently degrades to replication.  Tier C: advice, not a gate — inside
 ``shard_map`` bodies the layout is pinned by the enclosing specs, which
-the lexical check can only see when they share a file.
+the lexical check can only see when they share a file.  The
+partition-rule engine's constructors (``dp_rows_spec`` & co.) count as
+markers: resolving through the rule engine IS pinning the layout.
+
+``hand-built-partition-spec`` — the partition-rule engine
+(deepspeed_tpu/sharding/) is the single home of axis-name layout
+decisions; a ``PartitionSpec`` / ``P`` construction naming a mesh axis
+as a string literal anywhere else re-wires the layout by hand, invisible
+to the rule tables, the ZeRO layer, and the sharding-drift checker.
+Tier B.  Empty / all-``None`` specs (replicated) and specs built from
+variables (spec plumbing) are fine.
 """
 from __future__ import annotations
 
@@ -21,6 +32,11 @@ _COLLECTIVES = {
 }
 _SHARDING_MARKERS = {
     "with_sharding_constraint", "NamedSharding", "PartitionSpec", "shard_map",
+    # partition-rule-engine constructors (deepspeed_tpu/sharding/): a
+    # layout resolved through the rule engine is a pinned layout
+    "dp_rows_spec", "batch_pspec", "replicated_pspec", "stacked_batch_pspec",
+    "stacked_micro_batch_pspec", "fsdp_trailing_spec", "batch_sharding",
+    "replicated_sharding", "SpecLayout", "PartitionRules", "match_partition_rules",
 }
 _PATH_SEGMENTS = ("comm/", "zero/")
 
@@ -65,3 +81,58 @@ def check(rule, ctx):
                 "never pins a layout (with_sharding_constraint / NamedSharding / "
                 "shard_map); XLA propagation decides the output sharding",
             )
+
+
+# ---------------------------------------------------------------------------
+# hand-built-partition-spec
+# ---------------------------------------------------------------------------
+
+# the framework mesh axes (sharding/mesh.py MESH_AXES) — a spec literal
+# naming one of these is a layout decision
+_MESH_AXIS_NAMES = {"pipe", "data", "fsdp", "seq", "model", "expert"}
+# the rule engine is the sanctioned home of axis-literal spec construction
+_SPEC_EXEMPT_DIR = "deepspeed_tpu/sharding/"
+
+
+def _is_pspec_ctor(node: ast.Call) -> bool:
+    f = node.func
+    name = getattr(f, "id", None) or getattr(f, "attr", None)
+    return name in ("P", "PartitionSpec")
+
+
+def _literal_axes(node: ast.Call):
+    """Mesh-axis string literals passed (possibly inside tuples) to a
+    PartitionSpec constructor."""
+    found = []
+    for arg in node.args:
+        elts = arg.elts if isinstance(arg, ast.Tuple) else [arg]
+        for e in elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str) and e.value in _MESH_AXIS_NAMES:
+                found.append(e.value)
+    return found
+
+
+@register(
+    "hand-built-partition-spec",
+    Severity.B,
+    "PartitionSpec built from mesh-axis string literals outside "
+    "deepspeed_tpu/sharding/ — resolve layouts through the partition-rule "
+    "engine (sharding.rules / sharding.layout) instead",
+)
+def check_hand_built_spec(rule, ctx):
+    import os
+
+    path = os.path.normpath(ctx.path).replace(os.sep, "/")
+    if _SPEC_EXEMPT_DIR in path:
+        return
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and _is_pspec_ctor(node):
+            axes = _literal_axes(node)
+            if axes:
+                yield make_finding(
+                    rule, ctx, node,
+                    f"hand-built PartitionSpec names mesh axis literal(s) "
+                    f"{sorted(set(axes))} outside the partition-rule engine — "
+                    "every engine must resolve layouts through "
+                    "deepspeed_tpu.sharding (rule tables / SpecLayout helpers)",
+                )

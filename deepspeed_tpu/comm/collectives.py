@@ -202,7 +202,7 @@ def quantized_allreduce_replicated(
     for convergence parity over many steps (nearest rounding carries a
     systematic sub-LSB bias).
     """
-    from jax.sharding import PartitionSpec as P
+    from deepspeed_tpu.sharding.layout import dp_rows_spec, replicated_pspec
 
     n, m = x_rows.shape
     if m % n:
@@ -217,8 +217,8 @@ def quantized_allreduce_replicated(
     mapped = _shard_map()(
         body,
         mesh=mesh,
-        in_specs=(P(axis_name), P()),
-        out_specs=P(),
+        in_specs=(dp_rows_spec(axis_name), replicated_pspec()),
+        out_specs=replicated_pspec(),
         **_sm_flags(),
     )
     return mapped(x_rows, key)
@@ -227,12 +227,13 @@ def quantized_allreduce_replicated(
 def dense_allreduce_replicated(x_rows, mesh, axis_name: AxisName = "data"):
     """Full-precision allreduce-mean over exchange rows — the dense
     rung of the same (n, M)-rows interface, for A/B measurement."""
-    from jax.sharding import PartitionSpec as P
+    from deepspeed_tpu.sharding.layout import dp_rows_spec, replicated_pspec
 
     def body(x):
         return jax.lax.pmean(x[0], axis_name)
 
     mapped = _shard_map()(
-        body, mesh=mesh, in_specs=(P(axis_name),), out_specs=P(), **_sm_flags()
+        body, mesh=mesh, in_specs=(dp_rows_spec(axis_name),), out_specs=replicated_pspec(),
+        **_sm_flags(),
     )
     return mapped(x_rows)

@@ -89,11 +89,15 @@ def _body(x, worker_error, server_error, *, axis_name: str):
 
 
 def _exchange(x_per_rank, worker_error, server_error, mesh, axis_name, replicated_out: bool):
-    from jax.sharding import PartitionSpec as P
+    # per-rank exchange rows resolve through the partition-rule engine's
+    # layout helpers (one row per rank of the exchange grid)
+    from deepspeed_tpu.sharding.layout import dp_rows_spec, replicated_pspec
 
     n, m = x_per_rank.shape
     if m % n:
         raise ValueError(f"tensor length {m} not divisible by axis size {n}")
+
+    rows = dp_rows_spec(axis_name)
 
     def body(x, werr, serr):
         out, new_werr, new_serr = _body(x, werr, serr, axis_name=axis_name)
@@ -102,8 +106,8 @@ def _exchange(x_per_rank, worker_error, server_error, mesh, axis_name, replicate
     mapped = _shard_map()(
         body,
         mesh=mesh,
-        in_specs=(P(axis_name), P(axis_name), P(axis_name)),
-        out_specs=(P() if replicated_out else P(axis_name), P(axis_name), P(axis_name)),
+        in_specs=(rows, rows, rows),
+        out_specs=(replicated_pspec() if replicated_out else rows, rows, rows),
         **_sm_flags(),
     )
     return mapped(x_per_rank, worker_error, server_error)
@@ -146,11 +150,12 @@ def compressed_allreduce_compressed_out(
     already moves exactly these bytes; exposing them lets the caller
     STORE the synced momentum at 1 byte/param (it is exactly
     sign×chunk-scale by construction) and decompress transiently."""
-    from jax.sharding import PartitionSpec as P
+    from deepspeed_tpu.sharding.layout import dp_rows_spec, replicated_pspec
 
     n, m = x_per_rank.shape
     if m % n:
         raise ValueError(f"tensor length {m} not divisible by axis size {n}")
+    rows = dp_rows_spec(axis_name)
 
     def body(x, werr, serr):
         n_ = jax.lax.psum(1, axis_name)
@@ -178,8 +183,8 @@ def compressed_allreduce_compressed_out(
     mapped = _shard_map()(
         body,
         mesh=mesh,
-        in_specs=(P(axis_name), P(axis_name), P(axis_name)),
-        out_specs=(P(), P(), P(axis_name), P(axis_name)),
+        in_specs=(rows, rows, rows),
+        out_specs=(replicated_pspec(), replicated_pspec(), rows, rows),
         **_sm_flags(),
     )
     return mapped(x_per_rank, worker_error, server_error)

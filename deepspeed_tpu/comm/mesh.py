@@ -16,124 +16,38 @@ process group.  Axis names:
 The reference's ZeRO partitions over the *entire* DP group; here the DP
 group is factored into ``data × fsdp`` so ZeRO stage selection is a
 sharding-rule choice, not a different optimizer class.
+
+Mesh construction and the ICI×DCN topology machinery live in
+:mod:`deepspeed_tpu.sharding.mesh` (the partition-rule engine's home);
+this module keeps the historical entry points (``make_mesh``,
+``batch_pspec``) and the cheap :class:`MeshInfo` accessors.
 """
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Dict, Optional, Sequence
 
 import numpy as np
 
-from deepspeed_tpu.config.config import MeshConfig
-from deepspeed_tpu.utils.logging import logger
-
-# Canonical axis order: outermost (slowest-varying, most DCN-tolerant) first.
-# pipe and data tolerate slower links; model/seq need the fastest ICI, so they
-# are innermost (adjacent device ids share a physical link on TPU slices).
-MESH_AXES: Tuple[str, ...] = ("pipe", "data", "fsdp", "seq", "model", "expert")
-
-
-def resolve_mesh_shape(cfg: MeshConfig, n_devices: int) -> Dict[str, int]:
-    """Fill in the -1 ("remaining") axis and validate the product."""
-    sizes = {ax: int(getattr(cfg, ax)) for ax in MESH_AXES}
-    free = [ax for ax, s in sizes.items() if s == -1]
-    if len(free) > 1:
-        raise ValueError(f"At most one mesh axis may be -1, got {free}")
-    fixed = 1
-    for ax, s in sizes.items():
-        if s != -1:
-            if s < 1:
-                raise ValueError(f"mesh axis {ax} must be >=1 or -1, got {s}")
-            fixed *= s
-    if free:
-        rem, mod = divmod(n_devices, fixed)
-        if mod:
-            raise ValueError(f"{n_devices} devices not divisible by fixed axes product {fixed}")
-        sizes[free[0]] = rem
-    total = int(np.prod(list(sizes.values())))
-    if total != n_devices:
-        raise ValueError(f"Mesh {sizes} covers {total} devices but {n_devices} are available")
-    return sizes
+# canonical definitions now live in the sharding package; re-exported
+# here for the historical import paths
+from deepspeed_tpu.sharding.layout import batch_pspec, replicated_pspec  # noqa: F401
+from deepspeed_tpu.sharding.mesh import (  # noqa: F401
+    MESH_AXES,
+    build_mesh,
+    resolve_mesh_shape,
+    split_dcn_ici,
+)
 
 
-def split_dcn_ici(sizes: Dict[str, int], n_processes: int) -> Optional[Tuple[Dict[str, int], Dict[str, int]]]:
-    """Factor each axis into (DCN, ICI) parts for a multi-host mesh: the
-    process count is absorbed by the outermost (most DCN-tolerant) axes
-    first — ``pipe`` and ``data`` ride the slow inter-host links, while
-    ``model``/``seq`` stay inside a host's ICI domain (SURVEY §2.6 /
-    scaling-book mesh recipe).  Returns (dcn_sizes, ici_sizes) or None
-    when the process count cannot be factored into the axis sizes."""
-    import math
-
-    dcn = {ax: 1 for ax in sizes}
-    ici = dict(sizes)
-    left = n_processes
-    for ax in MESH_AXES:  # outermost first
-        if left == 1:
-            break
-        f = math.gcd(left, ici[ax])
-        # absorb the largest factor of `left` that divides this axis
-        while f > 1 and left % f == 0 and ici[ax] % f == 0:
-            dcn[ax] *= f
-            ici[ax] //= f
-            left //= f
-            f = math.gcd(left, ici[ax])
-    return None if left != 1 else (dcn, ici)
-
-
-def make_mesh(cfg: Optional[MeshConfig] = None, devices: Optional[Sequence] = None):
+def make_mesh(cfg=None, devices: Optional[Sequence] = None):
     """Build the framework mesh over the given (default: all) devices.
 
-    Multi-host: devices are arranged with
-    ``mesh_utils.create_hybrid_device_mesh`` so axis neighbors inside a
-    host connect over ICI and only the DCN-tolerant outer axes cross
-    hosts (the reference tunes NCCL hierarchies for the same reason,
-    SURVEY §2.6)."""
-    import jax
-    from jax.sharding import Mesh
-
-    if cfg is None:
-        cfg = MeshConfig()
-    if devices is None:
-        devices = jax.devices()
-    sizes = resolve_mesh_shape(cfg, len(devices))
-    shape = tuple(sizes[ax] for ax in MESH_AXES)
-
-    dev_array = None
-    if jax.process_count() > 1 and len(devices) == jax.device_count():
-        split = split_dcn_ici(sizes, jax.process_count())
-        if split is not None:
-            from jax.experimental import mesh_utils
-
-            dcn, ici = split
-            try:
-                # process_is_granule: our dcn factors come from the
-                # process count, so each process is one granule (the
-                # default groups by slice_index, which only matches when
-                # processes == slices)
-                dev_array = mesh_utils.create_hybrid_device_mesh(
-                    tuple(ici[ax] for ax in MESH_AXES),
-                    tuple(dcn[ax] for ax in MESH_AXES),
-                    devices=devices,
-                    process_is_granule=True,
-                )
-                logger.info(
-                    "hybrid mesh: dcn=" + "×".join(str(dcn[ax]) for ax in MESH_AXES)
-                    + " ici=" + "×".join(str(ici[ax]) for ax in MESH_AXES)
-                )
-            except Exception as e:
-                logger.warning(f"hybrid mesh construction failed ({e}); using flat device order")
-        else:
-            logger.warning(
-                f"process count {jax.process_count()} does not factor into mesh {sizes}; "
-                "using flat device order (cross-host collectives may ride slow links)"
-            )
-    if dev_array is None:
-        dev_array = np.asarray(devices).reshape(shape)
-    mesh = Mesh(dev_array, MESH_AXES)
-    logger.info(
-        "mesh: " + " × ".join(f"{ax}={sizes[ax]}" for ax in MESH_AXES if sizes[ax] > 1 or ax == "data")
-    )
+    Multi-host / multi-slice device sets get the 2-level hybrid ICI×DCN
+    arrangement so only DCN-tolerant outer axes cross slow links (see
+    :func:`deepspeed_tpu.sharding.mesh.build_mesh`, which also returns
+    the topology descriptor)."""
+    mesh, _ = build_mesh(cfg, devices)
     return mesh
 
 
@@ -177,27 +91,3 @@ class MeshInfo:
     @property
     def world_size(self) -> int:
         return int(np.prod(list(self.sizes.values())))
-
-
-# ---------------------------------------------------------------------------
-# Standard sharding specs
-# ---------------------------------------------------------------------------
-
-def batch_pspec(ndim: int = 2, seq_dim: Optional[int] = 1, seq_sharded: bool = False):
-    """PartitionSpec for a batch input: dim 0 sharded over (data, fsdp)
-    — fsdp ranks see distinct micro-slices (the fsdp axis is part of the
-    DP group, matching ZeRO's partitioning over the whole DP world) — and
-    optionally the sequence dim over ``seq`` for context parallelism."""
-    from jax.sharding import PartitionSpec as P
-
-    spec = [None] * ndim
-    spec[0] = ("data", "fsdp")
-    if seq_sharded and seq_dim is not None and ndim > seq_dim:
-        spec[seq_dim] = "seq"
-    return P(*spec)
-
-
-def replicated_pspec():
-    from jax.sharding import PartitionSpec as P
-
-    return P()

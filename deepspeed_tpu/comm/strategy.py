@@ -52,38 +52,58 @@ class Decision:
     reason: str
 
 
-def select_strategy(cfg, nbytes: int, dtype, n_ranks: int) -> Decision:
+def select_strategy(cfg, nbytes: int, dtype, n_ranks: int, link: str = "ici") -> Decision:
     """Pure policy: strategy for one exchange of ``nbytes`` bytes of
-    ``dtype`` across ``n_ranks`` ranks, under a ``CommConfig``.
+    ``dtype`` across ``n_ranks`` ranks riding ``link`` (``"ici"``,
+    ``"dcn"``, or ``"ici+dcn"`` from the mesh topology descriptor),
+    under a ``CommConfig``.
 
     The dense floor applies to every strategy request: quantization of
     integer/bool payloads is meaningless, a single-rank axis moves no
     bytes, and sub-threshold tensors are latency- (not bandwidth-)
     bound, where the quantize/dequantize round trip only adds steps.
-    """
+    DCN-crossing exchanges hit the bandwidth wall ~25x sooner (per-link
+    GB/s gap), so their dense floor is ``comm.dcn_threshold_bytes`` and
+    ``auto`` compresses them aggressively (EQuARX motivation: topology
+    is a first-class input to comm decisions)."""
     import jax.numpy as jnp
 
+    crosses_dcn = link != "ici"
+    threshold = cfg.dcn_threshold_bytes if crosses_dcn else cfg.threshold_bytes
     if n_ranks <= 1:
         return Decision(STRATEGY_DENSE, "axis size 1 — nothing crosses the wire")
     if not jnp.issubdtype(jnp.dtype(dtype), jnp.floating):
         return Decision(STRATEGY_DENSE, f"dtype {jnp.dtype(dtype).name} is not a float — quantized exchange undefined")
-    if nbytes < cfg.threshold_bytes:
+    if nbytes < threshold:
+        knob = "comm.dcn_threshold_bytes" if crosses_dcn else "comm.threshold_bytes"
         return Decision(
             STRATEGY_DENSE,
-            f"{nbytes} B < comm.threshold_bytes ({cfg.threshold_bytes}) — latency-bound, dense wins",
+            f"{nbytes} B < {knob} ({threshold}) on {link} — latency-bound, dense wins",
         )
     want = cfg.strategy
     if want == STRATEGY_DENSE:
+        if crosses_dcn:
+            return Decision(
+                STRATEGY_DENSE,
+                f"comm.strategy = dense (explicit; NOTE: {link} link — "
+                "strategy 'auto' would compress the inter-slice hops)",
+            )
         return Decision(STRATEGY_DENSE, "comm.strategy = dense")
     if want == STRATEGY_INT8:
-        return Decision(STRATEGY_INT8, "comm.strategy = int8")
+        return Decision(STRATEGY_INT8, f"comm.strategy = int8 ({link})")
     if want == STRATEGY_ONEBIT:
         ef = "with" if cfg.error_feedback else "WITHOUT"
-        return Decision(STRATEGY_ONEBIT, f"comm.strategy = onebit ({ef} error feedback)")
+        return Decision(STRATEGY_ONEBIT, f"comm.strategy = onebit ({ef} error feedback, {link})")
     # auto: bandwidth-bound float exchange on a multi-rank grid → int8
     # (stateless + unbiased; onebit needs the residual rows, so it stays
     # an explicit opt-in — its win over int8 is marginal on TPU, where
     # signs ride ICI as int8 anyway; see docs/comm.md)
+    if crosses_dcn:
+        return Decision(
+            STRATEGY_INT8,
+            f"auto policy: {nbytes} B float over {n_ranks} ranks crosses DCN "
+            f"({link}) — compressed inter-slice exchange",
+        )
     return Decision(
         STRATEGY_INT8,
         f"auto policy: {nbytes} B float over {n_ranks} ranks is bandwidth-bound",
@@ -114,6 +134,7 @@ def step_comm_bytes(
     param_bytes: int = 2,
     grad_bytes: int = 4,
     reduce_scatter: bool = True,
+    topology=None,
 ) -> Dict[str, Any]:
     """Per-train-step collective-byte model extending
     :func:`~deepspeed_tpu.runtime.zero.stages.zero_step_comm_model` with
@@ -125,6 +146,15 @@ def step_comm_bytes(
     reduces into the sharded accumulator), while the explicit
     compressed strategies accumulate per-rank rows locally and exchange
     ONCE per step — so their byte advantage grows with ``gas``.
+
+    ``topology`` (a :class:`~deepspeed_tpu.sharding.mesh.MeshTopology`)
+    splits the grad-exchange term into intra-slice (ICI) and
+    inter-slice (DCN) rows when the exchange's grid spans slices.  The
+    split is pure *attribution* — ``grad-exchange`` and ``total`` are
+    unchanged (the runtime executes one flat exchange): the DCN row —
+    the scarce-bandwidth one the policy table keys on — carries 1/ici
+    of the ring weight and is gas-independent for the compressed
+    strategies.
     """
     from deepspeed_tpu.runtime.zero.stages import zero_step_comm_model
 
@@ -137,6 +167,8 @@ def step_comm_bytes(
         reduce_scatter=reduce_scatter,
     )
     out = dict(base)
+    dp_axes = ("data", "fsdp")
+    dcn_ranks = topology.dcn_ranks(dp_axes) if topology is not None else 1
     if dp <= 1:
         ge = 0
     elif strategy == STRATEGY_DENSE:
@@ -153,6 +185,23 @@ def step_comm_bytes(
         out["reduce-scatter"] = 0
         out["all-reduce"] = 0
         ge = 2 * n_params + 8 * dp
+    if ge > 0 and topology is not None:
+        # link-tier attribution of the SAME flat exchange (the runtime
+        # executes one flat ring — the split does not change `ge` or
+        # `total`, it only names where the bytes ride): a ring over a
+        # grid spanning `split_dcn` slices crosses DCN on split_dcn of
+        # its hops, so the DCN row carries 1/ici of the ring weight —
+        # the scarce-bandwidth row the policy table keys on, and
+        # gas-independent for the compressed strategies (their flat ge
+        # is).  Dense with data==1 has ge==0 (its fsdp share lives in
+        # `base`), so no rows are fabricated for it.
+        split_axes = ("data",) if strategy == STRATEGY_DENSE else dp_axes
+        grid = data if strategy == STRATEGY_DENSE else dp
+        split_dcn = topology.dcn_ranks(split_axes)
+        if split_dcn > 1:
+            inter = ge * split_dcn // grid  # == ge / ici ranks
+            out["grad-exchange-ici"] = int(ge - inter)
+            out["grad-exchange-dcn"] = int(inter)
     out["grad-exchange"] = int(ge)
     out["strategy"] = strategy
     out["total"] = int(out["all-gather"] + out["reduce-scatter"] + out["all-reduce"] + ge)
@@ -163,11 +212,14 @@ class CommLayer:
     """Per-engine comm facade: policy decisions + the exchange entry
     points.  Construction is cheap; everything here is trace-time."""
 
-    def __init__(self, mesh, mesh_info, config, zero_config=None):
+    def __init__(self, mesh, mesh_info, config, zero_config=None, topology=None):
         self.mesh = mesh
         self.mesh_info = mesh_info
         self.config = config
         self.zero_config = zero_config
+        # ICI×DCN topology descriptor (sharding/mesh.py); None = assume
+        # single-slice all-ICI (the pre-multi-slice behavior)
+        self.topology = topology
         # site -> Decision: the active strategy table (ds_report rows)
         self.decisions: Dict[str, Decision] = {}
 
@@ -176,9 +228,26 @@ class CommLayer:
         names = axes if isinstance(axes, (tuple, list)) else (axes,)
         return int(np.prod([self.mesh_info.sizes.get(a, 1) for a in names]))
 
+    def _axis_link(self, axes) -> str:
+        """The link kind an exchange over ``axes`` rides (topology row
+        key: ici / dcn / ici+dcn)."""
+        if self.topology is None:
+            return "ici"
+        names = axes if isinstance(axes, (tuple, list)) else (axes,)
+        links = {self.topology.link(a) for a in names if self.mesh_info.sizes.get(a, 1) > 1}
+        if not links or links == {"ici"}:
+            return "ici"
+        if links == {"dcn"}:
+            return "dcn"
+        return "ici+dcn"
+
     def select(self, nbytes: int, dtype, axes, site: str) -> str:
-        """Pick + record the strategy for one exchange site."""
-        d = select_strategy(self.config, int(nbytes), dtype, self._axis_ranks(axes))
+        """Pick + record the strategy for one exchange site, keyed on
+        the (size, dtype, rank-count, link) row of the policy table."""
+        d = select_strategy(
+            self.config, int(nbytes), dtype, self._axis_ranks(axes),
+            link=self._axis_link(axes),
+        )
         self.decisions[site] = d
         if d.strategy == STRATEGY_DENSE and self.config.strategy in (STRATEGY_INT8, STRATEGY_ONEBIT):
             logger.info(f"comm: site '{site}' stays dense ({d.reason})")

@@ -138,6 +138,11 @@ class ZeroConfig:
     ignore_unused_parameters: bool = True
     legacy_stage1: bool = False
     cpu_offload: bool = False  # legacy alias for offload_optimizer.device=cpu
+    # cross-replica weight-update sharding (arXiv:2004.13336): at stage
+    # >= 1 the optimizer state/update also shards across the pure
+    # ``data`` axis — ~dp× less update FLOPs + opt-state bytes per
+    # replica for one updated-params all-gather (docs/sharding.md)
+    cross_replica_weight_update: bool = True
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ZeroConfig":
@@ -177,6 +182,7 @@ class ZeroConfig:
             ignore_unused_parameters=bool(_pop(d, "ignore_unused_parameters", True)),
             legacy_stage1=bool(_pop(d, "legacy_stage1", False)),
             cpu_offload=cpu_offload,
+            cross_replica_weight_update=bool(_pop(d, "cross_replica_weight_update", True)),
         )
         _check_empty(
             d, C.ZERO_OPTIMIZATION,
@@ -683,6 +689,7 @@ class CommConfig:
 
     strategy: str = C.COMM_STRATEGY_DEFAULT
     threshold_bytes: int = C.COMM_THRESHOLD_BYTES_DEFAULT
+    dcn_threshold_bytes: int = C.COMM_DCN_THRESHOLD_BYTES_DEFAULT
     quantize_bits: int = C.COMM_QUANTIZE_BITS_DEFAULT
     error_feedback: bool = C.COMM_ERROR_FEEDBACK_DEFAULT
     stochastic_rounding: bool = C.COMM_STOCHASTIC_ROUNDING_DEFAULT
@@ -695,6 +702,9 @@ class CommConfig:
         out = cls(
             strategy=str(_pop(d, "strategy", C.COMM_STRATEGY_DEFAULT)).lower(),
             threshold_bytes=int(_pop(d, "threshold_bytes", C.COMM_THRESHOLD_BYTES_DEFAULT)),
+            dcn_threshold_bytes=int(
+                _pop(d, "dcn_threshold_bytes", C.COMM_DCN_THRESHOLD_BYTES_DEFAULT)
+            ),
             quantize_bits=int(_pop(d, "quantize_bits", C.COMM_QUANTIZE_BITS_DEFAULT)),
             error_feedback=bool(_pop(d, "error_feedback", C.COMM_ERROR_FEEDBACK_DEFAULT)),
             stochastic_rounding=bool(
@@ -709,6 +719,10 @@ class CommConfig:
         if out.threshold_bytes < 0:
             raise DeepSpeedConfigError(
                 f"'{C.COMM}.threshold_bytes' must be >= 0, got {out.threshold_bytes}"
+            )
+        if out.dcn_threshold_bytes < 0:
+            raise DeepSpeedConfigError(
+                f"'{C.COMM}.dcn_threshold_bytes' must be >= 0, got {out.dcn_threshold_bytes}"
             )
         if out.quantize_bits != C.COMM_QUANTIZE_BITS_DEFAULT:
             # XLA has no bit-packed dtype: int8 is the densest exchange
@@ -741,6 +755,10 @@ class ServingConfig:
     max_queue: int = C.SERVING_MAX_QUEUE_DEFAULT
     max_new_tokens: int = C.SERVING_MAX_NEW_TOKENS_DEFAULT
     deadline_seconds: float = C.SERVING_DEADLINE_SECONDS_DEFAULT
+    # static top-k head width for per-slot sampling: traced per-request
+    # top_k thresholds against the top-max_top_k logits (one executable
+    # for any greedy/sampled mix); submit() rejects top_k > max_top_k
+    max_top_k: int = C.SERVING_MAX_TOP_K_DEFAULT
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ServingConfig":
@@ -762,8 +780,13 @@ class ServingConfig:
             deadline_seconds=float(
                 _pop(d, "deadline_seconds", C.SERVING_DEADLINE_SECONDS_DEFAULT)
             ),
+            max_top_k=int(_pop(d, "max_top_k", C.SERVING_MAX_TOP_K_DEFAULT)),
         )
         _check_empty(d, C.SERVING, _known_keys(cls))
+        if out.max_top_k < 1:
+            raise DeepSpeedConfigError(
+                f"'{C.SERVING}.max_top_k' must be >= 1, got {out.max_top_k}"
+            )
         if out.num_slots < 1:
             raise DeepSpeedConfigError(
                 f"'{C.SERVING}.num_slots' must be >= 1, got {out.num_slots}"

@@ -228,6 +228,9 @@ COMM_STRATEGIES = [
 # must be an explicit opt-in ("auto" enables the size/dtype policy)
 COMM_STRATEGY_DEFAULT = COMM_STRATEGY_DENSE
 COMM_THRESHOLD_BYTES_DEFAULT = 65536  # below this, dense always wins
+# DCN-crossing exchanges are bandwidth-bound ~25x sooner than ICI
+# (per-link GB/s gap), so `auto` compresses above a much lower floor
+COMM_DCN_THRESHOLD_BYTES_DEFAULT = 4096
 COMM_QUANTIZE_BITS_DEFAULT = 8  # int8 is the densest ICI-native format
 COMM_ERROR_FEEDBACK_DEFAULT = True  # onebit strategy's residual carry
 COMM_STOCHASTIC_ROUNDING_DEFAULT = True  # int8 strategy's unbiased rounding
@@ -245,6 +248,10 @@ SERVING_PREFILL_CHUNKS_PER_STEP_DEFAULT = 1  # chunks interleaved per decode ste
 SERVING_MAX_QUEUE_DEFAULT = 64  # waiting requests before submit() rejects
 SERVING_MAX_NEW_TOKENS_DEFAULT = 128  # per-request default generation budget
 SERVING_DEADLINE_SECONDS_DEFAULT = 0.0  # 0 = no queue-wait deadline
+# static top-k head width for per-slot sampling (traced per-request k
+# thresholds against the top-max_top_k logits; one decode executable
+# for any greedy/sampled mix) — requests with top_k > max_top_k reject
+SERVING_MAX_TOP_K_DEFAULT = 64
 
 #############################################
 # Sanitizer (ds_san: trace-time & runtime checkers; docs/ds_san.md)

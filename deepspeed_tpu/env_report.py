@@ -287,6 +287,82 @@ def comm_report(config=None) -> None:
         print(f"{name} " + "." * (30 - len(name)) + f" {value}")
 
 
+def sharding_report(config=None) -> None:
+    """Partition-rule engine + mesh topology rows (docs/sharding.md):
+    the family rule catalog, the derived mesh shape and its ICI×DCN
+    factoring over the available devices, and the cross-replica
+    weight-update sharding status with its ~dp× byte/FLOP model."""
+    from deepspeed_tpu.config.config import MeshConfig, ZeroConfig
+    from deepspeed_tpu.sharding.mesh import MESH_AXES, resolve_mesh_shape, _granules, split_dcn_ici
+    from deepspeed_tpu.sharding.rules import family_catalog
+    from deepspeed_tpu.sharding.update import weight_update_model
+
+    mc = getattr(config, "mesh", None) or MeshConfig()
+    zc = getattr(config, "zero_config", None) or ZeroConfig()
+    print()
+    print("sharding / partition-rule engine:")
+    rows = [
+        (
+            "partition-rule families",
+            ", ".join(f"{k} ({v} rules)" for k, v in family_catalog().items()),
+        ),
+    ]
+    try:
+        import jax
+
+        devices = jax.devices()
+        sizes = resolve_mesh_shape(mc, len(devices))
+        rows.append(
+            ("mesh shape", " × ".join(f"{ax}={sizes[ax]}" for ax in MESH_AXES if sizes[ax] > 1) or "1 device")
+        )
+        granules = _granules(devices)
+        if granules is not None and len(granules) > 1:
+            split = split_dcn_ici(sizes, len(granules))
+            if split is not None:
+                dcn, ici = split
+                rows.append(
+                    (
+                        "topology",
+                        f"{len(granules)} slices: dcn="
+                        + "×".join(str(dcn[ax]) for ax in MESH_AXES)
+                        + " ici=" + "×".join(str(ici[ax]) for ax in MESH_AXES),
+                    )
+                )
+            else:
+                rows.append(("topology", f"{len(granules)} granules (unfactorable — flat order)"))
+        else:
+            rows.append(("topology", "single slice (all-ICI)"))
+        dp = sizes.get("data", 1) * sizes.get("fsdp", 1)
+    except Exception as e:  # no devices / bad mesh config: still report
+        rows.append(("mesh shape", f"unavailable ({e})"))
+        dp = 1
+    cross = zc.stage >= 1 and getattr(zc, "cross_replica_weight_update", True)
+    rows.append(
+        (
+            "weight-update sharding",
+            (
+                f"cross-replica (default ZeRO-1): ~{dp}x less update FLOPs + "
+                f"opt-state bytes/replica, one params all-gather/step"
+                if cross and dp > 1
+                else ("off (zero_optimization.cross_replica_weight_update=false)"
+                      if zc.stage >= 1 else "n/a (zero stage 0)")
+            ),
+        )
+    )
+    if dp > 1:
+        m = weight_update_model(125_000_000, dp)
+        rows.append(
+            (
+                "byte model @125M params",
+                f"{m['opt_state_bytes_per_replica'] / 1e6:.0f} MB opt state/replica "
+                f"(vs {weight_update_model(125_000_000, dp, sharded=False)['opt_state_bytes_per_replica'] / 1e6:.0f} replicated), "
+                f"{m['update_allgather_bytes'] / 1e6:.0f} MB all-gather/step",
+            )
+        )
+    for name, value in rows:
+        print(f"{name} " + "." * (30 - len(name)) + f" {value}")
+
+
 def serving_report(config=None) -> None:
     """Serving-layer summary rows (docs/serving.md).  ``config`` may be
     a DeepSpeedConfig, a ServingConfig, or None (defaults).  Prints the
@@ -341,6 +417,7 @@ def cli_main() -> int:
     overlap_report()
     sanitizer_report()
     comm_report()
+    sharding_report()
     serving_report()
     return 0 if ok else 1
 

@@ -41,6 +41,50 @@ from deepspeed_tpu.utils.logging import log_dist, logger
 _STAGE_CHUNK_BYTES = 256 << 20
 
 
+def sample_logits(logits32, r, do_sample: bool, temperature: float, top_k: int):
+    """The generation sampling head (STATIC params — compiled into each
+    ``generate()`` signature).  ``logits32`` (..., V) float32; greedy
+    when ``do_sample`` is False (note ``x / 1.0`` is bit-exact, so the
+    default ``temperature=1.0`` greedy path equals a bare argmax)."""
+    logits32 = logits32 / jnp.maximum(temperature, 1e-6)
+    if not do_sample:
+        return jnp.argmax(logits32, axis=-1).astype(jnp.int32)
+    if top_k > 0:
+        # k > V degenerates to no filtering; lax.top_k requires k <= V
+        top_k = min(top_k, logits32.shape[-1])
+        kth = jax.lax.top_k(logits32, top_k)[0][..., -1:]
+        logits32 = jnp.where(logits32 < kth, -jnp.inf, logits32)
+    return jax.random.categorical(r, logits32, axis=-1).astype(jnp.int32)
+
+
+def sample_logits_pooled(logits32, keys, sample_flag, temperature, top_k, max_top_k: int):
+    """:func:`sample_logits` for a slot pool: per-row TRACED sampling
+    params (serving's per-request temperature/top-k/seed ride the fixed
+    decode signature — one executable for any greedy/sampled mix).
+
+    ``logits32`` (S, V); ``keys`` (S,) PRNG keys; ``sample_flag`` (S,)
+    bool; ``temperature`` (S,) f32; ``top_k`` (S,) i32 (0 = no top-k
+    filter).  Rows with ``sample_flag`` False take the bare argmax —
+    bit-identical to ``sample_logits(do_sample=False, temperature=1.0)``,
+    the serving ⇄ solo-``generate()`` greedy parity contract.  Traced
+    per-row k thresholds against the STATIC top-``max_top_k`` head
+    (``jax.lax.top_k`` needs a static k; requests with
+    ``top_k > max_top_k`` are rejected at submit)."""
+    greedy = jnp.argmax(logits32, axis=-1).astype(jnp.int32)
+    lg = logits32 / jnp.maximum(temperature[:, None], 1e-6)
+    # lax.top_k requires k <= V: a vocab narrower than max_top_k clamps
+    # the static head (per-row k >= V then keeps every logit — the same
+    # no-filter semantics, and greedy-only pools stay V-agnostic)
+    head_k = min(max_top_k, logits32.shape[-1])
+    head = jax.lax.top_k(lg, head_k)[0]  # (S, head_k), sorted desc
+    kth = jnp.take_along_axis(
+        head, jnp.clip(top_k - 1, 0, head_k - 1)[:, None], axis=-1
+    )
+    lg = jnp.where((top_k[:, None] > 0) & (lg < kth), -jnp.inf, lg)
+    sampled = jax.vmap(jax.random.categorical)(keys, lg).astype(jnp.int32)
+    return jnp.where(sample_flag, sampled, greedy)
+
+
 @functools.partial(jax.jit, static_argnums=1)
 def _split_flat(buf, shapes):
     """Split one flat staging buffer into per-leaf arrays on device.
@@ -128,6 +172,17 @@ class InferenceEngine:
 
         self._is_gpt = isinstance(self.model_config, gpt2_mod.GPT2Config)
         self._family = gpt2_mod if self._is_gpt else bert_mod
+        # partition-rule engine: the family table every param layout
+        # resolves through (sharding/rules.py; packed-int8 aware)
+        from deepspeed_tpu.sharding.rules import rules_for_config, rules_for_family
+
+        try:
+            self._rules = rules_for_config(self.model_config)
+        except ValueError:
+            # duck-typed configs outside the built-in MROs keep working
+            # (the same fallback as self._family above); the table is
+            # only consulted when a layout actually needs resolving
+            self._rules = rules_for_family("gpt2" if self._is_gpt else "bert")
         # disable remat for inference (no backward to save memory for)
         if getattr(self.model_config, "remat", False):
             self.model_config = dataclasses.replace(self.model_config, remat=False)
@@ -200,19 +255,11 @@ class InferenceEngine:
     def _tp_spec(self, path: str, shape) -> P:
         if self.mp_world_size <= 1:
             return P()
-        # int8-packed weights nest one level: .../<name>_w/q carries the
-        # weight spec; .../<name>_w/s drops the contracted (input) dim
-        parts = path.split("/")
-        packed_kind = parts[-1] if parts[-1] in ("q", "s") else None
-        if packed_kind:
-            path = "/".join(parts[:-1])
-        spec = self._family.tp_spec_fn(path, shape)
-        if spec is None:
-            return P()
-        if packed_kind == "s":
-            dims = tuple(spec)
-            spec = P(*(dims[:-2] + (dims[-1],))) if len(dims) >= 2 else P()
-        return spec
+        # partition-rule engine resolution: the family rule table
+        # normalizes packed-int8 paths itself (.../<name>_w/q carries
+        # the weight spec; .../<name>_w/s drops the contracted dim)
+        spec = self._rules.spec(path, shape)
+        return spec if spec is not None else P()
 
     def _shard_params(self, params, owned: bool = False):
         # int8 payloads must stay int8; scales stay f32.  Cast on HOST
@@ -489,13 +536,9 @@ class InferenceEngine:
         eos = -1 if eos_token_id is None else int(eos_token_id)
 
         def sample_token(logits32, r):
-            logits32 = logits32 / jnp.maximum(temperature, 1e-6)
-            if not do_sample:
-                return jnp.argmax(logits32, axis=-1).astype(jnp.int32)
-            if top_k > 0:
-                kth = jax.lax.top_k(logits32, top_k)[0][..., -1:]
-                logits32 = jnp.where(logits32 < kth, -jnp.inf, logits32)
-            return jax.random.categorical(r, logits32, axis=-1).astype(jnp.int32)
+            return sample_logits(
+                logits32, r, do_sample=do_sample, temperature=temperature, top_k=top_k
+            )
 
         def gen(params, tokens, rng, attention_mask):
             k_cache, v_cache = init_kv_cache(cfg.n_layer, B, cfg.n_head, T + N, cfg.head_dim, self._kv_dtype)

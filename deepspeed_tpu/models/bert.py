@@ -141,17 +141,11 @@ def init_params_device(cfg: BertConfig, seed: int = 0, dtype=jnp.float32):
 
 
 def tp_spec_fn(path: str, shape) -> Optional[P]:
-    name = path.split("/")[-1]
-    col = {"qkv_w": P(None, None, "model"), "qkv_b": P(None, "model"),
-           "fc_w": P(None, None, "model"), "fc_b": P(None, "model")}
-    row = {"proj_w": P(None, "model", None), "fc_proj_w": P(None, "model", None)}
-    if name in col:
-        return col[name]
-    if name in row:
-        return row[name]
-    if name == "tok_emb":
-        return P("model", None)
-    return None
+    """Adapter over the partition-rule engine's ``bert`` family table
+    (sharding/rules.py) — the single source of truth for this layout."""
+    from deepspeed_tpu.sharding.rules import rules_for_family
+
+    return rules_for_family("bert").spec(path, shape)
 
 
 def _bert_block(cfg: BertConfig, x, lp, mask_bias, rng, deterministic):

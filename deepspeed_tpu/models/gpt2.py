@@ -216,26 +216,12 @@ def tp_spec_fn(path: str, shape) -> Optional[P]:
     """Megatron-style tensor-parallel specs over the ``model`` axis
     (reference delegates TP to Megatron mpu; inference-side slicing in
     module_inject/replace_module.py:11-88 follows the same column/row
-    split), plus expert-parallel specs over ``expert`` for MoE weights."""
-    name = path.split("/")[-1]
-    col = {"qkv_w": P(None, None, "model"), "qkv_b": P(None, "model"),
-           "fc_w": P(None, None, "model"), "fc_b": P(None, "model")}
-    row = {"proj_w": P(None, "model", None), "fc_proj_w": P(None, "model", None)}
-    # MoE expert weights: experts over `expert`, FFN hidden dim over
-    # `model` (EP × TP); layer dim leads (moe_param_specs is the single
-    # source of truth for this layout).
-    from deepspeed_tpu.moe.layer import moe_param_specs
+    split), plus expert-parallel specs over ``expert`` for MoE weights.
+    Thin adapter over the partition-rule engine's ``gpt2`` family table
+    (sharding/rules.py) — the single source of truth for this layout."""
+    from deepspeed_tpu.sharding.rules import rules_for_family
 
-    moe = {k: v for k, v in moe_param_specs(layer_dim=True, tp_axis="model").items() if k != "gate_w"}
-    if name in col:
-        return col[name]
-    if name in row:
-        return row[name]
-    if name in moe:
-        return moe[name]
-    if name == "wte":
-        return P("model", None)  # vocab-parallel embedding
-    return None
+    return rules_for_family("gpt2").spec(path, shape)
 
 
 # per-(config-values, seq) layout cache: layouts are static numpy, built once

@@ -67,21 +67,12 @@ def moe_param_specs(layer_dim: bool = False, tp_axis: Optional[str] = None) -> D
     """PartitionSpecs for MoE weights: experts over ``expert``, and
     (optionally) the expert-FFN hidden dim over ``tp_axis`` (EP × TP).
 
-    ``layer_dim=True`` prepends a replicated leading dim for models that
-    stack per-layer weights for ``lax.scan`` (e.g. models/gpt2.py).
-    This is the single source of truth — model ``tp_spec_fn``s should
-    consume it rather than re-declare the layout.
-    """
-    specs = {
-        "gate_w": P(),
-        "w1": P(EXPERT_AXIS, None, tp_axis),
-        "b1": P(EXPERT_AXIS, tp_axis),
-        "w2": P(EXPERT_AXIS, tp_axis, None),
-        "b2": P(EXPERT_AXIS, None),
-    }
-    if layer_dim:
-        specs = {k: P(None, *v) for k, v in specs.items()}
-    return specs
+    Back-compat re-export: the layout now lives in the partition-rule
+    engine (:func:`deepspeed_tpu.sharding.rules.moe_param_specs`), which
+    every engine resolves through."""
+    from deepspeed_tpu.sharding.rules import moe_param_specs as _specs
+
+    return _specs(layer_dim=layer_dim, tp_axis=tp_axis)
 
 
 def _capacity(tokens: int, num_experts: int, factor: float, min_capacity: int) -> int:

@@ -34,8 +34,16 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from deepspeed_tpu.comm.mesh import MeshInfo, batch_pspec, make_mesh
+from deepspeed_tpu.comm.mesh import MeshInfo
 from deepspeed_tpu.config.config import DeepSpeedConfig
+from deepspeed_tpu.sharding import (
+    batch_pspec,
+    build_mesh,
+    derive_topology,
+    dp_rows_spec,
+    stacked_batch_pspec,
+)
+from deepspeed_tpu.sharding.rules import PartitionRules
 from deepspeed_tpu.config import constants as C
 from deepspeed_tpu.runtime.fp16.loss_scaler import LossScaler
 from deepspeed_tpu.runtime.lr_schedules import get_lr_schedule
@@ -85,6 +93,7 @@ class DeepSpeedEngine:
         lr_scheduler: Any = None,
         mesh=None,
         tp_spec_fn=None,
+        partition_rules=None,
         loss_fn: Optional[Callable] = None,
         rng: Optional[jax.Array] = None,
         dist_init_required: Optional[bool] = None,
@@ -92,12 +101,23 @@ class DeepSpeedEngine:
         """``model``: callable ``(params, batch, rng) -> loss`` (or outputs
         if ``loss_fn`` given, then ``loss_fn(outputs, batch) -> loss``).
         ``params``: initial parameter pytree (host or device arrays).
+        ``partition_rules``: how parameter layouts resolve — a
+        :class:`~deepspeed_tpu.sharding.rules.PartitionRules`, a family
+        name (``"gpt2"``/``"bert"``/``"neo"``/``"moe"``), or an ordered
+        ``(regex, PartitionSpec)`` table; ``tp_spec_fn`` (legacy) wraps
+        into the same engine.
         """
         self.config = config
         self._model_fn = model
         self._loss_fn = loss_fn
-        self.mesh = mesh if mesh is not None else make_mesh(config.mesh)
+        if mesh is not None:
+            self.mesh = mesh
+            self.topology = derive_topology(mesh)
+        else:
+            self.mesh, self.topology = build_mesh(config.mesh)
         self.mesh_info = MeshInfo.from_mesh(self.mesh)
+        # -- partition-rule engine (docs/sharding.md) ----------------------
+        self.partition_rules = PartitionRules.coerce(partition_rules, tp_spec_fn)
         self.global_rank = jax.process_index()
         self.world_size = self.mesh_info.world_size
 
@@ -110,9 +130,14 @@ class DeepSpeedEngine:
             self.compute_dtype = jnp.float32
         self.loss_scaler = LossScaler.from_config(config.fp16)
 
-        # -- sharding rules (ZeRO stage -> specs) --------------------------
+        # -- sharding rules (ZeRO stage -> specs), resolved through the
+        # partition-rule engine; data_size arms cross-replica
+        # weight-update sharding (the default ZeRO-1, docs/sharding.md)
         self.zero_rules = ZeroShardingRules(
-            config.zero_config, fsdp_size=self.mesh_info.fsdp_world_size, tp_spec_fn=tp_spec_fn
+            config.zero_config,
+            fsdp_size=self.mesh_info.fsdp_world_size,
+            tp_spec_fn=self.partition_rules.tp_spec_fn(),
+            data_size=self.mesh_info.sizes.get("data", 1),
         )
 
         # -- optimizer -----------------------------------------------------
@@ -184,6 +209,11 @@ class DeepSpeedEngine:
         # -- state ---------------------------------------------------------
         self._param_specs = self.zero_rules.tree_param_specs(params)
         self._grad_specs = self.zero_rules.tree_grad_specs(params)
+        # update-phase layout: one params-shaped tree of opt-state specs;
+        # constraining the averaged grads to it inside the update makes
+        # GSPMD shard the whole optimizer computation across the dp grid
+        # (cross-replica weight-update sharding, arXiv:2004.13336)
+        self._update_specs = self.zero_rules.tree_opt_specs_like(params)
         if self._offload:
             self._host_opt = self._configure_host_offload_optimizer(params)
             params = self._shard_params(params, dtype=self.compute_dtype)
@@ -786,6 +816,16 @@ class DeepSpeedEngine:
         with the overflow decision made by the caller (the explicit
         comm-exchange path checks finiteness on the pre-quantization
         rows, where an inf is still visible)."""
+        if self.zero_rules.cross_replica_active:
+            # cross-replica weight-update sharding: pin the averaged
+            # grads to the optimizer-state layout so the partitioner
+            # computes each replica's 1/dp slice of the update (a local
+            # slice of the reduced grads — no extra comm on entry; the
+            # updated params all-gather once at the out_shardings pin)
+            grads = jax.lax.with_sharding_constraint(
+                grads,
+                jax.tree.map(self._sh, self._update_specs, is_leaf=lambda x: isinstance(x, P)),
+            )
         grad_norm = jnp.zeros((), jnp.float32)
         if self.config.gradient_clipping > 0.0:
             grads, grad_norm = _clip_by_global_norm(grads, self.config.gradient_clipping)
@@ -846,9 +886,13 @@ class DeepSpeedEngine:
 
         return scoped_to(self.mesh, fn)
 
-    def _get_compiled(self, name: str, fn, donate: bool = True):
+    def _get_compiled(self, name: str, fn, donate: bool = True, out_shardings=None):
         if name not in self._compiled:
-            self._compiled[name] = jax.jit(self._scoped(fn), donate_argnums=(0,) if donate else ())
+            self._compiled[name] = jax.jit(
+                self._scoped(fn),
+                donate_argnums=(0,) if donate else (),
+                out_shardings=out_shardings,
+            )
             self.compilation_count += 1
             if self._sanitizer is not None:
                 self._sanitizer.recompile.note(f"engine.{name}", None, owner=id(self))
@@ -943,7 +987,7 @@ class DeepSpeedEngine:
 
     def _enter_onebit_frozen(self) -> None:
         n = self.mesh_info.dp_world_size  # exchange rows = full dp grid
-        row_spec = P(self._dp_exchange_axes())
+        row_spec = dp_rows_spec(self._dp_exchange_axes())
         # NOTE: the frozen layout replicates the momentum (in its int8
         # compressed exchange form — 1 byte/param) and the fp32 variance
         # (the exchange needs the full momentum on every rank to
@@ -1016,7 +1060,7 @@ class DeepSpeedEngine:
         axes = self._onebit_exchange_axes()
         gas = self.gradient_accumulation_steps
         mp = state["opt_state"].m_signs.shape[0]
-        row_sh = self._sh(P(axes))
+        row_sh = self._sh(dp_rows_spec(axes))
         acc0 = jax.lax.with_sharding_constraint(jnp.zeros((n, mp), jnp.float32), row_sh)
 
         def body(carry, mb):
@@ -1178,7 +1222,7 @@ class DeepSpeedEngine:
 
         self.comm = CommLayer(
             self.mesh, self.mesh_info, getattr(config, "comm", None) or CommConfig(),
-            zero_config=config.zero_config,
+            zero_config=config.zero_config, topology=self.topology,
         )
         # satellite: the previously-unwired reduce_scatter flag is now
         # honored by ZeroShardingRules.grad_spec; warn once when it
@@ -1232,7 +1276,7 @@ class DeepSpeedEngine:
             self._state_shardings["grad_acc"] = {}
             mp = self._comm_flat_len
             if want == STRATEGY_ONEBIT and self.comm.config.error_feedback:
-                row_sh = self._sh(P(axes))
+                row_sh = self._sh(dp_rows_spec(axes))
                 comm_sh = {"worker_error": row_sh, "server_error": row_sh}
                 self.state["comm"] = jax.jit(
                     lambda: {
@@ -1262,6 +1306,7 @@ class DeepSpeedEngine:
             gas=self.gradient_accumulation_steps,
             strategy=self._comm_grad_strategy,
             reduce_scatter=self.config.zero_config.reduce_scatter,
+            topology=self.topology,
         )
         return {
             "strategy": self._comm_grad_strategy,
@@ -1284,7 +1329,7 @@ class DeepSpeedEngine:
         axes = self._dp_exchange_axes()
         gas = self.gradient_accumulation_steps
         mp = self._comm_flat_len
-        row_sh = self._sh(P(axes))
+        row_sh = self._sh(dp_rows_spec(axes))
         acc0 = jax.lax.with_sharding_constraint(jnp.zeros((n, mp), jnp.float32), row_sh)
 
         def body(carry, mb):
@@ -1356,7 +1401,7 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     def _stacked_sharding(self, ndim_stacked: int):
         return self._sh(
-            P(*([None] + list(batch_pspec(ndim_stacked - 1, seq_sharded=self.mesh_info.seq_parallel_world_size > 1))))
+            stacked_batch_pspec(ndim_stacked, seq_sharded=self.mesh_info.seq_parallel_world_size > 1)
         )
 
     def _stack_and_place(self, batch: Any) -> Any:
@@ -1463,7 +1508,10 @@ class DeepSpeedEngine:
             self.timers(FORWARD_TIMER).start()
         with self.timeline.phase("data_wait"):
             batch = self._prepare_batch(batch)
-        fn = self._get_compiled("micro_step", self._micro_step_impl)
+        fn = self._get_compiled(
+            "micro_step", self._micro_step_impl,
+            out_shardings=(self._state_shardings, self._sh(P())),
+        )
         san = self._sanitizer
         donated = jax.tree.leaves(self.state) if san is not None else None
         t_compute = time.perf_counter()
@@ -1513,7 +1561,18 @@ class DeepSpeedEngine:
             if self._offload:
                 info = self._host_apply_step()
             else:
-                fn = self._get_compiled("apply_step", self._apply_step_impl)
+                # pin the output state to the declared layout: the
+                # cross-replica update computes over dp-sharded state,
+                # and without the pin GSPMD would keep the updated
+                # params dp-sharded too (sharding drift vs the declared
+                # replicated param spec; the pin is where the one
+                # updated-params all-gather lands)
+                scalar = self._sh(P())
+                fn = self._get_compiled(
+                    "apply_step", self._apply_step_impl,
+                    out_shardings=(self._state_shardings,
+                                   {"lr": scalar, "grad_norm": scalar, "overflow": scalar}),
+                )
                 san = self._sanitizer
                 donated = jax.tree.leaves(self.state) if san is not None else None
                 with self._sup_region("engine.step"):

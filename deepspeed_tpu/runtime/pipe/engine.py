@@ -58,7 +58,12 @@ from deepspeed_tpu.comm import collectives
 from deepspeed_tpu.config.config import DeepSpeedConfig
 from deepspeed_tpu.runtime.engine import DeepSpeedEngine
 from deepspeed_tpu.runtime.pipe.module import PipelineModule
+from deepspeed_tpu.sharding.layout import DEFAULT_LAYOUT, batch_pspec
+from deepspeed_tpu.sharding.rules import PartitionRules
 from deepspeed_tpu.utils.logging import log_dist
+
+# stacked-body leaf spec: [L, ...] over the pipe axis (sharding/layout.py)
+_PIPE_STACKED = DEFAULT_LAYOUT.stacked(None)
 
 
 class PipelineEngine(DeepSpeedEngine):
@@ -71,6 +76,7 @@ class PipelineEngine(DeepSpeedEngine):
         mesh=None,
         params: Any = None,
         tp_spec_fn=None,
+        partition_rules=None,
         **kw,
     ):
         from deepspeed_tpu.comm.mesh import make_mesh
@@ -90,17 +96,22 @@ class PipelineEngine(DeepSpeedEngine):
         if params is None:
             params = module.build_params(jax.random.PRNGKey(config.seed))
         self._micro_batches = config.gradient_accumulation_steps
-        self._client_tp_spec_fn = tp_spec_fn
         # grads go straight into _apply_update; no accumulator buffer
         # (saves a full fp32 params-sized tree vs the base engine)
         self._use_grad_acc = False
+
+        # partition-rule engine: the client's table (PartitionRules,
+        # family name, rule table, or legacy tp_spec_fn) gains the
+        # stacked-body view — leaves under ``blocks`` get the pipe axis
+        # on their leading stacked dim, per-block specs shift right
+        base_rules = PartitionRules.coerce(partition_rules, tp_spec_fn)
 
         super().__init__(
             model=self._pipelined_loss,
             params=params,
             config=config,
             mesh=mesh,
-            tp_spec_fn=self._pipe_tp_spec,
+            partition_rules=base_rules.stacked(prefix="blocks"),
             **kw,
         )
 
@@ -119,23 +130,8 @@ class PipelineEngine(DeepSpeedEngine):
         )
 
     # ------------------------------------------------------------------
-    # sharding: body leaves get P('pipe') on the stacked dim
-    # ------------------------------------------------------------------
-    def _pipe_tp_spec(self, path: str, shape) -> Optional[P]:
-        if path.startswith("blocks/") or path == "blocks":
-            # a client tp_spec_fn sees the per-block path and shape (the
-            # stacked dim is prepended here)
-            if self._client_tp_spec_fn is not None:
-                base = self._client_tp_spec_fn(path, shape[1:])
-                if base is not None:
-                    return P("pipe", *tuple(base))
-            return P("pipe")
-        if self._client_tp_spec_fn is not None:
-            return self._client_tp_spec_fn(path, shape)
-        return None
-
-    # ------------------------------------------------------------------
-    # the compiled pipeline
+    # the compiled pipeline (body leaves are sharded _PIPE_STACKED on
+    # their stacked dim by the partition-rule engine's stacked() view)
     # ------------------------------------------------------------------
     def _split_batch(self, batch: Any) -> Tuple[Any, Any]:
         if isinstance(batch, (tuple, list)) and len(batch) == 2:
@@ -163,7 +159,7 @@ class PipelineEngine(DeepSpeedEngine):
             mb = B // M
             x_mb = x.reshape((M, mb) + x.shape[1:])
             x_mb = jax.lax.with_sharding_constraint(
-                x_mb, self._sh(P(None, ("data", "fsdp")))
+                x_mb, self._sh(DEFAULT_LAYOUT.micro_batch_stack(x_mb.ndim))
             )
             y_mb = self._pipeline_body(params["blocks"], x_mb, rng)
             x = y_mb.reshape((B,) + y_mb.shape[2:])
@@ -245,7 +241,7 @@ class PipelineEngine(DeepSpeedEngine):
             return out
 
         in_specs = (
-            jax.tree.map(lambda _: P("pipe"), block_params),
+            jax.tree.map(lambda _: _PIPE_STACKED, block_params),
             P(),
             P() if rng is not None else None,
         )
@@ -285,7 +281,9 @@ class PipelineEngine(DeepSpeedEngine):
                 B = x.shape[0]
                 assert B % M == 0, f"batch {B} not divisible by {M} micro-batches"
                 x = x.reshape((M, B // M) + x.shape[1:])
-                return jax.lax.with_sharding_constraint(x, self._sh(P(None, ("data", "fsdp"))))
+                return jax.lax.with_sharding_constraint(
+                    x, self._sh(DEFAULT_LAYOUT.micro_batch_stack(x.ndim))
+                )
 
             return jax.tree.map(one, tree)
 
@@ -406,7 +404,7 @@ class PipelineEngine(DeepSpeedEngine):
             return loss_sum / M, dblocks, dpre, dpost
 
         in_specs = [
-            jax.tree.map(lambda _: P("pipe"), bp),
+            jax.tree.map(lambda _: _PIPE_STACKED, bp),
             jax.tree.map(lambda _: P(), inp_mb),
             jax.tree.map(lambda _: P(), lab_mb),
             jax.tree.map(lambda _: P(), pre_sub),
@@ -414,7 +412,7 @@ class PipelineEngine(DeepSpeedEngine):
         ]
         out_specs = (
             P(),
-            jax.tree.map(lambda _: P("pipe"), bp),
+            jax.tree.map(lambda _: _PIPE_STACKED, bp),
             jax.tree.map(lambda _: P(), pre_sub),
             jax.tree.map(lambda _: P(), post_sub),
         )
@@ -457,7 +455,7 @@ class PipelineEngine(DeepSpeedEngine):
         full = jax.tree.map(
             lambda x: jax.device_put(
                 np.asarray(x) if not isinstance(x, jax.Array) else x,
-                self._sh(P(("data", "fsdp"))),
+                self._sh(batch_pspec(1)),
             ),
             full,
         )
@@ -511,7 +509,7 @@ class PipelineEngine(DeepSpeedEngine):
         full = jax.tree.map(
             lambda x: jax.device_put(
                 np.asarray(x) if not isinstance(x, jax.Array) else x,
-                self._sh(P(("data", "fsdp"))),
+                self._sh(batch_pspec(1)),
             ),
             full,
         )

@@ -296,8 +296,9 @@ class ZeroInfinityEngine:
         self.skipped_steps = 0
         self._compiled: Dict[str, Any] = {}
         # batch rows shard over the whole DP world (data × fsdp), the
-        # same convention as the in-HBM engine (comm/mesh.batch_pspec)
-        self._batch_sh = NamedSharding(mesh, P(("data", "fsdp")))
+        # same convention as the in-HBM engine (sharding/layout.py,
+        # re-exported through comm.mesh)
+        self._batch_sh = NamedSharding(mesh, batch_pspec(1))
         # ZeRO-3 × ZeRO-Infinity composition (reference stage3.py:2633-2686
         # + partitioned_param_swapper.py:36 swap per-rank *partitions*):
         # each uploaded group is SHARDED over the fsdp axis — per-device
@@ -323,22 +324,11 @@ class ZeroInfinityEngine:
         """fsdp PartitionSpec for one stacked-block leaf ``(gl, ...)``:
         shard the largest trailing dim divisible by the fsdp size (the
         leading stacked-layer dim stays whole — group_layers may be
-        smaller than the axis); replicate when nothing divides."""
-        from jax.sharding import PartitionSpec as P
+        smaller than the axis); replicate when nothing divides.
+        Resolved through the partition-rule engine's layout helper."""
+        from deepspeed_tpu.sharding.layout import fsdp_trailing_spec
 
-        n = self.mesh_info.fsdp_world_size
-        dims = list(shape)
-        if n <= 1 or len(dims) < 2:
-            return P()
-        best = None
-        for i in range(len(dims) - 1, 0, -1):
-            if dims[i] % n == 0 and (best is None or dims[i] > dims[best]):
-                best = i
-        if best is None:
-            return P()
-        spec = [None] * len(dims)
-        spec[best] = "fsdp"
-        return P(*spec)
+        return fsdp_trailing_spec(shape, self.mesh_info.fsdp_world_size)
 
     def _sharded_dim(self, group_shape) -> Optional[int]:
         """Index of the fsdp-sharded dim of one group leaf, or None."""
@@ -577,7 +567,7 @@ class ZeroInfinityEngine:
                 return
 
     def _upload_resident(self) -> Any:
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from deepspeed_tpu.sharding.layout import replicated_sharding
 
         # explicit replicated sharding: under multi-process execution
         # every host holds identical resident params and device_put
@@ -585,7 +575,7 @@ class ZeroInfinityEngine:
         # would commit to one local device and break the global mesh)
         return jax.device_put(
             jax.tree.map(lambda a: jnp.asarray(a, self.compute_dtype), self._resident_host),
-            NamedSharding(self.mesh, P()),
+            replicated_sharding(self.mesh),
         )
 
     # ------------------------------------------------------------------

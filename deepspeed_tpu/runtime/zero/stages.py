@@ -27,29 +27,19 @@ the largest dimension not already consumed by ``model``/other axes.
 """
 from __future__ import annotations
 
-from typing import Any, Optional, Sequence, Tuple
+from typing import Any, Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.config.config import ZeroConfig
-
-
-def _spec_tuple(spec: Optional[P], ndim: int) -> Tuple[Any, ...]:
-    """Normalize a PartitionSpec to a full-length tuple."""
-    if spec is None:
-        return (None,) * ndim
-    t = tuple(spec)
-    return t + (None,) * (ndim - len(t))
-
-
-def _used_axes(entry) -> Sequence[str]:
-    if entry is None:
-        return ()
-    if isinstance(entry, str):
-        return (entry,)
-    return tuple(entry)
+from deepspeed_tpu.sharding.layout import DEFAULT_LAYOUT
+from deepspeed_tpu.sharding.update import (
+    add_mesh_axis,
+    add_update_axis,
+    spec_tuple as _spec_tuple,
+)
 
 
 def add_fsdp_axis(
@@ -65,38 +55,42 @@ def add_fsdp_axis(
     ``min_size`` elements (the ZeRO-3 persistence threshold,
     stage3.py:1416) or with no divisible dim stay as-is (replicated over
     fsdp) — matching the reference's ``persistent_parameters`` behavior.
+    (Thin wrapper over the axis-placement primitive in sharding/update.py.)
     """
-    ndim = len(shape)
-    base = _spec_tuple(base_spec, ndim)
-    if fsdp_size <= 1:
-        return P(*base)
-    if int(np.prod(shape)) < max(min_size, 1) and min_size > 0:
-        return P(*base)
-    candidates = [
-        (shape[i], i)
-        for i in range(ndim)
-        if base[i] is None and shape[i] % fsdp_size == 0 and shape[i] >= fsdp_size
-    ]
-    if not candidates:
-        return P(*base)
-    _, dim = max(candidates)
-    new = list(base)
-    new[dim] = "fsdp"
-    return P(*new)
+    return add_mesh_axis(shape, base_spec, DEFAULT_LAYOUT.fsdp_axis, fsdp_size, min_size=min_size)
 
 
 class ZeroShardingRules:
     """Produces PartitionSpecs for params / grads / optimizer state for a
     given ZeRO stage.  ``tp_spec_fn(path, shape)`` supplies the
-    tensor-parallel base spec (the ``model`` axis) if any."""
+    tensor-parallel base spec (the ``model`` axis) if any — in practice
+    the partition-rule engine's adapter
+    (:meth:`deepspeed_tpu.sharding.rules.PartitionRules.tp_spec_fn`).
 
-    def __init__(self, zero_config: ZeroConfig, fsdp_size: int, tp_spec_fn=None):
+    ``data_size`` enables **cross-replica weight-update sharding**
+    (arXiv:2004.13336; ``zero_optimization.cross_replica_weight_update``,
+    default on): optimizer state — and with it the update computation —
+    shards across the pure ``data`` axis too, so stage 1 on a pure-DP
+    mesh cuts per-replica update FLOPs and optimizer-state bytes ~dp×
+    for one updated-params all-gather per step."""
+
+    def __init__(self, zero_config: ZeroConfig, fsdp_size: int, tp_spec_fn=None, data_size: int = 1):
         self.config = zero_config
         self.stage = zero_config.stage
         self.fsdp_size = fsdp_size
+        self.data_size = data_size
         self.tp_spec_fn = tp_spec_fn or (lambda path, shape: None)
         # paths stored flat-padded in engine state (see plan_flat)
         self.flat_paths: set = set()
+
+    @property
+    def cross_replica_active(self) -> bool:
+        """Whether optimizer state shards across the pure data axis."""
+        return (
+            self.stage >= 1
+            and self.data_size > 1
+            and getattr(self.config, "cross_replica_weight_update", True)
+        )
 
     # -- flat-fallback plan ------------------------------------------------
     def plan_flat(self, params: Any) -> dict:
@@ -134,10 +128,16 @@ class ZeroShardingRules:
         self.flat_paths = set(plan)
         return plan
 
+    def _flat_spec(self) -> P:
+        """Spec of a flat-padded 1-D state leaf (sharded over fsdp)."""
+        from deepspeed_tpu.sharding.layout import dp_rows_spec
+
+        return dp_rows_spec(DEFAULT_LAYOUT.fsdp_axis)
+
     # -- params ------------------------------------------------------------
     def param_spec(self, path, shape) -> P:
         if path in self.flat_paths:
-            return P("fsdp") if self.stage >= 3 else P()
+            return self._flat_spec() if self.stage >= 3 else P()
         base = self.tp_spec_fn(path, shape)
         if self.stage >= 3 and self.fsdp_size > 1:
             return add_fsdp_axis(shape, base, self.fsdp_size, min_size=self.config.param_persistence_threshold)
@@ -151,7 +151,7 @@ class ZeroShardingRules:
         # wire and a params-sized grad buffer per chip; the engine warns
         # once and the comm layer records the forced-dense decision
         if path in self.flat_paths:
-            return P("fsdp") if self.stage >= 2 and self.config.reduce_scatter else P()
+            return self._flat_spec() if self.stage >= 2 and self.config.reduce_scatter else P()
         base = self.tp_spec_fn(path, shape)
         if self.stage >= 2 and self.fsdp_size > 1 and self.config.reduce_scatter:
             # stage 3 grads are sharded the same way as the param so the
@@ -163,12 +163,26 @@ class ZeroShardingRules:
     # -- optimizer state ---------------------------------------------------
     def opt_spec(self, path, shape) -> P:
         if path in self.flat_paths:
-            return P("fsdp")
+            # flat leaves keep their fsdp-only layout (their padded size
+            # is a function of fsdp_size alone — see plan_flat); the
+            # cross-replica win on these rare awkward leaves is not
+            # worth a second padding geometry
+            return self._flat_spec()
         base = self.tp_spec_fn(path, shape)
+        spec = base if base is not None else P()
         if self.stage >= 1 and self.fsdp_size > 1:
             min_size = self.config.param_persistence_threshold if self.stage >= 3 else 0
-            return add_fsdp_axis(shape, base, self.fsdp_size, min_size=min_size)
-        return base if base is not None else P()
+            spec = add_fsdp_axis(shape, base, self.fsdp_size, min_size=min_size)
+        if self.cross_replica_active:
+            # cross-replica weight-update sharding: the update math
+            # follows the optimizer-state placement, so extending the
+            # state across ``data`` shards the update ~dp× (the params
+            # all-gather back at the constraint in the engine's update)
+            spec = add_update_axis(
+                shape, spec, DEFAULT_LAYOUT.data_axis, self.data_size,
+                fsdp_axis=DEFAULT_LAYOUT.fsdp_axis, fsdp_size=self.fsdp_size,
+            )
+        return spec
 
     # -- pytree helpers ----------------------------------------------------
     def tree_param_specs(self, params: Any) -> Any:

@@ -19,7 +19,12 @@ per-slot position vector).  Admitting, retiring, or chunk-advancing
 sequences only changes *values*, never abstract signatures — proven
 under an armed ds_san run (tests/test_serving.py) rather than asserted.
 Both executables donate the cache pool, so the slot cache is updated
-in place; decoding is greedy (``generate(do_sample=False)`` parity).
+in place.  Decoding is greedy by default (``generate(do_sample=False)``
+bit-parity); per-request sampling (``submit(do_sample=True,
+temperature=..., top_k=..., seed=...)``) rides the same fixed signature
+as per-slot vectors — temperature/top-k/seed per slot, keys derived
+from (seed, position) so outputs are reproducible regardless of slot
+assignment or pool churn.
 """
 from __future__ import annotations
 
@@ -85,9 +90,9 @@ class ServingEngine:
                     f"or raise max_out_tokens"
                 )
         kv_dtype = "int8" if config.kv_cache_dtype == "int8" else engine._kv_dtype
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from deepspeed_tpu.sharding.layout import replicated_sharding
 
-        self._replicated = NamedSharding(engine.mesh, P())
+        self._replicated = replicated_sharding(engine.mesh)
         self.pool = SlotKVPool(
             mcfg.n_layer, config.num_slots, mcfg.n_head, max_len, mcfg.head_dim,
             kv_dtype, sharding=self._replicated,
@@ -135,11 +140,13 @@ class ServingEngine:
 
     def _get_prefill(self):
         if self._prefill_fn is None:
+            from deepspeed_tpu.inference.engine import sample_logits_pooled
             from deepspeed_tpu.ops.transformer.inference import forward_with_cache
 
             icfg = self.engine.inference_config(self.pool.max_len)
             n_pos = self.engine.model_config.n_positions
             chunk = self.config.prefill_chunk
+            max_top_k = self.config.max_top_k
 
             def _take_slot(c, slot):
                 return jax.tree.map(
@@ -155,7 +162,7 @@ class ServingEngine:
                     c, cs,
                 )
 
-            def fn(params, toks, slot, pos, take_idx, k_pool, v_pool):
+            def fn(params, toks, slot, pos, take_idx, flag, temp, topk, seed, k_pool, v_pool):
                 ks, vs = _take_slot(k_pool, slot), _take_slot(v_pool, slot)
                 # explicit clipped position ids: the zero-padded chunk
                 # tail must not clamp the wpe slice and shift real rows
@@ -165,13 +172,22 @@ class ServingEngine:
                 logits, ks, vs = forward_with_cache(
                     params, toks, ks, vs, pos, icfg, position_ids=position_ids
                 )
-                first = jnp.argmax(
-                    logits[0, take_idx].astype(jnp.float32), axis=-1
-                ).astype(jnp.int32)
+                # the first generated token samples with the request's
+                # params (the same key schedule as decode: key = seed
+                # folded with the fed token's cache position)
+                key = jax.random.fold_in(jax.random.PRNGKey(seed), pos + take_idx)
+                first = sample_logits_pooled(
+                    logits[0, take_idx].astype(jnp.float32)[None, :],
+                    key[None],
+                    flag[None],
+                    temp[None],
+                    topk[None],
+                    max_top_k,
+                )[0]
                 return first, _put_slot(k_pool, ks, slot), _put_slot(v_pool, vs, slot)
 
             self._prefill_fn = self._wrap(
-                jax.jit(self.engine._scoped(fn), donate_argnums=(5, 6)),
+                jax.jit(self.engine._scoped(fn), donate_argnums=(9, 10)),
                 "serving.prefill",
             )
             self.prefill_compiles += 1
@@ -179,23 +195,31 @@ class ServingEngine:
 
     def _get_decode(self):
         if self._decode_fn is None:
+            from deepspeed_tpu.inference.engine import sample_logits_pooled
             from deepspeed_tpu.ops.transformer.inference import forward_with_cache
 
             icfg = self.engine.inference_config(self.pool.max_len)
+            max_top_k = self.config.max_top_k
 
-            def fn(params, toks, pos, k_pool, v_pool):
+            def fn(params, toks, pos, flags, temps, topks, seeds, k_pool, v_pool):
                 # per-slot pos: slot-indexed cache write + position mask
                 # (ops/transformer/inference.py), auto-clipped position ids
                 logits, k_pool, v_pool = forward_with_cache(
                     params, toks[:, None], k_pool, v_pool, pos, icfg
                 )
-                nxt = jnp.argmax(
-                    logits[:, -1].astype(jnp.float32), axis=-1
-                ).astype(jnp.int32)
+                # per-(request seed, position) keys: reproducible per
+                # request regardless of slot assignment or pool churn
+                keys = jax.vmap(
+                    lambda s, p: jax.random.fold_in(jax.random.PRNGKey(s), p)
+                )(seeds, pos)
+                nxt = sample_logits_pooled(
+                    logits[:, -1].astype(jnp.float32), keys, flags, temps, topks,
+                    max_top_k,
+                )
                 return nxt, k_pool, v_pool
 
             self._decode_fn = self._wrap(
-                jax.jit(self.engine._scoped(fn), donate_argnums=(3, 4)),
+                jax.jit(self.engine._scoped(fn), donate_argnums=(7, 8)),
                 "serving.decode",
             )
             self.decode_compiles += 1
@@ -210,10 +234,26 @@ class ServingEngine:
         max_new_tokens: Optional[int] = None,
         eos_token_id: Optional[int] = None,
         deadline_seconds: Optional[float] = None,
+        do_sample: bool = False,
+        temperature: float = 1.0,
+        top_k: int = 0,
+        seed: int = 0,
     ) -> int:
         """Enqueue one request; returns its id.  Raises
         :class:`ServingQueueFull` when the queue is at its bound and
-        ``ValueError`` when the request cannot ever fit the pool."""
+        ``ValueError`` when the request cannot ever fit the pool.
+
+        Sampling is per-request (``do_sample``/``temperature``/``top_k``/
+        ``seed`` become per-slot vectors of the fixed decode signature):
+        tokens are reproducible for a given (seed, position) regardless
+        of slot assignment or what else shares the pool; greedy requests
+        (the default) bit-match solo ``generate(do_sample=False)``."""
+        if do_sample and top_k > self.config.max_top_k:
+            raise ValueError(
+                f"top_k={top_k} exceeds serving.max_top_k={self.config.max_top_k} "
+                "(the static top-k head width of the one compiled decode step); "
+                "raise serving.max_top_k or lower the request's top_k"
+            )
         req = self.scheduler.submit(
             prompt,
             max_new_tokens=(
@@ -221,6 +261,10 @@ class ServingEngine:
             ),
             eos_token_id=eos_token_id,
             deadline_seconds=deadline_seconds,
+            do_sample=do_sample,
+            temperature=temperature,
+            top_k=top_k,
+            seed=seed,
             now=time.monotonic(),
             step=self._step_count,
         )
@@ -267,17 +311,23 @@ class ServingEngine:
     def _run_prefill(self, job: PrefillJob) -> None:
         san = self._sanitizer
         fn = self._get_prefill()
+        r = job.req
         # explicit staging of the host-side chunk + scalars onto the
         # serving mesh (transfer-guard clean: device_put is sanctioned,
         # and pre-placing on the mesh means the jit has nothing to move)
-        toks, slot, pos, take = jax.device_put(
-            (job.tokens[None, :], np.int32(job.req.slot), np.int32(job.start),
-             np.int32(job.take_idx)),
+        toks, slot, pos, take, flag, temp, topk, seed = jax.device_put(
+            (job.tokens[None, :], np.int32(r.slot), np.int32(job.start),
+             np.int32(job.take_idx), np.bool_(r.do_sample),
+             np.float32(r.temperature), np.int32(r.top_k),
+             np.uint32(r.seed & 0xFFFFFFFF)),
             self._replicated,
         )
         guard = san.transfer.guard("serving.prefill") if san is not None else nullcontext()
         with guard:
-            first, k, v = fn(self.engine.params, toks, slot, pos, take, self.pool.k, self.pool.v)
+            first, k, v = fn(
+                self.engine.params, toks, slot, pos, take, flag, temp, topk, seed,
+                self.pool.k, self.pool.v,
+            )
         self.pool.swap(k, v)
         # explicit d2h read doubles as the fence that keeps prefill_ms
         # honest; the value is the first generated token on final chunks
@@ -287,10 +337,16 @@ class ServingEngine:
     def _run_decode(self, toks: np.ndarray, pos: np.ndarray, decoding) -> None:
         san = self._sanitizer
         fn = self._get_decode()
-        toks_d, pos_d = jax.device_put((toks, pos), self._replicated)
+        flags, temps, topks, seeds = self.scheduler.sampling_inputs()
+        toks_d, pos_d, fl_d, t_d, k_d, s_d = jax.device_put(
+            (toks, pos, flags, temps, topks, seeds), self._replicated
+        )
         guard = san.transfer.guard("serving.decode") if san is not None else nullcontext()
         with guard:
-            nxt, k, v = fn(self.engine.params, toks_d, pos_d, self.pool.k, self.pool.v)
+            nxt, k, v = fn(
+                self.engine.params, toks_d, pos_d, fl_d, t_d, k_d, s_d,
+                self.pool.k, self.pool.v,
+            )
         self.pool.swap(k, v)
         out = np.asarray(jax.device_get(nxt))
         now = time.monotonic()

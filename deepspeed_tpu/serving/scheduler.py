@@ -53,6 +53,12 @@ class Request:
     max_new_tokens: int
     eos_token_id: Optional[int] = None
     deadline_seconds: Optional[float] = None  # queue-wait bound; None = scheduler default
+    # per-request sampling params (ride the fixed decode signature as
+    # per-slot vectors; greedy when do_sample is False)
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_k: int = 0
+    seed: int = 0
 
     status: str = QUEUED
     slot: Optional[int] = None
@@ -163,12 +169,20 @@ class ContinuousScheduler:
         deadline_seconds: Optional[float] = None,
         now: float = 0.0,
         step: int = 0,
+        do_sample: bool = False,
+        temperature: float = 1.0,
+        top_k: int = 0,
+        seed: int = 0,
     ) -> Request:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.shape[0] < 1:
             raise ValueError("prompt must contain at least one token")
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if do_sample and temperature <= 0.0:
+            raise ValueError(f"temperature must be > 0 when sampling, got {temperature}")
+        if top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
         total = prompt.shape[0] + int(max_new_tokens)
         if total > self.capacity:
             raise ValueError(
@@ -188,6 +202,10 @@ class ContinuousScheduler:
             max_new_tokens=int(max_new_tokens),
             eos_token_id=eos_token_id,
             deadline_seconds=deadline_seconds,
+            do_sample=bool(do_sample),
+            temperature=float(temperature),
+            top_k=int(top_k),
+            seed=int(seed),
             submit_time=now,
             submit_step=step,
         )
@@ -294,6 +312,25 @@ class ContinuousScheduler:
             else:  # mid-prefill: next chunk overwrites this position
                 pos[slot] = r.prefill_pos
         return toks, pos, decoding
+
+    def sampling_inputs(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Fixed-shape per-slot sampling vectors (ride the same decode
+        signature every step): do_sample flags, temperatures, top-k
+        bounds, and seeds.  Non-active / non-sampling slots keep the
+        greedy defaults — their computed token is either discarded
+        (non-decoding) or the bare argmax (the solo-``generate()``
+        bit-match path)."""
+        S = self.pool.num_slots
+        flags = np.zeros((S,), bool)
+        temps = np.ones((S,), np.float32)
+        topks = np.zeros((S,), np.int32)
+        seeds = np.zeros((S,), np.uint32)
+        for slot, r in self._active.items():
+            flags[slot] = r.do_sample
+            temps[slot] = np.float32(r.temperature)
+            topks[slot] = np.int32(r.top_k)
+            seeds[slot] = np.uint32(r.seed & 0xFFFFFFFF)
+        return flags, temps, topks, seeds
 
     def note_decode(self, tokens_by_slot: Dict[int, int], now: float, step: int) -> None:
         """Append this step's token per decoding slot; retire at EOS or

@@ -1,0 +1,77 @@
+"""Partition-rule engine: the single home of PartitionSpec construction.
+
+Every engine in the zoo (DeepSpeedEngine, PipelineEngine,
+ZeroInfinityEngine, InferenceEngine, ServingEngine) resolves its
+parameter/batch/state layouts through this package instead of
+hand-building ``jax.sharding.PartitionSpec`` literals — the convergence
+the reference's per-subsystem partitioners (Megatron mpu, ZeRO
+partition_parameters.py, module_inject/replace_module.py) never had.
+The ds_lint rule ``hand-built-partition-spec`` enforces the seam.
+
+Layers (docs/sharding.md):
+
+* :mod:`~deepspeed_tpu.sharding.layout` — :class:`SpecLayout`, the
+  canonical axis names + batch/row/replicated spec constructors.
+* :mod:`~deepspeed_tpu.sharding.rules` — the ordered regex rule table
+  (fmengine ``match_partition_rules`` / T5X logical-axes style) with
+  built-in gpt2/bert/neo/MoE family rule sets.
+* :mod:`~deepspeed_tpu.sharding.mesh` — ``build_mesh()`` device-topology
+  mesh derivation incl. 2-level hybrid ICI×DCN meshes, and the
+  :class:`MeshTopology` descriptor the comm policy table keys on.
+* :mod:`~deepspeed_tpu.sharding.update` — cross-replica weight-update
+  sharding (arXiv:2004.13336, the XLA-native ZeRO-1): axis-placement
+  primitives and the update-phase byte/FLOP model.
+"""
+from deepspeed_tpu.sharding.layout import (
+    SpecLayout,
+    batch_pspec,
+    batch_sharding,
+    dp_rows_spec,
+    fsdp_trailing_spec,
+    replicated_pspec,
+    replicated_sharding,
+    stacked_batch_pspec,
+    stacked_micro_batch_pspec,
+)
+from deepspeed_tpu.sharding.mesh import (
+    MeshTopology,
+    build_mesh,
+    derive_topology,
+)
+from deepspeed_tpu.sharding.rules import (
+    PartitionRules,
+    match_partition_rules,
+    moe_param_specs,
+    rules_for_config,
+    rules_for_family,
+)
+from deepspeed_tpu.sharding.update import (
+    add_mesh_axis,
+    add_update_axis,
+    spec_tuple,
+    weight_update_model,
+)
+
+__all__ = [
+    "SpecLayout",
+    "batch_pspec",
+    "batch_sharding",
+    "dp_rows_spec",
+    "fsdp_trailing_spec",
+    "replicated_pspec",
+    "replicated_sharding",
+    "stacked_batch_pspec",
+    "stacked_micro_batch_pspec",
+    "MeshTopology",
+    "build_mesh",
+    "derive_topology",
+    "PartitionRules",
+    "match_partition_rules",
+    "moe_param_specs",
+    "rules_for_config",
+    "rules_for_family",
+    "add_mesh_axis",
+    "add_update_axis",
+    "spec_tuple",
+    "weight_update_model",
+]

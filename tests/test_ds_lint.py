@@ -51,6 +51,7 @@ def test_rule_catalog_shape():
         "unfenced-timing",  # PR 3 overlap tier-C rule
         "unguarded-collective-barrier",  # PR 5 supervision tier-B rule
         "raw-collective-outside-comm-layer",  # PR 6 comm-layer tier-B rule
+        "hand-built-partition-spec",  # PR 8 partition-rule-engine tier-B rule
     ):
         assert rid in rules, rid
 
@@ -633,6 +634,108 @@ class TestSharding:
             name="models/layer.py",
         )
         assert res.findings == []
+
+    def test_rule_engine_constructor_counts_as_marker(self, tmp_path):
+        # a layout resolved through the partition-rule engine is pinned:
+        # compressed.py-style exchanges routed via dp_rows_spec are clean
+        res = lint_src(
+            tmp_path,
+            """
+            import jax
+            from deepspeed_tpu.sharding.layout import dp_rows_spec
+
+            def exchange(x, axis):
+                rows = dp_rows_spec(axis)
+                return jax.lax.psum(x, axis), rows
+            """,
+            "missing-sharding-constraint",
+            name="comm/exchange.py",
+        )
+        assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# hand-built-partition-spec (tier B, PR 8 partition-rule engine)
+# ---------------------------------------------------------------------------
+
+
+class TestHandBuiltSpec:
+    def test_flags_axis_literal_specs(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            from jax.sharding import PartitionSpec as P
+            from jax.sharding import PartitionSpec
+
+            BATCH = P(("data", "fsdp"))
+            STACKED = PartitionSpec("pipe", None, "model")
+
+            def batch_spec(ndim):
+                return P("data", *([None] * (ndim - 1)))
+            """,
+            "hand-built-partition-spec",
+            name="runtime/custom_engine.py",
+        )
+        assert rule_ids(res) == ["hand-built-partition-spec"] * 3
+        assert all(f.severity == Severity.B for f in res.findings)
+        assert "partition-rule engine" in res.findings[0].message
+
+    def test_sharding_package_and_plumbing_are_clean(self, tmp_path):
+        # the rule engine itself is the sanctioned home; replicated specs
+        # and variable-axis plumbing (spec manipulation code) don't match
+        res = lint_src(
+            tmp_path,
+            """
+            from jax.sharding import PartitionSpec as P
+
+            REPL = P()
+            PADDED = P(None, None)
+
+            def rows(axis_name):
+                return P(axis_name)
+
+            def shift(base):
+                return P(None, *tuple(base))
+            """,
+            "hand-built-partition-spec",
+            name="runtime/plumbing.py",
+        )
+        assert res.findings == []
+        res2 = lint_src(
+            tmp_path,
+            """
+            from jax.sharding import PartitionSpec as P
+
+            def vocab_embedding():
+                return P("model", None)
+            """,
+            "hand-built-partition-spec",
+            name="deepspeed_tpu/sharding/layout2.py",
+        )
+        assert res2.findings == []
+
+    def test_engine_zoo_has_no_hand_built_specs(self):
+        # the acceptance seam: every engine resolves through sharding/;
+        # zero CURRENT findings and zero GRANDFATHERED entries repo-wide
+        res = lint_paths(
+            [os.path.join(REPO_ROOT, "deepspeed_tpu")],
+            select=["hand-built-partition-spec"],
+            use_baseline=False,
+        )
+        assert res.findings == [], [
+            f"{f.path}:{f.line}" for f in res.findings
+        ]
+
+    def test_baseline_shrank_not_grew(self):
+        # PR 8 satellite: rule-engine adoption retired the grandfathered
+        # missing-sharding-constraint entries — the checked-in baseline
+        # must stay at or below the post-adoption count (18; was 21)
+        with open(os.path.join(REPO_ROOT, ".ds_lint_baseline.json")) as f:
+            entries = json.load(f)["findings"]
+        assert len(entries) <= 18
+        rules_present = {e["rule"] for e in entries}
+        assert "missing-sharding-constraint" not in rules_present
+        assert "hand-built-partition-spec" not in rules_present
 
 
 # ---------------------------------------------------------------------------

@@ -364,3 +364,74 @@ def test_external_cache_entry_points_match_generate():
     # write to the last position forever
     with pytest.raises(ValueError, match=rf"pos={T + N} \+ T=1 exceeds"):
         eng.decode_step(np.asarray([[tok]], np.int32), k, v, T + N)
+
+
+# ---------------------------------------------------------------------------
+# per-slot sampling (temperature / top-k / seed) in the pooled decode step
+# ---------------------------------------------------------------------------
+
+def test_sampling_reproducible_across_slot_churn():
+    """A sampled request's tokens depend only on (seed, position) — the
+    same request must reproduce its output exactly when the pool is
+    busy with different neighbors and the slot assignment differs."""
+    eng = _engine()
+
+    def run(extra_first):
+        srv = ServingEngine(eng, num_slots=2, prefill_chunk=8, max_len=64)
+        prompts = _prompts(3, 4, 10, seed=5)
+        rids = {}
+        if extra_first:
+            # occupy slot 0 with a greedy request so the sampled one
+            # lands in a different slot than in the other run
+            rids["g"] = srv.submit(prompts[1], max_new_tokens=6)
+            srv.step()
+        rids["s"] = srv.submit(
+            prompts[0], max_new_tokens=8, do_sample=True, temperature=0.9,
+            top_k=16, seed=123,
+        )
+        res = srv.drain(max_steps=300)
+        return res[rids["s"]].tokens()
+
+    a = run(False)
+    b = run(True)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_mixed_pool_greedy_still_bit_matches_solo():
+    """Greedy requests must bit-match solo generate() even while a
+    sampling request shares the pool (flags select per slot)."""
+    eng = _engine(seed=11)
+    srv = ServingEngine(eng, num_slots=2, prefill_chunk=8, max_len=64)
+    prompts = _prompts(2, 4, 12, seed=6)
+    r_greedy = srv.submit(prompts[0], max_new_tokens=6)
+    r_samp = srv.submit(
+        prompts[1], max_new_tokens=6, do_sample=True, temperature=1.3, top_k=8, seed=77
+    )
+    res = srv.drain(max_steps=300)
+    np.testing.assert_array_equal(res[r_greedy].tokens(), _solo(eng, prompts[0], 6))
+    assert len(res[r_samp].generated) == 6
+    # the one-decode-executable contract survives the sampling inputs
+    assert srv.decode_compiles == 1 and srv.prefill_compiles == 1
+
+
+def test_top_k_one_equals_greedy():
+    """top_k=1 leaves only the argmax above the threshold — sampling
+    with any temperature must then produce the greedy tokens."""
+    eng = _engine(seed=3)
+    srv = ServingEngine(eng, num_slots=2, prefill_chunk=8, max_len=64)
+    p = _prompts(1, 5, 9, seed=8)[0]
+    rid = srv.submit(p, max_new_tokens=6, do_sample=True, temperature=2.5, top_k=1, seed=9)
+    res = srv.drain(max_steps=200)
+    np.testing.assert_array_equal(res[rid].tokens(), _solo(eng, p, 6))
+
+
+def test_sampling_validation():
+    eng = _engine()
+    srv = ServingEngine(eng, num_slots=1, prefill_chunk=8, max_len=32, max_top_k=16)
+    p = _prompts(1, 4, 6, seed=2)[0]
+    with pytest.raises(ValueError, match="max_top_k"):
+        srv.submit(p, max_new_tokens=2, do_sample=True, top_k=17)
+    with pytest.raises(ValueError, match="temperature"):
+        srv.submit(p, max_new_tokens=2, do_sample=True, temperature=0.0)
+    with pytest.raises(DeepSpeedConfigError, match="max_top_k"):
+        ServingEngine(eng, num_slots=1, prefill_chunk=8, max_len=32, max_top_k=0)
