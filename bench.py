@@ -65,11 +65,12 @@ def append_capability_record(rec: dict) -> None:
 
 
 def peak_flops_per_chip(backend: str) -> float:
-    """bf16 peak. v5e: 197 TFLOP/s. CPU fallback: nominal 1e12 so the
-    script still reports a number in dev environments."""
-    if backend in ("tpu", "axon"):
-        return 197e12
-    return 1e12
+    """bf16 peak per chip — the ONE table in
+    profiling.flops_profiler.PEAK_TFLOPS_BY_PLATFORM, so the analytic
+    MFU here and the telemetry gauge's share a denominator."""
+    from deepspeed_tpu.profiling.flops_profiler import peak_flops
+
+    return peak_flops("tpu" if backend in ("tpu", "axon") else backend)
 
 
 # ---------------------------------------------------------------------------
@@ -211,6 +212,7 @@ def bench_model(cfg, micro_bs, gas, seq, steps, zero_stage, label, opt_params=No
         log(f"[{label}] WARNING: ds_san is armed — timings include sanitizer overhead")
 
     comm = engine.comm_summary()
+    tel = engine.telemetry.summary() if getattr(engine, "telemetry", None) is not None else {}
     tokens_per_sec_chip = global_bs * seq / dt / n_dev
     # Training FLOPs/token ≈ 6*N + 12*L*D*seq (attention term)
     n_params = cfg.num_params()
@@ -218,7 +220,8 @@ def bench_model(cfg, micro_bs, gas, seq, steps, zero_stage, label, opt_params=No
     mfu = tokens_per_sec_chip * flops_per_token / peak_flops_per_chip(backend)
     log(
         f"[{label}] step={dt*1000:.1f}ms tokens/s/chip={tokens_per_sec_chip:,.0f} "
-        f"model={n_params/1e6:.0f}M seq={seq} zero={zero_stage} MFU={mfu*100:.1f}%"
+        f"model={n_params/1e6:.0f}M seq={seq} zero={zero_stage} MFU={mfu*100:.1f}% "
+        f"(telemetry gauge: {tel.get('mfu')})"
     )
     return {
         "metric": f"gpt2_{n_params//1_000_000}M_zero{zero_stage}_train_tokens_per_sec_per_chip",
@@ -235,6 +238,13 @@ def bench_model(cfg, micro_bs, gas, seq, steps, zero_stage, label, opt_params=No
         # per-step comm-bytes model
         "comm_strategy": comm["strategy"],
         "comm_bytes_per_step": comm["grad_exchange_bytes"],
+        # telemetry plane (docs/telemetry.md): the live compiled-cost
+        # MFU gauge (NB the scan caveat: truthful when the layer loop is
+        # unrolled, as the headline rung's config is), HBM bytes/step
+        # from the executable's cost analysis, and the snapshot digest
+        "mfu": tel.get("mfu"),
+        "hbm_bytes_per_step": tel.get("hbm_bytes_per_step"),
+        "telemetry": tel.get("telemetry"),
         "micro_bs": micro_bs,
         "gas": gas,
         "seq": seq,
@@ -323,6 +333,7 @@ def bench_bert(seq: int, micro_bs: int, gas: int, steps: int):
 
     dt, phases = _timed_steps(engine, batches, steps, label)
     comm = engine.comm_summary()
+    tel = engine.telemetry.summary() if getattr(engine, "telemetry", None) is not None else {}
     samples_s = global_bs / dt / n_dev
     n_params = cfg.num_params()
     flops_per_token = 6 * n_params + 12 * cfg.num_hidden_layers * cfg.hidden_size * seq
@@ -341,6 +352,9 @@ def bench_bert(seq: int, micro_bs: int, gas: int, steps: int):
         "ckpt_stall_ms": phases.get("ckpt_stall_ms", 0.0),
         "comm_strategy": comm["strategy"],
         "comm_bytes_per_step": comm["grad_exchange_bytes"],
+        "mfu": tel.get("mfu"),
+        "hbm_bytes_per_step": tel.get("hbm_bytes_per_step"),
+        "telemetry": tel.get("telemetry"),
         "micro_bs": micro_bs,
         "gas": gas,
         "seq": seq,

@@ -10,6 +10,7 @@ from deepspeed_tpu.analysis.rules import (  # noqa: F401
     jit_hygiene,
     prng,
     raw_collective,
+    raw_metric,
     sharding,
     side_effects,
     static_args,

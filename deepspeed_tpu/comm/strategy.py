@@ -249,6 +249,7 @@ class CommLayer:
             link=self._axis_link(axes),
         )
         self.decisions[site] = d
+        self._publish_decision(site, d.strategy)
         if d.strategy == STRATEGY_DENSE and self.config.strategy in (STRATEGY_INT8, STRATEGY_ONEBIT):
             logger.info(f"comm: site '{site}' stays dense ({d.reason})")
         return d.strategy
@@ -257,6 +258,21 @@ class CommLayer:
         """Record a decision made elsewhere (e.g. the engine's blocker
         fallbacks, or the 1-bit optimizer's momentum exchange)."""
         self.decisions[site] = Decision(strategy, reason)
+        self._publish_decision(site, strategy)
+
+    def _publish_decision(self, site: str, strategy: str) -> None:
+        """Per-site strategy decisions into the telemetry registry +
+        trace (docs/telemetry.md).  Trace-time only — decisions happen
+        at engine build / first lowering, never per step."""
+        from deepspeed_tpu.telemetry import get_registry, get_tracer
+
+        reg = get_registry()
+        if reg.enabled:
+            reg.counter("comm/decisions", site=site, strategy=strategy).inc()
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.add_instant("comm_decision", "comm",
+                               args={"site": site, "strategy": strategy})
 
     # -- dense (GSPMD) grad path ---------------------------------------
     def constrain_grads(self, grads, shardings, site: str = "grad-exchange"):

@@ -18,7 +18,7 @@ import dataclasses
 import difflib
 import json
 from dataclasses import dataclass, field
-from typing import Any, Dict, Iterable, List, Optional
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 from deepspeed_tpu.config import constants as C
 
@@ -972,6 +972,99 @@ class FlopsProfilerConfig:
 
 
 @dataclass
+class TelemetryConfig:
+    """``telemetry`` block (TPU-native extension; docs/telemetry.md):
+    the unified observability plane.  ``enabled`` arms the in-process
+    metrics registry (host dict updates only — measured <1% steps/s;
+    docs/telemetry.md overhead table); ``exporters`` turn on background
+    sinks (``jsonl`` | ``prometheus`` | ``tensorboard``) flushing every
+    ``export_interval_seconds`` off the hot path; ``trace`` records
+    Chrome-trace spans (StepTimeline phases, checkpoint writer, serving
+    request lifecycles) exported to ``trace_path``; ``profiler_dir``
+    enables the programmatic ``jax.profiler`` window capture
+    (on demand, or on the first serving TTFT above
+    ``slo_ttft_breach_ms``); ``aggregate`` piggybacks compact metric
+    snapshots on the supervision heartbeat so rank 0 exports cluster
+    min/mean/max with dead-rank flags in the same stream."""
+
+    enabled: bool = C.TELEMETRY_ENABLED_DEFAULT
+    ring: int = C.TELEMETRY_RING_DEFAULT
+    exporters: Tuple[str, ...] = ()
+    export_interval_seconds: float = C.TELEMETRY_EXPORT_INTERVAL_DEFAULT
+    output_path: str = C.TELEMETRY_OUTPUT_PATH_DEFAULT
+    trace: bool = C.TELEMETRY_TRACE_ENABLED_DEFAULT
+    trace_path: str = ""  # "" = <output_path>/trace.json
+    trace_buffer_events: int = C.TELEMETRY_TRACE_BUFFER_DEFAULT
+    profiler_dir: str = ""
+    profiler_capture_ms: int = C.TELEMETRY_PROFILER_CAPTURE_MS_DEFAULT
+    slo_ttft_breach_ms: float = C.TELEMETRY_SLO_TTFT_BREACH_MS_DEFAULT
+    aggregate: bool = C.TELEMETRY_AGGREGATE_DEFAULT
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "TelemetryConfig":
+        if d is None:
+            return cls()
+        d = dict(d)
+        raw_exp = _pop(d, "exporters", ())
+        if isinstance(raw_exp, str):
+            raw_exp = [raw_exp]
+        out = cls(
+            enabled=bool(_pop(d, "enabled", C.TELEMETRY_ENABLED_DEFAULT)),
+            ring=int(_pop(d, "ring", C.TELEMETRY_RING_DEFAULT)),
+            exporters=tuple(str(e).lower() for e in raw_exp),
+            export_interval_seconds=float(
+                _pop(d, "export_interval_seconds", C.TELEMETRY_EXPORT_INTERVAL_DEFAULT)
+            ),
+            output_path=str(_pop(d, C.TELEMETRY_OUTPUT_PATH, C.TELEMETRY_OUTPUT_PATH_DEFAULT)),
+            trace=bool(_pop(d, "trace", C.TELEMETRY_TRACE_ENABLED_DEFAULT)),
+            trace_path=str(_pop(d, "trace_path", "")),
+            trace_buffer_events=int(
+                _pop(d, "trace_buffer_events", C.TELEMETRY_TRACE_BUFFER_DEFAULT)
+            ),
+            profiler_dir=str(_pop(d, "profiler_dir", "")),
+            profiler_capture_ms=int(
+                _pop(d, "profiler_capture_ms", C.TELEMETRY_PROFILER_CAPTURE_MS_DEFAULT)
+            ),
+            slo_ttft_breach_ms=float(
+                _pop(d, "slo_ttft_breach_ms", C.TELEMETRY_SLO_TTFT_BREACH_MS_DEFAULT)
+            ),
+            aggregate=bool(_pop(d, "aggregate", C.TELEMETRY_AGGREGATE_DEFAULT)),
+        )
+        _check_empty(d, C.TELEMETRY, _known_keys(cls))
+        unknown = set(out.exporters) - set(C.TELEMETRY_EXPORTERS)
+        if unknown:
+            raise DeepSpeedConfigError(
+                f"'{C.TELEMETRY}.exporters' must be a subset of "
+                f"{C.TELEMETRY_EXPORTERS}, got {sorted(unknown)}"
+            )
+        if out.ring < 16:
+            raise DeepSpeedConfigError(
+                f"'{C.TELEMETRY}.ring' must be >= 16, got {out.ring}"
+            )
+        if out.export_interval_seconds <= 0:
+            raise DeepSpeedConfigError(
+                f"'{C.TELEMETRY}.export_interval_seconds' must be > 0, "
+                f"got {out.export_interval_seconds}"
+            )
+        if out.trace_buffer_events < 1000:
+            raise DeepSpeedConfigError(
+                f"'{C.TELEMETRY}.trace_buffer_events' must be >= 1000, "
+                f"got {out.trace_buffer_events}"
+            )
+        if out.profiler_capture_ms <= 0:
+            raise DeepSpeedConfigError(
+                f"'{C.TELEMETRY}.profiler_capture_ms' must be > 0, "
+                f"got {out.profiler_capture_ms}"
+            )
+        if out.slo_ttft_breach_ms < 0:
+            raise DeepSpeedConfigError(
+                f"'{C.TELEMETRY}.slo_ttft_breach_ms' must be >= 0, "
+                f"got {out.slo_ttft_breach_ms}"
+            )
+        return out
+
+
+@dataclass
 class TensorboardConfig:
     enabled: bool = C.TENSORBOARD_ENABLED_DEFAULT
     output_path: str = C.TENSORBOARD_OUTPUT_PATH_DEFAULT
@@ -1166,6 +1259,7 @@ _KNOWN_TOP_LEVEL = {
     C.SANITIZER,
     C.COMM,
     C.SERVING,
+    C.TELEMETRY,
     "activation_checkpointing",
     "flops_profiler",
     "aio",
@@ -1230,6 +1324,7 @@ class DeepSpeedConfig:
         self.sanitizer = SanitizerConfig.from_dict(d.get(C.SANITIZER))
         self.comm = CommConfig.from_dict(d.get(C.COMM))
         self.serving = ServingConfig.from_dict(d.get(C.SERVING))
+        self.telemetry = TelemetryConfig.from_dict(d.get(C.TELEMETRY))
         self.elasticity_dict = d.get("elasticity")
 
         self.gradient_clipping = float(d.get(C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT))

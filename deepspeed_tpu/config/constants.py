@@ -254,6 +254,22 @@ SERVING_DEADLINE_SECONDS_DEFAULT = 0.0  # 0 = no queue-wait deadline
 SERVING_MAX_TOP_K_DEFAULT = 64
 
 #############################################
+# Telemetry (unified metrics registry / trace export; docs/telemetry.md)
+#############################################
+TELEMETRY = "telemetry"
+TELEMETRY_ENABLED_DEFAULT = True  # in-process registry only; no sinks by default
+TELEMETRY_RING_DEFAULT = 1024  # per-metric ring-buffer samples
+TELEMETRY_EXPORTERS = ["jsonl", "prometheus", "tensorboard"]
+TELEMETRY_EXPORT_INTERVAL_DEFAULT = 10.0  # seconds between sink flushes
+TELEMETRY_OUTPUT_PATH = "output_path"
+TELEMETRY_OUTPUT_PATH_DEFAULT = ""  # "" = ./telemetry when a sink needs a path
+TELEMETRY_TRACE_ENABLED_DEFAULT = False  # Chrome-trace span buffer
+TELEMETRY_TRACE_BUFFER_DEFAULT = 100_000  # span ring-buffer events
+TELEMETRY_PROFILER_CAPTURE_MS_DEFAULT = 2000  # jax.profiler window length
+TELEMETRY_SLO_TTFT_BREACH_MS_DEFAULT = 0.0  # 0 = no on-breach capture
+TELEMETRY_AGGREGATE_DEFAULT = True  # piggyback snapshots on supervision beats
+
+#############################################
 # Sanitizer (ds_san: trace-time & runtime checkers; docs/ds_san.md)
 #############################################
 SANITIZER = "sanitizer"

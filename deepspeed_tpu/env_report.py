@@ -410,6 +410,71 @@ def serving_report(config=None) -> None:
         print(f"{name} " + "." * (30 - len(name)) + f" {value}")
 
 
+def telemetry_report(config=None) -> None:
+    """Telemetry-plane rows (docs/telemetry.md): enabled sinks and
+    cadence from the config, plus the LIVE process plane (registry
+    size, last export age, trace state) when one is armed."""
+    from deepspeed_tpu import telemetry as tel
+    from deepspeed_tpu.config.config import TelemetryConfig
+
+    t = getattr(config, "telemetry", config)
+    if t is None or not hasattr(t, "exporters"):
+        t = TelemetryConfig()
+    live = tel.status()
+    print()
+    print("telemetry configuration:")
+    age = live["last_export_age_seconds"]
+    rows = [
+        (
+            "metrics registry",
+            f"enabled (ring {t.ring} samples/metric)"
+            if t.enabled
+            else "disabled (zero-overhead: no publishes anywhere)",
+        ),
+        (
+            "exporters",
+            ", ".join(t.exporters) + f" every {t.export_interval_seconds:g}s"
+            if t.exporters
+            else "none configured (jsonl | prometheus | tensorboard)",
+        ),
+        (
+            "trace (Perfetto)",
+            f"enabled ({t.trace_buffer_events} event ring -> "
+            f"{t.trace_path or '<output_path>/trace.json'})"
+            if t.trace
+            else "disabled",
+        ),
+        (
+            "cross-rank aggregation",
+            "piggybacks on supervision beats (min/mean/max + dead-rank flags)"
+            if t.aggregate and t.enabled
+            else "off",
+        ),
+        (
+            "live registry",
+            f"{live['registry_size']} metric(s), rank {live['rank']}"
+            if live["enabled"]
+            else "not armed in this process",
+        ),
+        (
+            "last export",
+            "never"
+            if live["sinks"] and age is None
+            else (f"{age:.1f}s ago ({live['exports']} total)" if age is not None
+                  else "n/a (no sinks armed)"),
+        ),
+        (
+            "profiler capture",
+            f"dir {t.profiler_dir}, {t.profiler_capture_ms}ms window"
+            + (f", on TTFT > {t.slo_ttft_breach_ms:g}ms" if t.slo_ttft_breach_ms else " (on-demand)")
+            if t.profiler_dir
+            else "off (set telemetry.profiler_dir)",
+        ),
+    ]
+    for name, value in rows:
+        print(f"{name} " + "." * (30 - len(name)) + f" {value}")
+
+
 def cli_main() -> int:
     ok = op_report()
     debug_report()
@@ -419,6 +484,7 @@ def cli_main() -> int:
     comm_report()
     sharding_report()
     serving_report()
+    telemetry_report()
     return 0 if ok else 1
 
 

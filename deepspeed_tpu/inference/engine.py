@@ -647,4 +647,16 @@ class InferenceEngine:
             self._compiled[key] = self._build_generate(
                 B, T, max_new_tokens, do_sample, temperature, top_k, eos_token_id, masked=masked
             )
-        return self._compiled[key](self.params, input_ids, jax.random.PRNGKey(seed), attention_mask)
+        # telemetry (docs/telemetry.md): closed-generate calls count
+        # tokens dispatched; no fence is added — the span measures the
+        # host call window, the caller owns the sync
+        from deepspeed_tpu.telemetry import get_registry, get_tracer
+
+        reg, tracer = get_registry(), get_tracer()
+        if reg.enabled:
+            reg.counter("inference/generate_calls", engine="inference").inc()
+            reg.counter("inference/tokens_requested", engine="inference").inc(B * max_new_tokens)
+        with tracer.span("generate", "inference",
+                         args={"batch": B, "prompt_len": T,
+                               "max_new_tokens": max_new_tokens}):
+            return self._compiled[key](self.params, input_ids, jax.random.PRNGKey(seed), attention_mask)

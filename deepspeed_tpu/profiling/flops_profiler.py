@@ -47,6 +47,53 @@ def _num_params(tree: Any) -> int:
     return sum(int(np.prod(np.shape(p))) for p in jax.tree.leaves(tree))
 
 
+def cost_bytes(cost: Optional[Dict[str, float]]) -> float:
+    """HBM bytes from a ``cost_analysis()`` dict — one home for the
+    'bytes accessed' vs 'bytes_accessed' key-spelling difference across
+    jaxlib versions."""
+    cost = cost or {}
+    return float(cost.get("bytes accessed", cost.get("bytes_accessed", 0.0)))
+
+
+def peak_flops(backend: Optional[str] = None, n_devices: int = 1) -> float:
+    """bf16 peak FLOP/s for the MFU denominator.  Defaults to ONE
+    chip's peak: XLA ``cost_analysis()`` reports the *partitioned*
+    (per-device) module, so per-device flops over per-chip peak is the
+    correct MFU (verified against the analytic 6N+attention count on
+    the 8-device dryrun, within 10%; tests/test_telemetry.py pins it)."""
+    backend = backend or jax.default_backend()
+    return PEAK_TFLOPS_BY_PLATFORM.get(backend, 100.0) * 1e12 * max(1, int(n_devices))
+
+
+def derive_step_stats(
+    cost: Optional[Dict[str, float]],
+    wall_s: float,
+    backend: Optional[str] = None,
+) -> Dict[str, float]:
+    """The one MFU/HBM derivation (shared by the profiler, the engine's
+    telemetry gauges, and bench records): compiled-cost FLOPs and bytes
+    over a measured step wall against the PER-CHIP peak.
+
+    ``cost`` is the executable's ``cost_analysis()`` dict — the
+    **per-device** flops/bytes of the GSPMD-partitioned module, which is
+    why the denominator is one chip's peak.  NB the module-level scan
+    caveat applies: a ``lax.scan`` body is counted ONCE — profile with
+    the scan unrolled (bench.py's headline config does) for truthful
+    absolute numbers."""
+    cost = cost or {}
+    flops = float(cost.get("flops", 0.0))
+    hbm = cost_bytes(cost)
+    peak = peak_flops(backend)
+    achieved = flops / wall_s if wall_s and wall_s > 0 else float("nan")
+    return {
+        "flops_per_step": flops,
+        "hbm_bytes_per_step": hbm,
+        "achieved_flops": achieved,
+        "mfu": achieved / peak if peak else float("nan"),
+        "hbm_gbps": hbm / wall_s / 1e9 if wall_s and wall_s > 0 else float("nan"),
+    }
+
+
 def _fmt(n: float, unit: str = "") -> str:
     for scale, suffix in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "K")):
         if abs(n) >= scale:
@@ -65,7 +112,7 @@ def analyze_fn(fn: Callable, *args, static_argnums=()) -> Dict[str, float]:
     mem = compiled.memory_analysis()
     out = {
         "flops": float(cost.get("flops", 0.0)),
-        "bytes_accessed": float(cost.get("bytes accessed", cost.get("bytes_accessed", 0.0))),
+        "bytes_accessed": cost_bytes(cost),
         "peak_memory_bytes": float(getattr(mem, "temp_size_in_bytes", 0) or 0)
         + float(getattr(mem, "argument_size_in_bytes", 0) or 0),
     }
@@ -124,41 +171,76 @@ class FlopsProfiler:
             self._t0 = time.perf_counter()
 
     def end_step(self, step: int, cost: Optional[Dict[str, float]] = None, sync_token=None) -> None:
-        """``cost``: the train step's XLA cost analysis, captured by the
-        engine when it AOT-compiled the step — no recompile happens here."""
+        """Consume the compiled step's XLA cost analysis (captured by
+        the engine at AOT-compile time — no recompile happens here):
+        FLOPs *and* HBM bytes over the fenced latency, via the shared
+        :func:`derive_step_stats` derivation.  Results land in
+        ``self.results`` and, when the telemetry plane is armed, as
+        ``profile/*`` registry gauges."""
         if not (self.enabled and step == self.cfg.profile_step):
             return
         if sync_token is not None:
             jax.block_until_ready(sync_token)
         elapsed = time.perf_counter() - self._t0 if self._t0 else float("nan")
-        flops = float(cost.get("flops", float("nan"))) if cost else float("nan")
-        n_dev = jax.device_count()
-        peak = PEAK_TFLOPS_BY_PLATFORM.get(jax.default_backend(), 100.0) * 1e12 * n_dev
-        achieved = flops / elapsed if elapsed and elapsed > 0 else float("nan")
-        self.results = {
-            "step": step,
-            "flops_per_step": flops,
-            "latency_s": elapsed,
-            "achieved_flops": achieved,
-            "mfu": achieved / peak if peak else float("nan"),
-        }
+        stats = derive_step_stats(cost, elapsed)
+        self.results = {"step": step, "latency_s": elapsed, **stats}
         params = _num_params(self.engine.state["params"]) if self.engine is not None else 0
+        self.results["params"] = params
+        from deepspeed_tpu.telemetry import get_registry
+
+        reg = get_registry()
+        if reg.enabled:
+            for key in ("flops_per_step", "hbm_bytes_per_step", "mfu", "hbm_gbps"):
+                v = stats[key]
+                if np.isfinite(v):
+                    reg.gauge(f"profile/{key}").set(v)
         log_dist(
             f"flops profiler @ step {step}: params={_fmt(params)} "
-            f"flops/step={_fmt(flops, 'FLOPs')} latency={elapsed * 1e3:.1f}ms "
-            f"achieved={_fmt(achieved, 'FLOPS')} MFU={100 * self.results['mfu']:.1f}%"
+            f"flops/step={_fmt(stats['flops_per_step'], 'FLOPs')} "
+            f"hbm={_fmt(stats['hbm_bytes_per_step'], 'B')} "
+            f"({stats['hbm_gbps']:.1f} GB/s) latency={elapsed * 1e3:.1f}ms "
+            f"achieved={_fmt(stats['achieved_flops'], 'FLOPS')} "
+            f"MFU={100 * stats['mfu']:.1f}%"
         )
+
+
+def _live_bytes_by_device() -> Dict[int, int]:
+    """Per-device live-buffer accounting from ``jax.live_arrays()`` —
+    the real number on backends whose PJRT client exposes no
+    ``memory_stats`` (XLA:CPU, some tunnels): sum of addressable shard
+    bytes per device over every live Array."""
+    out: Dict[int, int] = {}
+    try:
+        arrays = jax.live_arrays()
+    except Exception:  # pragma: no cover - very old jax
+        return out
+    for a in arrays:
+        try:
+            for s in a.addressable_shards:
+                out[s.device.id] = out.get(s.device.id, 0) + int(s.data.nbytes)
+        except Exception:  # noqa: BLE001 — deleted/donated arrays mid-walk
+            continue
+    return out
 
 
 def see_memory_usage(message: str = "", force: bool = True) -> Dict[str, float]:
     """Reference ``see_memory_usage`` (runtime/utils.py:588): device +
-    host memory snapshot, from PJRT memory stats + psutil."""
+    host memory snapshot.  Devices report PJRT ``memory_stats`` where
+    the backend has them (TPU) and fall back to live-``jax.Array``
+    shard accounting (CPU and any stats-less PJRT client) — real
+    numbers on every platform, never silent zeros.  Host side prefers
+    psutil and falls back to ``resource.getrusage`` peak RSS."""
     out: Dict[str, float] = {}
+    live: Optional[Dict[int, int]] = None
     for d in jax.local_devices():
         stats = getattr(d, "memory_stats", lambda: None)()
         if stats:
             out[f"{d.id}/bytes_in_use"] = stats.get("bytes_in_use", 0)
             out[f"{d.id}/peak_bytes_in_use"] = stats.get("peak_bytes_in_use", 0)
+        else:
+            if live is None:
+                live = _live_bytes_by_device()
+            out[f"{d.id}/bytes_in_use"] = live.get(d.id, 0)
     try:
         import psutil
 
@@ -166,9 +248,19 @@ def see_memory_usage(message: str = "", force: bool = True) -> Dict[str, float]:
         out["host/used_gb"] = vm.used / 1e9
         out["host/percent"] = vm.percent
     except ImportError:
-        pass
+        try:
+            import resource
+
+            # ru_maxrss is KB on Linux — peak, not current, but honest
+            out["host/peak_rss_gb"] = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
+        except Exception:  # pragma: no cover - non-posix
+            pass
     if message or out:
         dev_in_use = sum(v for k, v in out.items() if k.endswith("/bytes_in_use"))
-        logger.info(f"memory usage {message}: device={_fmt(dev_in_use, 'B')} "
-                    + (f"host={out.get('host/used_gb', 0):.1f}GB" if "host/used_gb" in out else ""))
+        host = (
+            f"host={out['host/used_gb']:.1f}GB" if "host/used_gb" in out
+            else f"host_peak_rss={out.get('host/peak_rss_gb', 0):.1f}GB"
+            if "host/peak_rss_gb" in out else ""
+        )
+        logger.info(f"memory usage {message}: device={_fmt(dev_in_use, 'B')} " + host)
     return out

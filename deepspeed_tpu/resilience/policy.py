@@ -81,6 +81,11 @@ def retry_call(
                 ) from e
             if on_retry is not None:
                 on_retry(attempt, e, pause)
+            from deepspeed_tpu.telemetry import get_registry
+
+            get_registry().counter(
+                "resilience/retries", fn=getattr(fn, "__name__", "call")
+            ).inc()
             sleep(pause)
     raise RetryError(
         f"{getattr(fn, '__name__', 'call')} failed after {policy.max_attempts} attempt(s): {last!r}"

@@ -20,6 +20,14 @@ dead ones.  The consumer (:class:`~.supervisor.Supervisor`) polls
   staleness beyond the beat timeout means death.  Strictly weaker
   (timeout-only detection) but needs no network and survives any
   launcher.
+
+Telemetry piggyback (docs/telemetry.md): ``beat(seq, metrics=...)``
+optionally carries the rank's compact metric snapshot — one extra JSON
+payload on the line/file the channel already writes, no new
+connections, nothing on the hot path.  The consumer side retains the
+latest ``(seq, metrics)`` per peer (:meth:`peer_metrics`); rank 0's
+supervisor feeds them to the cross-rank aggregator, which flags dead
+ranks in the same exported stream the metrics ride in.
 """
 from __future__ import annotations
 
@@ -95,6 +103,8 @@ class FileBeatChannel:
         self._first_seen: Dict[int, float] = {}
         # rank -> (last observed seq, local-monotonic time it changed)
         self._last_change: Dict[int, tuple] = {}
+        # rank -> (seq, compact metric snapshot) — telemetry piggyback
+        self._peer_metrics: Dict[int, tuple] = {}
         os.makedirs(self.beat_dir, exist_ok=True)
 
     def _path(self, rank: int) -> str:
@@ -103,10 +113,17 @@ class FileBeatChannel:
     def start(self) -> None:  # nothing to spin up
         pass
 
-    def beat(self, seq: int) -> None:
-        atomic.atomic_write_text(
-            self._path(self.rank), json.dumps({"rank": self.rank, "seq": int(seq)})
-        )
+    def beat(self, seq: int, metrics: Optional[Dict[str, float]] = None) -> None:
+        doc = {"rank": self.rank, "seq": int(seq)}
+        if metrics:
+            doc["metrics"] = metrics
+        atomic.atomic_write_text(self._path(self.rank), json.dumps(doc))
+        if metrics:
+            self._peer_metrics[self.rank] = (int(seq), dict(metrics))
+
+    def peer_metrics(self) -> Dict[int, tuple]:
+        """Latest ``(seq, metrics)`` piggybacked per rank (incl. own)."""
+        return dict(self._peer_metrics)
 
     def goodbye(self) -> None:
         atomic.atomic_write_text(
@@ -132,6 +149,8 @@ class FileBeatChannel:
             if data.get("bye"):
                 self._sink.push(PeerEvent(r, "bye", "clean departure"))
                 continue
+            if isinstance(data.get("metrics"), dict):
+                self._peer_metrics[r] = (int(data.get("seq") or 0), data["metrics"])
             seq = data.get("seq")
             last = self._last_change.get(r)
             if last is None or last[0] != seq:
@@ -150,8 +169,11 @@ class FileBeatChannel:
 class TcpBeatChannel:
     """Rank-0 server + per-rank client over one TCP line protocol.
 
-    Lines: ``hello <rank>``, ``beat <rank> <seq>``, ``bye <rank>`` from
-    clients; ``dead <rank>`` / ``bye <rank>`` notices from the server.
+    Lines: ``hello <rank>``, ``beat <rank> <seq> [metrics-json]``,
+    ``bye <rank>`` from clients; ``dead <rank>`` / ``bye <rank>``
+    notices from the server.  The optional metrics payload is compact
+    JSON with no whitespace (the line is whitespace-split), produced by
+    :func:`deepspeed_tpu.telemetry.encode_metrics`.
     """
 
     name = "tcp"
@@ -183,6 +205,9 @@ class TcpBeatChannel:
         self._conns_lock = threading.Lock()
         self._last_beat: Dict[int, float] = {}
         self._started_at = 0.0
+        # rank -> (seq, compact metric snapshot) — telemetry piggyback
+        self._peer_metrics: Dict[int, tuple] = {}
+        self._metrics_lock = threading.Lock()
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> None:
@@ -215,11 +240,26 @@ class TcpBeatChannel:
                     pass
 
     # -- publishing -------------------------------------------------------
-    def beat(self, seq: int) -> None:
+    def beat(self, seq: int, metrics: Optional[Dict[str, float]] = None) -> None:
+        if metrics:
+            with self._metrics_lock:
+                # own metrics recorded locally on every rank; rank 0's
+                # land straight in the table the aggregator reads
+                self._peer_metrics[self.rank] = (int(seq), dict(metrics))
         if self.rank == 0:
             self._last_beat[0] = time.monotonic()  # server beats locally
             return
-        self._send(f"beat {self.rank} {int(seq)}\n")
+        payload = ""
+        if metrics:
+            from deepspeed_tpu.telemetry import encode_metrics
+
+            payload = " " + encode_metrics(metrics)
+        self._send(f"beat {self.rank} {int(seq)}{payload}\n")
+
+    def peer_metrics(self) -> Dict[int, tuple]:
+        """Latest ``(seq, metrics)`` piggybacked per rank (incl. own)."""
+        with self._metrics_lock:
+            return dict(self._peer_metrics)
 
     def goodbye(self) -> None:
         if self.rank == 0:
@@ -289,7 +329,9 @@ class TcpBeatChannel:
                 buf += chunk
                 while b"\n" in buf:
                     line, buf = buf.split(b"\n", 1)
-                    parts = line.decode(errors="ignore").split()
+                    # maxsplit keeps the beat's metrics payload intact
+                    # even if a future metric name/label contains spaces
+                    parts = line.decode(errors="ignore").split(None, 3)
                     if not parts:
                         continue
                     if parts[0] == "hello" and len(parts) >= 2:
@@ -298,7 +340,15 @@ class TcpBeatChannel:
                             self._conns[peer_rank] = conn
                         self._last_beat[peer_rank] = time.monotonic()
                     elif parts[0] == "beat" and len(parts) >= 2:
-                        self._last_beat[int(parts[1])] = time.monotonic()
+                        r = int(parts[1])
+                        self._last_beat[r] = time.monotonic()
+                        if len(parts) >= 4:
+                            from deepspeed_tpu.telemetry import decode_metrics
+
+                            m = decode_metrics(parts[3])
+                            if m is not None:
+                                with self._metrics_lock:
+                                    self._peer_metrics[r] = (int(parts[2]), m)
                     elif parts[0] == "bye" and len(parts) >= 2:
                         r = int(parts[1])
                         self._sink.push(PeerEvent(r, "bye", "clean departure"))

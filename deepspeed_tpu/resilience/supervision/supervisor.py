@@ -81,6 +81,8 @@ class Supervisor:
         on_rescue: Optional[Callable[[str, str], None]] = None,
         exit_fn: Callable[[int], None] = os._exit,
         clock: Callable[[], float] = time.monotonic,
+        metrics_fn: Optional[Callable[[], Optional[Dict[str, float]]]] = None,
+        aggregator=None,
     ):
         self.rank = int(rank)
         self.world_size = int(world_size)
@@ -94,6 +96,11 @@ class Supervisor:
         self.on_rescue = on_rescue
         self.exit_fn = exit_fn
         self._clock = clock
+        # telemetry piggyback (docs/telemetry.md): metrics_fn supplies
+        # this rank's compact snapshot per beat; the rank-0 supervisor
+        # feeds peer snapshots + death marks to the aggregator
+        self.metrics_fn = metrics_fn
+        self.aggregator = aggregator
 
         self.snapshot = SnapshotBox()
         self.peer_failure: Optional[PeerFailure] = None
@@ -143,21 +150,55 @@ class Supervisor:
             if faults.check_flag("hb.drop"):
                 continue  # injected heartbeat suppression (tests)
             try:
-                self.channel.beat(self._beat_seq)
+                metrics = None
+                if self.metrics_fn is not None:
+                    try:
+                        metrics = self.metrics_fn()
+                    except Exception as e:  # noqa: BLE001 — beats must not die with metrics
+                        logger.warning(f"supervision: metrics snapshot failed: {e!r}")
+                if metrics:
+                    self.channel.beat(self._beat_seq, metrics=metrics)
+                else:
+                    self.channel.beat(self._beat_seq)
             except Exception as e:  # noqa: BLE001
                 logger.warning(f"supervision: beat publish failed: {e!r}")
+
+    def _feed_aggregator(self) -> None:
+        """Pump piggybacked peer snapshots into the rank-0 aggregator
+        and export when anything changed (JSONL stream + cluster/*
+        gauges; docs/telemetry.md)."""
+        agg = self.aggregator
+        if agg is None:
+            return
+        # peer_metrics() already includes this rank's own snapshot (both
+        # channels record it in beat()), so the channel table is the one
+        # feed; equal-seq re-feeds are deduped by the aggregator
+        peer_metrics = getattr(self.channel, "peer_metrics", None)
+        if peer_metrics is not None:
+            for r, (seq, m) in peer_metrics().items():
+                agg.update(r, seq, m)
+        agg.export_line()
 
     def _monitor_loop(self) -> None:
         period = max(0.05, min(0.5, self.beat_interval / 2.0))
         while not self._stop.wait(period):
             try:
+                self._feed_aggregator()
                 for ev in self.channel.events():
+                    if self.aggregator is not None and ev.kind == "bye":
+                        self.aggregator.mark_bye(ev.rank)
+                        self.aggregator.export_line()
                     if ev.kind == "dead" and self.peer_failure is None:
                         self.peer_failure = PeerFailure(ev.rank, ev.reason)
                         self._failure_evt.set()
                         logger.error(
                             f"supervision: rank {ev.rank} declared dead ({ev.reason})"
                         )
+                        if self.aggregator is not None:
+                            # the dead rank must appear in the exported
+                            # aggregate stream BEFORE any rescue exit
+                            self.aggregator.mark_dead(ev.rank, ev.reason)
+                            self.aggregator.export_line(force=True)
                         self._run_rescue(
                             site=self._current_site() or "idle",
                             reason=f"peer rank {ev.rank} failed: {ev.reason}",
@@ -273,6 +314,17 @@ class Supervisor:
             logger.error(f"supervision rescue: emergency save failed: {e!r}")
             return 1
         self.rescued = True
+        from deepspeed_tpu import telemetry as _tel
+
+        _tel.get_registry().counter("supervision/emergency_saves", rank=self.rank).inc()
+        # the caller exits via os._exit (no atexit): flush the sinks and
+        # the aggregate stream NOW or the counter never reaches disk
+        try:
+            if self.aggregator is not None:
+                self.aggregator.export_line(force=True)
+            _tel.flush()
+        except Exception:  # noqa: BLE001 — the exit code matters more
+            pass
         logger.error(
             f"supervision rescue: committed verified emergency tag {path}; "
             f"exit {self.exit_code} (peer-failed-and-saved)"

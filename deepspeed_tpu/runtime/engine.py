@@ -429,6 +429,28 @@ class DeepSpeedEngine:
         # shape/static-arg drift shows up as a recount)
         self.compilation_count = 0
 
+        # -- telemetry plane (docs/telemetry.md) ---------------------------
+        # Arm the process-wide registry/tracer BEFORE the comm layer so
+        # its trace-time strategy decisions land in the registry; the
+        # TensorBoard monitor is created here (it is a telemetry sink —
+        # the engine's loss/lr/loss-scale events route through the
+        # manager, never via direct add_scalar: ds_lint raw-metric-emit)
+        from deepspeed_tpu import telemetry as _telemetry
+        from deepspeed_tpu.utils.monitor import TensorBoardMonitor
+
+        self.monitor = TensorBoardMonitor(
+            output_path=config.tensorboard.output_path,
+            job_name=config.tensorboard.job_name,
+            enabled=config.tensorboard.enabled,
+            rank=self.global_rank,
+        )
+        self.telemetry = _telemetry.configure(
+            getattr(config, "telemetry", None),
+            rank=self.global_rank, label="train", monitor=self.monitor,
+        )
+        if self.telemetry.collect or self.telemetry.tracer.enabled:
+            self.timeline.attach_telemetry(self.telemetry, prefix="train")
+
         # -- unified comm layer (docs/comm.md) -----------------------------
         # Strategy-selected collectives: the gradient exchange routes
         # through self.comm, which picks dense / int8-quantized (EQuARX)
@@ -446,14 +468,7 @@ class DeepSpeedEngine:
 
         # -- host-side bookkeeping ----------------------------------------
         from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
-        from deepspeed_tpu.utils.monitor import TensorBoardMonitor
 
-        self.monitor = TensorBoardMonitor(
-            output_path=config.tensorboard.output_path,
-            job_name=config.tensorboard.job_name,
-            enabled=config.tensorboard.enabled,
-            rank=self.global_rank,
-        )
         self._last_loss = None
         self._last_info = None
         self.flops_profiler = FlopsProfiler(config.flops_profiler, engine=self)
@@ -1293,6 +1308,8 @@ class DeepSpeedEngine:
             )
         summ = self.comm_summary()
         self.timeline.set_comm(summ["strategy"], summ["grad_exchange_bytes"])
+        if self.telemetry is not None:
+            self.telemetry.set_comm(summ)
 
     def comm_summary(self) -> Dict[str, Any]:
         """Active comm-strategy table + the per-step comm-bytes model
@@ -1671,6 +1688,10 @@ class DeepSpeedEngine:
                 self._train_step_cost = {k: float(v) for k, v in cost.items() if np.isscalar(v)}
             except Exception:
                 self._train_step_cost = {}
+            if self.telemetry is not None:
+                # the compiled step's cost analysis is the numerator of
+                # the live MFU / HBM-GB/s gauges (docs/telemetry.md)
+                self.telemetry.set_step_cost(self._train_step_cost)
         profile_step = self._host_global_step + 1
         self.flops_profiler.start_step(profile_step)
         donated = jax.tree.leaves(self.state) if san is not None else None
@@ -1906,19 +1927,38 @@ class DeepSpeedEngine:
             log_dist(f"step={step} lr={self.get_lr()[0]:.3e} loss_scale={self.loss_scale:.1f}")
             if self.wall_clock_breakdown and self.timeline.enabled:
                 log_dist(self.timeline.format_summary(self.config.steps_per_print))
-            if self.monitor.enabled:
-                # reference tags (engine.py:1178-1188, :1356-1382)
-                samples = int(jax.device_get(self.state["global_samples"]))
-                events = [
-                    (f"Train/Samples/lr", self.get_lr()[0]),
-                    (f"Train/Samples/loss_scale", self.loss_scale),
-                ]
-                if self._last_loss is not None:
-                    events.append(
-                        (f"Train/Samples/train_loss", float(jax.device_get(self._last_loss)))
+            tm = self.telemetry
+            if tm is not None and (tm.collect or tm.monitor_enabled):
+                # loss/lr/loss-scale route through the telemetry
+                # registry; the manager forwards the reference
+                # Train/Samples/* tags (engine.py:1178-1188, :1356-1382)
+                # to the TensorBoard sink unchanged.  The d2h reads are
+                # a deliberate report-cadence sync — paid ONLY when a
+                # consumer is armed (monitor / sinks / the already-
+                # syncing wall_clock_breakdown); the default registry-
+                # only path stays transfer-free: samples come from the
+                # host step mirror and the loss gauge is skipped.
+                sync = tm.monitor_enabled or tm.exports_armed or self.wall_clock_breakdown
+                if sync:
+                    samples = int(jax.device_get(self.state["global_samples"]))
+                    loss = (
+                        float(jax.device_get(self._last_loss))
+                        if self._last_loss is not None else None
                     )
-                self.monitor.write_events(events, samples)
-                self.monitor.flush()
+                else:
+                    # micro-step mirror, not global_step: overflow-
+                    # skipped steps still CONSUME their samples (the
+                    # device global_samples counts them too)
+                    samples = (
+                        self._host_micro_step
+                        * self.config.train_micro_batch_size_per_gpu
+                        * self.mesh_info.dp_world_size
+                    )
+                    loss = None
+                tm.publish_train_progress(
+                    step=step, samples=samples, loss=loss,
+                    lr=float(self.get_lr()[0]), loss_scale=float(self.loss_scale),
+                )
 
     # ------------------------------------------------------------------
     # resilience: preemption + divergence + supervision handling
@@ -1988,6 +2028,27 @@ class DeepSpeedEngine:
             channel = hb.FileBeatChannel(
                 sv.beat_dir, rank, world, beat_timeout=sv.beat_timeout_seconds
             )
+        # telemetry piggyback (docs/telemetry.md): rank-local compact
+        # snapshots ride every beat; rank 0 aggregates min/mean/max and
+        # flags dead ranks in the same exported stream.  The JSONL
+        # aggregate stream needs an explicit telemetry.output_path (no
+        # silent files in cwd); the cluster/* gauges always flow.
+        metrics_fn = None
+        aggregator = None
+        tcfg = getattr(self.config, "telemetry", None)
+        if tcfg is not None and tcfg.enabled and tcfg.aggregate:
+            from deepspeed_tpu import telemetry as _tel
+
+            reg = _tel.get_registry()
+            metrics_fn = lambda: (reg.snapshot_compact() or None) if reg.enabled else None
+            if rank == 0:
+                agg_path = (
+                    os.path.join(tcfg.output_path, f"aggregate_rank{rank}.jsonl")
+                    if tcfg.output_path else None
+                )
+                aggregator = _tel.CrossRankAggregator(
+                    world, jsonl_path=agg_path, registry=reg
+                )
         sup = Supervisor(
             rank=rank,
             world_size=world,
@@ -1998,6 +2059,8 @@ class DeepSpeedEngine:
             exit_code=sv.exit_code,
             save_dir_fn=lambda: self._resilience_ckpt_dir,
             checksum=self.resilience.checkpoint.checksum,
+            metrics_fn=metrics_fn,
+            aggregator=aggregator,
         ).start()
         log_dist(
             f"supervision: rank {rank}/{world} armed on the {channel.name} channel "
@@ -2126,6 +2189,9 @@ class DeepSpeedEngine:
         did not happen (deadline passed or save failed) — treat as a
         crash and resume from the previous tag."""
         wd = self._watchdog
+        from deepspeed_tpu.telemetry import get_registry
+
+        get_registry().counter("resilience/preemptions").inc()
         log_dist(
             f"preemption signal ({wd.signal_name}) received; attempting emergency "
             f"checkpoint ({wd.remaining():.0f}s of grace left)"
@@ -2163,6 +2229,9 @@ class DeepSpeedEngine:
         raise SystemExit(wd.exit_code)
 
     def _apply_divergence_action(self, action: str) -> None:
+        from deepspeed_tpu.telemetry import get_registry
+
+        get_registry().counter("resilience/divergence_actions", action=action).inc()
         n = self.resilience.divergence.threshold
         if action == C.DIVERGENCE_ACTION_FLOOR:
             old = self.loss_scaler.min_scale

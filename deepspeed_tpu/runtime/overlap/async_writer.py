@@ -118,10 +118,29 @@ class AsyncCheckpointWriter:
             pending = PendingSave(tag, final_path)
 
             def run():
+                # the background commit is a first-class trace span
+                # (docs/telemetry.md: checkpoint-writer track) and an
+                # async-saves counter; no-ops when the plane is off
+                from deepspeed_tpu.telemetry import PID_CHECKPOINT, get_registry, get_tracer
+
+                tracer = get_tracer()
+                t0 = tracer.now()
                 try:
                     commit_fn()
                 except BaseException as e:  # noqa: BLE001 — surfaced via drain()
                     pending.error = e
+                finally:
+                    tracer.add_span(
+                        "ckpt_commit", "checkpoint", t0, tracer.now(),
+                        pid=PID_CHECKPOINT,
+                        args={"tag": tag, "ok": pending.error is None},
+                    )
+                    reg = get_registry()
+                    if reg.enabled:
+                        reg.counter(
+                            "ckpt/async_saves",
+                            outcome="ok" if pending.error is None else "failed",
+                        ).inc()
 
             if not self._atexit_registered:
                 self._register_exit_drain()

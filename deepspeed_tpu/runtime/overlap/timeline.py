@@ -63,6 +63,22 @@ class StepTimeline:
         # once by the comm layer, carried into every summary/record
         self.comm_strategy: Optional[str] = None
         self.comm_bytes: Optional[int] = None
+        # telemetry plane attachment (docs/telemetry.md): None-checked
+        # on the hot path; when attached, phases become Chrome-trace
+        # spans and closed step records publish into the registry
+        self._telemetry = None
+        self._t_prefix = "train"
+        self._trace_pid = 0
+
+    def attach_telemetry(self, manager, prefix: str = "train", trace_pid: int = 0) -> None:
+        """Route this timeline into a
+        :class:`~deepspeed_tpu.telemetry.TelemetryManager`: every
+        ``phase()`` block also lands as a span (when tracing is armed)
+        and every ``end_step`` publishes the closed record as
+        histograms/gauges.  Detach with ``manager=None``."""
+        self._telemetry = manager
+        self._t_prefix = prefix
+        self._trace_pid = int(trace_pid)
 
     def set_comm(self, strategy: str, bytes_per_step: int) -> None:
         """Record the engine's active comm strategy + per-step
@@ -80,15 +96,25 @@ class StepTimeline:
 
     @contextmanager
     def phase(self, name: str):
-        """Time a host block and note it under ``name``."""
+        """Time a host block and note it under ``name`` (and as a trace
+        span when the attached telemetry plane has tracing armed)."""
         if not self.enabled:
             yield
             return
+        tm = self._telemetry
+        tracer = tm.tracer if tm is not None and tm.tracer.enabled else None
+        t0m = tracer.now() if tracer is not None else 0.0
         t0 = time.perf_counter()
         try:
             yield
         finally:
-            self.note(name, time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self.note(name, dt)
+            if tracer is not None:
+                tracer.add_span(
+                    f"{self._t_prefix}/{name}", self._t_prefix, t0m, t0m + dt,
+                    pid=self._trace_pid,
+                )
 
     def set_gauge(self, name: str, value: float) -> None:
         """Record a per-step level (queue depth, live slots, ...): kept
@@ -124,6 +150,12 @@ class StepTimeline:
         for _ in range(count):
             self.records.append(dict(rec))
         self.total_steps += count
+        if self._telemetry is not None:
+            # registry publish of the closed record (host dict ops; the
+            # manager also derives the live MFU gauge from the wall)
+            self._telemetry.publish_step(
+                self._t_prefix, rec, count=count, gauge_names=self._gauge_names
+            )
         self._pending = {}
         self._pending_gauges = {}
 

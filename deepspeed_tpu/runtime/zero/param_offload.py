@@ -785,6 +785,19 @@ class ZeroInfinityEngine:
             self.skipped_steps += 1
             logger.warning("offload_param step skipped on non-finite grads")
         self._last_info = {"lr": lr, "grad_norm": grad_norm, "overflow": overflow}
+        # telemetry (docs/telemetry.md): the streaming engine has no
+        # StepTimeline — publish its step counters/gauges directly
+        from deepspeed_tpu.telemetry import get_registry
+
+        reg = get_registry()
+        if reg.enabled:
+            reg.counter("zinf/steps", engine="offload").inc()
+            reg.gauge("zinf/lr", engine="offload").set(lr)
+            if overflow:
+                reg.counter("zinf/overflow_skips", engine="offload").inc()
+            if timing is not None:
+                for key, v in timing.items():
+                    reg.gauge(f"zinf/{key}", engine="offload").set(v)
         return jnp.mean(jnp.stack(losses))
 
     def eval_batch(self, batch: Any) -> jnp.ndarray:

@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepspeed_tpu import telemetry as _telemetry
 from deepspeed_tpu.config.config import ServingConfig
 from deepspeed_tpu.serving.pool import SlotKVPool
 from deepspeed_tpu.serving.scheduler import (
@@ -109,6 +110,24 @@ class ServingEngine:
         from deepspeed_tpu.runtime.overlap.timeline import StepTimeline
 
         self.timeline = StepTimeline(enabled=True, phases=("sched", "prefill", "decode"))
+
+        # telemetry (docs/telemetry.md): attach to whatever plane the
+        # process armed (the train engine's configure(), or an explicit
+        # telemetry.configure() from bench_serving / the smoke tool) —
+        # a no-config process gets no-op publishes.  The scheduler's
+        # lifecycle events become per-request spans + TTFT/TPOT
+        # histograms; step phases ride the timeline attachment.
+        # NB arm the plane BEFORE constructing engines: the timeline
+        # attachment and the manager's SLO config are captured here —
+        # a later configure() reaches the registry/tracer flags but not
+        # these construction-time decisions.
+        self.telemetry = _telemetry.manager_for("serving")
+        self._tel_ttft = self.telemetry.histogram("serving/ttft_ms")
+        self._tel_tpot = self.telemetry.histogram("serving/tpot_ms")
+        self._tel_queue_wait = self.telemetry.histogram("serving/queue_wait_ms")
+        if self.telemetry.collect or self.telemetry.tracer.enabled:
+            self.timeline.attach_telemetry(self.telemetry, prefix="serving")
+        self.scheduler.on_event = self._on_request_event
 
         from deepspeed_tpu.analysis.sanitizer import maybe_from_config
 
@@ -254,20 +273,27 @@ class ServingEngine:
                 "(the static top-k head width of the one compiled decode step); "
                 "raise serving.max_top_k or lower the request's top_k"
             )
-        req = self.scheduler.submit(
-            prompt,
-            max_new_tokens=(
-                max_new_tokens if max_new_tokens is not None else self.config.max_new_tokens
-            ),
-            eos_token_id=eos_token_id,
-            deadline_seconds=deadline_seconds,
-            do_sample=do_sample,
-            temperature=temperature,
-            top_k=top_k,
-            seed=seed,
-            now=time.monotonic(),
-            step=self._step_count,
-        )
+        try:
+            req = self.scheduler.submit(
+                prompt,
+                max_new_tokens=(
+                    max_new_tokens if max_new_tokens is not None else self.config.max_new_tokens
+                ),
+                eos_token_id=eos_token_id,
+                deadline_seconds=deadline_seconds,
+                do_sample=do_sample,
+                temperature=temperature,
+                top_k=top_k,
+                seed=seed,
+                now=time.monotonic(),
+                step=self._step_count,
+            )
+        except ServingQueueFull:
+            if self.telemetry.collect:
+                self.telemetry.counter("serving/rejected").inc()
+            raise
+        if self.telemetry.collect:
+            self.telemetry.counter("serving/submitted").inc()
         return req.request_id
 
     def step(self) -> bool:
@@ -308,6 +334,101 @@ class ServingEngine:
         return self.scheduler.pop_finished()
 
     # ------------------------------------------------------------------
+    # telemetry: per-request lifecycle (docs/telemetry.md span schema)
+    # ------------------------------------------------------------------
+    def _on_request_event(self, kind: str, r, now: float, step: int) -> None:
+        """Scheduler lifecycle hook → spans on the request's own trace
+        lane (tid = request id): queue → prefill → decode → retire, plus
+        the TTFT / per-output-token histograms the SLO bench reads.
+        Host dict ops only; spans cost nothing when tracing is off."""
+        tm = self.telemetry
+        tracer = tm.tracer if tm.tracer.enabled else None
+        rid = r.request_id
+        if kind == "admitted":
+            self._tel_queue_wait.observe((now - r.submit_time) * 1e3)
+            if tracer is not None:
+                tracer.add_span(
+                    "queue", "serving.request", r.submit_time, now,
+                    pid=_telemetry.PID_REQUESTS, tid=rid,
+                    args={"request": rid, "slot": r.slot, "prompt_len": r.prompt_len},
+                    tid_name=f"request {rid}",
+                )
+        elif kind == "first_token":
+            ttft_ms = (now - r.submit_time) * 1e3
+            self._tel_ttft.observe(ttft_ms)
+            if tracer is not None:
+                tracer.add_span(
+                    "prefill", "serving.request",
+                    r.admit_time if r.admit_time is not None else r.submit_time, now,
+                    pid=_telemetry.PID_REQUESTS, tid=rid,
+                    args={"request": rid, "ttft_ms": round(ttft_ms, 3),
+                          "chunks": -(-r.prompt_len // self.config.prefill_chunk)},
+                )
+            tm.check_slo(ttft_ms)
+        elif kind == "finished":
+            if tm.collect:
+                tm.counter("serving/finished", reason=r.finish_reason or "?").inc()
+                if len(r.generated) > 1 and r.first_token_time is not None:
+                    self._tel_tpot.observe(
+                        (now - r.first_token_time) * 1e3 / (len(r.generated) - 1)
+                    )
+            if tracer is not None:
+                if r.first_token_time is not None:
+                    tracer.add_span(
+                        "decode", "serving.request", r.first_token_time, now,
+                        pid=_telemetry.PID_REQUESTS, tid=rid,
+                        args={"request": rid, "tokens": len(r.generated)},
+                    )
+                tracer.add_instant(
+                    "retire", "serving.request", ts=now,
+                    pid=_telemetry.PID_REQUESTS, tid=rid,
+                    args={"request": rid, "finish_reason": r.finish_reason,
+                          "tokens": len(r.generated)},
+                )
+        elif kind == "expired":
+            if tm.collect:
+                tm.counter("serving/expired").inc()
+            if tracer is not None:
+                tracer.add_instant(
+                    "expired", "serving.request", ts=now,
+                    pid=_telemetry.PID_REQUESTS, tid=rid,
+                    args={"request": rid,
+                          "queue_wait_ms": round((now - r.submit_time) * 1e3, 3)},
+                )
+
+    def telemetry_summary(self) -> Dict[str, Any]:
+        """Compact roll-up for bench records — MODEL-derived, unlike the
+        train engine's compiled-cost gauges (the serving executables are
+        plain jit; docs/telemetry.md): ``mfu`` from 2·N FLOPs per
+        generated token over the live slots at the measured step wall
+        (per-chip share), and ``hbm_bytes_per_step`` as the decode
+        roofline traffic model — params read once per token step plus
+        the KV pool touched — an upper bound, not a measured access
+        count; plus the registry digest."""
+        from deepspeed_tpu.profiling.flops_profiler import peak_flops
+
+        mcfg = self.engine.model_config
+        n_params = mcfg.num_params() if hasattr(mcfg, "num_params") else 0
+        s = self.timeline.summary()
+        wall_s = s.get("wall_ms", 0.0) / 1e3
+        live = s.get("live_slots", 0.0)
+        # per-chip share of the model work (bench.py's tokens/s/chip
+        # convention): a sharded model splits the 2N across devices
+        flops_step = 2.0 * n_params * max(live, 0.0) / jax.device_count()
+        mfu = (
+            flops_step / wall_s / peak_flops() if wall_s > 0 and flops_step else None
+        )
+        param_bytes = sum(
+            int(np.prod(np.shape(p)) * np.dtype(p.dtype).itemsize)
+            for p in jax.tree.leaves(self.engine.params)
+        )
+        return {
+            "mfu": None if mfu is None else round(mfu, 6),
+            "hbm_bytes_per_step": param_bytes + self.pool.cache_bytes(),
+            "telemetry": self.telemetry.digest(),
+        }
+
+    # ------------------------------------------------------------------
     def _run_prefill(self, job: PrefillJob) -> None:
         san = self._sanitizer
         fn = self._get_prefill()
@@ -322,6 +443,8 @@ class ServingEngine:
              np.uint32(r.seed & 0xFFFFFFFF)),
             self._replicated,
         )
+        tracer = self.telemetry.tracer if self.telemetry.tracer.enabled else None
+        t0 = tracer.now() if tracer is not None else 0.0
         guard = san.transfer.guard("serving.prefill") if san is not None else nullcontext()
         with guard:
             first, k, v = fn(
@@ -332,7 +455,19 @@ class ServingEngine:
         # explicit d2h read doubles as the fence that keeps prefill_ms
         # honest; the value is the first generated token on final chunks
         tok = int(jax.device_get(first))
-        self.scheduler.note_prefill(job, tok, now=time.monotonic(), step=self._step_count)
+        now = time.monotonic()
+        if tracer is not None:
+            # chunk-level detail on the request's own lane, between its
+            # queue and prefill spans (the fenced read above makes the
+            # span a real device-work window, not dispatch overhead)
+            tracer.add_span(
+                "prefill_chunk", "serving.request", t0, now,
+                pid=_telemetry.PID_REQUESTS, tid=r.request_id,
+                args={"request": r.request_id, "start": job.start,
+                      "len": job.length, "final": job.final},
+                tid_name=f"request {r.request_id}",
+            )
+        self.scheduler.note_prefill(job, tok, now=now, step=self._step_count)
 
     def _run_decode(self, toks: np.ndarray, pos: np.ndarray, decoding) -> None:
         san = self._sanitizer
@@ -360,6 +495,9 @@ class ServingEngine:
         sched_ms, mean queue_depth/live_slots) for logs and bench
         records."""
         s = self.scheduler
+        if self.telemetry.collect:
+            self.telemetry.gauge("serving/queue_depth_now").set(s.queue_depth)
+            self.telemetry.gauge("serving/live_slots_now").set(self.pool.live_slots)
         out = {
             "submitted": s.submitted,
             "finished": s.finished_count,

@@ -43,6 +43,12 @@ class ServingQueueFull(RuntimeError):
     Callers back off / shed load; nothing in flight is affected."""
 
 
+# Process-global request ids: several engines in one process (bench
+# sweeps build one per (kv, load) point) must not reuse ids — the
+# telemetry trace keys per-request span lanes on them.
+_REQUEST_IDS = itertools.count()
+
+
 @dataclasses.dataclass
 class Request:
     """One sequence through the pool.  ``prompt`` is a 1-D int32 array;
@@ -66,9 +72,11 @@ class Request:
     generated: List[int] = dataclasses.field(default_factory=list)
     finish_reason: Optional[str] = None  # eos | length | expired
     submit_time: float = 0.0
+    admit_time: Optional[float] = None  # queue -> slot (prefill starts)
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
     submit_step: int = 0
+    admit_step: Optional[int] = None
     first_token_step: Optional[int] = None
     finish_step: Optional[int] = None
 
@@ -127,11 +135,20 @@ class ContinuousScheduler:
         self._queue: Deque[Request] = deque()
         self._active: Dict[int, Request] = {}  # slot -> request
         self._finished: Dict[int, Request] = {}  # request_id -> request
-        self._ids = itertools.count()
+        self._ids = _REQUEST_IDS
         self.submitted = 0
         self.rejected = 0
         self.expired = 0
         self.finished_count = 0
+        # lifecycle observer (the serving engine's telemetry hook):
+        # called as on_event(kind, request, now, step) at "admitted",
+        # "first_token", "finished", "expired" transitions.  Pure host
+        # callback — the scheduler itself stays jax- and telemetry-free.
+        self.on_event: Optional[Any] = None
+
+    def _emit(self, kind: str, r: Request, now: float, step: int) -> None:
+        if self.on_event is not None:
+            self.on_event(kind, r, now, step)
 
     # -- introspection ----------------------------------------------------
     @property
@@ -237,6 +254,7 @@ class ContinuousScheduler:
                         f"serving: request {r.request_id} expired after "
                         f"{now - r.submit_time:.3f}s in queue (deadline {deadline:g}s)"
                     )
+                    self._emit("expired", r, now, step)
                 else:
                     kept.append(r)
             self._queue = kept
@@ -246,7 +264,10 @@ class ContinuousScheduler:
             r.slot = self.pool.alloc(r.request_id)
             r.status = PREFILL
             r.prefill_pos = 0
+            r.admit_time = now
+            r.admit_step = step
             self._active[r.slot] = r
+            self._emit("admitted", r, now, step)
         # 3) prefill chunk budget, FIFO over mid-prefill slots
         jobs: List[PrefillJob] = []
         budget = self.prefill_chunks_per_step
@@ -289,6 +310,7 @@ class ContinuousScheduler:
         r.generated = [int(first_token)]
         r.first_token_time = now
         r.first_token_step = step
+        self._emit("first_token", r, now, step)
         if len(r.generated) >= r.max_new_tokens or (
             r.eos_token_id is not None and first_token == r.eos_token_id
         ):
@@ -356,3 +378,4 @@ class ContinuousScheduler:
         self.pool.free(r.slot)
         self._finished[r.request_id] = r
         self.finished_count += 1
+        self._emit("finished", r, now, step)

@@ -8,9 +8,21 @@ lr/scale events; writer only on global rank 0) emitting
 Uses ``torch.utils.tensorboard`` when available (torch-cpu ships in the
 image); otherwise falls back to a JSONL event log with the same tags so
 metrics are never silently dropped.
+
+Lifecycle: every constructed monitor registers an atexit flush+close —
+the JSONL fallback handle (and a buffering SummaryWriter) must not be
+dropped unflushed when the process dies between report cadences.
+``close()`` is idempotent and unregisters the hook.
+
+In the telemetry plane (docs/telemetry.md) this class is a *sink*: the
+engine publishes through the metrics registry and the manager forwards
+the reference ``Train/Samples/*`` tags here; ``ds_lint``'s
+``raw-metric-emit`` rule keeps new direct ``add_scalar`` call sites
+from growing outside ``telemetry/``.
 """
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import time
@@ -24,6 +36,7 @@ class TensorBoardMonitor:
         self.enabled = enabled and rank == 0
         self._writer = None
         self._jsonl = None
+        self._closed = False
         if not self.enabled:
             return
         out_dir = os.path.join(output_path or "runs", job_name)
@@ -35,6 +48,7 @@ class TensorBoardMonitor:
         except Exception as e:
             self._jsonl = open(os.path.join(out_dir, "events.jsonl"), "a")
             logger.warning(f"monitor: tensorboard unavailable ({e}); writing JSONL events to {out_dir}")
+        atexit.register(self.close)
 
     def add_scalar(self, tag: str, value: float, global_step: int) -> None:
         if not self.enabled:
@@ -53,9 +67,20 @@ class TensorBoardMonitor:
     def flush(self) -> None:
         if self._writer is not None:
             self._writer.flush()
+        elif self._jsonl is not None and not self._jsonl.closed:
+            self._jsonl.flush()
 
     def close(self) -> None:
+        """Flush + close both backends; idempotent (called by the
+        engine, the telemetry shutdown, AND atexit — whichever first)."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            atexit.unregister(self.close)
+        except Exception:  # pragma: no cover - interpreter teardown
+            pass
         if self._writer is not None:
             self._writer.close()
-        if self._jsonl is not None:
+        if self._jsonl is not None and not self._jsonl.closed:
             self._jsonl.close()

@@ -155,6 +155,12 @@ def main():
                 "snapshot_interval_steps": 1,
             },
         }
+        # telemetry cross-rank aggregation (docs/telemetry.md): rank-
+        # local snapshots piggyback on the beats above; rank 0 appends
+        # the min/mean/max aggregate stream (with dead-rank flags) that
+        # the kill test asserts on
+        cfg["telemetry"] = {"enabled": True,
+                            "output_path": os.path.join(a.out, "telemetry")}
         engine, _, _, _ = deepspeed_tpu.initialize(
             model=simple_model_loss, model_parameters=simple_model_init(32), config=cfg,
             dist_init_required=False,

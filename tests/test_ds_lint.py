@@ -52,6 +52,7 @@ def test_rule_catalog_shape():
         "unguarded-collective-barrier",  # PR 5 supervision tier-B rule
         "raw-collective-outside-comm-layer",  # PR 6 comm-layer tier-B rule
         "hand-built-partition-spec",  # PR 8 partition-rule-engine tier-B rule
+        "raw-metric-emit",  # PR 9 telemetry-plane tier-C rule
     ):
         assert rid in rules, rid
 
@@ -1290,6 +1291,59 @@ class TestRawCollective:
             "raw-collective-outside-comm-layer",
         )
         assert rule_ids(res2) == []
+
+
+# ---------------------------------------------------------------------------
+# raw-metric-emit (tier C, PR 9 telemetry plane)
+# ---------------------------------------------------------------------------
+
+
+class TestRawMetricEmit:
+    def test_flags_direct_emits_and_handbuilt_writer(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            from torch.utils.tensorboard import SummaryWriter
+
+            def report(monitor, step, loss):
+                writer = SummaryWriter(log_dir="runs")
+                writer.add_scalar("loss", loss, step)
+                monitor.write_events([("Train/Samples/lr", 0.1)], step)
+            """,
+            "raw-metric-emit",
+        )
+        assert rule_ids(res) == ["raw-metric-emit"] * 3
+        assert all(f.severity == Severity.C for f in res.findings)
+        assert "registry" in res.findings[1].message
+
+    def test_telemetry_package_and_monitor_are_exempt(self, tmp_path):
+        src = """
+            def export(monitor, snapshot, step):
+                for m in snapshot["metrics"]:
+                    monitor.add_scalar(m["name"], m["value"], step)
+            """
+        res = lint_src(tmp_path, src, "raw-metric-emit",
+                       name="deepspeed_tpu/telemetry/exporters.py")
+        assert rule_ids(res) == []
+        res2 = lint_src(tmp_path, src, "raw-metric-emit",
+                        name="deepspeed_tpu/utils/monitor.py")
+        assert rule_ids(res2) == []
+
+    def test_registry_publishes_are_clean(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            from deepspeed_tpu.telemetry import get_registry
+
+            def report(tm, loss, step):
+                tm.gauge("train/loss").set(loss)
+                get_registry().counter("steps").inc()
+                tm.publish_train_progress(step=step, samples=1, loss=loss,
+                                          lr=0.1, loss_scale=1.0)
+            """,
+            "raw-metric-emit",
+        )
+        assert rule_ids(res) == []
 
 
 # ---------------------------------------------------------------------------

@@ -58,6 +58,27 @@ def test_engine_profile_step(capsys):
     assert 0 <= res["mfu"] < 10  # sane range (CPU peak is a rough constant)
 
 
-def test_see_memory_usage_runs():
+def test_get_model_profile_as_string_and_bytes():
+    cfg = dataclasses.replace(gpt2.GPT2_TINY, remat=False)
+    params = gpt2.init_params(cfg)
+    toks = np.zeros((1, 16), np.int32)
+    f_s, m_s, p_s = get_model_profile(
+        lambda p, t: gpt2.apply(p, jnp.asarray(t), cfg, deterministic=True),
+        args=(params, toks), params=params, print_profile=False, as_string=True,
+    )
+    assert f_s.endswith("FLOPs") and m_s.endswith("MACs")
+    cost = analyze_fn(
+        lambda p, t: gpt2.apply(p, jnp.asarray(t), cfg, deterministic=True),
+        params, toks,
+    )
+    assert cost["bytes_accessed"] > 0  # HBM side of the profile is real too
+
+
+def test_see_memory_usage_reports_nonzero_on_cpu():
+    # keep a live device buffer so the CPU fallback (live-array shard
+    # accounting — PJRT:CPU has no memory_stats) has something to count
+    keep = jnp.ones((128, 128), jnp.float32)
     out = see_memory_usage("test")
     assert isinstance(out, dict)
+    dev = sum(v for k, v in out.items() if k.endswith("/bytes_in_use"))
+    assert dev >= keep.nbytes  # real per-device stats, not silent zeros

@@ -744,6 +744,23 @@ def test_two_process_kill_one_rank_elastic_restart(tmp_path):
     assert "rank 1" in meta["rescue_reason"]
     assert "EOF" in meta["rescue_reason"] or "died" in meta["rescue_reason"], meta["rescue_reason"]
 
+    # telemetry cross-rank aggregation (docs/telemetry.md): rank-local
+    # metrics piggybacked on the beat channel reached rank 0 BEFORE the
+    # kill (an aggregate line covers both ranks), and the killed rank
+    # shows up as dead — with its last-seen snapshot — in the same
+    # exported stream the metrics ride in.
+    agg_path = out / "telemetry" / "aggregate_rank0.jsonl"
+    assert agg_path.exists(), "rank-0 aggregate stream missing"
+    agg_lines = [json.loads(l) for l in agg_path.read_text().splitlines() if l.strip()]
+    assert any(
+        len(l["alive"]) == 2 and any(row["n"] == 2 for row in l["metrics"].values())
+        for l in agg_lines
+    ), "no aggregate line ever covered both live ranks"
+    dead_lines = [l for l in agg_lines if any(d["rank"] == 1 for d in l["dead"])]
+    assert dead_lines, "killed rank never flagged dead in the aggregate stream"
+    dead_row = next(d for d in dead_lines[-1]["dead"] if d["rank"] == 1)
+    assert dead_row["last_metrics"], "dead rank's last-seen snapshot missing"
+
     # rank 1 died at ITS 4th boundary; rank 0 rescued at the boundary of
     # some step k shortly after.  Step k trained but its record was cut
     # off by the rescue — the tag certifies state AND loader cursor at k.

@@ -123,23 +123,32 @@ def run_load(make_serving, workload, offered_rps, seed):
             time.sleep(min(0.005, max(0.0, pending[0][0] - now)))
         finished.update(srv.pop_results())
     makespan = time.monotonic() - t0
-    ttft, tpot, toks = [], [], 0
+    ttft, ttft_submit, tpot, toks = [], [], [], 0
     for rid, arr in ids.items():
         r = finished.get(rid)
         if r is None or r.first_token_time is None:
             continue
         toks += len(r.generated)
         ttft.append((r.first_token_time - t0 - arr) * 1e3)
+        # submit-anchored TTFT: the same timestamps the telemetry
+        # plane's per-request spans carry, so a trace.json reconstructs
+        # these two percentiles exactly (docs/telemetry.md; the
+        # arrival-anchored ttft_* above additionally charges the
+        # bench's submission-poll delay)
+        ttft_submit.append((r.first_token_time - r.submit_time) * 1e3)
         if len(r.generated) > 1 and r.finish_time is not None:
             tpot.append(
                 (r.finish_time - r.first_token_time) * 1e3 / (len(r.generated) - 1)
             )
     pct = lambda a, q: round(float(np.percentile(a, q)), 2) if a else None
     stats = srv.stats()
+    tel = srv.telemetry_summary()
     return {
         "tokens_per_s": round(toks / max(makespan, 1e-9), 1),
         "ttft_p50_ms": pct(ttft, 50),
         "ttft_p99_ms": pct(ttft, 99),
+        "ttft_submit_p50_ms": pct(ttft_submit, 50),
+        "ttft_submit_p99_ms": pct(ttft_submit, 99),
         "tpot_p50_ms": pct(tpot, 50),
         "tpot_p99_ms": pct(tpot, 99),
         "completed": len(ttft),
@@ -151,6 +160,9 @@ def run_load(make_serving, workload, offered_rps, seed):
         "sched_ms": stats["sched_ms"],
         "queue_depth": stats["queue_depth"],
         "decode_compiles": stats["decode_compiles"],
+        "mfu": tel["mfu"],
+        "hbm_bytes_per_step": tel["hbm_bytes_per_step"],
+        "telemetry": tel["telemetry"],
         **({"ds_san": True} if srv._sanitizer is not None else {}),
     }
 
@@ -168,12 +180,24 @@ def main():
     ap.add_argument("--prefill-chunk", type=int, default=None)
     ap.add_argument("--max-queue", type=int, default=256)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--trace", default=None, metavar="PATH",
+                    help="export a Chrome-trace/Perfetto trace.json of the "
+                         "run's spans (per-request lifecycles + step phases)")
     args = ap.parse_args()
 
     import jax
 
     import deepspeed_tpu
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.config.config import TelemetryConfig
     from deepspeed_tpu.serving import ServingEngine
+
+    # arm the process plane before any engine is built; tracing only
+    # when requested (the span buffer is a ring, but why pay for it)
+    telemetry.configure(
+        TelemetryConfig(trace=bool(args.trace), trace_path=args.trace or ""),
+        label="bench_serving",
+    )
 
     on_tpu = jax.default_backend() in ("tpu", "axon")
     if args.dryrun or not on_tpu:
@@ -235,6 +259,10 @@ def main():
                 f"ttft p50/p99 {rec['ttft_p50_ms']}/{rec['ttft_p99_ms']} ms, "
                 f"tpot p50/p99 {rec['tpot_p50_ms']}/{rec['tpot_p99_ms']} ms, "
                 f"queue {rec['queue_depth']}")
+
+    if args.trace:
+        path = telemetry.export_trace(args.trace)
+        log(f"trace exported -> {path}")
 
 
 if __name__ == "__main__":

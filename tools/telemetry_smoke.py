@@ -1,0 +1,123 @@
+"""Telemetry end-to-end smoke (CI `telemetry` job; docs/telemetry.md).
+
+One process, dryrun CPU mesh (8 virtual devices):
+
+1. arm the plane (registry + jsonl/prometheus sinks + Chrome-trace
+   buffer), run a few dryrun train steps, check the MFU / flops /
+   HBM gauges are live;
+2. run a serving engine through a handful of requests;
+3. export ``trace.json`` and validate it against the Chrome trace-event
+   schema (the same :func:`validate_chrome_trace` the tests gate on),
+   checking the per-request span lanes exist;
+4. scrape the Prometheus textfile and assert the expected families.
+
+Exit 0 on success; any failed check raises.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def log(msg):
+    print(f"[telemetry_smoke] {msg}", file=sys.stderr, flush=True)
+
+
+def main(out_dir: str) -> int:
+    import dataclasses
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import deepspeed_tpu
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.models import gpt2
+    from deepspeed_tpu.serving import ServingEngine
+    from deepspeed_tpu.telemetry import validate_chrome_trace
+
+    os.makedirs(out_dir, exist_ok=True)
+    trace_path = os.path.join(out_dir, "trace.json")
+
+    # -- 1) dryrun train with the full plane armed via the config block --
+    cfg = dataclasses.replace(gpt2.GPT2_TINY, remat=False, scan_unroll=gpt2.GPT2_TINY.n_layer)
+    model_fn, init_fn, tp_fn = gpt2.make_model(cfg)
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 2,
+        "telemetry": {
+            "enabled": True,
+            "exporters": ["jsonl", "prometheus"],
+            "export_interval_seconds": 60,  # we flush() explicitly
+            "output_path": out_dir,
+            "trace": True,
+            "trace_path": trace_path,
+        },
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model_fn, model_parameters=init_fn(), config=config, tp_spec_fn=tp_fn
+    )
+    batch = {"input_ids": np.zeros((16, 16), np.int32)}
+    for _ in range(4):
+        engine.train_batch(batch)
+    summ = engine.telemetry.summary()
+    assert summ["mfu"] is not None and summ["mfu"] > 0, f"MFU gauge not live: {summ}"
+    assert summ["hbm_bytes_per_step"], f"HBM gauge not live: {summ}"
+    log(f"train gauges live: mfu={summ['mfu']} hbm={summ['hbm_bytes_per_step']}")
+
+    # -- 2) a serving run over the same armed plane ----------------------
+    inf = deepspeed_tpu.init_inference(model="tiny", max_out_tokens=128)
+    srv = ServingEngine(inf, num_slots=2, prefill_chunk=16, max_len=64)
+    rng = np.random.default_rng(0)
+    for _ in range(4):
+        srv.submit(rng.integers(1, 100, 24, dtype=np.int32), max_new_tokens=4)
+    finished = srv.drain(max_steps=10_000)
+    assert len(finished) == 4, f"serving drain incomplete: {len(finished)}"
+    log(f"serving drained {len(finished)} requests")
+
+    # -- 3) trace.json: schema-valid, request lanes present --------------
+    telemetry.export_trace(trace_path)
+    doc = json.load(open(trace_path))
+    problems = validate_chrome_trace(doc)
+    assert not problems, f"trace schema problems: {problems[:10]}"
+    names = {e["name"] for e in doc["traceEvents"]}
+    for want in ("train/compile", "serving/decode", "queue", "prefill", "decode", "retire"):
+        assert want in names, f"expected span '{want}' missing; have {sorted(names)}"
+    req_lanes = {
+        e["tid"] for e in doc["traceEvents"]
+        if e.get("pid") == telemetry.PID_REQUESTS and e["ph"] == "X"
+    }
+    assert len(req_lanes) >= 4, f"expected >=4 request lanes, got {req_lanes}"
+    log(f"trace.json schema-valid: {len(doc['traceEvents'])} events, "
+        f"{len(req_lanes)} request lanes")
+
+    # -- 4) Prometheus textfile scrape -----------------------------------
+    telemetry.flush()
+    prom = open(os.path.join(out_dir, "metrics_rank0.prom")).read()
+    for family in ("ds_mfu", "ds_train_step_wall_ms", "ds_serving_ttft_ms_count",
+                   "ds_comm_bytes_per_step"):
+        assert family in prom, f"prometheus family '{family}' missing"
+    jsonl = open(os.path.join(out_dir, "metrics_rank0.jsonl")).read().strip().splitlines()
+    assert jsonl and json.loads(jsonl[-1])["metrics"], "jsonl export empty"
+    log(f"prometheus + jsonl sinks verified ({len(prom.splitlines())} prom lines, "
+        f"{len(jsonl)} jsonl exports)")
+    print("telemetry smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    import tempfile
+
+    out = sys.argv[1] if len(sys.argv) > 1 else tempfile.mkdtemp(prefix="ds_telemetry_smoke_")
+    sys.exit(main(out))
