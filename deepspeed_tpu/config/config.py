@@ -759,6 +759,26 @@ class ServingConfig:
     # top_k thresholds against the top-max_top_k logits (one executable
     # for any greedy/sampled mix); submit() rejects top_k > max_top_k
     max_top_k: int = C.SERVING_MAX_TOP_K_DEFAULT
+    # -- resilience (docs/serving.md §Resilience) ----------------------
+    # estimated-TTFT admission test: shed normal/low-priority submits
+    # whose estimated TTFT (queue backlog / measured step rate) exceeds
+    # this; 0 disables the test (hard max_queue bound still applies)
+    slo_ttft_ms: float = C.SERVING_SLO_TTFT_MS_DEFAULT
+    # degradation ladder: engage on queue_depth >= watermark*max_queue
+    # sustained degrade_engage_steps ticks, step back down after
+    # degrade_disengage_steps calm ticks (hysteresis)
+    degrade_queue_watermark: float = C.SERVING_DEGRADE_QUEUE_WATERMARK_DEFAULT
+    degrade_engage_steps: int = C.SERVING_DEGRADE_ENGAGE_STEPS_DEFAULT
+    degrade_disengage_steps: int = C.SERVING_DEGRADE_DISENGAGE_STEPS_DEFAULT
+    degrade_max_new_tokens: int = C.SERVING_DEGRADE_MAX_NEW_TOKENS_DEFAULT
+    # graceful drain: SIGTERM stops admission and drains in-flight
+    # requests for at most this long before the journal commit + exit 43
+    drain_deadline_seconds: float = C.SERVING_DRAIN_DEADLINE_SECONDS_DEFAULT
+    # write-ahead request journal ("" = off): submit/admit/first-token/
+    # retire records under serving/journal.py's atomic segment protocol
+    journal_dir: str = C.SERVING_JOURNAL_DIR_DEFAULT
+    journal_segment_records: int = C.SERVING_JOURNAL_SEGMENT_RECORDS_DEFAULT
+    journal_keep_segments: int = C.SERVING_JOURNAL_KEEP_SEGMENTS_DEFAULT
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ServingConfig":
@@ -781,6 +801,29 @@ class ServingConfig:
                 _pop(d, "deadline_seconds", C.SERVING_DEADLINE_SECONDS_DEFAULT)
             ),
             max_top_k=int(_pop(d, "max_top_k", C.SERVING_MAX_TOP_K_DEFAULT)),
+            slo_ttft_ms=float(_pop(d, "slo_ttft_ms", C.SERVING_SLO_TTFT_MS_DEFAULT)),
+            degrade_queue_watermark=float(
+                _pop(d, "degrade_queue_watermark", C.SERVING_DEGRADE_QUEUE_WATERMARK_DEFAULT)
+            ),
+            degrade_engage_steps=int(
+                _pop(d, "degrade_engage_steps", C.SERVING_DEGRADE_ENGAGE_STEPS_DEFAULT)
+            ),
+            degrade_disengage_steps=int(
+                _pop(d, "degrade_disengage_steps", C.SERVING_DEGRADE_DISENGAGE_STEPS_DEFAULT)
+            ),
+            degrade_max_new_tokens=int(
+                _pop(d, "degrade_max_new_tokens", C.SERVING_DEGRADE_MAX_NEW_TOKENS_DEFAULT)
+            ),
+            drain_deadline_seconds=float(
+                _pop(d, "drain_deadline_seconds", C.SERVING_DRAIN_DEADLINE_SECONDS_DEFAULT)
+            ),
+            journal_dir=str(_pop(d, "journal_dir", C.SERVING_JOURNAL_DIR_DEFAULT) or ""),
+            journal_segment_records=int(
+                _pop(d, "journal_segment_records", C.SERVING_JOURNAL_SEGMENT_RECORDS_DEFAULT)
+            ),
+            journal_keep_segments=int(
+                _pop(d, "journal_keep_segments", C.SERVING_JOURNAL_KEEP_SEGMENTS_DEFAULT)
+            ),
         )
         _check_empty(d, C.SERVING, _known_keys(cls))
         if out.max_top_k < 1:
@@ -829,6 +872,41 @@ class ServingConfig:
         if out.deadline_seconds < 0:
             raise DeepSpeedConfigError(
                 f"'{C.SERVING}.deadline_seconds' must be >= 0, got {out.deadline_seconds}"
+            )
+        if out.slo_ttft_ms < 0:
+            raise DeepSpeedConfigError(
+                f"'{C.SERVING}.slo_ttft_ms' must be >= 0 (0 disables the "
+                f"admission test), got {out.slo_ttft_ms}"
+            )
+        if not 0.0 < out.degrade_queue_watermark <= 1.0:
+            raise DeepSpeedConfigError(
+                f"'{C.SERVING}.degrade_queue_watermark' must be in (0, 1] "
+                f"(a fraction of max_queue), got {out.degrade_queue_watermark}"
+            )
+        if out.degrade_engage_steps < 1 or out.degrade_disengage_steps < 1:
+            raise DeepSpeedConfigError(
+                f"'{C.SERVING}.degrade_engage_steps'/'degrade_disengage_steps' must "
+                f"be >= 1, got {out.degrade_engage_steps}/{out.degrade_disengage_steps}"
+            )
+        if out.degrade_max_new_tokens < 0:
+            raise DeepSpeedConfigError(
+                f"'{C.SERVING}.degrade_max_new_tokens' must be >= 0 (0 disables "
+                f"the clamp rung), got {out.degrade_max_new_tokens}"
+            )
+        if out.drain_deadline_seconds < 0:
+            raise DeepSpeedConfigError(
+                f"'{C.SERVING}.drain_deadline_seconds' must be >= 0, "
+                f"got {out.drain_deadline_seconds}"
+            )
+        if out.journal_segment_records < 1:
+            raise DeepSpeedConfigError(
+                f"'{C.SERVING}.journal_segment_records' must be >= 1, "
+                f"got {out.journal_segment_records}"
+            )
+        if out.journal_keep_segments < 1:
+            raise DeepSpeedConfigError(
+                f"'{C.SERVING}.journal_keep_segments' must be >= 1, "
+                f"got {out.journal_keep_segments}"
             )
         return out
 

@@ -252,6 +252,26 @@ SERVING_DEADLINE_SECONDS_DEFAULT = 0.0  # 0 = no queue-wait deadline
 # thresholds against the top-max_top_k logits; one decode executable
 # for any greedy/sampled mix) — requests with top_k > max_top_k reject
 SERVING_MAX_TOP_K_DEFAULT = 64
+# -- serving resilience (docs/serving.md §Resilience) -----------------
+# priority tiers: 0 = high (never TTFT-shed), 1 = normal, 2 = low
+# (first to shed when the degradation ladder tops out)
+SERVING_PRIORITY_HIGH = 0
+SERVING_PRIORITY_NORMAL = 1
+SERVING_PRIORITY_LOW = 2
+SERVING_SLO_TTFT_MS_DEFAULT = 0.0  # 0 = no estimated-TTFT admission test
+# overload shed floor: a retry_after below this tells clients nothing
+SERVING_RETRY_AFTER_MIN_SECONDS_DEFAULT = 0.05
+# degradation ladder: engage when queue_depth >= watermark * max_queue
+# sustained engage_steps ticks; step back down after disengage_steps
+# calm ticks (hysteresis — disengage slower than engage)
+SERVING_DEGRADE_QUEUE_WATERMARK_DEFAULT = 0.75
+SERVING_DEGRADE_ENGAGE_STEPS_DEFAULT = 8
+SERVING_DEGRADE_DISENGAGE_STEPS_DEFAULT = 16
+SERVING_DEGRADE_MAX_NEW_TOKENS_DEFAULT = 32  # rung-1 clamp; 0 disables the rung
+SERVING_DRAIN_DEADLINE_SECONDS_DEFAULT = 30.0  # SIGTERM in-flight drain budget
+SERVING_JOURNAL_DIR_DEFAULT = ""  # "" = request journaling off
+SERVING_JOURNAL_SEGMENT_RECORDS_DEFAULT = 512  # records per WAL segment
+SERVING_JOURNAL_KEEP_SEGMENTS_DEFAULT = 4  # sealed segments before compaction
 
 #############################################
 # Telemetry (unified metrics registry / trace export; docs/telemetry.md)

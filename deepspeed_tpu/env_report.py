@@ -405,6 +405,34 @@ def serving_report(config=None) -> None:
             ),
         ),
         ("default generation budget", f"{s.max_new_tokens} tokens/request"),
+        # resilience rows (docs/serving.md §Resilience)
+        (
+            "overload shedding",
+            f"estimated-TTFT test vs slo_ttft_ms={s.slo_ttft_ms:g} "
+            "(priority 0 bypasses; sheds carry retry_after)"
+            if s.slo_ttft_ms
+            else "off (slo_ttft_ms=0; hard max_queue bound only)",
+        ),
+        (
+            "degradation ladder",
+            f"engage >= {s.degrade_queue_watermark:g}x max_queue for "
+            f"{s.degrade_engage_steps} ticks, disengage after "
+            f"{s.degrade_disengage_steps}; rungs: clamp max_new_tokens"
+            + (f"->{s.degrade_max_new_tokens}" if s.degrade_max_new_tokens else "(off)")
+            + " | 1 prefill chunk/step | shed low priority",
+        ),
+        (
+            "graceful drain",
+            f"SIGTERM -> stop admission, drain <= {s.drain_deadline_seconds:g}s, "
+            "journal commit, exit 43",
+        ),
+        (
+            "request journal",
+            f"{s.journal_dir} ({s.journal_segment_records} records/segment, "
+            f"compact past {s.journal_keep_segments} segments)"
+            if s.journal_dir
+            else "off (journal_dir unset; a crash loses queued+in-flight work)",
+        ),
     ]
     for name, value in rows:
         print(f"{name} " + "." * (30 - len(name)) + f" {value}")

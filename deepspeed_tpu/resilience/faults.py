@@ -30,6 +30,14 @@ Two plan kinds exist only for real processes:
 * ``stall`` — :func:`check_stall` sleeps ``seconds`` inside a blocking
   sync (site ``collective.stall``), modelling a wedged-but-alive peer
   for the hung-collective watchdog.
+
+Serving sites (``serving.submit``, ``serving.prefill``,
+``serving.decode``, ``serving.journal.commit``; docs/resilience.md) use
+the same machinery plus the ``latency`` action — a *repeating* sleep
+(:func:`check_latency`, default every call) that models a slow decode
+step so the overload tests can build real queue pressure without a big
+model.  ``stall`` fires ``times`` then disarms; ``latency`` keeps
+firing — a degraded chip, not a single wedge.
 """
 from __future__ import annotations
 
@@ -75,6 +83,20 @@ def check_stall(site: str) -> float:
     if _ACTIVE is None:
         return 0.0
     seconds = _ACTIVE.fire_stall(site)
+    if seconds > 0:
+        time.sleep(seconds)
+    return seconds
+
+
+def check_latency(site: str) -> float:
+    """Sleep for the planned *recurring* latency at ``site`` (0 when no
+    latency plan is armed).  Unlike :func:`check_stall` this fires on
+    every call (up to the plan's ``times``, default unbounded) — the
+    slow-decode injection the serving overload tests drive queue
+    pressure with."""
+    if _ACTIVE is None:
+        return 0.0
+    seconds = _ACTIVE.fire_latency(site)
     if seconds > 0:
         time.sleep(seconds)
     return seconds
@@ -135,6 +157,15 @@ class FaultInjector:
         self._plan(site, None, times, after, None, kind="stall", seconds=seconds)
         return self
 
+    def latency(self, site: str, seconds: float, times: int = 0, after: int = 0) -> "FaultInjector":
+        """Arm a *recurring* ``seconds``-long sleep at ``site``
+        (``check_latency``): every call sleeps, up to ``times`` fires
+        (``0`` = unbounded) — a persistently slow decode step for the
+        serving overload harness, not a one-shot wedge."""
+        self._plan(site, None, times if times > 0 else 1 << 30, after, None,
+                   kind="latency", seconds=seconds)
+        return self
+
     # -- firing -----------------------------------------------------------
     def _triggers(self, plan: dict) -> bool:
         plan["calls"] += 1
@@ -178,6 +209,18 @@ class FaultInjector:
             return plan["seconds"]
         return 0.0
 
+    def fire_latency(self, site: str) -> float:
+        plan = self._plans.get(site)
+        if plan is None or plan["kind"] != "latency":
+            return 0.0
+        if self._triggers(plan):
+            # one log line per site, not per fire: latency plans fire on
+            # every decode step and would otherwise flood the log
+            if plan["fired"] == 1:
+                self.log.append((site, "latency"))
+            return plan["seconds"]
+        return 0.0
+
     def calls(self, site: str) -> int:
         plan = self._plans.get(site)
         return plan["calls"] if plan else 0
@@ -212,7 +255,7 @@ class FaultInjector:
             entries.append({
                 "site": site,
                 "action": {"raise": "fail", "flag": "flag", "sigkill": "sigkill",
-                           "stall": "stall"}[p["kind"]],
+                           "stall": "stall", "latency": "latency"}[p["kind"]],
                 "times": p["times"], "after": p["after"], "seconds": p["seconds"],
                 **({"exc": p["exc"].__name__} if p["exc"] is not None and p["kind"] == "raise" else {}),
                 **({"probability": p["probability"]} if p["probability"] is not None else {}),
@@ -247,6 +290,11 @@ class FaultInjector:
                 inj.flag(site, times=times, after=after)
             elif action == "stall":
                 inj.stall(site, float(e.get("seconds", 1.0)), times=times, after=after)
+            elif action == "latency":
+                # times defaults to 1 via the shared parse above, but a
+                # latency plan's natural default is "every call"
+                inj.latency(site, float(e.get("seconds", 0.01)),
+                            times=int(e.get("times", 0)), after=after)
             else:
                 raise ValueError(f"unknown fault action '{action}' for site '{site}'")
         return inj

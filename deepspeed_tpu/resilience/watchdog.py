@@ -89,6 +89,12 @@ class PreemptionWatchdog:
         return self._requested_at is not None
 
     @property
+    def requested_at(self) -> Optional[float]:
+        """Monotonic stamp of the (first) preemption signal — the anchor
+        the serving drain budget counts down from (serving/watchdog.py)."""
+        return self._requested_at
+
+    @property
     def signal_name(self) -> str:
         if self._signum is None:
             return "none"

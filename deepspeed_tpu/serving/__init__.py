@@ -2,31 +2,53 @@
 
 The traffic-shaped rebuild of the reference's inference layer: a
 fixed-shape slot-pool KV cache (``pool.py``), a token-granularity
-admission/retirement scheduler with chunked prefill (``scheduler.py``),
-and a ``submit()/step()/drain()`` engine that serves any churning
-request stream against exactly one compiled decode executable
-(``engine.py``).
+admission/retirement scheduler with chunked prefill, priority tiers and
+a load-shedding admission controller (``scheduler.py``), a write-ahead
+request journal for crash recovery (``journal.py``), a SIGTERM graceful
+drain watchdog (``watchdog.py``), and a ``submit()/step()/drain()``
+engine that serves any churning request stream against exactly one
+compiled decode executable (``engine.py``).
 
     eng = deepspeed_tpu.init_inference(model="gpt2-xl", ...)
-    srv = ServingEngine(eng, num_slots=8, prefill_chunk=128)
+    srv = ServingEngine(eng, num_slots=8, prefill_chunk=128,
+                        journal_dir="/ckpt/serving-journal")
+    srv.install_watchdog()          # SIGTERM -> drain -> exit 43
+    srv.recover()                   # replay a crashed engine's journal
     rid = srv.submit(prompt_tokens, max_new_tokens=64)
     while srv.step():
         pass
     print(srv.result(rid).tokens())
 """
 from deepspeed_tpu.serving.engine import ServingEngine
+from deepspeed_tpu.serving.journal import JournalError, RequestJournal
 from deepspeed_tpu.serving.pool import SlotKVPool, SlotPoolError
 from deepspeed_tpu.serving.scheduler import (
+    PRIORITY_HIGH,
+    PRIORITY_LOW,
+    PRIORITY_NORMAL,
     ContinuousScheduler,
+    DegradationLadder,
     Request,
+    ServingDraining,
+    ServingOverloaded,
     ServingQueueFull,
 )
+from deepspeed_tpu.serving.watchdog import ServingWatchdog
 
 __all__ = [
     "ServingEngine",
     "SlotKVPool",
     "SlotPoolError",
     "ContinuousScheduler",
+    "DegradationLadder",
     "Request",
+    "RequestJournal",
+    "JournalError",
     "ServingQueueFull",
+    "ServingOverloaded",
+    "ServingDraining",
+    "ServingWatchdog",
+    "PRIORITY_HIGH",
+    "PRIORITY_NORMAL",
+    "PRIORITY_LOW",
 ]

@@ -39,14 +39,21 @@ import numpy as np
 
 from deepspeed_tpu import telemetry as _telemetry
 from deepspeed_tpu.config.config import ServingConfig
+from deepspeed_tpu.resilience import faults
+from deepspeed_tpu.serving.journal import JournalError, RequestJournal
 from deepspeed_tpu.serving.pool import SlotKVPool
 from deepspeed_tpu.serving.scheduler import (
+    PRIORITY_NORMAL,
     ContinuousScheduler,
     PrefillJob,
     Request,
+    ServingDraining,
+    ServingOverloaded,
     ServingQueueFull,
+    advance_request_ids,
 )
-from deepspeed_tpu.utils.logging import log_dist
+from deepspeed_tpu.serving.watchdog import ServingWatchdog
+from deepspeed_tpu.utils.logging import log_dist, logger
 
 
 class ServingEngine:
@@ -105,7 +112,42 @@ class ServingEngine:
             max_queue=config.max_queue,
             deadline_seconds=config.deadline_seconds,
             capacity=min(max_len, capacity),
+            slo_ttft_ms=config.slo_ttft_ms,
+            degrade_queue_watermark=config.degrade_queue_watermark,
+            degrade_engage_steps=config.degrade_engage_steps,
+            degrade_disengage_steps=config.degrade_disengage_steps,
+            degrade_max_new_tokens=config.degrade_max_new_tokens,
         )
+        # the admission controller's measured-service-rate feed: the
+        # telemetry registry's recent window when the plane is armed,
+        # the engine's local EWMA otherwise (scheduler stays jax-free)
+        self.scheduler.step_seconds_fn = self._measured_step_seconds
+        self._step_wall_ewma: Optional[float] = None
+
+        # write-ahead request journal (docs/serving.md §Resilience):
+        # "" = off.  A construction failure disables journaling rather
+        # than the engine — availability over durability, loudly.
+        self._journal: Optional[RequestJournal] = None
+        if config.journal_dir:
+            try:
+                self._journal = RequestJournal(
+                    config.journal_dir,
+                    segment_records=config.journal_segment_records,
+                    keep_segments=config.journal_keep_segments,
+                )
+                # id-reuse guard: a restarted process submitting BEFORE
+                # recover() must not hand out a journaled incomplete id
+                # (its retire record would drop the old acknowledged
+                # request from the replay set)
+                advance_request_ids(self._journal.last_request_id)
+            except OSError as e:
+                logger.error(
+                    f"serving: request journal at {config.journal_dir!r} failed "
+                    f"to open ({e!r}); journaling DISABLED — a crash loses "
+                    "in-flight and queued requests"
+                )
+        self._watchdog: Optional[ServingWatchdog] = None
+        self._journal_quarantined: Optional[str] = None
 
         from deepspeed_tpu.runtime.overlap.timeline import StepTimeline
 
@@ -245,6 +287,63 @@ class ServingEngine:
         return self._decode_fn
 
     # ------------------------------------------------------------------
+    # measured service rate (the admission controller's feed)
+    # ------------------------------------------------------------------
+    def _measured_step_seconds(self) -> Optional[float]:
+        """Recent mean serving-step wall in seconds.  THIS engine's EWMA
+        (compile steps excluded) wins once it exists; before the first
+        measured step, the telemetry registry's process-wide
+        ``serving/step_wall_ms`` window (the gauge the timeline
+        attachment publishes) seeds a fresh engine in an armed,
+        already-serving process.  None on a cold engine — which admits:
+        shedding needs evidence."""
+        if self._step_wall_ewma:
+            return self._step_wall_ewma
+        if self.telemetry.collect:
+            wm = self.telemetry.gauge("serving/step_wall_ms").window_mean()
+            if wm:
+                return wm / 1e3
+        return None
+
+    # ------------------------------------------------------------------
+    # journal plumbing (quarantine-on-failure; docs/serving.md)
+    # ------------------------------------------------------------------
+    def _journal_record(self, method: str, *args) -> None:
+        """Append one record; a failed append quarantines (the journal
+        can no longer certify anything) and serving continues."""
+        j = self._journal
+        if j is None:
+            return
+        try:
+            getattr(j, method)(*args)
+        except JournalError as e:
+            self._quarantine_journal(e)
+
+    def _journal_commit(self) -> bool:
+        """Commit appended records; False (after quarantine) when the
+        journal could not certify durability."""
+        j = self._journal
+        if j is None or not j.dirty:
+            return j is not None
+        try:
+            j.commit()
+            return True
+        except JournalError as e:
+            self._quarantine_journal(e)
+            return False
+
+    def _quarantine_journal(self, err: Exception) -> None:
+        j, self._journal = self._journal, None
+        logger.error(
+            f"serving: journal commit failed ({err}); quarantining — serving "
+            "continues WITHOUT crash recovery for new work"
+        )
+        j.quarantine()
+        self._journal_quarantined = j.quarantined
+        if self.telemetry.collect:
+            self.telemetry.counter("serving/journal_quarantined").inc()
+
+    # ------------------------------------------------------------------
     # request API
     # ------------------------------------------------------------------
     def submit(
@@ -257,10 +356,20 @@ class ServingEngine:
         temperature: float = 1.0,
         top_k: int = 0,
         seed: int = 0,
+        priority: int = PRIORITY_NORMAL,
     ) -> int:
         """Enqueue one request; returns its id.  Raises
-        :class:`ServingQueueFull` when the queue is at its bound and
-        ``ValueError`` when the request cannot ever fit the pool.
+        :class:`ServingQueueFull` when the queue is at its bound,
+        :class:`ServingOverloaded` (with ``retry_after``) when the
+        estimated TTFT exceeds ``serving.slo_ttft_ms`` or the
+        degradation ladder sheds the tier, :class:`ServingDraining`
+        after SIGTERM, and ``ValueError`` when the request cannot ever
+        fit the pool.  With a journal armed, the id is returned only
+        after the submit record committed — an acknowledged request
+        survives a crash.
+
+        ``priority``: 0 high (never TTFT-shed) / 1 normal (default) /
+        2 low (first shed under overload).
 
         Sampling is per-request (``do_sample``/``temperature``/``top_k``/
         ``seed`` become per-slot vectors of the fixed decode signature):
@@ -273,6 +382,16 @@ class ServingEngine:
                 "(the static top-k head width of the one compiled decode step); "
                 "raise serving.max_top_k or lower the request's top_k"
             )
+        if self._watchdog is not None and self._watchdog.draining:
+            if self.telemetry.collect:
+                self.telemetry.counter("serving/rejected").inc()
+            raise ServingDraining(
+                f"serving engine is draining ({self._watchdog.signal_name} "
+                f"received, {max(self._watchdog.remaining(), 0.0):.1f}s of drain "
+                "budget left); retry against the restarted engine",
+                retry_after=max(self._watchdog.remaining(), 0.0),
+            )
+        faults.check("serving.submit")
         try:
             req = self.scheduler.submit(
                 prompt,
@@ -285,25 +404,101 @@ class ServingEngine:
                 temperature=temperature,
                 top_k=top_k,
                 seed=seed,
+                priority=priority,
                 now=time.monotonic(),
                 step=self._step_count,
             )
+        except ServingOverloaded as e:
+            if self.telemetry.collect:
+                self.telemetry.counter("serving/rejected").inc()
+                self.telemetry.counter("serving/shed").inc()
+                self.telemetry.histogram("serving/retry_after_s").observe(
+                    e.retry_after or 0.0
+                )
+            raise
         except ServingQueueFull:
             if self.telemetry.collect:
                 self.telemetry.counter("serving/rejected").inc()
             raise
+        # WAL contract: the submit record is durable BEFORE the id is
+        # acknowledged (a commit failure quarantines; the request still
+        # serves — availability over durability, loudly)
+        self._journal_record("record_submit", req)
+        self._journal_commit()
         if self.telemetry.collect:
             self.telemetry.counter("serving/submitted").inc()
         return req.request_id
 
+    def recover(self) -> list:
+        """Replay the journal's incomplete requests into this engine
+        under their **original ids** (idempotent: a second ``recover()``
+        on the same engine re-reads the on-disk set, which now shows
+        them incomplete-but-resubmitted — they are deduped by id at the
+        scheduler).  Greedy and seeded-sampling replays bit-match the
+        uninterrupted run (docs/serving.md §Resilience).  Returns the
+        replayed ids, oldest first."""
+        if self._journal is None:
+            return []
+        try:
+            entries = self._journal.incomplete()
+        except JournalError as e:
+            self._quarantine_journal(e)
+            return []
+        replayed = []
+        for e in entries:
+            rid = int(e["id"])
+            if self.scheduler.request(rid) is not None:
+                continue  # already live here (double recover)
+            req = self.scheduler.submit(
+                np.asarray(e["prompt"], np.int32),
+                max_new_tokens=int(e["max_new"]),
+                eos_token_id=e.get("eos"),
+                # 0 = NO deadline (None falls back to the scheduler
+                # default): the queue wait already happened once, an
+                # acknowledged replay must not expire a second time
+                deadline_seconds=0.0,
+                do_sample=bool(e.get("do_sample", False)),
+                temperature=float(e.get("temperature", 1.0)),
+                top_k=int(e.get("top_k", 0)),
+                seed=int(e.get("seed", 0)),
+                priority=int(e.get("priority", PRIORITY_NORMAL)),
+                request_id=rid,
+                bypass_admission=True,  # accepted before the crash
+                now=time.monotonic(),
+                step=self._step_count,
+            )
+            advance_request_ids(rid)
+            # re-journal into the live segment: recovery is self-contained
+            # even after the old segments compact away
+            self._journal_record("record_submit", req)
+            replayed.append(rid)
+        self._journal_commit()
+        if replayed:
+            log_dist(
+                f"serving: replayed {len(replayed)} incomplete request(s) "
+                f"from the journal (ids {replayed[0]}..{replayed[-1]})"
+            )
+            if self.telemetry.collect:
+                self.telemetry.counter("serving/replayed").inc(len(replayed))
+        return replayed
+
     def step(self) -> bool:
         """One serving step: tick the scheduler, land this step's prefill
         chunks, then one decode step over the pool.  Returns whether any
-        work remains."""
+        work remains.  If a drain signal is pending (SIGTERM through the
+        installed :class:`ServingWatchdog`), runs the graceful drain and
+        exits with the watchdog's contract instead."""
+        if self._watchdog is not None and self._watchdog.draining:
+            self._drain_and_exit()
+        return self._step_once(admit=True)
+
+    def _step_once(self, admit: bool) -> bool:
         tl = self.timeline
         self._step_count += 1
+        compiles0 = self.prefill_compiles + self.decode_compiles
+        t0 = time.monotonic()
         with tl.phase("sched"):
-            plan = self.scheduler.tick(time.monotonic(), self._step_count)
+            plan = self.scheduler.tick(t0, self._step_count, admit=admit)
         with tl.phase("prefill"):
             for job in plan.prefill_jobs:
                 self._run_prefill(job)
@@ -314,18 +509,114 @@ class ServingEngine:
         tl.set_gauge("queue_depth", self.scheduler.queue_depth)
         tl.set_gauge("live_slots", self.pool.live_slots)
         tl.end_step()
+        # measured service rate for the admission controller (EWMA over
+        # non-empty, non-compile steps — a jit trace in the wall would
+        # poison the TTFT estimate into shedding everything for minutes;
+        # the registry window supersedes the EWMA when armed)
+        wall = time.monotonic() - t0
+        if (plan.prefill_jobs or decoding) and (
+            self.prefill_compiles + self.decode_compiles == compiles0
+        ):
+            self._step_wall_ewma = (
+                wall if self._step_wall_ewma is None
+                else 0.2 * wall + 0.8 * self._step_wall_ewma
+            )
+        # retirements this step become durable at the boundary
+        self._journal_commit()
         return self.scheduler.has_work()
 
     def drain(self, max_steps: Optional[int] = None) -> Dict[int, Request]:
         """Step until every submitted request finishes (or ``max_steps``
-        elapses); returns and clears the finished-request map."""
+        elapses); returns and clears the finished-request map.  Also
+        sweeps queued-deadline expiry first, so an idle engine's
+        over-deadline waiters expire even when no step runs."""
+        self.scheduler.sweep_expired(time.monotonic(), self._step_count)
         steps = 0
         while self.scheduler.has_work():
             self.step()
             steps += 1
             if max_steps is not None and steps >= max_steps:
                 break
+        self._journal_commit()
         return self.scheduler.pop_finished()
+
+    # ------------------------------------------------------------------
+    # graceful drain (docs/serving.md §Resilience)
+    # ------------------------------------------------------------------
+    def install_watchdog(
+        self,
+        drain_deadline_seconds: Optional[float] = None,
+        exit_code: Optional[int] = None,
+    ) -> ServingWatchdog:
+        """Arm SIGTERM/SIGINT graceful drain: admission stops, in-flight
+        requests drain within the deadline, undone work persists in the
+        journal, and the process exits 43 only after the journal
+        commits (1 otherwise)."""
+        if self._watchdog is None:
+            kw = {}
+            if exit_code is not None:
+                kw["exit_code"] = exit_code
+            self._watchdog = ServingWatchdog(
+                drain_deadline_seconds=(
+                    drain_deadline_seconds
+                    if drain_deadline_seconds is not None
+                    else self.config.drain_deadline_seconds
+                ),
+                **kw,
+            ).install()
+        return self._watchdog
+
+    def _drain_and_exit(self) -> None:
+        """The SIGTERM sequence.  Exit 43 certifies durable undone work
+        (journal committed) — or a complete drain when no journal is
+        armed; anything less is exit 1, the crash contract."""
+        wd = self._watchdog
+        log_dist(
+            f"serving: drain signal ({wd.signal_name}) received; admission "
+            f"stopped, draining {self.pool.live_slots} in-flight request(s) "
+            f"within {max(wd.remaining(), 0.0):.1f}s "
+            f"({self.scheduler.queue_depth} queued will replay from the journal)"
+        )
+        if self.telemetry.collect:
+            self.telemetry.counter("serving/drains").inc()
+        drained_all = True
+        try:
+            while self.scheduler.live and wd.remaining() > 0:
+                self._step_once(admit=False)
+        except BaseException as e:  # a dying drain must still certify honestly
+            logger.error(f"serving: drain loop failed: {e!r}")
+            drained_all = False
+        if self.scheduler.live:
+            drained_all = False
+            undone_live = sorted(self.pool.owners().values())
+            logger.warning(
+                f"serving: drain deadline ({wd.drain_deadline_seconds:g}s) cut "
+                f"off {len(undone_live)} in-flight request(s) {undone_live}; "
+                "they replay from the journal"
+            )
+        undone = self.scheduler.pending_ids()
+        if self._journal is not None:
+            self._journal_record("record_drain", undone)
+            committed = self._journal_commit()
+            if committed:
+                log_dist(
+                    f"serving: journal committed ({len(undone)} undone request(s) "
+                    f"durable); exiting with code {wd.exit_code}"
+                )
+                raise SystemExit(wd.exit_code)
+            logger.error("serving: journal could not commit at drain; exiting 1")
+            raise SystemExit(1)
+        if drained_all and not undone:
+            log_dist(
+                "serving: drained completely (no journal armed, nothing undone); "
+                f"exiting with code {wd.exit_code}"
+            )
+            raise SystemExit(wd.exit_code)
+        logger.error(
+            f"serving: {len(undone)} undone request(s) with no journal to "
+            "persist them; exiting 1 (crash contract)"
+        )
+        raise SystemExit(1)
 
     def result(self, request_id: int) -> Optional[Request]:
         return self.scheduler.request(request_id)
@@ -344,6 +635,14 @@ class ServingEngine:
         tm = self.telemetry
         tracer = tm.tracer if tm.tracer.enabled else None
         rid = r.request_id
+        # journal lifecycle records (committed at the step boundary;
+        # docs/serving.md §Resilience journal format)
+        if kind == "admitted":
+            self._journal_record("record_admit", r)
+        elif kind == "first_token":
+            self._journal_record("record_first_token", r)
+        elif kind in ("finished", "expired", "shed"):
+            self._journal_record("record_retire", r)
         if kind == "admitted":
             self._tel_queue_wait.observe((now - r.submit_time) * 1e3)
             if tracer is not None:
@@ -395,6 +694,19 @@ class ServingEngine:
                     args={"request": rid,
                           "queue_wait_ms": round((now - r.submit_time) * 1e3, 3)},
                 )
+        elif kind == "shed":
+            if tm.collect:
+                tm.counter("serving/shed").inc()
+                if r.retry_after is not None:
+                    tm.histogram("serving/retry_after_s").observe(r.retry_after)
+            if tracer is not None:
+                tracer.add_instant(
+                    "shed", "serving.request", ts=now,
+                    pid=_telemetry.PID_REQUESTS, tid=rid,
+                    args={"request": rid, "priority": r.priority,
+                          "ladder_rung": self.scheduler.ladder.level,
+                          "retry_after_s": r.retry_after},
+                )
 
     def telemetry_summary(self) -> Dict[str, Any]:
         """Compact roll-up for bench records — MODEL-derived, unlike the
@@ -430,6 +742,8 @@ class ServingEngine:
 
     # ------------------------------------------------------------------
     def _run_prefill(self, job: PrefillJob) -> None:
+        faults.check("serving.prefill")
+        faults.check_latency("serving.prefill")
         san = self._sanitizer
         fn = self._get_prefill()
         r = job.req
@@ -470,6 +784,8 @@ class ServingEngine:
         self.scheduler.note_prefill(job, tok, now=now, step=self._step_count)
 
     def _run_decode(self, toks: np.ndarray, pos: np.ndarray, decoding) -> None:
+        faults.check("serving.decode")
+        faults.check_latency("serving.decode")
         san = self._sanitizer
         fn = self._get_decode()
         flags, temps, topks, seeds = self.scheduler.sampling_inputs()
@@ -493,16 +809,33 @@ class ServingEngine:
     def stats(self) -> Dict[str, Any]:
         """Counters + per-step phase attribution (prefill_ms/decode_ms/
         sched_ms, mean queue_depth/live_slots) for logs and bench
-        records."""
+        records.  Host-side deadline sweep included: an idle engine's
+        over-deadline waiters expire the moment anyone looks, not only
+        when a ``step()`` happens to run."""
         s = self.scheduler
+        if s.sweep_expired(time.monotonic(), self._step_count):
+            self._journal_commit()
         if self.telemetry.collect:
             self.telemetry.gauge("serving/queue_depth_now").set(s.queue_depth)
             self.telemetry.gauge("serving/live_slots_now").set(self.pool.live_slots)
+        j = self._journal
         out = {
             "submitted": s.submitted,
             "finished": s.finished_count,
             "rejected": s.rejected,
             "expired": s.expired,
+            # resilience (docs/serving.md §Resilience)
+            "shed": s.shed_count + s.admission.shed,
+            "degrade_level": s.ladder.level,
+            "degrade_rung": s.ladder.rung,
+            "degrade_engagements": s.ladder.engagements,
+            "draining": bool(self._watchdog is not None and self._watchdog.draining),
+            "journal": (
+                "off" if j is None and not getattr(self, "_journal_quarantined", None)
+                else ("quarantined" if j is None else "on")
+            ),
+            "journal_records": 0 if j is None else j.records,
+            "journal_commits": 0 if j is None else j.commits,
             # instantaneous levels; the window MEANS arrive from the
             # timeline summary below as queue_depth / live_slots
             "queue_depth_now": s.queue_depth,
@@ -519,4 +852,7 @@ class ServingEngine:
         return out
 
 
-__all__ = ["ServingEngine", "ServingQueueFull", "Request"]
+__all__ = [
+    "ServingEngine", "ServingQueueFull", "ServingOverloaded", "ServingDraining",
+    "Request",
+]

@@ -75,6 +75,12 @@ class SlotKVPool:
     def owner(self, slot: int) -> Optional[Any]:
         return self._owner.get(slot)
 
+    def owners(self) -> Dict[int, Any]:
+        """Snapshot of slot -> request id (the serving drain logs the
+        in-flight set a deadline cut off; a copy, safe to iterate while
+        the scheduler retires)."""
+        return dict(self._owner)
+
     def alloc(self, request_id: Any) -> Optional[int]:
         """Claim a slot for ``request_id``; None when the pool is full."""
         if not self._free:

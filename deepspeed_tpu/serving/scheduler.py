@@ -18,16 +18,28 @@ decode step relies on: :meth:`decode_inputs` gives every non-decoding
 slot a write position whose contents are overwritten before they are
 ever attendable (a mid-prefill slot's next chunk start; position 0 for
 free slots, which the next occupant's first chunk overwrites).
+
+Overload management (docs/serving.md §Resilience): ``submit`` carries a
+**priority tier** (0 high / 1 normal / 2 low); admission into free
+slots is priority-then-FIFO.  An :class:`AdmissionController` sheds
+normal/low submits whose *estimated TTFT* — queue backlog over the
+measured step rate the engine feeds in — exceeds ``slo_ttft_ms``,
+raising :class:`ServingOverloaded` with a ``retry_after`` hint.  A
+:class:`DegradationLadder` engages on sustained queue pressure with
+hysteresis: clamp new admits' ``max_new_tokens`` → shrink the prefill
+chunk budget to 1 → shed queued low-priority requests.
 """
 from __future__ import annotations
 
 import dataclasses
-import itertools
+import math
+import threading
 from collections import deque
-from typing import Any, Deque, Dict, List, Optional, Tuple
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from deepspeed_tpu.config import constants as C
 from deepspeed_tpu.serving.pool import SlotKVPool
 from deepspeed_tpu.utils.logging import logger
 
@@ -36,17 +48,65 @@ PREFILL = "prefill"
 DECODE = "decode"
 DONE = "done"
 EXPIRED = "expired"
+SHED = "shed"
+
+PRIORITY_HIGH = C.SERVING_PRIORITY_HIGH
+PRIORITY_NORMAL = C.SERVING_PRIORITY_NORMAL
+PRIORITY_LOW = C.SERVING_PRIORITY_LOW
 
 
 class ServingQueueFull(RuntimeError):
     """Graceful admission rejection: the waiting queue is at its bound.
-    Callers back off / shed load; nothing in flight is affected."""
+    Callers back off / shed load; nothing in flight is affected.
+    ``retry_after`` (seconds, may be None) is the backoff hint derived
+    from the estimated backlog drain time."""
+
+    def __init__(self, msg: str, retry_after: Optional[float] = None):
+        super().__init__(msg)
+        self.retry_after = retry_after
 
 
-# Process-global request ids: several engines in one process (bench
-# sweeps build one per (kv, load) point) must not reuse ids — the
-# telemetry trace keys per-request span lanes on them.
-_REQUEST_IDS = itertools.count()
+class ServingOverloaded(ServingQueueFull):
+    """Load-shed rejection: the request's *estimated TTFT* (backlog over
+    the measured step rate) exceeds the configured SLO.  Subclasses
+    :class:`ServingQueueFull` so existing back-off handlers keep
+    working; ``retry_after`` estimates when the backlog will have
+    drained below the SLO."""
+
+
+class ServingDraining(ServingQueueFull):
+    """Admission stopped: the engine received SIGTERM and is draining
+    (docs/serving.md §Resilience).  Retry against the restarted engine
+    — journaled undone work replays there."""
+
+
+class _IdSource:
+    """Process-global request ids: several engines in one process (bench
+    sweeps build one per (kv, load) point) must not reuse ids — the
+    telemetry trace keys per-request span lanes on them.  Journal
+    replay preserves original ids, so :meth:`advance_past` bumps the
+    counter beyond any replayed id before fresh submits resume."""
+
+    def __init__(self):
+        self._n = -1
+        self._lock = threading.Lock()
+
+    def __next__(self) -> int:
+        with self._lock:
+            self._n += 1
+            return self._n
+
+    def advance_past(self, request_id: int) -> None:
+        with self._lock:
+            self._n = max(self._n, int(request_id))
+
+
+_REQUEST_IDS = _IdSource()
+
+
+def advance_request_ids(request_id: int) -> None:
+    """Module-level hook for journal replay (see :class:`_IdSource`)."""
+    _REQUEST_IDS.advance_past(request_id)
 
 
 @dataclasses.dataclass
@@ -65,6 +125,10 @@ class Request:
     temperature: float = 1.0
     top_k: int = 0
     seed: int = 0
+    # overload management (docs/serving.md §Resilience)
+    priority: int = PRIORITY_NORMAL  # 0 high / 1 normal / 2 low
+    retry_after: Optional[float] = None  # backoff hint on shed/expired results
+    degraded: bool = False  # admitted under an engaged degradation ladder
 
     status: str = QUEUED
     slot: Optional[int] = None
@@ -114,6 +178,145 @@ class StepPlan:
     prefill_jobs: List[PrefillJob]
 
 
+class DegradationLadder:
+    """Graduated load response with hysteresis (docs/serving.md
+    §Resilience).  ``update`` is called once per scheduler tick with the
+    queue depth; ``engage_steps`` consecutive pressured ticks climb one
+    rung, ``disengage_steps`` consecutive calm ticks step one down —
+    engaging fast and disengaging slow so the ladder does not flap at
+    the watermark.
+
+    Rungs: 0 normal · 1 clamp new admits' ``max_new_tokens`` · 2 shrink
+    the prefill chunk budget to one chunk/step · 3 shed queued
+    low-priority requests.
+    """
+
+    RUNGS = ("normal", "clamp_new_tokens", "shrink_prefill", "shed_low_priority")
+    MAX_LEVEL = 3
+
+    def __init__(self, max_queue: int, watermark: float = 0.75,
+                 engage_steps: int = 8, disengage_steps: int = 16):
+        self.max_queue = int(max_queue)
+        self.watermark = float(watermark)
+        self.engage_steps = max(1, int(engage_steps))
+        self.disengage_steps = max(1, int(disengage_steps))
+        self.level = 0
+        self.engagements = 0  # rung climbs over the scheduler's life
+        self._pressured_ticks = 0
+        self._calm_ticks = 0
+
+    @property
+    def rung(self) -> str:
+        return self.RUNGS[self.level]
+
+    def pressured(self, queue_depth: int) -> bool:
+        return self.max_queue > 0 and queue_depth >= self.watermark * self.max_queue
+
+    def update(self, queue_depth: int) -> int:
+        """One tick; returns the (possibly changed) level."""
+        if self.pressured(queue_depth):
+            self._calm_ticks = 0
+            self._pressured_ticks += 1
+            if self._pressured_ticks >= self.engage_steps and self.level < self.MAX_LEVEL:
+                self.level += 1
+                self.engagements += 1
+                self._pressured_ticks = 0
+                logger.warning(
+                    f"serving: degradation ladder engaged rung {self.level} "
+                    f"({self.rung}) at queue depth {queue_depth}/{self.max_queue}"
+                )
+        else:
+            self._pressured_ticks = 0
+            self._calm_ticks += 1
+            if self._calm_ticks >= self.disengage_steps and self.level > 0:
+                self.level -= 1
+                self._calm_ticks = 0
+                logger.info(
+                    f"serving: degradation ladder stepped down to rung "
+                    f"{self.level} ({self.rung})"
+                )
+        return self.level
+
+
+class AdmissionController:
+    """Estimated-TTFT load shedding.  The estimate is a queueing model
+    over *measured* time — ``step_seconds_fn`` returns the engine's
+    recent mean serving-step wall (the telemetry registry's window when
+    the plane is armed, a local EWMA otherwise); the backlog is counted
+    in steps:
+
+    * prefill work ahead: every queued prompt's chunks (plus the
+      candidate's own) over the effective chunks-per-step budget;
+    * slot wait: with no free slot, the mean remaining decode budget of
+      the live set, times how many queue "generations" precede the
+      candidate (``ceil(queue_position / num_slots)``).
+
+    It is an *estimate* feeding an SLO threshold, not a guarantee — the
+    point is that shed decisions track the actually-measured service
+    rate, so a slow chip sheds sooner at the same queue depth.  High
+    priority bypasses the test (only the hard ``max_queue`` bound
+    applies); without a measurement yet (cold engine) everything
+    admits."""
+
+    def __init__(self, scheduler: "ContinuousScheduler", slo_ttft_ms: float,
+                 retry_after_min: float = C.SERVING_RETRY_AFTER_MIN_SECONDS_DEFAULT):
+        self.scheduler = scheduler
+        self.slo_ttft_ms = float(slo_ttft_ms)
+        self.retry_after_min = float(retry_after_min)
+        self.shed = 0  # TTFT-shed submit rejections
+
+    def estimate_ttft_seconds(self, prompt_len: int,
+                              in_queue: bool = False) -> Optional[float]:
+        """``in_queue=True`` when the candidate already sits in the
+        queue (the rung-3 shed path pricing a waiter's retry_after):
+        its chunks are then inside the queue sum and its queue slot
+        inside ``len(_queue)`` — adding them again would double-count."""
+        s = self.scheduler
+        step_s = s.step_seconds_fn() if s.step_seconds_fn is not None else None
+        if not step_s or step_s <= 0:
+            return None
+        chunk = s.prefill_chunk
+        chunks = sum(
+            math.ceil(max(r.prompt_len - r.prefill_pos, 0) / chunk) for r in s._queue
+        ) + (0 if in_queue else math.ceil(prompt_len / chunk))
+        steps = math.ceil(chunks / s.effective_chunks_per_step())
+        if not s.pool.free_slots:
+            live = [r for r in s._active.values()]
+            if live:
+                remaining = [
+                    max(r.max_new_tokens - len(r.generated), 1) for r in live
+                ]
+                mean_rem = sum(remaining) / len(remaining)
+                waiters = len(s._queue) + (0 if in_queue else 1)
+                generations = math.ceil(waiters / s.pool.num_slots)
+                steps += int(mean_rem * generations)
+        return steps * step_s
+
+    def retry_after_seconds(self, est_s: Optional[float]) -> float:
+        """How long until the backlog should have drained below the SLO
+        (floored — a sub-50ms hint tells a client nothing)."""
+        if est_s is None:
+            return max(self.retry_after_min, 1.0)
+        return max(self.retry_after_min, est_s - self.slo_ttft_ms / 1e3)
+
+    def check(self, prompt_len: int, priority: int) -> None:
+        """Raise :class:`ServingOverloaded` when the candidate's
+        estimated TTFT exceeds the SLO (normal/low priority only)."""
+        if self.slo_ttft_ms <= 0 or priority <= PRIORITY_HIGH:
+            return
+        est = self.estimate_ttft_seconds(prompt_len)
+        if est is not None and est * 1e3 > self.slo_ttft_ms:
+            self.shed += 1
+            retry = self.retry_after_seconds(est)
+            raise ServingOverloaded(
+                f"serving overloaded: estimated TTFT {est * 1e3:.0f}ms exceeds "
+                f"slo_ttft_ms={self.slo_ttft_ms:g} "
+                f"(queue {self.scheduler.queue_depth}, priority {priority}); "
+                f"retry after {retry:.2f}s",
+                retry_after=retry,
+            )
+
+
 class ContinuousScheduler:
     def __init__(
         self,
@@ -123,6 +326,11 @@ class ContinuousScheduler:
         max_queue: int = 64,
         deadline_seconds: float = 0.0,
         capacity: Optional[int] = None,
+        slo_ttft_ms: float = 0.0,
+        degrade_queue_watermark: float = C.SERVING_DEGRADE_QUEUE_WATERMARK_DEFAULT,
+        degrade_engage_steps: int = C.SERVING_DEGRADE_ENGAGE_STEPS_DEFAULT,
+        degrade_disengage_steps: int = C.SERVING_DEGRADE_DISENGAGE_STEPS_DEFAULT,
+        degrade_max_new_tokens: int = C.SERVING_DEGRADE_MAX_NEW_TOKENS_DEFAULT,
     ):
         self.pool = pool
         self.prefill_chunk = int(prefill_chunk)
@@ -139,7 +347,19 @@ class ContinuousScheduler:
         self.submitted = 0
         self.rejected = 0
         self.expired = 0
+        self.shed_count = 0  # queued requests shed by the ladder
         self.finished_count = 0
+        self.degrade_max_new_tokens = max(0, int(degrade_max_new_tokens))
+        self.ladder = DegradationLadder(
+            max_queue=self.max_queue,
+            watermark=degrade_queue_watermark,
+            engage_steps=degrade_engage_steps,
+            disengage_steps=degrade_disengage_steps,
+        )
+        self.admission = AdmissionController(self, slo_ttft_ms=slo_ttft_ms)
+        # measured serving-step wall feed (seconds; engine-owned so the
+        # scheduler stays jax- and telemetry-free)
+        self.step_seconds_fn: Optional[Callable[[], Optional[float]]] = None
         # lifecycle observer (the serving engine's telemetry hook):
         # called as on_event(kind, request, now, step) at "admitted",
         # "first_token", "finished", "expired" transitions.  Pure host
@@ -162,6 +382,14 @@ class ContinuousScheduler:
     def has_work(self) -> bool:
         return bool(self._queue or self._active)
 
+    def pending_ids(self) -> List[int]:
+        """Ids of every request not yet finished (queued + in-flight) —
+        the graceful drain's undone set."""
+        return sorted(
+            [r.request_id for r in self._queue]
+            + [r.request_id for r in self._active.values()]
+        )
+
     def request(self, request_id: int) -> Optional[Request]:
         if request_id in self._finished:
             return self._finished[request_id]
@@ -177,6 +405,12 @@ class ContinuousScheduler:
         out, self._finished = self._finished, {}
         return out
 
+    def effective_chunks_per_step(self) -> int:
+        """The prefill chunk budget after the degradation ladder: rung 2
+        ("shrink_prefill") caps it at one chunk/step so decode latency
+        for the live set is protected at the cost of new-request TTFT."""
+        return 1 if self.ladder.level >= 2 else self.prefill_chunks_per_step
+
     # -- admission --------------------------------------------------------
     def submit(
         self,
@@ -190,7 +424,15 @@ class ContinuousScheduler:
         temperature: float = 1.0,
         top_k: int = 0,
         seed: int = 0,
+        priority: int = PRIORITY_NORMAL,
+        request_id: Optional[int] = None,
+        bypass_admission: bool = False,
     ) -> Request:
+        """``priority``: 0 high (never TTFT-shed) / 1 normal / 2 low
+        (first shed when the ladder tops out).  ``request_id`` +
+        ``bypass_admission`` are the journal-replay surface: replayed
+        requests were *already accepted* before the crash, so they keep
+        their ids and skip every overload test."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.shape[0] < 1:
             raise ValueError("prompt must contain at least one token")
@@ -200,6 +442,11 @@ class ContinuousScheduler:
             raise ValueError(f"temperature must be > 0 when sampling, got {temperature}")
         if top_k < 0:
             raise ValueError(f"top_k must be >= 0, got {top_k}")
+        if priority not in (PRIORITY_HIGH, PRIORITY_NORMAL, PRIORITY_LOW):
+            raise ValueError(
+                f"priority must be {PRIORITY_HIGH} (high), {PRIORITY_NORMAL} "
+                f"(normal) or {PRIORITY_LOW} (low), got {priority}"
+            )
         total = prompt.shape[0] + int(max_new_tokens)
         if total > self.capacity:
             raise ValueError(
@@ -207,14 +454,40 @@ class ContinuousScheduler:
                 f"= {total} exceeds the serving capacity {self.capacity} "
                 f"(pool max_len={self.pool.max_len})"
             )
-        if len(self._queue) >= self.max_queue:
-            self.rejected += 1
-            raise ServingQueueFull(
-                f"serving queue is full ({len(self._queue)} waiting >= "
-                f"max_queue={self.max_queue}); retry later or raise serving.max_queue"
-            )
+        if not bypass_admission:
+            if len(self._queue) >= self.max_queue:
+                self.rejected += 1
+                retry = self.admission.retry_after_seconds(
+                    self.admission.estimate_ttft_seconds(prompt.shape[0])
+                )
+                raise ServingQueueFull(
+                    f"serving queue is full ({len(self._queue)} waiting >= "
+                    f"max_queue={self.max_queue}); retry after ~{retry:.2f}s "
+                    f"or raise serving.max_queue",
+                    retry_after=retry,
+                )
+            if self.ladder.level >= 3 and priority >= PRIORITY_LOW:
+                # rung 3: low priority is shed at the door, not queued
+                # then expired — the queue is for work that can be served
+                self.rejected += 1
+                self.admission.shed += 1
+                retry = self.admission.retry_after_seconds(
+                    self.admission.estimate_ttft_seconds(prompt.shape[0])
+                )
+                raise ServingOverloaded(
+                    f"serving overloaded: degradation ladder at rung "
+                    f"{self.ladder.level} ({self.ladder.rung}) sheds low-priority "
+                    f"submits; retry after {retry:.2f}s",
+                    retry_after=retry,
+                )
+            # estimated-TTFT admission test (high priority bypasses)
+            try:
+                self.admission.check(prompt.shape[0], priority)
+            except ServingOverloaded:
+                self.rejected += 1
+                raise
         req = Request(
-            request_id=next(self._ids),
+            request_id=next(self._ids) if request_id is None else int(request_id),
             prompt=prompt,
             max_new_tokens=int(max_new_tokens),
             eos_token_id=eos_token_id,
@@ -223,44 +496,115 @@ class ContinuousScheduler:
             temperature=float(temperature),
             top_k=int(top_k),
             seed=int(seed),
+            priority=int(priority),
             submit_time=now,
             submit_step=step,
         )
+        if request_id is not None:
+            self._ids.advance_past(request_id)
         self._queue.append(req)
         self.submitted += 1
         return req
 
     # -- per-step policy --------------------------------------------------
-    def tick(self, now: float, step: int) -> StepPlan:
-        """Expire over-deadline waiters, admit queued requests into free
-        slots, and pick this step's prefill chunks."""
-        # 1) queue-wait deadlines
-        if self._queue:
-            kept: Deque[Request] = deque()
-            for r in self._queue:
-                deadline = (
-                    r.deadline_seconds
-                    if r.deadline_seconds is not None
-                    else self.deadline_seconds
+    def sweep_expired(self, now: float, step: int) -> int:
+        """Expire queued requests past their queue-wait deadline.  Runs
+        inside every :meth:`tick`, AND host-side from the engine's
+        ``stats()``/``drain()`` — an idle engine (submitted work but no
+        ``step()`` being driven) must still expire waiters rather than
+        hold them past their deadline forever."""
+        if not self._queue:
+            return 0
+        n = 0
+        kept: Deque[Request] = deque()
+        for r in self._queue:
+            deadline = (
+                r.deadline_seconds
+                if r.deadline_seconds is not None
+                else self.deadline_seconds
+            )
+            if deadline and (now - r.submit_time) > deadline:
+                r.status = EXPIRED
+                r.finish_reason = "expired"
+                r.finish_time = now
+                r.finish_step = step
+                self._finished[r.request_id] = r
+                self.expired += 1
+                n += 1
+                logger.warning(
+                    f"serving: request {r.request_id} expired after "
+                    f"{now - r.submit_time:.3f}s in queue (deadline {deadline:g}s)"
                 )
-                if deadline and (now - r.submit_time) > deadline:
-                    r.status = EXPIRED
-                    r.finish_reason = "expired"
-                    r.finish_time = now
-                    r.finish_step = step
-                    self._finished[r.request_id] = r
-                    self.expired += 1
-                    logger.warning(
-                        f"serving: request {r.request_id} expired after "
-                        f"{now - r.submit_time:.3f}s in queue (deadline {deadline:g}s)"
-                    )
-                    self._emit("expired", r, now, step)
-                else:
-                    kept.append(r)
-            self._queue = kept
-        # 2) admission: queued -> free slots (FIFO)
-        while self._queue and self.pool.free_slots:
-            r = self._queue.popleft()
+                self._emit("expired", r, now, step)
+            else:
+                kept.append(r)
+        self._queue = kept
+        return n
+
+    def shed_queued_low_priority(self, now: float, step: int) -> int:
+        """Ladder rung 3: retire queued low-priority requests with a
+        ``retry_after`` hint — explicit shed beats silent deadline death
+        under sustained overload."""
+        if not any(r.priority >= PRIORITY_LOW for r in self._queue):
+            return 0
+        n = 0
+        kept: Deque[Request] = deque()
+        for r in self._queue:
+            if r.priority >= PRIORITY_LOW:
+                r.status = SHED
+                r.finish_reason = "shed"
+                r.finish_time = now
+                r.finish_step = step
+                r.retry_after = self.admission.retry_after_seconds(
+                    self.admission.estimate_ttft_seconds(r.prompt_len, in_queue=True)
+                )
+                self._finished[r.request_id] = r
+                self.shed_count += 1
+                n += 1
+                self._emit("shed", r, now, step)
+            else:
+                kept.append(r)
+        self._queue = kept
+        if n:
+            logger.warning(
+                f"serving: shed {n} queued low-priority request(s) at ladder "
+                f"rung {self.ladder.level}"
+            )
+        return n
+
+    def _pop_next(self) -> Request:
+        """Highest-priority (lowest tier number) queued request, FIFO
+        within a tier — an O(queue) scan, fine at max_queue scale."""
+        best_i, best = 0, None
+        for i, r in enumerate(self._queue):
+            if best is None or r.priority < best.priority:
+                best_i, best = i, r
+                if r.priority == PRIORITY_HIGH:
+                    break
+        del self._queue[best_i]
+        return best
+
+    def tick(self, now: float, step: int, admit: bool = True) -> StepPlan:
+        """Expire over-deadline waiters, update the degradation ladder,
+        admit queued requests into free slots (priority-then-FIFO), and
+        pick this step's prefill chunks.  ``admit=False`` is drain mode:
+        in-flight requests keep decoding, the queue stays parked (its
+        journaled work replays on the restarted engine)."""
+        # 1) queue-wait deadlines
+        self.sweep_expired(now, step)
+        # 2) degradation ladder (hysteresis inside)
+        self.ladder.update(len(self._queue))
+        if admit and self.ladder.level >= 3:
+            self.shed_queued_low_priority(now, step)
+        # 3) admission: queued -> free slots (priority, then FIFO)
+        while admit and self._queue and self.pool.free_slots:
+            r = self._pop_next()
+            if self.ladder.level >= 1 and self.degrade_max_new_tokens:
+                # rung 1: clamp the generation budget of NEW admits only
+                # — in-flight budgets are a contract already accepted
+                if r.max_new_tokens > self.degrade_max_new_tokens:
+                    r.max_new_tokens = self.degrade_max_new_tokens
+                    r.degraded = True
             r.slot = self.pool.alloc(r.request_id)
             r.status = PREFILL
             r.prefill_pos = 0
@@ -268,9 +612,9 @@ class ContinuousScheduler:
             r.admit_step = step
             self._active[r.slot] = r
             self._emit("admitted", r, now, step)
-        # 3) prefill chunk budget, FIFO over mid-prefill slots
+        # 4) prefill chunk budget, FIFO over mid-prefill slots
         jobs: List[PrefillJob] = []
-        budget = self.prefill_chunks_per_step
+        budget = self.effective_chunks_per_step()
         prefilling = sorted(
             (r for r in self._active.values() if r.status == PREFILL),
             key=lambda r: r.request_id,

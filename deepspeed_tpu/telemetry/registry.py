@@ -176,6 +176,15 @@ class Histogram(Metric):
     def mean(self) -> Optional[float]:
         return self.sum / self.count if self.count else None
 
+    def window_mean(self) -> Optional[float]:
+        """Mean over the RING (recent window) — what a load-tracking
+        consumer wants (the serving admission controller estimates TTFT
+        from the *current* decode wall, not the lifetime mean, which a
+        warmup compile would skew forever)."""
+        with self._lock:
+            ring = list(self._ring)
+        return sum(ring) / len(ring) if ring else None
+
     def compact_value(self) -> float:
         m = self.mean()
         return m if m is not None else 0.0

@@ -68,9 +68,10 @@ def build_workload(n, prompt_lo, prompt_hi, max_new, seed, vocab):
 def warm(srv, workload):
     """Compile both serving executables before the measured window (a
     fresh ServingEngine's first chunk/decode otherwise charges the jit
-    trace to the first request's latency)."""
+    trace to the first request's latency).  Priority 0: the warm-up
+    must admit even when --overload arms the shedder."""
     w = workload[0]
-    srv.submit(w["prompt"], max_new_tokens=min(2, w["max_new"]))
+    srv.submit(w["prompt"], max_new_tokens=min(2, w["max_new"]), priority=0)
     srv.drain(max_steps=10_000)
     srv.timeline.reset_window()
     return srv
@@ -107,6 +108,7 @@ def run_load(make_serving, workload, offered_rps, seed):
     pending = list(zip(arrivals, workload))
     ids = {}  # request_id -> scheduled arrival offset
     finished = {}
+    shed_retry = []  # retry_after hints carried by shed/queue-full rejections
     while pending or srv.scheduler.has_work():
         now = time.monotonic() - t0
         while pending and pending[0][0] <= now:
@@ -114,8 +116,10 @@ def run_load(make_serving, workload, offered_rps, seed):
             try:
                 rid = srv.submit(w["prompt"], max_new_tokens=w["max_new"])
                 ids[rid] = arr
-            except ServingQueueFull:
-                pass  # shed load under overload; scheduler counts the rejection
+            except ServingQueueFull as e:
+                # shed load under overload; scheduler counts the rejection
+                if e.retry_after is not None:
+                    shed_retry.append(e.retry_after)
         if srv.scheduler.has_work():
             srv.step()
         elif pending:
@@ -154,6 +158,14 @@ def run_load(make_serving, workload, offered_rps, seed):
         "completed": len(ttft),
         "rejected": stats["rejected"],
         "expired": stats["expired"],
+        # overload-resilience fields (docs/serving.md §Resilience):
+        # shed_rate over OFFERED requests; the ttft_* percentiles above
+        # are admitted-only, which is exactly the shedder's SLO claim
+        "shed": stats["shed"],
+        "shed_rate": round(stats["rejected"] / max(len(workload), 1), 3),
+        "retry_after_p50_s": pct(shed_retry, 50),
+        "degrade_engagements": stats["degrade_engagements"],
+        "degrade_level_final": stats["degrade_level"],
         "offered_rps": round(offered_rps, 3),
         "prefill_ms": stats["prefill_ms"],
         "decode_ms": stats["decode_ms"],
@@ -179,6 +191,12 @@ def main():
     ap.add_argument("--num-slots", type=int, default=None)
     ap.add_argument("--prefill-chunk", type=int, default=None)
     ap.add_argument("--max-queue", type=int, default=256)
+    ap.add_argument("--overload", action="store_true",
+                    help="overload-resilience mode: arm the estimated-TTFT "
+                         "shedder (--slo-ttft-ms) and run 2x/4x offered load, "
+                         "recording shed-rate + admitted-p99 TTFT")
+    ap.add_argument("--slo-ttft-ms", type=float, default=500.0,
+                    help="serving.slo_ttft_ms for --overload engines")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--trace", default=None, metavar="PATH",
                     help="export a Chrome-trace/Perfetto trace.json of the "
@@ -212,6 +230,8 @@ def main():
     max_new = args.max_new or max_new
     slots = args.num_slots or slots
     chunk = args.prefill_chunk or chunk
+    if args.overload and args.loads == "0.5,1.0,2.0":
+        args.loads = "2.0,4.0"  # the shed regime, unless --loads overrides
     loads = [float(x) for x in args.loads.split(",") if x]
 
     t0 = time.monotonic()
@@ -231,9 +251,15 @@ def main():
         tag = "int8" if kv == "int8" else "bf16"
 
         def make_serving():
+            kw = {}
+            if args.overload:
+                # arm the admission controller; the capacity measurement
+                # below stays unshedded (closed-loop never queues deep)
+                kw["slo_ttft_ms"] = args.slo_ttft_ms
             return ServingEngine(
                 engine, num_slots=slots, prefill_chunk=chunk, max_len=max_len,
                 kv_cache_dtype=kv, max_queue=args.max_queue, max_new_tokens=max_new,
+                **kw,
             )
 
         tok_s, req_s, dt = run_closed_loop(make_serving, workload)
@@ -242,12 +268,14 @@ def main():
         for load in loads:
             rec = run_load(make_serving, workload, max(req_s * load, 1e-3),
                            seed=args.seed + int(load * 1000))
+            prefix = "serving_overload" if args.overload else "serving"
             rec = {
-                "metric": f"serving_{model.replace('-', '_')}_{tag}kv_load{load:g}",
+                "metric": f"{prefix}_{model.replace('-', '_')}_{tag}kv_load{load:g}",
                 "value": rec.pop("tokens_per_s"),
                 "unit": "tokens/s",
                 "kv_cache_dtype": tag,
                 "load_fraction": load,
+                **({"slo_ttft_ms": args.slo_ttft_ms} if args.overload else {}),
                 "num_slots": slots,
                 "prefill_chunk": chunk,
                 "max_len": max_len,
@@ -258,7 +286,10 @@ def main():
             log(f"[{tag}] load {load:g}x: {rec['value']} tok/s, "
                 f"ttft p50/p99 {rec['ttft_p50_ms']}/{rec['ttft_p99_ms']} ms, "
                 f"tpot p50/p99 {rec['tpot_p50_ms']}/{rec['tpot_p99_ms']} ms, "
-                f"queue {rec['queue_depth']}")
+                f"queue {rec['queue_depth']}"
+                + (f", shed_rate {rec['shed_rate']:.1%} "
+                   f"(admitted p99 {rec['ttft_submit_p99_ms']} ms vs "
+                   f"SLO {args.slo_ttft_ms:g})" if args.overload else ""))
 
     if args.trace:
         path = telemetry.export_trace(args.trace)
