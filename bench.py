@@ -38,6 +38,8 @@ import numpy as np
 START = time.time()
 HERE = os.path.dirname(os.path.abspath(__file__))
 EXTRA_PATH = os.path.join(HERE, "BENCH_EXTRA.json")
+BENCH_JSON_PATH = os.path.join(HERE, "BENCH.json")
+HISTORY_PATH = os.path.join(HERE, "bench_history.jsonl")
 DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", 1620))
 
 
@@ -641,12 +643,36 @@ def _parse_records(out: str):
     return recs
 
 
+def _apply_injection(rec: dict) -> dict:
+    """CI perf-sentinel knob: ``DS_BENCH_INJECT=pattern:scale[,...]``
+    scales matching metrics' values (e.g. ``decode:0.9`` = a synthetic
+    10% decode-tokens/s regression).  The record is marked ``injected``
+    so a doctored number can never pass as a measurement."""
+    spec = os.environ.get("DS_BENCH_INJECT", "")
+    if not spec or not isinstance(rec.get("value"), (int, float)):
+        return rec
+    for part in spec.split(","):
+        pat, _, scale = part.partition(":")
+        if pat and scale and pat in rec.get("metric", ""):
+            rec = dict(
+                rec,
+                value=round(rec["value"] * float(scale), 4),
+                injected={"pattern": pat, "scale": float(scale)},
+            )
+            log(f"INJECTED {pat}:{scale} -> {rec['metric']} = {rec['value']}")
+    return rec
+
+
 def _run_child(name: str, budget: float):
     """Run one rung child; returns (records, failure_reason|None)."""
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--rung", name],
             stdout=subprocess.PIPE, timeout=budget, cwd=HERE,
+            # children (and the grandchild sweeps they spawn) must not
+            # append bench history themselves — the parent is the one
+            # writer for a driver run (regression.history_append gates)
+            env={**os.environ, "DS_BENCH_CHILD": "1"},
         )
     except subprocess.TimeoutExpired as e:
         log(f"[{name}] TIMED OUT at {budget:.0f}s — killed")
@@ -661,7 +687,26 @@ def _run_child(name: str, budget: float):
     return recs, None
 
 
+def _load_regression():
+    """Import telemetry/regression.py by FILE PATH: the parent process
+    runs no jax at all (children own the chip), and going through the
+    ``deepspeed_tpu`` package __init__ would initialize a backend.  The
+    module is deliberately stdlib-only, so this is safe."""
+    import importlib.util
+
+    path = os.path.join(HERE, "deepspeed_tpu", "telemetry", "regression.py")
+    spec = importlib.util.spec_from_file_location("_ds_bench_regression", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def main():
+    _regression = _load_regression()
+    git_sha, history_append, new_run_id = (
+        _regression.git_sha, _regression.history_append, _regression.new_run_id
+    )
+
     extra = []
     if os.path.exists(EXTRA_PATH):
         os.remove(EXTRA_PATH)  # never let a stale record outlive this run
@@ -670,11 +715,38 @@ def main():
         with open(EXTRA_PATH, "w") as f:
             json.dump(extra, f, indent=1)
 
+    # consolidated machine-readable summary (rung -> headline metrics):
+    # rewritten after every rung so the trajectory survives a cap kill,
+    # finalized at the end — no more parsing log tails to recover a run
+    run_id = new_run_id()
+    sha = git_sha(HERE)
+    rung_summary = {}
+
+    def flush_bench_json(done=False):
+        doc = {
+            "schema": 1,
+            "ts": time.time(),
+            "run_id": run_id,
+            "git_sha": sha,
+            "complete": done,
+            "wall_s": round(time.time() - START, 1),
+            "rungs": rung_summary,
+        }
+        tmp = BENCH_JSON_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, BENCH_JSON_PATH)
+
     headline_printed = False
     skip_big = os.environ.get("BENCH_SKIP_BIG") == "1"
     retries_used = 0
 
     active = [r for r in RUNGS if not (skip_big and r[0] != "headline")]
+    only = [s for s in os.environ.get("BENCH_RUNGS", "").split(",") if s]
+    if only:
+        # CI perf-sentinel subset (and a dev convenience): run only the
+        # named rungs, in ladder order
+        active = [r for r in active if r[0] in only]
     for i, (name, est, cap) in enumerate(active):
         rest_est = sum(e for _, e, _ in active[i + 1:])
         # the rung must fit inside its own kill cap: launching when
@@ -684,7 +756,9 @@ def main():
             log(f"[{name}] SKIPPED: {remaining():.0f}s left < {est}s estimate + 45s teardown")
             extra.append({"metric": name, "skipped": True,
                           "reason": f"{remaining():.0f}s budget left < {est}s estimate + 45s teardown"})
+            rung_summary[name] = {"skipped": True, "reason": "budget"}
             flush_extra()
+            flush_bench_json()
             continue
         budget = min(cap, remaining() - 45)
         log(f"[{name}] launching (cap {budget:.0f}s, {remaining():.0f}s left)")
@@ -734,7 +808,10 @@ def main():
 
         if fail_reason is not None and not records:
             extra.append({"metric": name, "skipped": True, "reason": fail_reason})
+            rung_summary[name] = {"skipped": True, "reason": fail_reason}
             flush_extra()
+            flush_bench_json()
+        records = [_apply_injection(rec) for rec in records]
         for rec in records:
             if name == "headline" and not headline_printed and "vs_baseline" in rec:
                 # the driver records this line — print it the moment the
@@ -744,6 +821,26 @@ def main():
             extra.append(rec)
             flush_extra()
             log(f"[{name}] recorded: {rec.get('metric')} = {rec.get('value')}")
+        if records:
+            keep = ("metric", "value", "unit", "vs_baseline", "mfu_pct",
+                    "step_ms", "backend", "injected")
+            rung_summary[name] = {
+                "records": [
+                    {k: r[k] for k in keep if k in r} for r in records
+                    if not r.get("skipped")
+                ],
+            }
+            flush_bench_json()
+            # persistent bench history (docs/performance.md §Regression
+            # workflow): one schema'd line per measured record, keyed by
+            # (rung, metric, config fingerprint, git sha, backend)
+            try:
+                n = history_append(records, rung=name, path=HISTORY_PATH,
+                                   run_id=run_id, sha=sha)
+                if n:
+                    log(f"[{name}] bench_history += {n} line(s)")
+            except Exception as e:  # noqa: BLE001 — history must not kill a bench
+                log(f"[{name}] bench_history append FAILED: {e}")
 
     if not headline_printed:
         # honest failure record — still parseable by the driver
@@ -752,8 +849,9 @@ def main():
             "value": 0, "unit": "tokens/s/chip", "vs_baseline": 0,
             "error": "headline rung did not complete",
         }), flush=True)
+    flush_bench_json(done=True)
     log(f"done in {time.time()-START:.0f}s; {sum(1 for r in extra if not r.get('skipped'))} records, "
-        f"{sum(1 for r in extra if r.get('skipped'))} skips")
+        f"{sum(1 for r in extra if r.get('skipped'))} skips; summary -> {BENCH_JSON_PATH}")
 
 
 if __name__ == "__main__":

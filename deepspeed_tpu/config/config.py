@@ -1077,6 +1077,12 @@ class TelemetryConfig:
     profiler_capture_ms: int = C.TELEMETRY_PROFILER_CAPTURE_MS_DEFAULT
     slo_ttft_breach_ms: float = C.TELEMETRY_SLO_TTFT_BREACH_MS_DEFAULT
     aggregate: bool = C.TELEMETRY_AGGREGATE_DEFAULT
+    # per-kernel cost attribution + runtime anomaly watch (ISSUE 11)
+    attribution: bool = C.TELEMETRY_ATTRIBUTION_DEFAULT
+    attribution_max_hlo_mb: float = C.TELEMETRY_ATTRIBUTION_MAX_HLO_MB_DEFAULT
+    spike_factor: float = C.TELEMETRY_SPIKE_FACTOR_DEFAULT
+    spike_min_window: int = C.TELEMETRY_SPIKE_MIN_WINDOW_DEFAULT
+    straggler_factor: float = C.TELEMETRY_STRAGGLER_FACTOR_DEFAULT
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "TelemetryConfig":
@@ -1107,6 +1113,17 @@ class TelemetryConfig:
                 _pop(d, "slo_ttft_breach_ms", C.TELEMETRY_SLO_TTFT_BREACH_MS_DEFAULT)
             ),
             aggregate=bool(_pop(d, "aggregate", C.TELEMETRY_AGGREGATE_DEFAULT)),
+            attribution=bool(_pop(d, "attribution", C.TELEMETRY_ATTRIBUTION_DEFAULT)),
+            attribution_max_hlo_mb=float(
+                _pop(d, "attribution_max_hlo_mb", C.TELEMETRY_ATTRIBUTION_MAX_HLO_MB_DEFAULT)
+            ),
+            spike_factor=float(_pop(d, "spike_factor", C.TELEMETRY_SPIKE_FACTOR_DEFAULT)),
+            spike_min_window=int(
+                _pop(d, "spike_min_window", C.TELEMETRY_SPIKE_MIN_WINDOW_DEFAULT)
+            ),
+            straggler_factor=float(
+                _pop(d, "straggler_factor", C.TELEMETRY_STRAGGLER_FACTOR_DEFAULT)
+            ),
         )
         _check_empty(d, C.TELEMETRY, _known_keys(cls))
         unknown = set(out.exporters) - set(C.TELEMETRY_EXPORTERS)
@@ -1138,6 +1155,20 @@ class TelemetryConfig:
             raise DeepSpeedConfigError(
                 f"'{C.TELEMETRY}.slo_ttft_breach_ms' must be >= 0, "
                 f"got {out.slo_ttft_breach_ms}"
+            )
+        if out.spike_factor <= 1.0:
+            raise DeepSpeedConfigError(
+                f"'{C.TELEMETRY}.spike_factor' must be > 1, got {out.spike_factor}"
+            )
+        if out.straggler_factor <= 1.0:
+            raise DeepSpeedConfigError(
+                f"'{C.TELEMETRY}.straggler_factor' must be > 1, "
+                f"got {out.straggler_factor}"
+            )
+        if out.attribution_max_hlo_mb <= 0:
+            raise DeepSpeedConfigError(
+                f"'{C.TELEMETRY}.attribution_max_hlo_mb' must be > 0, "
+                f"got {out.attribution_max_hlo_mb}"
             )
         return out
 

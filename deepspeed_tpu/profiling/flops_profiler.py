@@ -42,6 +42,18 @@ PEAK_TFLOPS_BY_PLATFORM = {
     "gpu": 312.0,   # A100 bf16, for completeness
 }
 
+# peak HBM GB/s per chip — the roofline denominator that pairs with the
+# table above (machine balance = peak flops / peak bytes; the attribution
+# module's compute- vs memory-bound verdicts key on it).
+PEAK_HBM_GBPS_BY_PLATFORM = {
+    "tpu": 819.0,   # v5e HBM2
+    # 0.5 TFLOPS / 100 GB/s → machine balance 5 flops/byte: far enough
+    # from both the dryrun train matmuls (AI ~10) and the decode
+    # matvecs (AI ~1) that the pinned roofline verdicts are stable
+    "cpu": 100.0,
+    "gpu": 2039.0,  # A100 80GB
+}
+
 
 def _num_params(tree: Any) -> int:
     return sum(int(np.prod(np.shape(p))) for p in jax.tree.leaves(tree))
@@ -63,6 +75,13 @@ def peak_flops(backend: Optional[str] = None, n_devices: int = 1) -> float:
     the 8-device dryrun, within 10%; tests/test_telemetry.py pins it)."""
     backend = backend or jax.default_backend()
     return PEAK_TFLOPS_BY_PLATFORM.get(backend, 100.0) * 1e12 * max(1, int(n_devices))
+
+
+def peak_hbm_bytes_per_s(backend: Optional[str] = None) -> float:
+    """Peak HBM bytes/s for ONE chip — the roofline bandwidth ceiling
+    (per-device, matching :func:`peak_flops`)."""
+    backend = backend or jax.default_backend()
+    return PEAK_HBM_GBPS_BY_PLATFORM.get(backend, 100.0) * 1e9
 
 
 def derive_step_stats(

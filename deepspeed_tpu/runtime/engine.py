@@ -1311,6 +1311,14 @@ class DeepSpeedEngine:
         if self.telemetry is not None:
             self.telemetry.set_comm(summ)
 
+    def train_step_attribution(self):
+        """The compiled train step's per-kernel cost table
+        (:class:`~deepspeed_tpu.telemetry.attribution.Attribution`), or
+        None before the first compile / when the plane is disabled —
+        surfaced by ds_report, bench records, and the perf-sentinel
+        roofline artifact."""
+        return self.telemetry.attribution() if self.telemetry is not None else None
+
     def comm_summary(self) -> Dict[str, Any]:
         """Active comm-strategy table + the per-step comm-bytes model
         (docs/comm.md) — surfaced by ds_report and bench.py records."""
@@ -1692,6 +1700,10 @@ class DeepSpeedEngine:
                 # the compiled step's cost analysis is the numerator of
                 # the live MFU / HBM-GB/s gauges (docs/telemetry.md)
                 self.telemetry.set_step_cost(self._train_step_cost)
+                # per-kernel cost attribution (docs/telemetry.md
+                # §Attribution): one HLO walk per new executable —
+                # compile-time only, nothing added to the hot path
+                self.telemetry.attribute_compiled(executable, "train_step")
         profile_step = self._host_global_step + 1
         self.flops_profiler.start_step(profile_step)
         donated = jax.tree.leaves(self.state) if san is not None else None
@@ -2047,7 +2059,8 @@ class DeepSpeedEngine:
                     if tcfg.output_path else None
                 )
                 aggregator = _tel.CrossRankAggregator(
-                    world, jsonl_path=agg_path, registry=reg
+                    world, jsonl_path=agg_path, registry=reg,
+                    straggler_factor=tcfg.straggler_factor,
                 )
         sup = Supervisor(
             rank=rank,

@@ -176,6 +176,7 @@ class ServingEngine:
         self._sanitizer = maybe_from_config(None)
         self._prefill_fn = None
         self._decode_fn = None
+        self._decode_jit = None  # unwrapped jit handle (attribute_decode)
         self.prefill_compiles = 0
         self.decode_compiles = 0
         self._step_count = 0
@@ -279,12 +280,38 @@ class ServingEngine:
                 )
                 return nxt, k_pool, v_pool
 
-            self._decode_fn = self._wrap(
-                jax.jit(self.engine._scoped(fn), donate_argnums=(7, 8)),
-                "serving.decode",
-            )
+            self._decode_jit = jax.jit(self.engine._scoped(fn), donate_argnums=(7, 8))
+            self._decode_fn = self._wrap(self._decode_jit, "serving.decode")
             self.decode_compiles += 1
         return self._decode_fn
+
+    def attribute_decode(self):
+        """Per-kernel cost attribution of the decode executable
+        (docs/telemetry.md §Attribution): AOT-lower the decode function
+        against the pool's own shapes — abstract args only, so nothing
+        executes, no slot state is touched, and the sanitizer's
+        one-executable recompile proof is unaffected.  Returns an
+        :class:`~deepspeed_tpu.telemetry.attribution.Attribution` or
+        None when the backend exposes no HLO text."""
+        from deepspeed_tpu.telemetry.attribution import attribute_executable
+
+        self._get_decode()  # ensure the jit handle exists
+        S = self.pool.num_slots
+        abstract = lambda tree: jax.tree.map(  # noqa: E731
+            lambda a: jax.ShapeDtypeStruct(np.shape(a), a.dtype), tree
+        )
+        compiled = self._decode_jit.lower(
+            abstract(self.engine.params),
+            jax.ShapeDtypeStruct((S,), jnp.int32),   # toks
+            jax.ShapeDtypeStruct((S,), jnp.int32),   # pos
+            jax.ShapeDtypeStruct((S,), jnp.bool_),   # flags
+            jax.ShapeDtypeStruct((S,), jnp.float32),  # temps
+            jax.ShapeDtypeStruct((S,), jnp.int32),   # topks
+            jax.ShapeDtypeStruct((S,), jnp.uint32),  # seeds
+            abstract(self.pool.k),
+            abstract(self.pool.v),
+        ).compile()
+        return attribute_executable(compiled, label="serving_decode")
 
     # ------------------------------------------------------------------
     # measured service rate (the admission controller's feed)

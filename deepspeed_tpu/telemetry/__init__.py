@@ -30,7 +30,22 @@ from deepspeed_tpu.telemetry.exporters import (
     PrometheusTextfileExporter,
     TensorBoardSink,
 )
+from deepspeed_tpu.telemetry.attribution import (
+    BUCKETS,
+    Attribution,
+    attribute_executable,
+    attribute_hlo_text,
+    attribute_jit,
+)
 from deepspeed_tpu.telemetry.manager import TelemetryManager
+from deepspeed_tpu.telemetry.regression import (
+    bench_diff,
+    check_step_spike,
+    find_stragglers,
+    history_append,
+    history_bless,
+    history_load,
+)
 from deepspeed_tpu.telemetry.registry import (
     Counter,
     Gauge,
@@ -198,6 +213,10 @@ __all__ = [
     "JsonlExporter", "PrometheusTextfileExporter", "TensorBoardSink", "ExportLoop",
     "CrossRankAggregator", "encode_metrics", "decode_metrics",
     "TelemetryManager",
+    "Attribution", "BUCKETS",
+    "attribute_executable", "attribute_hlo_text", "attribute_jit",
+    "bench_diff", "history_append", "history_bless", "history_load",
+    "check_step_spike", "find_stragglers",
     "configure", "manager_for", "get_registry", "get_tracer",
     "flush", "export_trace", "shutdown", "status", "reset_for_tests",
 ]

@@ -46,10 +46,12 @@ class CrossRankAggregator:
     """Rank-0 state: latest (seq, metrics) per rank + liveness marks."""
 
     def __init__(self, world_size: int, jsonl_path: Optional[str] = None,
-                 registry=None):
+                 registry=None, straggler_factor: float = 1.5):
         self.world_size = int(world_size)
         self.jsonl_path = os.path.abspath(jsonl_path) if jsonl_path else None
         self.registry = registry
+        self.straggler_factor = float(straggler_factor)
+        self._flagged_stragglers: set = set()
         self.exports = 0
         self._lock = threading.Lock()
         self._latest: Dict[int, Dict[str, float]] = {}
@@ -112,10 +114,18 @@ class CrossRankAggregator:
             }
             for name, vs in sorted(names.items())
         }
+        # runtime anomaly watch (regression.py): rank step wall vs the
+        # cluster median, flagged in the SAME stream that detects death
+        from deepspeed_tpu.telemetry.regression import find_stragglers
+
+        stragglers = find_stragglers(
+            latest, alive, factor=self.straggler_factor
+        )
         return {
             "ts": time.time(),
             "world_size": self.world_size,
             "alive": alive,
+            "stragglers": stragglers,
             "dead": [
                 {"rank": r, "reason": reason, "last_seq": seqs.get(r),
                  "last_metrics": latest.get(r)}
@@ -136,6 +146,19 @@ class CrossRankAggregator:
         if self.registry is not None and self.registry.enabled:
             self.registry.gauge("cluster/alive_ranks").set(len(agg["alive"]))
             self.registry.gauge("cluster/dead_ranks").set(len(agg["dead"]))
+            flagged = {s["rank"] for s in agg["stragglers"]}
+            # rank count, not (rank, metric) pairs — consistent with the
+            # sibling alive/dead rank gauges
+            self.registry.gauge("cluster/stragglers").set(len(flagged))
+            for s in agg["stragglers"]:
+                self.registry.gauge(
+                    "cluster/straggler_factor", rank=s["rank"]
+                ).set(s["factor"])
+            # a recovered rank must stop reading as a straggler: zero
+            # the per-rank gauge the moment it drops off the list
+            for rank in self._flagged_stragglers - flagged:
+                self.registry.gauge("cluster/straggler_factor", rank=rank).set(0.0)
+            self._flagged_stragglers = flagged
             for name, row in agg["metrics"].items():
                 # qualified names may carry labels ({...}); keep them in
                 # the gauge name verbatim — the cluster view is keyed by

@@ -192,7 +192,13 @@ class ExportLoop:
             self.exports += 1
 
     def last_export_age(self) -> Optional[float]:
-        return None if self.last_export_at is None else time.monotonic() - self.last_export_at
+        # falsy (None OR a zero/unset stamp) means "never exported" —
+        # returning a monotonic-epoch delta here is how ds_report once
+        # printed a billions-of-seconds "age" for a loop that had not
+        # flushed yet
+        if not self.last_export_at:
+            return None
+        return time.monotonic() - self.last_export_at
 
     def stop(self) -> None:
         """Final flush + close (idempotent; registered atexit)."""

@@ -32,6 +32,8 @@ class TelemetryManager:
         self.monitor = monitor
         self.config = config
         self._cost: Dict[str, float] = {}
+        self._attribution = None  # per-kernel cost table (attribution.py)
+        self._spikes = 0
         self._jax_backend: Optional[str] = None
         self._profiler_fired = False
         self._lock = threading.Lock()
@@ -109,6 +111,43 @@ class TelemetryManager:
     def step_cost(self) -> Dict[str, float]:
         return dict(self._cost)
 
+    # -- per-kernel attribution (compile-time one-shot; attribution.py) ------
+    def set_attribution(self, attribution) -> None:
+        """Carry the compiled step's per-kernel cost table: registry
+        gauges + Perfetto counter tracks now, ds_report/bench rows on
+        demand.  Never raises — attribution is evidence, not control."""
+        if attribution is None:
+            return
+        self._attribution = attribution
+        try:
+            attribution.publish(self)
+        except Exception as e:  # noqa: BLE001
+            logger.warning(f"telemetry: attribution publish failed: {e!r}")
+
+    def attribution(self):
+        return self._attribution
+
+    def attribute_compiled(self, compiled, label: str) -> None:
+        """Walk one compiled executable into the bucket table (gated on
+        ``telemetry.attribution``; skipped while the plane is disabled —
+        the walk is one-shot at compile time but still not free)."""
+        cfg = self.config
+        if cfg is not None and not getattr(cfg, "attribution", True):
+            return
+        if not (self.registry.enabled or self.tracer.enabled):
+            return
+        from deepspeed_tpu.telemetry.attribution import attribute_executable
+
+        try:
+            attr = attribute_executable(
+                compiled, label=label, backend=self._backend(),
+                max_hlo_mb=float(getattr(cfg, "attribution_max_hlo_mb", 256.0) or 256.0),
+            )
+        except Exception as e:  # noqa: BLE001
+            logger.warning(f"telemetry: attribution walk failed: {e!r}")
+            return
+        self.set_attribution(attr)
+
     def _backend(self) -> str:
         # memoized: jax.default_backend() is not free on a per-step path
         if self._jax_backend is None:
@@ -136,8 +175,15 @@ class TelemetryManager:
             if g in rec:
                 self._g(f"{prefix}/{g}").set(rec[g])
         if wall > 0:
-            self._g(f"{prefix}/step_wall_ms").set(wall * 1e3)
+            wall_ms = wall * 1e3
+            g_wall = self._g(f"{prefix}/step_wall_ms")
+            # spike test against the window BEFORE this sample joins it
+            # (a spike must not mask itself), then publish
+            prev_mean = g_wall.window_mean()
+            prev_count = len(g_wall._ring)
+            g_wall.set(wall_ms)
             self._g(f"{prefix}/steps_per_s").set(1.0 / wall)
+            self._check_spike(prefix, wall_ms, prev_mean, prev_count)
             if self._cost:
                 # the ONE shared MFU/HBM derivation (flops_profiler)
                 from deepspeed_tpu.profiling.flops_profiler import derive_step_stats
@@ -148,6 +194,34 @@ class TelemetryManager:
                 if stats["hbm_bytes_per_step"]:
                     self._g("hbm_gbps").set(stats["hbm_gbps"])
         self._c(f"{prefix}/steps").inc(count)
+
+    def _check_spike(self, prefix: str, wall_ms: float,
+                     prev_mean: Optional[float], prev_count: int) -> None:
+        """Runtime anomaly watch (regression.py): a step wall far above
+        its own recent window becomes a structured event — counter,
+        Perfetto instant, and a (rate-limited) log line."""
+        from deepspeed_tpu.telemetry.regression import check_step_spike
+
+        cfg = self.config
+        event = check_step_spike(
+            wall_ms, prev_mean, prev_count,
+            spike_factor=float(getattr(cfg, "spike_factor", 2.5) or 2.5),
+            min_window=int(getattr(cfg, "spike_min_window", 8) or 8),
+        )
+        if event is None:
+            return
+        self._spikes += 1
+        self._c(f"{prefix}/anomaly/step_spikes").inc()
+        if self.tracer.enabled:
+            self.tracer.add_instant("step_wall_spike", "anomaly", args=event)
+        if self._spikes <= 3 or self._spikes % 32 == 0:
+            # a sustained slowdown flags every step until the window
+            # catches up; don't let the log become the second anomaly
+            logger.warning(
+                f"telemetry[{self.label}]: step wall spike — "
+                f"{event['wall_ms']:.1f}ms vs window mean "
+                f"{event['window_mean_ms']:.1f}ms ({event['factor']}x)"
+            )
 
     # -- engine progress events ---------------------------------------------
     def publish_train_progress(self, step: int, samples: int, loss: Optional[float],
@@ -190,12 +264,20 @@ class TelemetryManager:
         from deepspeed_tpu.profiling.flops_profiler import cost_bytes
 
         mfu = self.registry.gauge("mfu", engine=self.label)
-        return {
+        out = {
             "mfu": None if mfu.value is None else round(mfu.value, 4),
             "flops_per_step": self._cost.get("flops"),
             "hbm_bytes_per_step": cost_bytes(self._cost) or None,
             "telemetry": self.digest(),
         }
+        if self._attribution is not None:
+            # top buckets by roofline time share — the bench record's
+            # one-line answer to "which kernel family owns this step"
+            out["attribution_top"] = [
+                {"bucket": b, "time_share_pct": s}
+                for b, s in self._attribution.top_buckets(3)
+            ]
+        return out
 
     def digest(self) -> Dict[str, Any]:
         """Content digest of the current compact snapshot — a bench

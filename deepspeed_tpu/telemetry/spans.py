@@ -128,6 +128,22 @@ class TraceBuffer:
             ev["args"] = args
         self._push(ev)
 
+    def add_counter(self, name: str, series: Dict[str, float],
+                    ts: Optional[float] = None, pid: int = PID_ENGINE,
+                    tid: int = 0) -> None:
+        """One "C" counter sample: Perfetto renders each ``series`` key
+        as a stacked counter track under ``name`` (the attribution
+        module emits per-bucket time-share tracks this way)."""
+        if not self.enabled:
+            return
+        self._ensure_meta(pid, tid)
+        self._push({
+            "name": name, "ph": "C",
+            "ts": round(self._us(self.now() if ts is None else ts), 3),
+            "pid": pid, "tid": tid,
+            "args": {str(k): float(v) for k, v in series.items()},
+        })
+
     def add_instant(self, name: str, cat: str, ts: Optional[float] = None,
                     pid: int = PID_ENGINE, tid: int = 0,
                     args: Optional[Dict[str, Any]] = None) -> None:
@@ -227,6 +243,12 @@ def validate_chrome_trace(doc: Any) -> List[str]:
                 problems.append(f"{where}: 'dur' must be a non-negative number")
         if ph == "i" and ev.get("s") not in (None, "t", "p", "g"):
             problems.append(f"{where}: instant scope 's' must be t/p/g")
+        if ph == "C":
+            ts = ev.get("ts")
+            if not isinstance(ts, (int, float)) or ts < 0:
+                problems.append(f"{where}: 'ts' must be a non-negative number")
+            if not isinstance(ev.get("args"), dict) or not ev["args"]:
+                problems.append(f"{where}: counter events need a non-empty 'args' object")
         if "args" in ev and not isinstance(ev["args"], dict):
             problems.append(f"{where}: 'args' must be an object")
     return problems

@@ -1,0 +1,107 @@
+"""Bench-history diff + the perf-sentinel CI gate.
+
+Reads ``bench_history.jsonl`` (written by ``bench.py`` and the
+standalone ``tools/bench_*.py`` sweeps), computes noise-aware deltas —
+newest run vs the median of the prior window per (metric, backend,
+config-fingerprint) key, thresholds widened by the window's own MAD —
+and prints one verdict row per key.
+
+Exit status (``--gate``): 0 when no key regresses, 1 on any ``regress``
+verdict — the ``perf-sentinel`` CI job runs exactly this.
+
+Blessing an intentional change: ``bench_diff.py --bless '<metric>|*'
+--note 'why'`` appends a marker; diffs ignore history before the last
+applicable marker, so the new normal becomes the baseline instead of a
+permanent red (docs/performance.md §Regression workflow).
+
+Run:
+  python tools/bench_diff.py                 # verdict table
+  python tools/bench_diff.py --gate          # CI gate (exit 1 on regress)
+  python tools/bench_diff.py --bless '*' --note 'flash kernel rewrite'
+  python tools/bench_diff.py --json out.json # machine-readable verdicts
+"""
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_regression():
+    # file-path import: keeps this CLI jax-free (usable on a bare CI
+    # runner and inside the jax-free bench.py parent's environment)
+    path = os.path.join(HERE, "deepspeed_tpu", "telemetry", "regression.py")
+    spec = importlib.util.spec_from_file_location("_ds_bench_regression", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    reg = _load_regression()
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--history", default=os.path.join(HERE, "bench_history.jsonl"))
+    p.add_argument("--window", type=int, default=8,
+                   help="baseline = median of up to N prior runs")
+    p.add_argument("--threshold", type=float, default=0.05,
+                   help="default relative regression threshold")
+    p.add_argument("--thresholds", default="",
+                   help="per-metric overrides: 'substr:0.08,substr2:0.03'")
+    p.add_argument("--band-cap", type=float, default=None,
+                   help="upper bound on the MAD-widened noise band (CI red check)")
+    p.add_argument("--metric", action="append", default=None,
+                   help="restrict to these metric names (repeatable)")
+    p.add_argument("--gate", action="store_true",
+                   help="exit 1 on any regress verdict (CI perf-sentinel)")
+    p.add_argument("--json", default="", help="also write verdicts as JSON")
+    p.add_argument("--bless", default="",
+                   help="record an intentional change for METRIC ('*' = all) and exit")
+    p.add_argument("--note", default="", help="why the bless is justified")
+    args = p.parse_args(argv)
+
+    if args.bless:
+        marker = reg.history_bless(args.bless, note=args.note, path=args.history)
+        print(f"blessed {marker['metric']!r} at {marker['git_sha']}"
+              + (f": {args.note}" if args.note else ""))
+        return 0
+
+    thresholds = {}
+    for part in (s for s in args.thresholds.split(",") if s):
+        pat, _, th = part.rpartition(":")
+        thresholds[pat] = float(th)
+
+    history = reg.history_load(args.history)
+    if not history:
+        print(f"no bench history at {args.history} — nothing to diff")
+        # a gate with no input stream must fail loudly: a silently
+        # broken history writer would otherwise gate green forever
+        return 1 if args.gate else 0
+    verdicts = reg.bench_diff(
+        history, window=args.window, default_threshold=args.threshold,
+        thresholds=thresholds, metrics=args.metric, band_cap=args.band_cap,
+    )
+    print(reg.format_verdicts(verdicts))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"schema": 1, "verdicts": verdicts}, f, indent=1)
+    ok, bad = reg.gate(verdicts)
+    if not ok:
+        print(f"\nREGRESSION: {len(bad)} metric(s) past their noise band", file=sys.stderr)
+        for v in bad:
+            print(
+                f"  {v['metric']} [{v['backend']}]: {v['value']:.1f} vs baseline "
+                f"{v['baseline']:.1f} ({v['delta_pct']:+.1f}%, band {v['band_pct']:.1f}%)",
+                file=sys.stderr,
+            )
+        if args.gate:
+            return 1
+    elif args.gate:
+        n = sum(1 for v in verdicts if v["verdict"] != "no-baseline")
+        print(f"\ngate OK: {n} baselined metric(s), no regressions")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -113,6 +113,10 @@ def main():
 
     rec = make_record(seq, n_layer, dt_f, tok_f, dt_s, tok_s)
     print("RESULT " + json.dumps(rec), flush=True)
+    from deepspeed_tpu.telemetry.regression import tool_history_emit
+
+    tool_history_emit(rec, rung="longctx-train",
+                      base_dir=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     if on_tpu:
         import bench
 

@@ -57,6 +57,12 @@ def log(msg):
 
 def emit(rec):
     print(json.dumps(rec), flush=True)
+    from deepspeed_tpu.telemetry.regression import tool_history_emit
+
+    # standalone runs feed the persistent bench history too (no-op when
+    # the bench.py driver parent is the history writer)
+    tool_history_emit(rec, rung="sharding",
+                      base_dir=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _opt_state_bytes(engine):

@@ -1,17 +1,14 @@
-"""Profile one compiled BERT-Large train step on the real chip (same
-per-source / per-HLO-category attribution as profile_train_step.py, for
-the seq128 samples/s rung — VERDICT r2 #8).
+"""Profile one compiled BERT-Large train step on the real chip (the
+seq128 samples/s rung — VERDICT r2 #8).  Same per-source /
+per-HLO-category cost walk as profile_train_step.py, now shared via
+``deepspeed_tpu.telemetry.attribution`` — this script is only the BERT
+harness.
 
 Run: python tools/profile_bert_step.py [seq] [micro_bs]
 """
-import collections
 import dataclasses
-import glob
-import gzip
-import json
 import os
 import sys
-import tempfile
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -19,11 +16,13 @@ import numpy as np
 
 
 def main():
-    import jax
-
     import deepspeed_tpu
     from deepspeed_tpu.models import bert
     from deepspeed_tpu.runtime.engine import _PlacedBatch
+    from deepspeed_tpu.telemetry.attribution import (
+        format_trace_tables,
+        profile_and_report,
+    )
 
     seq = int(sys.argv[1]) if len(sys.argv) > 1 else 128
     mb = int(sys.argv[2]) if len(sys.argv) > 2 else 64
@@ -60,50 +59,17 @@ def main():
     loss = engine.train_batch(placed)
     float(loss)
 
-    trace_dir = tempfile.mkdtemp(prefix="tpu_trace_")
-    with jax.profiler.trace(trace_dir):
-        for _ in range(steps):
-            loss = engine.train_batch(placed)
-        float(loss)
+    def one_step():
+        nonlocal loss
+        loss = engine.train_batch(placed)
 
-    f = sorted(glob.glob(os.path.join(trace_dir, "plugins/profile/*/*.trace.json.gz")))[-1]
-    with gzip.open(f) as fh:
-        data = json.load(fh)
-    ev = [
-        e
-        for e in data["traceEvents"]
-        if e.get("ph") == "X" and e.get("args") and e["args"].get("hlo_category")
-    ]
-    src_t = collections.Counter()
-    src_f = collections.Counter()
-    for e in ev:
-        if e["args"]["hlo_category"] in ("while", "conditional", "call"):
-            continue
-        s = e["args"].get("source", "?")
-        src_t[s] += e["dur"]
-        src_f[s] += int(e["args"].get("model_flops", 0) or 0)
-    print(f"{'source':68s} {'ms/step':>8s} {'TFLOP/s':>8s}")
-    for s, t in src_t.most_common(20):
-        tf = src_f[s] / (t * 1e-6) / 1e12 if t else 0
-        print(f"{s[-68:]:68s} {t/1e3/steps:8.1f} {tf:8.1f}")
+    tables = profile_and_report(one_step, steps=steps, sync=lambda: float(loss))
+    print(format_trace_tables(tables, unit="step"))
 
-    cat_t = collections.Counter()
-    cat_f = collections.Counter()
-    op_t = collections.Counter()
-    for e in ev:
-        c = e["args"]["hlo_category"]
-        if c in ("while", "conditional", "call"):
-            continue
-        cat_t[c] += e["dur"]
-        cat_f[c] += int(e["args"].get("model_flops", 0) or 0)
-        op_t[e.get("name", "?")[:70]] += e["dur"]
-    print(f"\n{'hlo category':30s} {'ms/step':>8s} {'TFLOP/s':>8s}")
-    for c, t in cat_t.most_common(12):
-        tf = cat_f[c] / (t * 1e-6) / 1e12 if t else 0
-        print(f"{c:30s} {t/1e3/steps:8.1f} {tf:8.1f}")
-    print(f"\n{'top ops':70s} {'ms/step':>8s}")
-    for o, t in op_t.most_common(15):
-        print(f"{o:70s} {t/1e3/steps:8.1f}")
+    attr = engine.train_step_attribution()
+    if attr is not None:
+        print()
+        print(attr.format_table())
 
 
 if __name__ == "__main__":

@@ -1,16 +1,13 @@
 """Profile the XL decode loop on the chip: per-HLO-category device time
 for the steady-state token scan (the instrument behind the decode
-dispatch work — run after any decode-path change).
+dispatch work — run after any decode-path change).  The cost walk is
+the shared one in ``deepspeed_tpu.telemetry.attribution``; durations
+are reported per TOKEN, not per step.
 
 Run: python tools/profile_decode.py [model] [B] [new_tokens]
 """
-import collections
-import glob
-import gzip
-import json
 import os
 import sys
-import tempfile
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -18,9 +15,11 @@ import numpy as np
 
 
 def main():
-    import jax
-
     import deepspeed_tpu
+    from deepspeed_tpu.telemetry.attribution import (
+        format_trace_tables,
+        profile_and_report,
+    )
 
     model = sys.argv[1] if len(sys.argv) > 1 else "gpt2-xl"
     B = int(sys.argv[2]) if len(sys.argv) > 2 else 8
@@ -32,35 +31,12 @@ def main():
     out = engine.generate(prompt, max_new_tokens=N, do_sample=False)
     _ = int(np.asarray(out)[0, -1])  # warm + compile
 
-    trace_dir = tempfile.mkdtemp(prefix="decode_trace_")
-    with jax.profiler.trace(trace_dir):
+    def one_run():
         out = engine.generate(prompt, max_new_tokens=N, do_sample=False)
-        _ = int(np.asarray(out)[0, -1])
+        _ = int(np.asarray(out)[0, -1])  # true sync inside the trace
 
-    f = sorted(glob.glob(os.path.join(trace_dir, "plugins/profile/*/*.trace.json.gz")))[-1]
-    with gzip.open(f) as fh:
-        data = json.load(fh)
-    ev = [
-        e for e in data["traceEvents"]
-        if e.get("ph") == "X" and e.get("args") and e["args"].get("hlo_category")
-    ]
-    cat_t = collections.Counter()
-    op_t = collections.Counter()
-    total = 0
-    for e in ev:
-        c = e["args"]["hlo_category"]
-        if c in ("while", "conditional", "call"):
-            continue
-        cat_t[c] += e["dur"]
-        op_t[e.get("name", "?")[:70]] += e["dur"]
-        total += e["dur"]
-    print(f"total device time: {total/1e3:.1f} ms for {N} tokens -> {total/1e3/N:.2f} ms/token")
-    print(f"\n{'hlo category':30s} {'ms/token':>9s}")
-    for c, t in cat_t.most_common(12):
-        print(f"{c:30s} {t/1e3/N:9.3f}")
-    print(f"\n{'top ops':70s} {'ms/token':>9s}")
-    for o, t in op_t.most_common(15):
-        print(f"{o:70s} {t/1e3/N:9.3f}")
+    tables = profile_and_report(one_run, steps=1, denom=N)
+    print(format_trace_tables(tables, unit="token"))
 
 
 if __name__ == "__main__":

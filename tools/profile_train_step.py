@@ -1,18 +1,18 @@
-"""Profile one compiled train step on the real chip and print per-source
-device-time attribution (the tool behind this round's MFU work: it
-exposed the fp32-dot flash kernels, the scan bookkeeping, and the
-per-line TFLOP/s of every matmul).
+"""Profile one compiled train step on the real chip: per-source /
+per-HLO-category / top-op device-time attribution (the tool behind the
+MFU work — it exposed the fp32-dot flash kernels, the scan bookkeeping,
+and the per-line TFLOP/s of every matmul).
+
+The cost walk itself lives in ``deepspeed_tpu.telemetry.attribution``
+(shared with profile_bert_step.py / profile_decode.py); this script is
+the GPT-2 harness around it, plus the compile-time roofline table from
+the executable's own HLO.
 
 Run: python tools/profile_train_step.py [preset] [micro_bs] [gas] [seq]
 """
-import collections
 import dataclasses
-import glob
-import gzip
-import json
 import os
 import sys
-import tempfile
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -20,11 +20,13 @@ import numpy as np
 
 
 def main():
-    import jax
-
     import deepspeed_tpu
     from deepspeed_tpu.models import gpt2
     from deepspeed_tpu.runtime.engine import _PlacedBatch
+    from deepspeed_tpu.telemetry.attribution import (
+        format_trace_tables,
+        profile_and_report,
+    )
 
     preset = sys.argv[1] if len(sys.argv) > 1 else "gpt2"
     mb = int(sys.argv[2]) if len(sys.argv) > 2 else 8
@@ -39,15 +41,12 @@ def main():
         c = CONFIGS[preset.split(":", 1)[1]]
         cfg = dataclasses.replace(gpt2.GPT2_LARGE, **c["model"])
         mb, gas = c["mb"], c["gas"]
+        opt_extra = c.get("opt") or {}
     else:
         cfg = dataclasses.replace(gpt2.PRESETS[preset], remat=False)
+        opt_extra = {}
     seq = min(seq, cfg.n_positions)
     model_fn, init_fn, tp_fn = gpt2.make_model(cfg)
-    opt_extra = {}
-    if preset.startswith("sweep:"):
-        from tools.sweep_774m import CONFIGS as _C
-
-        opt_extra = _C[preset.split(":", 1)[1]].get("opt") or {}
     config = {
         "train_micro_batch_size_per_gpu": mb,
         "gradient_accumulation_steps": gas,
@@ -68,53 +67,19 @@ def main():
     loss = engine.train_batch(placed)
     float(loss)  # true sync (block_until_ready is unreliable on tunnels)
 
-    trace_dir = tempfile.mkdtemp(prefix="tpu_trace_")
-    with jax.profiler.trace(trace_dir):
-        for _ in range(steps):
-            loss = engine.train_batch(placed)
-        float(loss)
+    def one_step():
+        nonlocal loss
+        loss = engine.train_batch(placed)
 
-    f = sorted(glob.glob(os.path.join(trace_dir, "plugins/profile/*/*.trace.json.gz")))[-1]
-    with gzip.open(f) as fh:
-        data = json.load(fh)
-    ev = [
-        e
-        for e in data["traceEvents"]
-        if e.get("ph") == "X" and e.get("args") and e["args"].get("hlo_category")
-    ]
-    src_t = collections.Counter()
-    src_f = collections.Counter()
-    for e in ev:
-        if e["args"]["hlo_category"] in ("while", "conditional", "call"):
-            continue
-        s = e["args"].get("source", "?")
-        src_t[s] += e["dur"]
-        src_f[s] += int(e["args"].get("model_flops", 0) or 0)
-    print(f"{'source':68s} {'ms/step':>8s} {'TFLOP/s':>8s}")
-    for s, t in src_t.most_common(20):
-        tf = src_f[s] / (t * 1e-6) / 1e12 if t else 0
-        print(f"{s[-68:]:68s} {t/1e3/steps:8.1f} {tf:8.1f}")
+    tables = profile_and_report(one_step, steps=steps, sync=lambda: float(loss))
+    print(format_trace_tables(tables, unit="step"))
 
-    # HLO-category view (dot vs fusion vs copy/convert traffic) and the
-    # top individual ops — separates "matmuls running slow" from
-    # "non-matmul time attributed to the same source line"
-    cat_t = collections.Counter()
-    cat_f = collections.Counter()
-    op_t = collections.Counter()
-    for e in ev:
-        c = e["args"]["hlo_category"]
-        if c in ("while", "conditional", "call"):
-            continue
-        cat_t[c] += e["dur"]
-        cat_f[c] += int(e["args"].get("model_flops", 0) or 0)
-        op_t[e.get("name", "?")[:70]] += e["dur"]
-    print(f"\n{'hlo category':30s} {'ms/step':>8s} {'TFLOP/s':>8s}")
-    for c, t in cat_t.most_common(12):
-        tf = cat_f[c] / (t * 1e-6) / 1e12 if t else 0
-        print(f"{c:30s} {t/1e3/steps:8.1f} {tf:8.1f}")
-    print(f"\n{'top ops':70s} {'ms/step':>8s}")
-    for o, t in op_t.most_common(15):
-        print(f"{o:70s} {t/1e3/steps:8.1f}")
+    # compile-time roofline view from the executable's own HLO — the
+    # same table the telemetry plane publishes as attribution/* gauges
+    attr = engine.train_step_attribution()
+    if attr is not None:
+        print()
+        print(attr.format_table())
 
 
 if __name__ == "__main__":
