@@ -547,6 +547,24 @@ def run_rung(name: str):
                   "reason": f"bench_sharding child rc={proc.returncode}"})
         for rec in recs:
             emit(rec)
+    elif name == "kernels":
+        # Pallas kernel microbench (docs/kernels.md): lax reference vs
+        # fused flash-decode (bf16 + int8 KV, 2k/16k context) and the
+        # one-pass fused optimizer update — speedup, parity error, and
+        # compiled-cost HBM bytes per cell.  Grandchild like serving
+        # (its own engine-free jax lifetime; --dryrun shapes on CPU).
+        import subprocess as sp
+
+        cmd = [sys.executable, os.path.join(HERE, "tools", "bench_kernels.py")]
+        if not on_tpu:
+            cmd.append("--dryrun")
+        proc = sp.run(cmd, stdout=sp.PIPE, cwd=HERE)
+        recs = _parse_records(proc.stdout.decode(errors="replace"))
+        if proc.returncode != 0 and not recs:
+            emit({"metric": "kernels", "skipped": True,
+                  "reason": f"bench_kernels child rc={proc.returncode}"})
+        for rec in recs:
+            emit(rec)
     elif name == "comm-strategies":
         # dense vs int8 vs 1-bit grad exchange + 1-bit LAMB, on the 124M
         # and bert-s512 configs (docs/comm.md).  Runs in a grandchild so
@@ -595,6 +613,10 @@ RUNGS = [
     # 16k sparse-vs-dense TRAINING (two engine builds; dense 16k steps
     # are ~2.2s each, so the measurement itself is ~30s warm)
     ("longctx-train", 240, 480),
+    # Pallas kernel microbench: fused flash-decode + fused optimizer
+    # update vs their lax/XLA references (docs/kernels.md); standalone
+    # jits only, no engine builds — cheap
+    ("kernels", 120, 300),
     # weight-update-sharding sweep: replicated vs cross-replica ZeRO-1
     # update-phase FLOPs/bytes per strategy (docs/sharding.md); 3
     # engine builds in one grandchild

@@ -8,6 +8,7 @@ from deepspeed_tpu.analysis.rules import (  # noqa: F401
     dtype_rules,
     host_sync,
     jit_hygiene,
+    pallas_seam,
     prng,
     raw_collective,
     raw_metric,

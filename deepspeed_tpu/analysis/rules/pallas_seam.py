@@ -1,0 +1,59 @@
+"""Rule: raw ``pl.pallas_call`` sites belong in the kernel seam.
+
+Every Pallas kernel is a block-size decision (the autotuner's domain,
+``ops/kernels/autotune.py``), a version-compat surface
+(``CompilerParams`` vs ``TPUCompilerParams`` — the exact drift that held
+11 tier-1 tests red on this container's jaxlib), and an attribution
+contract (docs/kernels.md: every kernel lands with a bucket pin and a
+bench rung).  A bare ``pl.pallas_call`` outside
+``deepspeed_tpu/ops/kernels/`` and ``deepspeed_tpu/ops/attention/``
+gets none of that: hardcoded tiles, per-call compat guards, and cost
+invisible to the roofline table.  New kernels go in ``ops/kernels/``
+(or the attention package, whose flash/splash kernels predate the
+seam) and route compiler params through
+:func:`deepspeed_tpu.ops.kernels.compat.tpu_compiler_params`.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from deepspeed_tpu.analysis.core import Severity, make_finding, register
+
+# the two sanctioned kernel homes (attention/ predates the seam and
+# already carries autotune defaults + attribution pins)
+_EXEMPT = ("deepspeed_tpu/ops/kernels/", "deepspeed_tpu/ops/attention/")
+
+
+def _is_pallas_call(node: ast.Call):
+    """Match ``pl.pallas_call(...)`` / ``pallas.pallas_call(...)`` /
+    bare ``pallas_call(...)`` (however the module was imported)."""
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == "pallas_call":
+        return True
+    if isinstance(f, ast.Name) and f.id == "pallas_call":
+        return True
+    return False
+
+
+@register(
+    "raw-pallas-call-outside-kernels",
+    Severity.B,
+    "direct pl.pallas_call site outside deepspeed_tpu/ops/kernels/ and "
+    "ops/attention/ — new kernels go through the kernel seam (autotuned "
+    "blocks, tpu_compiler_params version shim, attribution pin + bench "
+    "rung per docs/kernels.md)",
+)
+def check_raw_pallas_call(rule, ctx):
+    path = os.path.normpath(ctx.path).replace(os.sep, "/")
+    if any(marker in path for marker in _EXEMPT):
+        return
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and _is_pallas_call(node):
+            yield make_finding(
+                rule, ctx, node,
+                "raw 'pallas_call' outside the kernel seam — this kernel gets "
+                "no autotuned blocks, no CompilerParams version shim, and no "
+                "attribution/bench coverage; put it in ops/kernels/ (see "
+                "docs/kernels.md)",
+            )

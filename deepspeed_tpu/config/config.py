@@ -1369,6 +1369,7 @@ _KNOWN_TOP_LEVEL = {
     C.COMM,
     C.SERVING,
     C.TELEMETRY,
+    C.KERNELS,
     "activation_checkpointing",
     "flops_profiler",
     "aio",
@@ -1380,6 +1381,53 @@ _KNOWN_TOP_LEVEL = {
     "dataloader_drop_last",
     "seed",
 }
+
+
+@dataclass
+class KernelsConfig:
+    """``kernels`` block (TPU-native extension; docs/kernels.md): the
+    Pallas kernel suite.  ``enabled``: ``"auto"`` arms the suite on
+    TPU-class backends only (the lax/XLA paths stay the CPU ground
+    truth); ``true``/``false`` force it.  ``flash_decode`` /
+    ``fused_update`` subtract individual kernels from an armed suite.
+    ``autotune`` is the block-size tuner mode (``off`` = deterministic
+    defaults only, ``cache`` = read cached measured winners, ``force``
+    = allow re-measuring); ``autotune_cache_path`` overrides where the
+    JSON cache lives (default: next to the persistent compile cache).
+    The ``DS_KERNELS`` / ``DS_KERNEL_AUTOTUNE`` env vars win over this
+    block (escape hatches)."""
+
+    enabled: Any = C.KERNELS_ENABLED_AUTO
+    flash_decode: bool = C.KERNELS_FLASH_DECODE_DEFAULT
+    fused_update: bool = C.KERNELS_FUSED_UPDATE_DEFAULT
+    autotune: str = C.KERNELS_AUTOTUNE_DEFAULT
+    autotune_cache_path: str = ""
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "KernelsConfig":
+        if d is None:
+            return cls()
+        d = dict(d)
+        enabled = _pop(d, "enabled", C.KERNELS_ENABLED_AUTO)
+        out = cls(
+            enabled=enabled,
+            flash_decode=bool(_pop(d, "flash_decode", C.KERNELS_FLASH_DECODE_DEFAULT)),
+            fused_update=bool(_pop(d, "fused_update", C.KERNELS_FUSED_UPDATE_DEFAULT)),
+            autotune=str(_pop(d, "autotune", C.KERNELS_AUTOTUNE_DEFAULT)).lower(),
+            autotune_cache_path=str(_pop(d, "autotune_cache_path", "")),
+        )
+        _check_empty(d, C.KERNELS, _known_keys(cls))
+        if out.enabled not in C.KERNELS_ENABLED_CHOICES:
+            raise DeepSpeedConfigError(
+                f"'{C.KERNELS}.enabled' must be one of {C.KERNELS_ENABLED_CHOICES}, "
+                f"got {out.enabled!r}"
+            )
+        if out.autotune not in C.KERNELS_AUTOTUNE_MODES:
+            raise DeepSpeedConfigError(
+                f"'{C.KERNELS}.autotune' must be one of {C.KERNELS_AUTOTUNE_MODES}, "
+                f"got {out.autotune!r}"
+            )
+        return out
 
 
 class DeepSpeedConfig:
@@ -1434,6 +1482,7 @@ class DeepSpeedConfig:
         self.comm = CommConfig.from_dict(d.get(C.COMM))
         self.serving = ServingConfig.from_dict(d.get(C.SERVING))
         self.telemetry = TelemetryConfig.from_dict(d.get(C.TELEMETRY))
+        self.kernels = KernelsConfig.from_dict(d.get(C.KERNELS))
         self.elasticity_dict = d.get("elasticity")
 
         self.gradient_clipping = float(d.get(C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT))

@@ -314,3 +314,14 @@ DIVERGENCE_ACTIONS = [
     DIVERGENCE_ACTION_FLOOR,
     DIVERGENCE_ACTION_ROLLBACK,
 ]
+
+#############################################
+# Pallas kernel suite (ops/kernels; docs/kernels.md)
+#############################################
+KERNELS = "kernels"
+KERNELS_ENABLED_AUTO = "auto"  # armed on TPU-class backends only
+KERNELS_ENABLED_CHOICES = [KERNELS_ENABLED_AUTO, True, False]
+KERNELS_FLASH_DECODE_DEFAULT = True  # fused int8-KV flash-decode kernel
+KERNELS_FUSED_UPDATE_DEFAULT = True  # one-HBM-pass Adam/LAMB update
+KERNELS_AUTOTUNE_MODES = ["off", "cache", "force"]
+KERNELS_AUTOTUNE_DEFAULT = "cache"  # read-mostly; CI/tier-1 never measure

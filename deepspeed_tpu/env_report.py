@@ -535,6 +535,32 @@ def _attribution_rows(t) -> list:
     return [("attribution top-3", top)]
 
 
+def kernels_report(config=None) -> None:
+    """Pallas kernel-suite rows (docs/kernels.md): which kernels are
+    armed for this process/backend and the block autotuner cache state
+    (mode / path / entry count / LRU hits)."""
+    from deepspeed_tpu.ops import kernels as k
+
+    c = getattr(config, "kernels", None)
+    if c is not None:
+        k.configure_from_config(c)
+    rep = k.kernels_report()
+    at = rep["autotune"]
+    print()
+    print("pallas kernel suite:")
+    rows = [
+        ("suite armed", f"{'yes' if rep['suite_armed'] else 'no'} (DS_KERNELS={rep['env']})"),
+        ("flash_decode kernel", "armed" if rep["flash_decode"] else "off"),
+        ("fused_update kernel", "armed" if rep["fused_update"] else "off"),
+        ("autotune mode", at["mode"]),
+        ("autotune cache", at["path"] + ("" if at["cache_ok"] else " [CORRUPT -> defaults]")),
+        ("autotune entries", f"{at['entries']} on disk, {at['lru']} in LRU"),
+        ("autotune hits/misses", f"{at['hits']}/{at['misses']} ({at['tunes']} tuned this process)"),
+    ]
+    for name, value in rows:
+        print(f"{name} " + "." * (30 - len(name)) + f" {value}")
+
+
 def bench_history_report() -> None:
     """Bench trajectory rows: last run's sha + rung count from
     BENCH.json, history depth and the current regression-gate status
@@ -600,6 +626,7 @@ def cli_main() -> int:
     sharding_report()
     serving_report()
     telemetry_report()
+    kernels_report()
     bench_history_report()
     return 0 if ok else 1
 

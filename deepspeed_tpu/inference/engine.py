@@ -120,6 +120,7 @@ class InferenceEngine:
         kv_cache_dtype: str = "model",
         seed: int = 0,
         init_on_device: bool = False,
+        kernels: Any = None,
         **kwargs,
     ):
         """``model`` may be:
@@ -145,6 +146,17 @@ class InferenceEngine:
         self.kv_cache_dtype = kv_cache_dtype
         self._kv_dtype = "int8" if kv_cache_dtype == "int8" else self.dtype
         self._compiled: Dict[Any, Callable] = {}
+
+        # Pallas kernel suite (docs/kernels.md): `kernels` may be a
+        # KernelsConfig, a raw `kernels` config dict, or None (keep the
+        # process state — DS_KERNELS env still wins inside the dispatch)
+        if kernels is not None:
+            from deepspeed_tpu.config.config import KernelsConfig
+            from deepspeed_tpu.ops import kernels as _kernels_mod
+
+            if isinstance(kernels, dict):
+                kernels = KernelsConfig.from_dict(kernels)
+            _kernels_mod.configure_from_config(kernels)
 
         # -- resolve model family + params --------------------------------
         from deepspeed_tpu.models import bert as bert_mod
@@ -366,9 +378,20 @@ class InferenceEngine:
                     is_leaf=lambda m: hasattr(m, "shape"),
                 )
             }
-        restored = ckptr.restore(
-            state_path, args=ocp.args.PyTreeRestore(item=target, partial_restore=True)
-        )
+        try:
+            restored = ckptr.restore(
+                state_path, args=ocp.args.PyTreeRestore(item=target, partial_restore=True)
+            )
+        except TypeError:
+            # older orbax has no partial_restore kwarg: read the whole
+            # tree (host arrays, disk shapes) and keep the params subtree
+            restored = ckptr.restore(state_path)
+            restored = {
+                "params": jax.tree.map(
+                    lambda t, v: np.asarray(v, t.dtype), target["params"],
+                    restored["params"],
+                )
+            }
         log_dist(f"inference: loaded params from {state_dir}")
         return restored["params"]
 
@@ -532,7 +555,17 @@ class InferenceEngine:
         )
 
         cfg = self.model_config
-        icfg = self.inference_config(T + N)
+        # Static cache capacity: T+N, rounded up to the flash-decode
+        # kernel's 128-row grid when the suite is armed (docs/kernels.md)
+        # — the padded tail sits beyond every query position (pos < T+N)
+        # so it is never attendable; without alignment the token loop
+        # would silently fall back to the lax path for most (T, N).
+        from deepspeed_tpu.ops import kernels as _kernels_mod
+
+        S = T + N
+        if _kernels_mod.flash_decode_armed():
+            S = -(-S // 128) * 128
+        icfg = self.inference_config(S)
         eos = -1 if eos_token_id is None else int(eos_token_id)
 
         def sample_token(logits32, r):
@@ -541,14 +574,17 @@ class InferenceEngine:
             )
 
         def gen(params, tokens, rng, attention_mask):
-            k_cache, v_cache = init_kv_cache(cfg.n_layer, B, cfg.n_head, T + N, cfg.head_dim, self._kv_dtype)
+            k_cache, v_cache = init_kv_cache(cfg.n_layer, B, cfg.n_head, S, cfg.head_dim, self._kv_dtype)
             if masked:
                 # left-padded prompts: real positions start at 0 per
                 # example; padded cache slots are never attendable
+                # (incl. the kernel-alignment tail beyond T+N)
                 prompt_mask = attention_mask.astype(bool)  # (B, T)
                 position_ids = jnp.maximum(jnp.cumsum(prompt_mask.astype(jnp.int32), axis=1) - 1, 0)
                 real_len = jnp.sum(prompt_mask.astype(jnp.int32), axis=1)  # (B,)
-                full_mask = jnp.concatenate([prompt_mask, jnp.ones((B, N), bool)], axis=1)
+                full_mask = jnp.concatenate(
+                    [prompt_mask, jnp.ones((B, N), bool),
+                     jnp.zeros((B, S - T - N), bool)], axis=1)
                 logits, k_cache, v_cache = forward_with_cache(
                     params, tokens, k_cache, v_cache, 0, icfg,
                     key_padding_mask=full_mask, position_ids=position_ids,

@@ -106,20 +106,22 @@ class DeepSpeedCPUAdam:
                 n, lr, b1, b2, self.eps, self.weight_decay, step_count, int(self.adamw_mode),
             )
             return
-        # numpy fallback — identical math
+        # numpy fallback — the SAME update body the Pallas fused-update
+        # kernel and the XLA leaf path execute (ops/kernels/fused_update
+        # .adam_update_reference), so the ZeRO-Offload/Infinity drain
+        # and the on-device optimizer can never drift apart
+        from deepspeed_tpu.ops.kernels.fused_update import adam_update_reference
+
         g = grads.astype(np.float32, copy=False)
-        if not self.adamw_mode and self.weight_decay > 0:
-            g = g + self.weight_decay * params
-        exp_avg *= b1
-        exp_avg += (1 - b1) * g
-        exp_avg_sq *= b2
-        exp_avg_sq += (1 - b2) * np.square(g)
         bc1 = 1 - b1 ** step_count
         bc2 = 1 - b2 ** step_count
-        update = (exp_avg / bc1) / (np.sqrt(exp_avg_sq / bc2) + self.eps)
-        if self.adamw_mode and self.weight_decay > 0:
-            update = update + self.weight_decay * params
-        params -= lr * update
+        # inplace=True: moments/params mutate in their own buffers — the
+        # drain path exists because host memory is scarce, so the shared
+        # body must not allocate leaf-sized fresh state arrays here
+        adam_update_reference(
+            np, params, g, exp_avg, exp_avg_sq, lr, b1, b2, self.eps,
+            self.weight_decay, self.adamw_mode, bc1, bc2, inplace=True,
+        )
 
 
 @register_op("cpu_adam", "native", "OpenMP/auto-vectorized host Adam for ZeRO-Offload (AVX cpu_adam analog)")

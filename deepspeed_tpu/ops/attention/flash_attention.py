@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
+from deepspeed_tpu.ops.kernels.compat import tpu_compiler_params
 from deepspeed_tpu.ops.registry import register_op
 from deepspeed_tpu.utils.logging import logger
 
@@ -739,7 +740,7 @@ def _flash_bwd_fused_pallas(
             jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
             jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,

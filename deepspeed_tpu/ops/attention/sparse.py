@@ -49,6 +49,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
+from deepspeed_tpu.ops.kernels.compat import tpu_compiler_params
+
 from deepspeed_tpu.ops.attention.flash_attention import DEFAULT_MASK_VALUE
 from deepspeed_tpu.ops.registry import register_op
 
@@ -710,7 +712,7 @@ def _splash_fwd(q, k, v, layout: np.ndarray, block: int, causal: bool, sm_scale:
         kern,
         grid_spec=grid_spec,
         out_shape=out_shape,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary", "arbitrary"),
         ),
         interpret=interpret,
@@ -913,7 +915,7 @@ def _splash_bwd(q, k, v, out, lse, g, layout: np.ndarray, block: int, causal: bo
         dq_kern,
         grid_spec=grid_spec,
         out_shape=[jax.ShapeDtypeStruct((B * H, nb, block, hd), q.dtype)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary", "arbitrary"),
         ),
         interpret=interpret,
@@ -960,7 +962,7 @@ def _splash_bwd(q, k, v, out, lse, g, layout: np.ndarray, block: int, causal: bo
             jax.ShapeDtypeStruct((B * H, nb, block, hd), k.dtype),
             jax.ShapeDtypeStruct((B * H, nb, block, hd), v.dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -1,0 +1,45 @@
+"""Version-compat shims for the Pallas TPU surface.
+
+jax renamed ``pltpu.TPUCompilerParams`` to ``pltpu.CompilerParams`` (and
+shuffled a few keyword spellings) across the 0.4.x line; this container
+ships the old spelling.  Every kernel in the suite goes through
+:func:`tpu_compiler_params` so the suite — and the pre-existing flash
+attention kernels — run on either jaxlib without per-call guards.
+(Same pattern as ``comm/collectives.py``'s ``_sm_flags`` shim for
+``shard_map`` keyword drift.)
+"""
+from __future__ import annotations
+
+from typing import Any
+
+
+def on_tpu_backend() -> bool:
+    """One home for the TPU-class backend probe (the arming default,
+    the interpret-mode default, and the bench dispatch all key on it —
+    a new backend name gets added HERE, not in four call sites)."""
+    try:
+        import jax
+
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:  # noqa: BLE001 — no backend = not a TPU
+        return False
+
+
+def tpu_compiler_params(**kw: Any):
+    """``pltpu.CompilerParams(**kw)`` on new jax, ``TPUCompilerParams``
+    on old; unsupported keywords are dropped (they are hints, not
+    semantics)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams", None
+    )
+    if cls is None:  # pallas too old to accept params at all
+        return None
+    try:
+        return cls(**kw)
+    except TypeError:
+        import dataclasses
+
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in kw.items() if k in known})

@@ -1,0 +1,341 @@
+"""Fused single-query flash-decode kernel over the slot-pool KV layout.
+
+The decode hot path (one query token per live slot against an S-position
+cache) was a chain of XLA fusions: for the int8 pool it **dequantized
+the codes, materialized fp32-sized score/operand tensors, then
+attended** — the ``kv-dequant`` attribution bucket that caps GPT-Neo
+2.7B long-context int8 decode (~1,152 tok/s, BENCH_EXTRA).  This kernel
+collapses the round-trip: int8 codes + scales stream HBM→VMEM once,
+dequantization happens **in-register inside the flash inner loop**
+(codes are the dot operands; the per-row scales fold into the score row
+and the probability row exactly like the lax path), and the online
+softmax never materializes an (S,) tensor in HBM.  The bf16/f32 pool
+runs the same kernel minus the dequant.
+
+Contract (mirrors ``ops/transformer/inference.cache_attention``, which
+remains the lax fallback and the numerics ground truth):
+
+* ``q``: (B, H, 1, d) — exactly one query per slot (decode / one-token
+  speculative step).  ``B`` is the slot axis of the serving pool or the
+  batch axis of ``generate()``.
+* caches: (B, H, S, d) arrays, or the int8 pair ``{"q": int8 codes,
+  "s": (B, H, S, 1) fp32 scales}`` from ``init_kv_cache``.
+* ``pos``: scalar or per-slot (B,) write offsets; key ``j`` is
+  attendable iff ``j <= pos[b]`` (the overwrite-before-attend serving
+  invariant rides on this mask).
+* ``key_padding_mask``: optional (B, S), True = attendable (left-padded
+  ``generate()`` prompts).
+* Inference-only: no ``custom_vjp``, no lse output, no dropout — the
+  decode step is never differentiated, so the kernel carries none of
+  the training machinery.
+
+Grid: ``(B // block_slots, H, S // block_k)`` with the kv axis
+sequential ("arbitrary"); each program keeps (m, l, acc) for its
+``block_slots`` rows in VMEM scratch across kv steps, so K/V blocks
+double-buffer through VMEM while the previous block computes.
+``block_k`` / ``block_slots`` come from the autotuner
+(:mod:`deepspeed_tpu.ops.kernels.autotune`) — deterministic defaults
+unless a measured tuning is cached.
+
+Off-TPU the kernel runs under ``interpret=True`` (tests); the engines
+only dispatch here when the kernel suite is armed
+(:func:`deepspeed_tpu.ops.kernels.flash_decode_armed`), so CPU tier-1
+stays on the lax path unless a test forces ``DS_KERNELS=1``.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from deepspeed_tpu.ops.kernels.compat import on_tpu_backend as _on_tpu, tpu_compiler_params
+from deepspeed_tpu.ops.registry import register_op
+
+# Same mask constant as cache_attention: fully-masked rows degrade to
+# the same uniform softmax on both paths (parity over garbage rows the
+# serving step deliberately carries).
+NEG_INF = -1e30
+
+
+def decode_supported(B: int, H: int, S: int, d: int) -> bool:
+    """Shapes the kernel grid can serve: the kv axis must offer at least
+    one >=128 block, head_dim must be lane-layout friendly.  Everything
+    else falls back to the lax path (tiny unit-test caches)."""
+    return S >= 128 and S % 128 == 0 and d >= 8 and B >= 1 and H >= 1
+
+
+def _pick_block_k(S: int, pref: int) -> int:
+    b = min(pref, S)
+    while b > 128 and S % b:
+        b //= 2
+    return b if S % b == 0 else 128
+
+
+def _pick_block_slots(B: int, pref: int) -> int:
+    b = max(1, min(pref, B))
+    while b > 1 and B % b:
+        b //= 2
+    return b
+
+
+# ---------------------------------------------------------------------------
+# kernel body
+# ---------------------------------------------------------------------------
+
+def _flash_decode_kernel(
+    pos_ref,          # SMEM (B, 1) int32 — per-slot query position (full array)
+    q_ref,            # (block_slots, 1, 1, d)
+    k_ref,            # (block_slots, 1, block_k, d)  codes or bf16/f32
+    v_ref,            # (block_slots, 1, block_k, d)
+    *rest,            # [ks_ref, vs_ref] int8 scales (block_slots,1,1,block_k); [kpm_ref (block_slots,1,S)]; o_ref; scratch: m, l, acc
+    sm_scale: float,
+    block_k: int,
+    block_slots: int,
+    quant: bool,
+    masked: bool,
+):
+    refs = list(rest)
+    ks_ref = refs.pop(0) if quant else None
+    vs_ref = refs.pop(0) if quant else None
+    kpm_ref = refs.pop(0) if masked else None
+    o_ref, m_ref, l_ref, acc_ref = refs
+
+    slot0 = pl.program_id(0) * block_slots
+    kv_idx = pl.program_id(2)
+    num_kv = pl.num_programs(2)
+    col0 = kv_idx * block_k
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    key_idx = col0 + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+
+    # static unroll over the slot rows of this program: each row is an
+    # independent sequence (its own K/V and position), so the math is a
+    # (1, d) x (d, block_k) matvec chain per row — decode is memory-
+    # bound, the MXU shape hardly matters, the K/V stream does.
+    for s in range(block_slots):
+        row = pl.dslice(s, 1)
+        q = q_ref[s, 0].astype(jnp.float32)                      # (1, d)
+        k = k_ref[s, 0].astype(jnp.float32)                      # (block_k, d)
+        scores = jax.lax.dot_general(
+            q, k,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale                                             # (1, block_k)
+        if quant:
+            # in-register dequant, scale OUTSIDE the dot (the codes are
+            # the streamed operands — identical factoring to the lax
+            # path, so parity is a tolerance not a rewrite)
+            scores = scores * ks_ref[s, 0]                       # (1, block_k)
+        allowed = key_idx <= pos_ref[slot0 + s, 0]
+        if masked:
+            allowed = jnp.logical_and(
+                allowed, kpm_ref[s, :, pl.dslice(col0, block_k)] > 0
+            )
+        scores = jnp.where(allowed, scores, NEG_INF)
+
+        m_prev = m_ref[row]                                      # (1, 1)
+        l_prev = l_ref[row]
+        m_cur = jnp.max(scores, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(scores - m_new)                              # (1, block_k)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[row] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        m_ref[row] = m_new
+        if quant:
+            p = p * vs_ref[s, 0]
+        v = v_ref[s, 0].astype(jnp.float32)                      # (block_k, d)
+        acc_ref[row] = acc_ref[row] * alpha + jnp.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(kv_idx == num_kv - 1)
+    def _emit():
+        l = jnp.where(l_ref[:] == 0.0, 1.0, l_ref[:])            # (bs, 1)
+        o_ref[:] = (acc_ref[:] / l)[:, None, None, :].astype(o_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# host-graph wrapper
+# ---------------------------------------------------------------------------
+
+def flash_decode(
+    q: jnp.ndarray,
+    k_cache,
+    v_cache,
+    pos,
+    sm_scale: Optional[float] = None,
+    key_padding_mask=None,
+    block_k: Optional[int] = None,
+    block_slots: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Single-query attention against a slot cache; see module docs.
+    Returns (B, H, 1, d) in ``q.dtype``.  Block sizes default to the
+    autotuner's table (cached measured winners when present)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    from deepspeed_tpu.ops.kernels.autotune import get_autotuner
+
+    quant = isinstance(k_cache, dict)
+    k_op = k_cache["q"] if quant else k_cache
+    v_op = v_cache["q"] if quant else v_cache
+    B, H, T, d = q.shape
+    S = k_op.shape[2]
+    if T != 1:
+        raise ValueError(f"flash_decode serves exactly one query per slot, got T={T}")
+    if not decode_supported(B, H, S, d):
+        raise ValueError(
+            f"flash_decode grid cannot serve (B={B}, H={H}, S={S}, d={d}); "
+            "callers must dispatch through decode_supported()"
+        )
+    if sm_scale is None:
+        sm_scale = 1.0 / (d ** 0.5)
+    if interpret is None:
+        interpret = not _on_tpu()
+
+    blocks = get_autotuner().blocks_for("flash_decode", B=B, H=H, S=S, d=d, int8=quant)
+    bk = _pick_block_k(S, block_k or blocks["block_k"])
+    bs = _pick_block_slots(B, block_slots or blocks["block_slots"])
+
+    # per-slot position vector (scalar pos broadcasts: every generate()
+    # row decodes at the same offset), shaped (B, 1) for SMEM blocks
+    pos_vec = jnp.broadcast_to(
+        jnp.asarray(pos, jnp.int32).reshape(-1), (B,)
+    ).reshape(B, 1)
+
+    grid = (B // bs, H, S // bk)
+    in_specs = [
+        pl.BlockSpec((bs, 1, 1, d), lambda sb, h, kv: (sb, h, 0, 0)),
+        pl.BlockSpec((bs, 1, bk, d), lambda sb, h, kv: (sb, h, kv, 0)),
+        pl.BlockSpec((bs, 1, bk, d), lambda sb, h, kv: (sb, h, kv, 0)),
+    ]
+    args = [q, k_op, v_op]
+    if quant:
+        # (B, H, S, 1) scales -> (B, H, 1, S) row vectors (a contiguous
+        # reshape) so in-kernel scale rows share the score layout
+        ks = k_cache["s"].reshape(B, H, 1, S)
+        vs = v_cache["s"].reshape(B, H, 1, S)
+        spec = pl.BlockSpec((bs, 1, 1, bk), lambda sb, h, kv: (sb, h, 0, kv))
+        in_specs += [spec, spec]
+        args += [ks, vs]
+    masked = key_padding_mask is not None
+    if masked:
+        # (B, S) -> (B, 1, S) f32: the trailing (1, S) block equals the
+        # array dims, which Mosaic requires when B isn't sublane-aligned
+        kpm = key_padding_mask.astype(jnp.float32).reshape(B, 1, S)
+        in_specs.append(pl.BlockSpec((bs, 1, S), lambda sb, h, kv: (sb, 0, 0)))
+        args.append(kpm)
+
+    kern = functools.partial(
+        _flash_decode_kernel,
+        # static python scale (a traced sm_scale cannot close into the
+        # kernel body; callers pass None or a host float)
+        sm_scale=sm_scale,
+        block_k=bk,
+        block_slots=bs,
+        quant=quant,
+        masked=masked,
+    )
+    # pos rides SMEM un-blocked (the drop_seed pattern from the flash
+    # fwd kernel): every program reads its absolute slot rows
+    in_specs.insert(0, pl.BlockSpec(memory_space=pltpu.SMEM))
+    out = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((bs, 1, 1, d), lambda sb, h, kv: (sb, h, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, 1, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bs, 1), jnp.float32),   # m
+            pltpu.VMEM((bs, 1), jnp.float32),   # l
+            pltpu.VMEM((bs, d), jnp.float32),   # acc
+        ],
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(pos_vec, *args)
+    return out
+
+
+def flash_decode_reference(q, k_cache, v_cache, pos, sm_scale=None, key_padding_mask=None):
+    """The lax ground truth — literally ``cache_attention`` (kept as an
+    alias so the parity tests and the bench name one seam)."""
+    from deepspeed_tpu.ops.transformer.inference import cache_attention
+
+    return cache_attention(
+        q, k_cache, v_cache, pos, sm_scale=sm_scale,
+        key_padding_mask=key_padding_mask, use_kernel=False,
+    )
+
+
+def tune_decode_blocks(B: int, H: int, S: int, d: int, kv_dtype="bfloat16",
+                       iters: int = 8) -> dict:
+    """Measured block search for one decode shape (host-side; run BEFORE
+    executables build — e.g. ``tools/bench_kernels.py`` or an explicit
+    serving warmup).  Times the standalone kernel on synthetic buffers
+    with a ``block_until_ready`` fence per candidate and persists the
+    winner through the process autotuner.  Honors DS_KERNEL_AUTOTUNE:
+    mode ``off``/``cache`` return without measuring (defaults / cached
+    winner)."""
+    import time
+
+    import numpy as np
+
+    from deepspeed_tpu.ops.kernels.autotune import get_autotuner
+    from deepspeed_tpu.ops.transformer.inference import init_kv_cache
+
+    tuner = get_autotuner()
+    quant = kv_dtype == "int8" or kv_dtype == jnp.int8
+    key = dict(B=B, H=H, S=S, d=d, int8=quant)
+    if tuner.mode != "force":
+        return tuner.blocks_for("flash_decode", **key)
+
+    rng = np.random.default_rng(0)
+    qd = jnp.asarray(rng.standard_normal((B, H, 1, d)), jnp.bfloat16)
+    k_cache, v_cache = init_kv_cache(1, B, H, S, d, "int8" if quant else jnp.bfloat16)
+    squeeze = lambda c: jax.tree.map(lambda a: a[0], c)  # noqa: E731 — drop layer dim
+    k_cache, v_cache = squeeze(k_cache), squeeze(v_cache)
+    if quant:
+        k_cache = dict(k_cache, q=jnp.asarray(rng.integers(-127, 127, k_cache["q"].shape), jnp.int8),
+                       s=jnp.abs(jnp.asarray(rng.standard_normal(k_cache["s"].shape), jnp.float32)) + 0.01)
+        v_cache = dict(v_cache, q=jnp.asarray(rng.integers(-127, 127, v_cache["q"].shape), jnp.int8),
+                       s=jnp.abs(jnp.asarray(rng.standard_normal(v_cache["s"].shape), jnp.float32)) + 0.01)
+    else:
+        k_cache = jnp.asarray(rng.standard_normal(k_cache.shape), jnp.bfloat16)
+        v_cache = jnp.asarray(rng.standard_normal(v_cache.shape), jnp.bfloat16)
+    pos = jnp.full((B,), S - 1, jnp.int32)
+
+    def timer(blocks):
+        # host-side standalone tuning probe on synthetic replicated
+        # buffers — no mesh layout to pin
+        fn = jax.jit(  # ds-lint: disable=bare-jit
+            lambda q_, k_, v_, p_: flash_decode(
+                q_, k_, v_, p_, block_k=blocks["block_k"],
+                block_slots=blocks["block_slots"],
+            )
+        )
+        fn(qd, k_cache, v_cache, pos).block_until_ready()  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(qd, k_cache, v_cache, pos)
+        out.block_until_ready()
+        return (time.perf_counter() - t0) / iters
+
+    return tuner.tune("flash_decode", timer, **key)
+
+
+@register_op(
+    "flash_decode", "pallas",
+    "Fused single-query flash decode over the slot KV pool; int8 codes dequantized in-register",
+)
+def _load_flash_decode():
+    return flash_decode

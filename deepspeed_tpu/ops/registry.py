@@ -80,6 +80,8 @@ def all_ops() -> Dict[str, OpSpec]:
         "deepspeed_tpu.ops.transformer.transformer",
         "deepspeed_tpu.ops.transformer.inference",
         "deepspeed_tpu.ops.attention.sparse",
+        "deepspeed_tpu.ops.kernels.flash_decode",
+        "deepspeed_tpu.ops.kernels.fused_update",
         "deepspeed_tpu.ops.utils_op",
     ):
         try:

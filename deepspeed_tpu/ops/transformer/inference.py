@@ -120,7 +120,8 @@ def slot_cache_write(cache, t, pos):
     )(cache, t, pos)
 
 
-def cache_attention(q, k_cache, v_cache, pos, sm_scale: Optional[float] = None, key_padding_mask=None):
+def cache_attention(q, k_cache, v_cache, pos, sm_scale: Optional[float] = None,
+                    key_padding_mask=None, use_kernel: Optional[bool] = None):
     """Attend queries (B,H,T,d) against a static cache (B,H,S,d).
 
     Allowed keys for query i: cache index j <= pos + i (``pos`` = write
@@ -131,8 +132,32 @@ def cache_attention(q, k_cache, v_cache, pos, sm_scale: Optional[float] = None, 
     ``key_padding_mask`` (B, S) True=attendable additionally masks
     left-padded prompt slots.  Reference decode softmax:
     ``csrc/transformer/inference/csrc/softmax.cu``.
+
+    Single-query steps (T=1 — pool decode, generate()'s token loop)
+    dispatch to the fused Pallas flash-decode kernel when the kernel
+    suite is armed (``ops/kernels``, docs/kernels.md): int8 KV codes
+    stream compressed and dequantize in-register, eliminating the
+    dequant→materialize→attend round-trip this lax path pays.  The lax
+    path below stays the numerics ground truth and the CPU/tier-1
+    fallback; ``use_kernel`` forces the choice (tests / the reference
+    twin).  The decision is trace-time static, so a built executable
+    never flips.
     """
     quant = isinstance(k_cache, dict)
+    if use_kernel is None:
+        from deepspeed_tpu.ops import kernels as _kernels
+
+        use_kernel = _kernels.flash_decode_armed()
+    if use_kernel and q.shape[2] == 1:
+        from deepspeed_tpu.ops.kernels.flash_decode import decode_supported, flash_decode
+
+        B, H, _, d = q.shape
+        S = (k_cache["q"] if quant else k_cache).shape[2]
+        if decode_supported(B, H, S, d):
+            return flash_decode(
+                q, k_cache, v_cache, pos, sm_scale=sm_scale,
+                key_padding_mask=key_padding_mask,
+            )
     if quant:
         # int8 cache: the CODES are the dot operands (a plain convert
         # fuses into the dot's operand read, so int8 is what streams
@@ -140,8 +165,13 @@ def cache_attention(q, k_cache, v_cache, pos, sm_scale: Optional[float] = None, 
         # (T,S) score matrix and folded into p before the value dot.
         # Dequantizing first (codes*scale as the operand) defeats
         # operand fusion and materializes an f32-sized cache per step.
-        k_scale = k_cache["s"][..., 0][:, :, None, :]  # (B,H,1,S)
-        v_scale = v_cache["s"][..., 0][:, :, None, :]
+        # The kv_dequant scope pins this round-trip to the `kv-dequant`
+        # attribution bucket (docs/telemetry.md) — the cost the fused
+        # decode kernel deletes, so the pin is visible exactly when
+        # this lax path runs.
+        with jax.named_scope("kv_dequant"):
+            k_scale = k_cache["s"][..., 0][:, :, None, :]  # (B,H,1,S)
+            v_scale = v_cache["s"][..., 0][:, :, None, :]
         k_op, v_op = k_cache["q"], v_cache["q"]
     else:
         k_scale = v_scale = None
@@ -152,7 +182,8 @@ def cache_attention(q, k_cache, v_cache, pos, sm_scale: Optional[float] = None, 
         sm_scale = 1.0 / (d ** 0.5)
     s = jnp.einsum("bhtd,bhsd->bhts", q.astype(jnp.float32), k_op.astype(jnp.float32)) * sm_scale
     if quant:
-        s = s * k_scale
+        with jax.named_scope("kv_dequant"):
+            s = s * k_scale
     key_idx = jnp.arange(S)[None, None, None, :]
     pos_off = pos[:, None, None, None] if _per_slot(pos) else pos
     q_idx = pos_off + jnp.arange(T)[None, None, :, None]
@@ -162,7 +193,8 @@ def cache_attention(q, k_cache, v_cache, pos, sm_scale: Optional[float] = None, 
     s = jnp.where(allowed, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     if quant:
-        p = p * v_scale
+        with jax.named_scope("kv_dequant"):
+            p = p * v_scale
     out = jnp.einsum("bhts,bhsd->bhtd", p, v_op.astype(jnp.float32))
     return out.astype(q.dtype)
 

@@ -52,7 +52,9 @@ def _ring_attention_sharded(q, k, v, *, axis_name: str, causal: bool, sm_scale: 
     ``q, k, v``: local shards ``(B, H, T/P, D)``; sequence is sharded
     contiguously (shard ``r`` holds positions ``[r*T/P, (r+1)*T/P)``).
     """
-    ring = jax.lax.axis_size(axis_name)
+    from deepspeed_tpu.comm.collectives import static_axis_size
+
+    ring = static_axis_size(axis_name)  # version-compat lax.axis_size
     my = jax.lax.axis_index(axis_name)
     b, h, t_local, d = q.shape
     qf = q.astype(jnp.float32) * sm_scale
@@ -174,7 +176,12 @@ def _seq_parallel_call(body_fn, q, k, v, causal, sm_scale, mesh, axis_name, **bo
         body_fn, axis_name=axis_name, causal=causal, sm_scale=float(sm_scale), **body_kwargs
     )
     spec = P(None, None, axis_name, None)
-    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, axis_names={axis_name}, check_vma=False)
+    # version-compat shard_map (axis_names/check_vma vs auto/check_rep
+    # keyword drift across the jax 0.4.x line) — same shim the pipeline
+    # engine's per-stage bodies use
+    from deepspeed_tpu.comm.collectives import shard_map_manual
+
+    fn = shard_map_manual(body, mesh, in_specs=(spec, spec, spec), out_specs=spec, manual_axes={axis_name})
     return fn(q, k, v)
 
 

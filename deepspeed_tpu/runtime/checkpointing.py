@@ -616,8 +616,23 @@ def _restore_tag(
                         }
                     else:
                         full_target[k] = {}
-                # other keys (e.g. opt_state with an unknown schema)
-                # stay omitted — works only when the tag lacks them too
+            # remaining skipped keys with no reconstructible schema
+            # (e.g. an offload engine reading a non-offload tag's
+            # opt_state): rebuild DISK-shaped targets from orbax
+            # metadata — old orbax insists the restore target cover
+            # every on-disk key; the values are discarded below
+            try:
+                disk_meta = ckptr.metadata(os.path.join(path, "state"))
+            except Exception:  # noqa: BLE001 — metadata is best-effort help
+                disk_meta = {}
+            for k in skip_keys:
+                if k in full_target or k not in disk_meta:
+                    continue
+                full_target[k] = jax.tree.map(
+                    lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype, sharding=repl),
+                    disk_meta[k],
+                    is_leaf=lambda m: hasattr(m, "shape") and hasattr(m, "dtype"),
+                )
             out = dict(ckptr.restore(os.path.join(path, "state"), full_target))
         for k in skip_keys:
             out[k] = {}

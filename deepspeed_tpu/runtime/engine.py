@@ -451,6 +451,13 @@ class DeepSpeedEngine:
         if self.telemetry.collect or self.telemetry.tracer.enabled:
             self.timeline.attach_telemetry(self.telemetry, prefix="train")
 
+        # -- Pallas kernel suite (docs/kernels.md) -------------------------
+        # Process-wide arming from the `kernels` block; resolved by the
+        # ops-level dispatches at trace time (fused update, flash decode)
+        from deepspeed_tpu.ops import kernels as _kernels_mod
+
+        _kernels_mod.configure_from_config(getattr(config, "kernels", None))
+
         # -- unified comm layer (docs/comm.md) -----------------------------
         # Strategy-selected collectives: the gradient exchange routes
         # through self.comm, which picks dense / int8-quantized (EQuARX)
@@ -851,35 +858,69 @@ class DeepSpeedEngine:
             # bits each step — without them v falls back to nearest
             # rounding and sub-LSB EMA increments are systematically lost
             upd_kw["rng"] = jax.random.fold_in(state["rng"], state["global_step"] + 997_001)
-        in_producer_skip = getattr(self.optimizer, "supports_skip", False)
-        if in_producer_skip:
-            # overflow handling happens INSIDE the optimizer's producer
-            # pass: updates come out zero and the state keeps its old
-            # values.  The alternative — where(overflow, old, new) over
-            # the state tree below — re-reads old AND new (state-sized
-            # extra HBM traffic; ~26 ms/step at 774M, because the donated
-            # output buffer forces `new` to materialize before the select)
-            upd_kw["skip"] = overflow
-        updates, new_opt = self.optimizer.update(
-            grads, state["opt_state"], state["params"], lr=lr, **upd_kw
-        )
+        # fused-update kernel seam (ops/kernels, docs/kernels.md): when
+        # armed and the optimizer/state is kernel-eligible, ONE Pallas
+        # kernel per leaf does the master-weight read + moment update +
+        # param-dtype cast in a single HBM pass, with the overflow skip
+        # folded in-producer.  Trace-time static decision; the XLA path
+        # below stays the fallback and the numerics ground truth.
+        fused = None
+        from deepspeed_tpu.ops import kernels as _kernels
 
-        if in_producer_skip:
-            new_params = jax.tree.map(
-                lambda p, u: (p.astype(jnp.float32) + u).astype(p.dtype),
-                state["params"], updates,
-            )
+        if _kernels.fused_update_armed():
+            if _kernels.on_tpu_backend() and self.mesh.devices.size > 1:
+                # compiled Mosaic custom calls are opaque to the GSPMD
+                # partitioner: on a multi-device mesh the sharded update
+                # (cross-replica ZeRO-1, fsdp state) would lose its
+                # per-replica-slice contract.  Multi-chip fused updates
+                # need the shard_map integration (future arc); keep the
+                # partitionable XLA path.  (Off-TPU interpret mode
+                # lowers to plain jax ops, which partition fine — the
+                # 8-device CPU dryrun tests run the seam.)
+                _kernels.warn_once(
+                    f"fused-update-multichip-{id(self)}",
+                    "kernels: fused_update armed but the mesh spans "
+                    f"{self.mesh.devices.size} devices — keeping the "
+                    "partitionable XLA update (docs/kernels.md)",
+                )
+            else:
+                from deepspeed_tpu.ops.kernels.fused_update import engine_update
+
+                fused = engine_update(
+                    self.optimizer, grads, state["opt_state"], state["params"], lr, overflow
+                )
+        if fused is not None:
+            new_params, new_opt = fused
         else:
-            def apply_or_skip(p, u):
-                return jnp.where(overflow, p, (p.astype(jnp.float32) + u).astype(p.dtype))
-
-            new_params = jax.tree.map(apply_or_skip, state["params"], updates)
-            # on overflow, keep the old optimizer state too
-            new_opt = jax.tree.map(
-                lambda old, new: jnp.where(overflow, old, new) if hasattr(old, "shape") else new,
-                state["opt_state"],
-                new_opt,
+            in_producer_skip = getattr(self.optimizer, "supports_skip", False)
+            if in_producer_skip:
+                # overflow handling happens INSIDE the optimizer's producer
+                # pass: updates come out zero and the state keeps its old
+                # values.  The alternative — where(overflow, old, new) over
+                # the state tree below — re-reads old AND new (state-sized
+                # extra HBM traffic; ~26 ms/step at 774M, because the donated
+                # output buffer forces `new` to materialize before the select)
+                upd_kw["skip"] = overflow
+            updates, new_opt = self.optimizer.update(
+                grads, state["opt_state"], state["params"], lr=lr, **upd_kw
             )
+
+            if in_producer_skip:
+                new_params = jax.tree.map(
+                    lambda p, u: (p.astype(jnp.float32) + u).astype(p.dtype),
+                    state["params"], updates,
+                )
+            else:
+                def apply_or_skip(p, u):
+                    return jnp.where(overflow, p, (p.astype(jnp.float32) + u).astype(p.dtype))
+
+                new_params = jax.tree.map(apply_or_skip, state["params"], updates)
+                # on overflow, keep the old optimizer state too
+                new_opt = jax.tree.map(
+                    lambda old, new: jnp.where(overflow, old, new) if hasattr(old, "shape") else new,
+                    state["opt_state"],
+                    new_opt,
+                )
         if self.quantizer is not None:
             # MoQ: fake-quantize weights right after the update
             # (reference _take_model_step :1284-1290); an overflow step is

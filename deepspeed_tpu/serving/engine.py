@@ -86,6 +86,15 @@ class ServingEngine:
                     f"n_positions={mcfg.n_positions}) = {capacity}"
                 )
             max_len = config.max_len
+            from deepspeed_tpu.ops import kernels as _kernels_mod
+
+            if _kernels_mod.flash_decode_armed() and max_len % 128:
+                logger.warning(
+                    f"serving.max_len={max_len} is not a multiple of 128, so "
+                    "the fused flash-decode kernel cannot serve this pool "
+                    "(decode falls back to the lax dequant path; "
+                    "docs/kernels.md) — align max_len to 128 to arm it"
+                )
         else:
             # derive: the engine capacity floored to a chunk multiple
             # (chunk-multiple capacity guarantees the last prefill
@@ -97,6 +106,33 @@ class ServingEngine:
                     f"engine's generation capacity {capacity}; lower the chunk "
                     f"or raise max_out_tokens"
                 )
+            from deepspeed_tpu.ops import kernels as _kernels_mod
+
+            if _kernels_mod.flash_decode_armed() and max_len % 128:
+                # flash-decode kernel grid wants S % 128 == 0: floor the
+                # derived capacity to a (chunk, 128) common multiple so
+                # the decode hot path actually takes the kernel; keep
+                # the chunk floor when the capacity is too small for one
+                import math
+
+                step = math.lcm(config.prefill_chunk, 128)
+                aligned = (capacity // step) * step
+                if aligned >= config.prefill_chunk:
+                    log_dist(
+                        f"serving: derived max_len {max_len} -> {aligned} "
+                        "(floored to the flash-decode kernel's "
+                        f"lcm(chunk={config.prefill_chunk}, 128)={step} grid; "
+                        "set serving.max_len explicitly to keep the larger "
+                        "capacity on the lax path — docs/kernels.md)"
+                    )
+                    max_len = aligned
+                else:
+                    logger.warning(
+                        f"serving: derived max_len={max_len} cannot align to "
+                        "the flash-decode kernel's 128-row grid within the "
+                        f"engine capacity {capacity}; decode falls back to "
+                        "the lax path (docs/kernels.md)"
+                    )
         kv_dtype = "int8" if config.kv_cache_dtype == "int8" else engine._kv_dtype
         from deepspeed_tpu.sharding.layout import replicated_sharding
 

@@ -143,6 +143,14 @@ def classify(opcode: str, op_name: str, source_file: str) -> str:
     op = op_name or ""
     if "/comm/" in src:
         return "comm-collective"
+    # the Pallas kernel suite (ops/kernels, docs/kernels.md) wins over
+    # the dequant match: with the fused flash-decode kernel armed, the
+    # int8 scale math happens IN-KERNEL and is attention work — the
+    # kv-dequant bucket exists to expose the un-fused round-trip
+    if "ops/kernels/flash_decode" in src or "flash_decode" in op:
+        return "attention"
+    if "ops/kernels/fused_update" in src or "fused_update" in op:
+        return "optimizer-update"
     if "quantiz" in src or "dequant" in op or "quantize" in op:
         return "kv-dequant"
     if "ops/attention" in src or "flash_attention" in op or "attention" in op:

@@ -46,7 +46,14 @@ def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir: str, tag: Optional[
             lambda m: np.zeros(m.shape, np.float32), meta_params, is_leaf=lambda m: hasattr(m, "shape")
         )
     }
-    restored = ckptr.restore(state_dir, args=ocp.args.PyTreeRestore(item=target, partial_restore=True))
+    try:
+        restored = ckptr.restore(
+            state_dir, args=ocp.args.PyTreeRestore(item=target, partial_restore=True)
+        )
+    except TypeError:
+        # older orbax has no partial_restore kwarg: read the whole tree
+        # (host arrays) and keep the params subtree
+        restored = {"params": ckptr.restore(state_dir)["params"]}
 
     flat: Dict[str, np.ndarray] = {}
 

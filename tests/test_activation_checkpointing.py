@@ -23,6 +23,11 @@ def _stacked_params(L, d, seed=0):
 
 
 def test_checkpoint_same_value_and_grad():
+    from tests.capabilities import REMAT_BITEXACT_SKIP, remat_grads_bitexact
+
+    if not remat_grads_bitexact():
+        pytest.skip(REMAT_BITEXACT_SKIP)
+
     d = 16
     p = {"w": jax.random.normal(jax.random.PRNGKey(0), (d, d), jnp.float32)}
     x = jax.random.normal(jax.random.PRNGKey(1), (4, d), jnp.float32)
@@ -42,6 +47,10 @@ def test_checkpoint_same_value_and_grad():
 def test_checkpoint_rng_reproducible():
     """Recompute must see identical randomness (the reference's RNG
     fork/restore machinery, checkpointing.py:122-238 — free in JAX)."""
+    from tests.capabilities import REMAT_BITEXACT_SKIP, remat_grads_bitexact
+
+    if not remat_grads_bitexact():
+        pytest.skip(REMAT_BITEXACT_SKIP)
     d = 16
     p = {"w": jax.random.normal(jax.random.PRNGKey(0), (d, d), jnp.float32)}
     x = jax.random.normal(jax.random.PRNGKey(1), (4, d), jnp.float32)

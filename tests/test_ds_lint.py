@@ -53,6 +53,7 @@ def test_rule_catalog_shape():
         "raw-collective-outside-comm-layer",  # PR 6 comm-layer tier-B rule
         "hand-built-partition-spec",  # PR 8 partition-rule-engine tier-B rule
         "raw-metric-emit",  # PR 9 telemetry-plane tier-C rule
+        "raw-pallas-call-outside-kernels",  # PR 12 kernel-seam tier-B rule
     ):
         assert rid in rules, rid
 
@@ -1291,6 +1292,78 @@ class TestRawCollective:
             "raw-collective-outside-comm-layer",
         )
         assert rule_ids(res2) == []
+
+
+# ---------------------------------------------------------------------------
+# raw-pallas-call-outside-kernels (tier B, PR 12 kernel seam)
+# ---------------------------------------------------------------------------
+
+
+class TestPallasSeam:
+    def test_flags_raw_pallas_call_outside_seam(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import jax
+            import jax.numpy as jnp
+            from jax.experimental import pallas as pl
+
+            def double(x):
+                def kern(x_ref, o_ref):
+                    o_ref[:] = x_ref[:] * 2.0
+
+                return pl.pallas_call(
+                    kern, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype)
+                )(x)
+            """,
+            "raw-pallas-call-outside-kernels",
+            name="deepspeed_tpu/runtime/mymod.py",
+        )
+        assert rule_ids(res) == ["raw-pallas-call-outside-kernels"]
+        assert all(f.severity == Severity.B for f in res.findings)
+        assert "ops/kernels" in res.findings[0].message
+
+    def test_bare_import_spelling_also_flags(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            from jax.experimental.pallas import pallas_call
+
+            def f(kern, x, shape):
+                return pallas_call(kern, out_shape=shape)(x)
+            """,
+            "raw-pallas-call-outside-kernels",
+            name="deepspeed_tpu/serving/mymod.py",
+        )
+        assert rule_ids(res) == ["raw-pallas-call-outside-kernels"]
+
+    def test_kernel_seam_packages_are_clean(self, tmp_path):
+        src = """
+            from jax.experimental import pallas as pl
+
+            def launch(kern, x, shape):
+                return pl.pallas_call(kern, out_shape=shape)(x)
+            """
+        for home in (
+            "deepspeed_tpu/ops/kernels/mykernel.py",
+            "deepspeed_tpu/ops/attention/mykernel.py",
+        ):
+            res = lint_src(tmp_path, src, "raw-pallas-call-outside-kernels", name=home)
+            assert rule_ids(res) == [], home
+
+    def test_non_pallas_calls_are_clean(self, tmp_path):
+        res = lint_src(
+            tmp_path,
+            """
+            import jax.numpy as jnp
+
+            def f(x):
+                return jnp.sum(x)
+            """,
+            "raw-pallas-call-outside-kernels",
+            name="deepspeed_tpu/runtime/mymod.py",
+        )
+        assert rule_ids(res) == []
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,452 @@
+"""Pallas kernel suite tests (docs/kernels.md, ISSUE 12).
+
+Coverage: flash-decode bit/tolerance parity vs the lax ``cache_attention``
+ground truth over the (dtype, context, block) grid — int8 codes
+dequantized in-register, per-slot positions, padding masks, scalar pos;
+fused Adam/LAMB update parity incl. the in-producer overflow skip and
+the ragged-leaf XLA fallback; the engine-level fused-update seam
+(trajectory parity against the stock XLA path); autotuner cache
+round-trip, corrupt-cache fallback-to-defaults, mode semantics, and the
+LRU; serving churn parity with the kernel armed (decode_compiles still
+== 1 under armed ds_san); and the attribution pin that the
+``kv-dequant`` bucket goes to ~0 with the fused decode kernel armed.
+
+Off-TPU every kernel runs under ``interpret=True`` — the same kernel
+body, so the parity statements carry to hardware modulo MXU rounding.
+"""
+import dataclasses
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.kernels import autotune as at
+from deepspeed_tpu.ops.kernels import flash_decode as fd
+from deepspeed_tpu.ops.kernels import fused_update as fu
+from deepspeed_tpu.ops.transformer.inference import _kv_quant, cache_attention
+
+pytestmark = pytest.mark.kernels
+
+
+def _rand(shape, dtype=jnp.float32, seed=0):
+    return jnp.asarray(np.random.default_rng(seed).standard_normal(shape), dtype)
+
+
+def _int8_cache(k, v):
+    kq, ks = _kv_quant(k)
+    vq, vs = _kv_quant(v)
+    return {"q": kq, "s": ks}, {"q": vq, "s": vs}
+
+
+def _max_err(a, b):
+    return float(jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32))))
+
+
+# ---------------------------------------------------------------------------
+# flash decode: parity vs the lax reference
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kv", ["f32", "bf16", "int8"])
+@pytest.mark.parametrize("S", [128, 256])
+@pytest.mark.parametrize("block_k", [128, 256])
+@pytest.mark.parametrize("block_slots", [1, 2])
+def test_flash_decode_parity_cells(kv, S, block_k, block_slots):
+    B, H, d = 4, 3, 64
+    q = _rand((B, H, 1, d), jnp.float32, seed=1)
+    k = _rand((B, H, S, d), jnp.float32, seed=2)
+    v = _rand((B, H, S, d), jnp.float32, seed=3)
+    if kv == "bf16":
+        k, v = k.astype(jnp.bfloat16), v.astype(jnp.bfloat16)
+        kc, vc = k, v
+    elif kv == "int8":
+        kc, vc = _int8_cache(k, v)
+    else:
+        kc, vc = k, v
+    # per-slot positions incl. the edges (fresh slot at 0, full cache)
+    pos = jnp.asarray([0, S // 3, S - 1, 7], jnp.int32)
+    ref = cache_attention(q, kc, vc, pos, use_kernel=False)
+    out = fd.flash_decode(
+        q, kc, vc, pos, block_k=block_k, block_slots=block_slots, interpret=True
+    )
+    assert out.shape == ref.shape and out.dtype == ref.dtype
+    assert _max_err(ref, out) < 2e-5, (kv, S, block_k, block_slots)
+
+
+def test_flash_decode_scalar_pos_and_padding_mask():
+    B, H, S, d = 2, 4, 128, 16
+    q = _rand((B, H, 1, d), jnp.float32, seed=4)
+    k = _rand((B, H, S, d), jnp.float32, seed=5)
+    v = _rand((B, H, S, d), jnp.float32, seed=6)
+    mask = jnp.asarray(
+        np.random.default_rng(7).integers(0, 2, (B, S)), bool
+    ).at[:, 0].set(True)
+    ref = cache_attention(q, k, v, 64, key_padding_mask=mask, use_kernel=False)
+    out = fd.flash_decode(q, k, v, 64, key_padding_mask=mask, interpret=True)
+    assert _max_err(ref, out) < 2e-5
+    # and through a jit with a traced scalar pos (generate()'s form)
+    f = jax.jit(lambda q, k, v, p: fd.flash_decode(q, k, v, p, interpret=True))
+    out2 = f(q, k, v, jnp.int32(64))
+    assert _max_err(cache_attention(q, k, v, jnp.int32(64), use_kernel=False), out2) < 2e-5
+
+
+def test_flash_decode_contract_errors():
+    q = _rand((2, 2, 1, 16))
+    k = _rand((2, 2, 128, 16))
+    with pytest.raises(ValueError, match="one query"):
+        fd.flash_decode(_rand((2, 2, 2, 16)), k, k, 0, interpret=True)
+    with pytest.raises(ValueError, match="decode_supported"):
+        fd.flash_decode(q, _rand((2, 2, 96, 16)), _rand((2, 2, 96, 16)), 0, interpret=True)
+    assert not fd.decode_supported(2, 2, 96, 16)   # ragged S
+    assert not fd.decode_supported(2, 2, 64, 16)   # S < 128
+    assert fd.decode_supported(8, 12, 2048, 64)
+
+
+def test_cache_attention_dispatch_honors_env(monkeypatch):
+    """DS_KERNELS=1 routes T=1 cache_attention through the kernel; tiny
+    caches (S<128) and prefill (T>1) stay on the lax path."""
+    from deepspeed_tpu.ops.kernels import flash_decode as fd_mod
+
+    calls = []
+    real = fd_mod.flash_decode
+    monkeypatch.setattr(
+        fd_mod, "flash_decode",
+        lambda *a, **kw: calls.append(1) or real(*a, **kw),
+    )
+    monkeypatch.setenv("DS_KERNELS", "1")
+    B, H, S, d = 2, 2, 128, 16
+    q, k, v = _rand((B, H, 1, d)), _rand((B, H, S, d)), _rand((B, H, S, d))
+    ref = cache_attention(q, k, v, jnp.asarray([3, 50], jnp.int32), use_kernel=False)
+    out = cache_attention(q, k, v, jnp.asarray([3, 50], jnp.int32))
+    assert calls == [1]
+    assert _max_err(ref, out) < 2e-5
+    # prefill shape: no kernel call
+    cache_attention(_rand((B, H, 4, d)), k, v, 0)
+    assert calls == [1]
+    # too-small cache: lax fallback
+    cache_attention(q, _rand((B, H, 64, d)), _rand((B, H, 64, d)), 0)
+    assert calls == [1]
+    monkeypatch.setenv("DS_KERNELS", "0")
+    cache_attention(q, k, v, jnp.asarray([3, 50], jnp.int32))
+    assert calls == [1]
+
+
+# ---------------------------------------------------------------------------
+# fused optimizer update
+# ---------------------------------------------------------------------------
+
+def _tree(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        # kernel-eligible bf16 leaf (lane-aligned), ragged fp32 leaf
+        "w": jnp.asarray(rng.standard_normal((64, 256)), jnp.bfloat16),
+        "b": jnp.asarray(rng.standard_normal((100,)), jnp.float32),
+    }
+
+
+def _grads_like(params, seed=1):
+    rng = np.random.default_rng(seed)
+    return jax.tree.map(
+        lambda p: jnp.asarray(rng.standard_normal(p.shape), p.dtype), params
+    )
+
+
+@pytest.mark.parametrize("opt_kind", ["adamw", "adam_l2", "lamb"])
+def test_fused_update_trajectory_parity(opt_kind):
+    from deepspeed_tpu.ops.adam.fused_adam import FusedAdam
+    from deepspeed_tpu.ops.lamb.fused_lamb import FusedLamb
+
+    if opt_kind == "lamb":
+        opt = FusedLamb(lr=1e-2, weight_decay=0.01)
+    else:
+        opt = FusedAdam(lr=1e-2, weight_decay=0.01, adam_w_mode=(opt_kind == "adamw"))
+    params = _tree()
+    grads = _grads_like(params)
+    st_ref, p_ref = opt.init(params), params
+    st_k, p_k = opt.init(params), params
+    for _ in range(3):
+        upd, st_ref = opt.update(grads, st_ref, p_ref, lr=jnp.float32(1e-2))
+        p_ref = jax.tree.map(
+            lambda p, u: (p.astype(jnp.float32) + u).astype(p.dtype), p_ref, upd
+        )
+        res = fu.engine_update(opt, grads, st_k, p_k, jnp.float32(1e-2), None, interpret=True)
+        assert res is not None
+        p_k, st_k = res
+    for a, b in zip(jax.tree.leaves(p_ref), jax.tree.leaves(p_k)):
+        assert _max_err(a, b) < 1e-5
+    for a, b in zip(jax.tree.leaves(st_ref.exp_avg), jax.tree.leaves(st_k.exp_avg)):
+        assert _max_err(a, b) < 1e-6
+    assert int(st_k.step) == 3
+
+
+def test_fused_update_overflow_skip_preserves_state():
+    from deepspeed_tpu.ops.adam.fused_adam import FusedAdam
+
+    opt = FusedAdam(lr=1e-2)
+    params = _tree()
+    st = opt.init(params)
+    bad = jax.tree.map(lambda g: g.at[(0,) * g.ndim].set(jnp.inf), _grads_like(params))
+    p_k, st_k = fu.engine_update(
+        opt, bad, st, params, jnp.float32(1e-2), jnp.bool_(True), interpret=True
+    )
+    for a, b in zip(jax.tree.leaves(p_k), jax.tree.leaves(params)):
+        assert bool(jnp.all(a == b))
+    for a, b in zip(jax.tree.leaves(st_k.exp_avg), jax.tree.leaves(st.exp_avg)):
+        assert bool(jnp.all(a == b))
+    assert int(st_k.step) == 0  # skipped steps don't count
+
+
+def test_fused_update_ineligible_optimizers_return_none():
+    from deepspeed_tpu.ops.adam.fused_adam import FusedAdam, SGD
+
+    params = _tree()
+    grads = _grads_like(params)
+    sgd = SGD(lr=1e-2)
+    assert fu.engine_update(sgd, grads, sgd.init(params), params, 1e-2, None) is None
+    a8 = FusedAdam(lr=1e-2, state_precision="8bit")
+    assert fu.engine_update(a8, grads, a8.init(params), params, 1e-2, None) is None
+
+
+def test_shared_update_body_numpy_matches_jax():
+    """ONE update body, three executors: the numpy execution (the
+    ZeRO-Offload drain's cpu_adam fallback) must match the jnp one."""
+    rng = np.random.default_rng(3)
+    p = rng.standard_normal((32, 256)).astype(np.float32)
+    g = rng.standard_normal((32, 256)).astype(np.float32)
+    m = np.zeros_like(p)
+    v = np.zeros_like(p)
+    args = (0.01, 0.9, 0.999, 1e-8, 0.01, True, 1 - 0.9, 1 - 0.999)
+    pn_np, mn_np, vn_np = fu.adam_update_reference(np, p, g, m, v, *args)
+    pn_j, mn_j, vn_j = fu.adam_update_reference(
+        jnp, jnp.asarray(p), jnp.asarray(g), jnp.asarray(m), jnp.asarray(v), *args
+    )
+    np.testing.assert_allclose(pn_np, np.asarray(pn_j), rtol=1e-6)
+    np.testing.assert_allclose(vn_np, np.asarray(vn_j), rtol=1e-6)
+
+
+def test_engine_train_parity_with_fused_update(monkeypatch):
+    """The _apply_update seam end-to-end: a tiny engine trained with the
+    fused-update kernel armed matches the stock XLA path's loss
+    trajectory (and the overflow machinery still composes)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt2
+
+    cfg = dataclasses.replace(gpt2.GPT2_TINY, remat=False)
+    model_fn, init_fn, tp_fn = gpt2.make_model(cfg)
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3, "weight_decay": 0.01}},
+        "steps_per_print": 1000,
+    }
+    # conftest's 8 virtual devices: batch = gas(1) x micro_bs(2) x dp(8)
+    batch = {
+        "input_ids": np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (16, 32), dtype=np.int32
+        )
+    }
+
+    def run(env):
+        monkeypatch.setenv("DS_KERNELS", env)
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model=model_fn, model_parameters=init_fn(seed=11), config=config,
+            tp_spec_fn=tp_fn,
+        )
+        return [float(eng.train_batch(batch)) for _ in range(3)]
+
+    ref = run("0")
+    fused = run("1")
+    np.testing.assert_allclose(ref, fused, rtol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# autotuner
+# ---------------------------------------------------------------------------
+
+def test_autotune_defaults_are_deterministic():
+    a = at.default_blocks("flash_decode", S=16384, int8=True, B=4)
+    b = at.default_blocks("flash_decode", S=16384, int8=True, B=4)
+    assert a == b
+    assert a["block_k"] >= 512  # long context takes the big block
+    assert at.default_blocks("flash_decode", S=128, B=1)["block_k"] == 128
+    assert at.default_blocks("fused_update")["block_rows"] > 0
+    with pytest.raises(KeyError):
+        at.default_blocks("nope")
+
+
+def test_autotune_cache_roundtrip(tmp_path):
+    path = str(tmp_path / "kernel_autotune.json")
+    tuner = at.Autotuner(path=path, mode="force")
+    timings = {128: 0.004, 256: 0.002, 512: 0.009}
+    picked = tuner.tune(
+        "flash_decode",
+        lambda blocks: timings[blocks["block_k"]],
+        candidates=[{"block_k": k, "block_slots": 1} for k in timings],
+        S=256, int8=False, B=4,
+    )
+    assert picked == {"block_k": 256, "block_slots": 1}
+    # a FRESH tuner over the same file (new process twin) hits the cache
+    tuner2 = at.Autotuner(path=path, mode="cache")
+    assert tuner2.blocks_for("flash_decode", S=256, int8=False, B=4) == picked
+    assert tuner2.stats()["entries"] == 1 and tuner2.stats()["hits"] == 1
+    # cache mode returns the cached winner without calling the timer
+    assert tuner2.tune(
+        "flash_decode", lambda b: (_ for _ in ()).throw(AssertionError("measured")),
+        S=256, int8=False, B=4,
+    ) == picked
+    # LRU hit path (second lookup never re-reads disk)
+    assert tuner2.blocks_for("flash_decode", S=256, int8=False, B=4) == picked
+    assert tuner2.stats()["hits"] == 3
+
+
+def test_autotune_corrupt_cache_falls_back_to_defaults(tmp_path):
+    path = str(tmp_path / "kernel_autotune.json")
+    with open(path, "w") as f:
+        f.write("{ this is not json")
+    tuner = at.Autotuner(path=path, mode="cache")
+    blocks = tuner.blocks_for("flash_decode", S=256, int8=False, B=4)
+    assert blocks == at.default_blocks("flash_decode", S=256, int8=False, B=4)
+    assert tuner.stats()["cache_ok"] is False
+    # a tune over a corrupt cache never overwrites the unreadable file
+    tuner.record("fp", {"block_k": 128}, 1.0)
+    with open(path) as f:
+        assert f.read().startswith("{ this is not json")
+    # structurally-invalid JSON degrades the same way
+    path2 = str(tmp_path / "k2.json")
+    with open(path2, "w") as f:
+        json.dump({"entries": {"fp": {"no_blocks": 1}}}, f)
+    t2 = at.Autotuner(path=path2, mode="cache")
+    assert t2.blocks_for("fused_update") == at.default_blocks("fused_update")
+    assert t2.stats()["cache_ok"] is False
+
+
+def test_autotune_off_mode_ignores_cache(tmp_path):
+    path = str(tmp_path / "kernel_autotune.json")
+    force = at.Autotuner(path=path, mode="force")
+    force.record(at.fingerprint("fused_update"), {"block_rows": 1024}, 1.0)
+    off = at.Autotuner(path=path, mode="off")
+    assert off.blocks_for("fused_update") == at.default_blocks("fused_update")
+    assert off.tune("fused_update", lambda b: 0.0) == at.default_blocks("fused_update")
+
+
+def test_autotune_failed_candidates_degrade(tmp_path):
+    tuner = at.Autotuner(path=str(tmp_path / "k.json"), mode="force")
+
+    def bad_timer(blocks):
+        raise RuntimeError("grid refused")
+
+    assert tuner.tune("fused_update", bad_timer) == at.default_blocks("fused_update")
+
+
+def test_autotune_env_mode_escape_hatch(monkeypatch):
+    monkeypatch.setenv("DS_KERNEL_AUTOTUNE", "off")
+    assert at.autotune_mode() == "off"
+    monkeypatch.setenv("DS_KERNEL_AUTOTUNE", "bogus")
+    assert at.autotune_mode() == "cache"  # typo never flips CI to tuning
+    monkeypatch.delenv("DS_KERNEL_AUTOTUNE")
+    assert at.autotune_mode() == "cache"
+
+
+def test_fingerprint_keys_on_jaxlib_and_topology():
+    fp = at.fingerprint("flash_decode", S=256, int8=True)
+    assert "jaxlib=" in fp and "topo=" in fp and "S=256" in fp
+    assert fp != at.fingerprint("flash_decode", S=512, int8=True)
+
+
+# ---------------------------------------------------------------------------
+# serving churn with the kernel armed (compile stability + parity)
+# ---------------------------------------------------------------------------
+
+def test_serving_churn_parity_with_kernel_armed(monkeypatch):
+    """The serving acceptance proof with DS_KERNELS=1: a churning live
+    set still runs against exactly ONE decode executable under an armed
+    ds_san (the kernel is inside the trace, not a new signature), and
+    greedy outputs bit-match the engine's solo generate() — which runs
+    the SAME armed kernel path."""
+    import deepspeed_tpu
+    from deepspeed_tpu.analysis.sanitizer import core as san_core
+    from deepspeed_tpu.analysis.sanitizer.core import Sanitizer
+    from deepspeed_tpu.config.config import SanitizerConfig
+    from deepspeed_tpu.models import gpt2
+    from deepspeed_tpu.serving import ServingEngine
+
+    monkeypatch.setenv("DS_KERNELS", "1")
+    cfg = dataclasses.replace(gpt2.GPT2_TINY, remat=False)
+    params = gpt2.init_params(cfg, seed=7)
+    params["wpe"] = params["wpe"] * 40.0  # position-sensitive
+    eng = deepspeed_tpu.init_inference(
+        model_config=cfg, params=params, dtype=jnp.float32,
+        max_out_tokens=cfg.n_positions,
+    )
+    san = san_core.install(Sanitizer(SanitizerConfig.from_dict(
+        {"enabled": True, "checkers": ["recompile", "transfer"], "compile_budget": 2}
+    )))
+    try:
+        srv = ServingEngine(eng, num_slots=2, prefill_chunk=32, max_len=128,
+                            max_new_tokens=4)
+        rng = np.random.default_rng(8)
+        prompts = [
+            rng.integers(1, cfg.vocab_size, n, dtype=np.int32)
+            for n in (40, 9, 17, 5)
+        ]
+        rids = [srv.submit(prompts[0], max_new_tokens=4),
+                srv.submit(prompts[1], max_new_tokens=3)]
+        srv.step()
+        rids += [srv.submit(p, max_new_tokens=3) for p in prompts[2:]]
+        res = srv.drain(max_steps=200)
+        assert sorted(res) == sorted(rids)
+        assert srv.decode_compiles == 1 and srv.prefill_compiles == 1
+        counts = san.recompile.compile_counts()
+        assert counts.get("serving.decode") == 1, counts
+        assert san.findings == [], [f.format() for f in san.findings]
+    finally:
+        san_core.uninstall()
+    for rid, prompt in zip(rids, prompts):
+        n_new = 4 if rid == rids[0] else 3
+        solo = np.asarray(eng.generate(prompt[None, :], max_new_tokens=n_new))[0]
+        np.testing.assert_array_equal(res[rid].tokens(), solo)
+
+
+# ---------------------------------------------------------------------------
+# attribution pin: the kv-dequant bucket dies with the kernel armed
+# ---------------------------------------------------------------------------
+
+def test_attribution_kv_dequant_bucket_eliminated():
+    from deepspeed_tpu.telemetry.attribution import attribute_executable
+
+    B, H, S, d = 4, 2, 256, 64
+    q = _rand((B, H, 1, d), jnp.bfloat16, seed=1)
+    kc, vc = _int8_cache(_rand((B, H, S, d), seed=2), _rand((B, H, S, d), seed=3))
+    pos = jnp.asarray([5, 100, 255, 0], jnp.int32)
+
+    def attribute(use_kernel):
+        f = jax.jit(lambda q, kc, vc, p: cache_attention(
+            q, kc, vc, p, use_kernel=use_kernel
+        ))
+        return attribute_executable(
+            f.lower(q, kc, vc, pos).compile(), label=f"decode_k{use_kernel}"
+        )
+
+    off = attribute(False)
+    on = attribute(True)
+    assert off is not None and on is not None
+    # lax int8 decode pays the dequant round-trip...
+    assert off.buckets["kv-dequant"].flops > 0
+    assert off.buckets["kv-dequant"].bytes > 0
+    # ...the fused kernel eliminates the bucket (scales fold in-register
+    # into attention work)
+    assert on.buckets["kv-dequant"].flops == 0
+    assert on.buckets["kv-dequant"].bytes == 0
+    assert on.buckets["attention"].flops > 0
+
+
+def test_kernels_report_shape(monkeypatch):
+    from deepspeed_tpu.ops import kernels as k
+
+    monkeypatch.setenv("DS_KERNELS", "1")
+    rep = k.kernels_report()
+    assert rep["suite_armed"] is True and rep["flash_decode"] is True
+    assert {"mode", "path", "entries", "hits"} <= set(rep["autotune"])
+    monkeypatch.setenv("DS_KERNELS", "0")
+    assert k.kernels_report()["suite_armed"] is False

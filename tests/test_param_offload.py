@@ -249,7 +249,9 @@ def test_fsdp_streaming_device_shard_bytes():
         [p for p, _ in jax.tree_util.tree_flatten_with_path(g)[0]],
         jax.tree.leaves(g),
     ):
-        n_shards = len({s.index for s in leaf.addressable_shards})
+        from tests.capabilities import shard_index_key
+
+        n_shards = len({shard_index_key(s) for s in leaf.addressable_shards})
         total = leaf.size * leaf.dtype.itemsize
         per_dev = max(
             int(np.prod(s.data.shape)) * leaf.dtype.itemsize for s in leaf.addressable_shards
@@ -284,7 +286,9 @@ def test_fsdp_streaming_nvme(tmp_path):
     e = _build(_fsdp_config(fsdp=2, device="nvme", nvme_path=str(tmp_path)))
     g = e._upload_group(0)
     qkv = g["qkv_w"]
-    assert len({s.index for s in qkv.addressable_shards}) == 2  # really sharded
+    from tests.capabilities import shard_index_key
+
+    assert len({shard_index_key(s) for s in qkv.addressable_shards}) == 2  # really sharded
     fixed = _batches(1, seed=13)[0]
     l0 = float(e.eval_batch(fixed))
     for _ in range(3):

@@ -163,6 +163,10 @@ def _make_engine(nblocks, pipe, gas, micro_bs, dim=16, stage=0, dtype="fp32"):
 @pytest.mark.parametrize("pipe", [2, 4])
 def test_pipeline_matches_sequential_loss(pipe):
     """Pipelined loss must equal the sequential (pipe=1) loss exactly."""
+    from tests.capabilities import PARTITION_ID_SKIP, cpu_supports_spmd_collectives
+
+    if not cpu_supports_spmd_collectives():
+        pytest.skip(PARTITION_ID_SKIP)
     gas, micro_bs, dim = 4, 2, 16
     bs = gas * micro_bs
     batch = pipe_batch(bs, dim)
@@ -178,6 +182,10 @@ def test_pipeline_matches_sequential_loss(pipe):
 def test_pipeline_train_matches_sequential_train():
     """One optimizer step through the pipelined program matches the
     sequential engine's step (same grads, same update)."""
+    from tests.capabilities import PARTITION_ID_SKIP, cpu_supports_spmd_collectives
+
+    if not cpu_supports_spmd_collectives():
+        pytest.skip(PARTITION_ID_SKIP)
     gas, micro_bs, dim = 4, 2, 16
     bs = gas * micro_bs
     batch = pipe_batch(bs, dim)
@@ -196,6 +204,11 @@ def test_pipeline_train_matches_sequential_train():
 
 
 def test_pipeline_convergence():
+    from tests.capabilities import PARTITION_ID_SKIP, cpu_supports_spmd_collectives
+
+    if not cpu_supports_spmd_collectives():
+        pytest.skip(PARTITION_ID_SKIP)
+
     gas, micro_bs, dim = 4, 4, 16
     bs = gas * micro_bs
     engine, _ = _make_engine(nblocks=4, pipe=2, gas=gas, micro_bs=micro_bs, dim=dim, stage=1)
@@ -220,6 +233,11 @@ def test_pipeline_engine_rejects_micro_api():
 
 
 def test_pipeline_data_iterator_api():
+    from tests.capabilities import PARTITION_ID_SKIP, cpu_supports_spmd_collectives
+
+    if not cpu_supports_spmd_collectives():
+        pytest.skip(PARTITION_ID_SKIP)
+
     gas, micro_bs, dim = 2, 2, 16
     engine, _ = _make_engine(nblocks=4, pipe=2, gas=gas, micro_bs=micro_bs, dim=dim)
     micro = [pipe_batch(micro_bs, dim, seed=s) for s in range(gas)]
@@ -242,6 +260,10 @@ def _make_engine_sched(schedule, gas, micro_bs=4, dim=64, nblocks=4):
 def test_1f1b_matches_gpipe_step():
     """Both schedules are the same math: identical loss and identical
     post-step params."""
+    from tests.capabilities import PARTITION_ID_SKIP, cpu_supports_spmd_collectives
+
+    if not cpu_supports_spmd_collectives():
+        pytest.skip(PARTITION_ID_SKIP)
     gas, micro_bs, dim = 4, 2, 16
     batch = pipe_batch(gas * micro_bs, dim)
     e_1f1b = _make_engine_sched("1f1b", gas, micro_bs, dim)
@@ -261,6 +283,10 @@ def test_1f1b_activation_memory_bounded_in_micro_batches():
     memory must stay ~flat as micro-batch count grows, while GPipe's
     grows with it (the property the schedule exists for — reference
     schedule.py:182)."""
+    from tests.capabilities import PARTITION_ID_SKIP, cpu_supports_spmd_collectives
+
+    if not cpu_supports_spmd_collectives():
+        pytest.skip(PARTITION_ID_SKIP)
 
     def temp_bytes(schedule, gas):
         engine = _make_engine_sched(schedule, gas)
@@ -331,6 +357,10 @@ def test_pipeline_3d_tp_parity():
     """pipe×model×data (2×2×2) with a REAL tp_spec through _pipe_tp_spec
     must match the sequential single-axis run step for step — the 3D row
     of SURVEY §2.5 executed, not just plumbed (VERDICT r4 missing #2)."""
+    from tests.capabilities import PARTITION_ID_SKIP, cpu_supports_spmd_collectives
+
+    if not cpu_supports_spmd_collectives():
+        pytest.skip(PARTITION_ID_SKIP)
     batch = pipe_batch(8, 16, seed=5)
     e3d = _make_3d_engine({"pipe": 2, "model": 2, "data": 2}, tp=True)
     eref = _make_3d_engine({"data": -1}, tp=False)
@@ -339,7 +369,9 @@ def test_pipeline_3d_tp_parity():
     w1 = e3d.state["params"]["blocks"]["w1"]
     spec = w1.sharding.spec
     assert tuple(spec)[:1] == ("pipe",) and "model" in tuple(spec), spec
-    assert len({s.index for s in w1.addressable_shards}) >= 4  # pipe×model shards
+    from tests.capabilities import shard_index_key
+
+    assert len({shard_index_key(s) for s in w1.addressable_shards}) >= 4  # pipe×model shards
 
     l3, lr_ = [], []
     for i in range(4):

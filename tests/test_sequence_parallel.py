@@ -29,6 +29,11 @@ def qkv(rng):
 
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_attention_matches_dense(qkv, causal):
+    from tests.capabilities import PARTITION_ID_SKIP, cpu_supports_spmd_collectives
+
+    if not cpu_supports_spmd_collectives():
+        pytest.skip(PARTITION_ID_SKIP)
+
     q, k, v = qkv
     mesh = seq_mesh(4)
     out = jax.jit(lambda q, k, v: ring_attention(q, k, v, causal=causal, mesh=mesh))(q, k, v)
@@ -38,6 +43,11 @@ def test_ring_attention_matches_dense(qkv, causal):
 
 @pytest.mark.parametrize("causal", [False, True])
 def test_ulysses_matches_dense(qkv, causal):
+    from tests.capabilities import PARTITION_ID_SKIP, cpu_supports_spmd_collectives
+
+    if not cpu_supports_spmd_collectives():
+        pytest.skip(PARTITION_ID_SKIP)
+
     q, k, v = qkv
     mesh = seq_mesh(4)
     out = jax.jit(
@@ -48,6 +58,11 @@ def test_ulysses_matches_dense(qkv, causal):
 
 
 def test_ring_attention_grads_match_dense(qkv):
+    from tests.capabilities import PARTITION_ID_SKIP, cpu_supports_spmd_collectives
+
+    if not cpu_supports_spmd_collectives():
+        pytest.skip(PARTITION_ID_SKIP)
+
     q, k, v = qkv
     mesh = seq_mesh(4)
 
@@ -82,6 +97,10 @@ def test_heads_not_divisible_raises(qkv):
 def test_gpt2_trains_sequence_parallel(mode):
     """End-to-end: GPT-2 tiny with seq-parallel attention on a
     (data=2, seq=4) mesh through the full engine train_batch path."""
+    from tests.capabilities import PARTITION_ID_SKIP, cpu_supports_spmd_collectives
+
+    if not cpu_supports_spmd_collectives():
+        pytest.skip(PARTITION_ID_SKIP)
     import deepspeed_tpu
     from deepspeed_tpu.models import gpt2
 
@@ -115,6 +134,10 @@ def test_two_engines_different_meshes_coexist():
     trace resolves ITS engine's mesh (ambient, engine-scoped), never the
     other's — the round-2 'global mesh replaced (last engine wins)'
     singleton is gone (VERDICT r2 weak #5)."""
+    from tests.capabilities import PARTITION_ID_SKIP, cpu_supports_spmd_collectives
+
+    if not cpu_supports_spmd_collectives():
+        pytest.skip(PARTITION_ID_SKIP)
     import deepspeed_tpu
     from deepspeed_tpu.models import gpt2
 
