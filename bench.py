@@ -528,6 +528,26 @@ def run_rung(name: str):
                   "reason": f"bench_serving child rc={proc.returncode}"})
         for rec in recs:
             emit(rec)
+    elif name == "fleet":
+        # fleet failover rung (docs/serving.md §Fleet): 3-replica
+        # FleetRouter under seeded Poisson load, one replica killed
+        # mid-run and supervised back in the background — the emitted
+        # failover_over_steady_p99 ratio is the fleet proof bound
+        # (admitted p99 TTFT <= 2x steady-state).  Grandchild like the
+        # serving rung (its own engine builds + HBM lifetime).
+        import subprocess as sp
+
+        cmd = [sys.executable, os.path.join(HERE, "tools", "bench_serving.py"),
+               "--fleet"]
+        if not on_tpu:
+            cmd.append("--dryrun")
+        proc = sp.run(cmd, stdout=sp.PIPE, cwd=HERE)
+        recs = _parse_records(proc.stdout.decode(errors="replace"))
+        if proc.returncode != 0 and not recs:
+            emit({"metric": "fleet", "skipped": True,
+                  "reason": f"bench_serving --fleet child rc={proc.returncode}"})
+        for rec in recs:
+            emit(rec)
     elif name == "sharding":
         # weight-update-sharding sweep (docs/sharding.md): replicated vs
         # cross-replica ZeRO-1 (vs the composed data x fsdp grid) —
@@ -630,6 +650,10 @@ RUNGS = [
     # a grandchild; measured dryrun ~60s, TPU budget dominated by the
     # engine build + one prefill/decode compile pair per pool
     ("serving", 240, 480),
+    # fleet failover proof (docs/serving.md §Fleet): 3 replica engines +
+    # 1 capacity anchor + 1 supervised rebuild in a grandchild; the
+    # record carries failover_over_steady_p99 for the <=2x bound
+    ("fleet", 240, 480),
 ]
 
 # Plausibility floors for each rung's PRIMARY record on REAL TPU —

@@ -738,6 +738,123 @@ class CommConfig:
 
 
 @dataclass
+class FleetConfig:
+    """``serving.fleet`` block (docs/serving.md §Fleet): the front-door
+    router over N engine replicas — least-estimated-TTFT placement, a
+    per-replica circuit breaker with seeded-jitter exponential backoff,
+    optional tail-latency hedging, and supervised lossless replica
+    restart (journal replay under original ids)."""
+
+    replicas: int = C.SERVING_FLEET_REPLICAS_DEFAULT
+    route_retries: int = C.SERVING_FLEET_ROUTE_RETRIES_DEFAULT
+    breaker_failures: int = C.SERVING_FLEET_BREAKER_FAILURES_DEFAULT
+    breaker_backoff_seconds: float = C.SERVING_FLEET_BREAKER_BACKOFF_SECONDS_DEFAULT
+    breaker_backoff_max_seconds: float = (
+        C.SERVING_FLEET_BREAKER_BACKOFF_MAX_SECONDS_DEFAULT
+    )
+    breaker_halfopen_probes: int = C.SERVING_FLEET_BREAKER_HALFOPEN_PROBES_DEFAULT
+    hedge: bool = C.SERVING_FLEET_HEDGE_DEFAULT
+    hedge_factor: float = C.SERVING_FLEET_HEDGE_FACTOR_DEFAULT
+    hedge_min_observations: int = C.SERVING_FLEET_HEDGE_MIN_OBSERVATIONS_DEFAULT
+    max_restarts: int = C.SERVING_FLEET_MAX_RESTARTS_DEFAULT
+    restart_backoff_seconds: float = C.SERVING_FLEET_RESTART_BACKOFF_SECONDS_DEFAULT
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "FleetConfig":
+        if d is None:
+            return cls()
+        if isinstance(d, FleetConfig):
+            d = dataclasses.asdict(d)
+        d = dict(d)
+        block = f"{C.SERVING}.{C.SERVING_FLEET}"
+        out = cls(
+            replicas=int(_pop(d, "replicas", C.SERVING_FLEET_REPLICAS_DEFAULT)),
+            route_retries=int(
+                _pop(d, "route_retries", C.SERVING_FLEET_ROUTE_RETRIES_DEFAULT)
+            ),
+            breaker_failures=int(
+                _pop(d, "breaker_failures", C.SERVING_FLEET_BREAKER_FAILURES_DEFAULT)
+            ),
+            breaker_backoff_seconds=float(
+                _pop(d, "breaker_backoff_seconds",
+                     C.SERVING_FLEET_BREAKER_BACKOFF_SECONDS_DEFAULT)
+            ),
+            breaker_backoff_max_seconds=float(
+                _pop(d, "breaker_backoff_max_seconds",
+                     C.SERVING_FLEET_BREAKER_BACKOFF_MAX_SECONDS_DEFAULT)
+            ),
+            breaker_halfopen_probes=int(
+                _pop(d, "breaker_halfopen_probes",
+                     C.SERVING_FLEET_BREAKER_HALFOPEN_PROBES_DEFAULT)
+            ),
+            hedge=bool(_pop(d, "hedge", C.SERVING_FLEET_HEDGE_DEFAULT)),
+            hedge_factor=float(
+                _pop(d, "hedge_factor", C.SERVING_FLEET_HEDGE_FACTOR_DEFAULT)
+            ),
+            hedge_min_observations=int(
+                _pop(d, "hedge_min_observations",
+                     C.SERVING_FLEET_HEDGE_MIN_OBSERVATIONS_DEFAULT)
+            ),
+            max_restarts=int(
+                _pop(d, "max_restarts", C.SERVING_FLEET_MAX_RESTARTS_DEFAULT)
+            ),
+            restart_backoff_seconds=float(
+                _pop(d, "restart_backoff_seconds",
+                     C.SERVING_FLEET_RESTART_BACKOFF_SECONDS_DEFAULT)
+            ),
+        )
+        _check_empty(d, block, _known_keys(cls))
+        if out.replicas < 1:
+            raise DeepSpeedConfigError(
+                f"'{block}.replicas' must be >= 1, got {out.replicas}"
+            )
+        if out.route_retries < 0:
+            raise DeepSpeedConfigError(
+                f"'{block}.route_retries' must be >= 0, got {out.route_retries}"
+            )
+        if out.breaker_failures < 1:
+            raise DeepSpeedConfigError(
+                f"'{block}.breaker_failures' must be >= 1, got {out.breaker_failures}"
+            )
+        if out.breaker_backoff_seconds < 0:
+            raise DeepSpeedConfigError(
+                f"'{block}.breaker_backoff_seconds' must be >= 0, "
+                f"got {out.breaker_backoff_seconds}"
+            )
+        if out.breaker_backoff_max_seconds < out.breaker_backoff_seconds:
+            raise DeepSpeedConfigError(
+                f"'{block}.breaker_backoff_max_seconds' "
+                f"({out.breaker_backoff_max_seconds}) must be >= "
+                f"breaker_backoff_seconds ({out.breaker_backoff_seconds})"
+            )
+        if out.breaker_halfopen_probes < 1:
+            raise DeepSpeedConfigError(
+                f"'{block}.breaker_halfopen_probes' must be >= 1, "
+                f"got {out.breaker_halfopen_probes}"
+            )
+        if out.hedge_factor <= 0:
+            raise DeepSpeedConfigError(
+                f"'{block}.hedge_factor' must be > 0, got {out.hedge_factor}"
+            )
+        if out.hedge_min_observations < 1:
+            raise DeepSpeedConfigError(
+                f"'{block}.hedge_min_observations' must be >= 1, "
+                f"got {out.hedge_min_observations}"
+            )
+        if out.max_restarts < 0:
+            raise DeepSpeedConfigError(
+                f"'{block}.max_restarts' must be >= 0 (0 = never restart), "
+                f"got {out.max_restarts}"
+            )
+        if out.restart_backoff_seconds < 0:
+            raise DeepSpeedConfigError(
+                f"'{block}.restart_backoff_seconds' must be >= 0, "
+                f"got {out.restart_backoff_seconds}"
+            )
+        return out
+
+
+@dataclass
 class ServingConfig:
     """``serving`` block (TPU-native extension; docs/serving.md): the
     continuous-batching slot-pool engine.  ``num_slots`` concurrent
@@ -779,13 +896,18 @@ class ServingConfig:
     journal_dir: str = C.SERVING_JOURNAL_DIR_DEFAULT
     journal_segment_records: int = C.SERVING_JOURNAL_SEGMENT_RECORDS_DEFAULT
     journal_keep_segments: int = C.SERVING_JOURNAL_KEEP_SEGMENTS_DEFAULT
+    # fleet front-door (docs/serving.md §Fleet): router + breaker +
+    # hedging + supervised replica restart over N engine replicas
+    fleet: FleetConfig = field(default_factory=FleetConfig)
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ServingConfig":
         if d is None:
             return cls()
         d = dict(d)
+        fleet = FleetConfig.from_dict(_pop(d, C.SERVING_FLEET, None))
         out = cls(
+            fleet=fleet,
             num_slots=int(_pop(d, "num_slots", C.SERVING_NUM_SLOTS_DEFAULT)),
             max_len=int(_pop(d, "max_len", C.SERVING_MAX_LEN_DEFAULT)),
             kv_cache_dtype=str(

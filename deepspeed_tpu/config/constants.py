@@ -272,6 +272,28 @@ SERVING_DRAIN_DEADLINE_SECONDS_DEFAULT = 30.0  # SIGTERM in-flight drain budget
 SERVING_JOURNAL_DIR_DEFAULT = ""  # "" = request journaling off
 SERVING_JOURNAL_SEGMENT_RECORDS_DEFAULT = 512  # records per WAL segment
 SERVING_JOURNAL_KEEP_SEGMENTS_DEFAULT = 4  # sealed segments before compaction
+# -- fleet front-door (serving.fleet.*; docs/serving.md §Fleet) -------
+SERVING_FLEET = "fleet"
+SERVING_FLEET_REPLICAS_DEFAULT = 1  # engine replicas behind the router
+SERVING_FLEET_ROUTE_RETRIES_DEFAULT = 2  # extra replicas tried per submit
+# circuit breaker: consecutive failures that trip a replica OPEN, then
+# seeded-jitter exponential backoff (resilience/policy.py RetryPolicy
+# schedule) before a half-open probe is admitted
+SERVING_FLEET_BREAKER_FAILURES_DEFAULT = 3
+SERVING_FLEET_BREAKER_BACKOFF_SECONDS_DEFAULT = 0.5
+SERVING_FLEET_BREAKER_BACKOFF_MAX_SECONDS_DEFAULT = 30.0
+SERVING_FLEET_BREAKER_HALFOPEN_PROBES_DEFAULT = 1
+# tail-latency hedging: duplicate a first-token-less request to a
+# second replica after hedge_factor * observed p99 TTFT (armed only
+# past hedge_min_observations samples); first token wins, the loser is
+# cancelled via scheduler retirement
+SERVING_FLEET_HEDGE_DEFAULT = False
+SERVING_FLEET_HEDGE_FACTOR_DEFAULT = 1.5
+SERVING_FLEET_HEDGE_MIN_OBSERVATIONS_DEFAULT = 16
+# replica supervision: restarts per replica before it stays dead, with
+# the same RetryPolicy backoff schedule between restart attempts
+SERVING_FLEET_MAX_RESTARTS_DEFAULT = 3
+SERVING_FLEET_RESTART_BACKOFF_SECONDS_DEFAULT = 0.2
 
 #############################################
 # Telemetry (unified metrics registry / trace export; docs/telemetry.md)

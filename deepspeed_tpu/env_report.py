@@ -434,6 +434,39 @@ def serving_report(config=None) -> None:
             else "off (journal_dir unset; a crash loses queued+in-flight work)",
         ),
     ]
+    # fleet front-door rows (docs/serving.md §Fleet)
+    f = getattr(s, "fleet", None)
+    if f is not None:
+        rows += [
+            (
+                "fleet router",
+                f"{f.replicas} replica(s), least-estimated-TTFT placement, "
+                f"{f.route_retries} failover retr"
+                + ("y" if f.route_retries == 1 else "ies")
+                + " per submit",
+            ),
+            (
+                "fleet breaker",
+                f"trip at {f.breaker_failures} consecutive failures, "
+                f"backoff {f.breaker_backoff_seconds:g}s.."
+                f"{f.breaker_backoff_max_seconds:g}s, "
+                f"{f.breaker_halfopen_probes} half-open probe(s)",
+            ),
+            (
+                "fleet hedging",
+                f"duplicate after {f.hedge_factor:g}x p99 TTFT "
+                f"(armed past {f.hedge_min_observations} samples; "
+                "first token wins, loser cancelled)"
+                if f.hedge
+                else "off (hedge=false; per-request opt-in via submit)",
+            ),
+            (
+                "fleet restart",
+                f"supervised, <= {f.max_restarts} restart(s)/replica, "
+                f"{f.restart_backoff_seconds:g}s backoff; journal replay "
+                "re-binds in-flight ids (lossless)",
+            ),
+        ]
     for name, value in rows:
         print(f"{name} " + "." * (30 - len(name)) + f" {value}")
 

@@ -38,6 +38,12 @@ the same machinery plus the ``latency`` action — a *repeating* sleep
 step so the overload tests can build real queue pressure without a big
 model.  ``stall`` fires ``times`` then disarms; ``latency`` keeps
 firing — a degraded chip, not a single wedge.
+
+Fleet sites (``router.route`` — fail + recurring latency on the route
+path, ``router.hedge`` — fail at hedge launch, ``replica.death`` — a
+``flag`` plan the router polls each step to kill a live replica;
+docs/resilience.md §Fleet) drive the front-door chaos matrix in
+``tests/test_fleet.py`` and ``tools/fleet_chaos.py``.
 """
 from __future__ import annotations
 

@@ -20,6 +20,13 @@ compiled decode executable (``engine.py``).
     print(srv.result(rid).tokens())
 """
 from deepspeed_tpu.serving.engine import ServingEngine
+from deepspeed_tpu.serving.fleet import (
+    FleetOverloaded,
+    FleetRouter,
+    LocalReplica,
+    ReplicaDeadError,
+    ReplicaSupervisor,
+)
 from deepspeed_tpu.serving.journal import JournalError, RequestJournal
 from deepspeed_tpu.serving.pool import SlotKVPool, SlotPoolError
 from deepspeed_tpu.serving.scheduler import (
@@ -37,6 +44,11 @@ from deepspeed_tpu.serving.watchdog import ServingWatchdog
 
 __all__ = [
     "ServingEngine",
+    "FleetRouter",
+    "FleetOverloaded",
+    "LocalReplica",
+    "ReplicaDeadError",
+    "ReplicaSupervisor",
     "SlotKVPool",
     "SlotPoolError",
     "ContinuousScheduler",

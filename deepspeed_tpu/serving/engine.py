@@ -160,6 +160,10 @@ class ServingEngine:
         self.scheduler.step_seconds_fn = self._measured_step_seconds
         self._step_wall_ewma: Optional[float] = None
 
+        # client_key -> request id (the fleet router's at-most-once
+        # admission map; seeded from the journal when one is armed)
+        self._client_keys: Dict[str, int] = {}
+
         # write-ahead request journal (docs/serving.md §Resilience):
         # "" = off.  A construction failure disables journaling rather
         # than the engine — availability over durability, loudly.
@@ -176,6 +180,9 @@ class ServingEngine:
                 # (its retire record would drop the old acknowledged
                 # request from the replay set)
                 advance_request_ids(self._journal.last_request_id)
+                # at-most-once admission: journaled client keys survive
+                # a restart, so a duplicate resubmit dedups here too
+                self._client_keys.update(self._journal.client_keys)
             except OSError as e:
                 logger.error(
                     f"serving: request journal at {config.journal_dir!r} failed "
@@ -420,6 +427,7 @@ class ServingEngine:
         top_k: int = 0,
         seed: int = 0,
         priority: int = PRIORITY_NORMAL,
+        client_key: Optional[str] = None,
     ) -> int:
         """Enqueue one request; returns its id.  Raises
         :class:`ServingQueueFull` when the queue is at its bound,
@@ -438,7 +446,22 @@ class ServingEngine:
         ``seed`` become per-slot vectors of the fixed decode signature):
         tokens are reproducible for a given (seed, position) regardless
         of slot assignment or what else shares the pool; greedy requests
-        (the default) bit-match solo ``generate(do_sample=False)``."""
+        (the default) bit-match solo ``generate(do_sample=False)``.
+
+        ``client_key`` is an idempotency key (docs/serving.md §Fleet):
+        a resubmit carrying a key this engine has already acknowledged
+        — in memory or in the journal, i.e. across a crash/restart —
+        returns the ORIGINAL id without a second admission."""
+        if client_key is not None:
+            known = self._client_keys.get(client_key)
+            if known is not None:
+                if self.scheduler.request(known) is not None:
+                    return known
+                # the original admission was delivered and popped — the
+                # dedup window is the request's tracked lifetime, so a
+                # retry after discharge is a NEW request (returning the
+                # dead id would strand the caller waiting forever)
+                del self._client_keys[client_key]
         if do_sample and top_k > self.config.max_top_k:
             raise ValueError(
                 f"top_k={top_k} exceeds serving.max_top_k={self.config.max_top_k} "
@@ -468,6 +491,7 @@ class ServingEngine:
                 top_k=top_k,
                 seed=seed,
                 priority=priority,
+                client_key=client_key,
                 now=time.monotonic(),
                 step=self._step_count,
             )
@@ -488,9 +512,17 @@ class ServingEngine:
         # serves — availability over durability, loudly)
         self._journal_record("record_submit", req)
         self._journal_commit()
+        if client_key is not None:
+            self._client_keys[client_key] = req.request_id
         if self.telemetry.collect:
             self.telemetry.counter("serving/submitted").inc()
         return req.request_id
+
+    def client_request_id(self, client_key: str) -> Optional[int]:
+        """The id this engine acknowledged for ``client_key`` (in memory
+        or journaled), or None — the fleet router's at-most-once dedup
+        probe (docs/serving.md §Fleet)."""
+        return self._client_keys.get(client_key)
 
     def recover(self) -> list:
         """Replay the journal's incomplete requests into this engine
@@ -527,9 +559,12 @@ class ServingEngine:
                 priority=int(e.get("priority", PRIORITY_NORMAL)),
                 request_id=rid,
                 bypass_admission=True,  # accepted before the crash
+                client_key=e.get("ck"),
                 now=time.monotonic(),
                 step=self._step_count,
             )
+            if e.get("ck"):
+                self._client_keys[str(e["ck"])] = rid
             advance_request_ids(rid)
             # re-journal into the live segment: recovery is self-contained
             # even after the old segments compact away
@@ -681,6 +716,19 @@ class ServingEngine:
         )
         raise SystemExit(1)
 
+    def cancel(self, request_id: int) -> bool:
+        """Retire a queued or in-flight request without finishing it
+        (the hedge loser's path; docs/serving.md §Fleet).  The retire
+        record journals and commits immediately — a cancelled request
+        must not replay after a crash.  False when the id is unknown or
+        already retired."""
+        ok = self.scheduler.cancel(
+            request_id, now=time.monotonic(), step=self._step_count
+        )
+        if ok:
+            self._journal_commit()
+        return ok
+
     def result(self, request_id: int) -> Optional[Request]:
         return self.scheduler.request(request_id)
 
@@ -704,8 +752,14 @@ class ServingEngine:
             self._journal_record("record_admit", r)
         elif kind == "first_token":
             self._journal_record("record_first_token", r)
-        elif kind in ("finished", "expired", "shed"):
+        elif kind in ("finished", "cancelled"):
             self._journal_record("record_retire", r)
+        elif kind in ("expired", "shed"):
+            # reject record, committed NOW rather than at the step
+            # boundary: a crash in between must not resurrect a request
+            # the client was already told to retry elsewhere
+            self._journal_record("record_reject", r)
+            self._journal_commit()
         if kind == "admitted":
             self._tel_queue_wait.observe((now - r.submit_time) * 1e3)
             if tracer is not None:
@@ -746,6 +800,15 @@ class ServingEngine:
                     pid=_telemetry.PID_REQUESTS, tid=rid,
                     args={"request": rid, "finish_reason": r.finish_reason,
                           "tokens": len(r.generated)},
+                )
+        elif kind == "cancelled":
+            if tm.collect:
+                tm.counter("serving/cancelled").inc()
+            if tracer is not None:
+                tracer.add_instant(
+                    "cancelled", "serving.request", ts=now,
+                    pid=_telemetry.PID_REQUESTS, tid=rid,
+                    args={"request": rid, "tokens": len(r.generated)},
                 )
         elif kind == "expired":
             if tm.collect:
@@ -889,6 +952,7 @@ class ServingEngine:
             "expired": s.expired,
             # resilience (docs/serving.md §Resilience)
             "shed": s.shed_count + s.admission.shed,
+            "cancelled": s.cancelled_count,
             "degrade_level": s.ladder.level,
             "degrade_rung": s.ladder.rung,
             "degrade_engagements": s.ladder.engagements,

@@ -1,0 +1,40 @@
+"""Fleet front-door: health-gated routing over N serving replicas with
+failover, hedged retries, and lossless supervised restart.
+
+See docs/serving.md §Fleet for the architecture."""
+from deepspeed_tpu.serving.fleet.health import (
+    CLOSED,
+    DEAD,
+    DEGRADED,
+    DRAINING,
+    HALF_OPEN,
+    HEALTHY,
+    OPEN,
+    CircuitBreaker,
+    ReplicaHealth,
+)
+from deepspeed_tpu.serving.fleet.replica import LocalReplica, ReplicaDeadError
+from deepspeed_tpu.serving.fleet.router import (
+    FleetHandle,
+    FleetOverloaded,
+    FleetRouter,
+)
+from deepspeed_tpu.serving.fleet.supervisor import ReplicaSupervisor
+
+__all__ = [
+    "FleetRouter",
+    "FleetHandle",
+    "FleetOverloaded",
+    "LocalReplica",
+    "ReplicaDeadError",
+    "ReplicaSupervisor",
+    "CircuitBreaker",
+    "ReplicaHealth",
+    "HEALTHY",
+    "DEGRADED",
+    "DRAINING",
+    "DEAD",
+    "CLOSED",
+    "OPEN",
+    "HALF_OPEN",
+]

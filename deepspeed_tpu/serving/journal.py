@@ -12,6 +12,9 @@ request's lifecycle as append-only JSONL segments under one directory:
   reproduce the clamped run, not the requested one).
 * ``first`` / ``retire`` — progress + completion markers.  A ``retire``
   makes the request complete: it never replays.
+* ``reject`` — involuntary retirement (shed / expired), terminal like a
+  retire but committed *immediately* by the engine: a crash right after
+  a shed must not resurrect the shed request at replay.
 * ``drain`` — the graceful-drain marker listing the ids left undone
   (informational; the undone set is derivable from submit−retire).
 
@@ -63,6 +66,7 @@ SUBMIT = "submit"
 ADMIT = "admit"
 FIRST = "first"
 RETIRE = "retire"
+REJECT = "reject"  # involuntary retirement (shed/expired): never replays
 DRAIN = "drain"
 
 
@@ -143,9 +147,23 @@ def incomplete_requests(path: str) -> List[Dict[str, Any]]:
             merged[rid] = dict(rec)
         elif t == ADMIT and rid in merged:
             merged[rid]["max_new"] = rec.get("max_new", merged[rid].get("max_new"))
-        elif t == RETIRE:
+        elif t in (RETIRE, REJECT):
+            # a reject is terminal exactly like a retire: a shed/expired
+            # request must never be resurrected by recover()
             merged.pop(rid, None)
     return [merged[k] for k in sorted(merged)]
+
+
+def client_keys(path: str) -> Dict[str, int]:
+    """client_key -> request id over every journaled submit (latest
+    wins): the at-most-once admission lookup — a resubmit carrying a
+    key the journal has already acknowledged is a duplicate, even
+    across a crash/restart (docs/serving.md §Fleet)."""
+    out: Dict[str, int] = {}
+    for rec in read_records(path):
+        if rec.get("t") == SUBMIT and rec.get("ck"):
+            out[str(rec["ck"])] = int(rec["id"])
+    return out
 
 
 class RequestJournal:
@@ -168,12 +186,17 @@ class RequestJournal:
         # incomplete journaled id (whose retire record would silently
         # drop the old acknowledged request from the replay set)
         self.last_request_id = -1
+        # client_key -> id over journaled submits (at-most-once lookup;
+        # kept current by record_submit so the engine never re-reads)
+        self.client_keys: Dict[str, int] = {}
         if segs:
             try:
                 for rec in read_records(self.path):
                     rid = rec.get("id", -1)
                     if isinstance(rid, int):
                         self.last_request_id = max(self.last_request_id, rid)
+                    if rec.get("t") == SUBMIT and rec.get("ck"):
+                        self.client_keys[str(rec["ck"])] = int(rec["id"])
             except JournalError:
                 pass  # replay (recover) surfaces + quarantines corruption
             # restart-loop bound: every construction opens a fresh
@@ -261,7 +284,10 @@ class RequestJournal:
             "temperature": float(req.temperature),
             "top_k": int(req.top_k),
             "seed": int(req.seed),
+            **({"ck": str(req.client_key)} if getattr(req, "client_key", None) else {}),
         })
+        if getattr(req, "client_key", None):
+            self.client_keys[str(req.client_key)] = int(req.request_id)
 
     def record_admit(self, req) -> None:
         self._append({"t": ADMIT, "id": int(req.request_id),
@@ -274,6 +300,16 @@ class RequestJournal:
     def record_retire(self, req) -> None:
         self._append({"t": RETIRE, "id": int(req.request_id),
                       "reason": req.finish_reason or "?"})
+
+    def record_reject(self, req) -> None:
+        """Involuntary retirement (shed / expired): terminal like a
+        retire, but named so post-mortems can tell a served request from
+        a shed one.  The engine commits this record IMMEDIATELY — a
+        crash between a shed and the next step boundary must not
+        resurrect the shed request at recover()."""
+        self._append({"t": REJECT, "id": int(req.request_id),
+                      "reason": req.finish_reason or "?",
+                      "retry_after": req.retry_after})
 
     def record_drain(self, undone: List[int]) -> None:
         self._append({"t": DRAIN, "id": -1, "undone": [int(x) for x in undone]})
@@ -343,5 +379,5 @@ class RequestJournal:
 
 __all__ = [
     "RequestJournal", "JournalError", "incomplete_requests", "read_records",
-    "SUBMIT", "ADMIT", "FIRST", "RETIRE", "DRAIN",
+    "client_keys", "SUBMIT", "ADMIT", "FIRST", "RETIRE", "REJECT", "DRAIN",
 ]

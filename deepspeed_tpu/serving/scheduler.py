@@ -49,6 +49,7 @@ DECODE = "decode"
 DONE = "done"
 EXPIRED = "expired"
 SHED = "shed"
+CANCELLED = "cancelled"
 
 PRIORITY_HIGH = C.SERVING_PRIORITY_HIGH
 PRIORITY_NORMAL = C.SERVING_PRIORITY_NORMAL
@@ -129,6 +130,9 @@ class Request:
     priority: int = PRIORITY_NORMAL  # 0 high / 1 normal / 2 low
     retry_after: Optional[float] = None  # backoff hint on shed/expired results
     degraded: bool = False  # admitted under an engaged degradation ladder
+    # caller-chosen idempotency key (the fleet router's at-most-once
+    # admission contract; journaled in the submit record)
+    client_key: Optional[str] = None
 
     status: str = QUEUED
     slot: Optional[int] = None
@@ -348,6 +352,7 @@ class ContinuousScheduler:
         self.rejected = 0
         self.expired = 0
         self.shed_count = 0  # queued requests shed by the ladder
+        self.cancelled_count = 0  # explicit cancel() retirements
         self.finished_count = 0
         self.degrade_max_new_tokens = max(0, int(degrade_max_new_tokens))
         self.ladder = DegradationLadder(
@@ -427,6 +432,7 @@ class ContinuousScheduler:
         priority: int = PRIORITY_NORMAL,
         request_id: Optional[int] = None,
         bypass_admission: bool = False,
+        client_key: Optional[str] = None,
     ) -> Request:
         """``priority``: 0 high (never TTFT-shed) / 1 normal / 2 low
         (first shed when the ladder tops out).  ``request_id`` +
@@ -497,6 +503,7 @@ class ContinuousScheduler:
             top_k=int(top_k),
             seed=int(seed),
             priority=int(priority),
+            client_key=client_key,
             submit_time=now,
             submit_step=step,
         )
@@ -528,6 +535,11 @@ class ContinuousScheduler:
                 r.finish_reason = "expired"
                 r.finish_time = now
                 r.finish_step = step
+                # same backoff contract as shed: every involuntary
+                # retirement carries a retry_after hint
+                r.retry_after = self.admission.retry_after_seconds(
+                    self.admission.estimate_ttft_seconds(r.prompt_len, in_queue=True)
+                )
                 self._finished[r.request_id] = r
                 self.expired += 1
                 n += 1
@@ -571,6 +583,36 @@ class ContinuousScheduler:
                 f"rung {self.ladder.level}"
             )
         return n
+
+    def cancel(self, request_id: int, now: float, step: int) -> bool:
+        """Retire a queued or in-flight request without finishing it —
+        the hedge loser's retirement path (docs/serving.md §Fleet).  An
+        in-flight cancel frees the slot immediately (the freed slot's
+        stale cache is unreachable by the overwrite-before-attend
+        invariant); the result surfaces with status CANCELLED so the
+        engine journals a retire record.  False when the id is unknown
+        or already retired."""
+        for i, r in enumerate(self._queue):
+            if r.request_id == request_id:
+                del self._queue[i]
+                self._retire_cancelled(r, now, step)
+                return True
+        for slot, r in list(self._active.items()):
+            if r.request_id == request_id:
+                del self._active[slot]
+                self.pool.free(slot)
+                self._retire_cancelled(r, now, step)
+                return True
+        return False
+
+    def _retire_cancelled(self, r: Request, now: float, step: int) -> None:
+        r.status = CANCELLED
+        r.finish_reason = "cancelled"
+        r.finish_time = now
+        r.finish_step = step
+        self._finished[r.request_id] = r
+        self.cancelled_count += 1
+        self._emit("cancelled", r, now, step)
 
     def _pop_next(self) -> Request:
         """Highest-priority (lowest tier number) queued request, FIFO

@@ -509,6 +509,47 @@ def test_idle_engine_deadline_sweep_via_stats_and_drain(eng):
     assert res[r4].finish_reason == "expired"
 
 
+def test_shed_then_crash_never_resurrects_shed_requests(eng, tmp_path):
+    """Regression (ISSUE 14 satellite): a rung-3 ladder shed must
+    journal its reject record IMMEDIATELY — a crash right after the
+    shed (no drain, no clean close) must not let recover() resurrect
+    the shed request, and the shed verdict must carry ``retry_after``
+    (the backpressure hint the fleet router keys on)."""
+    srv = _srv(
+        eng, tmp_path=tmp_path, num_slots=1, max_queue=8, slo_ttft_ms=0.0,
+        degrade_queue_watermark=0.5, degrade_engage_steps=2,
+        degrade_disengage_steps=4, degrade_max_new_tokens=2,
+    )
+    prompts = _prompts(40, 6, 8, seed=23)
+    submitted = [srv.submit(prompts[0], max_new_tokens=24)]
+    shed_ids = []
+    for i, p in enumerate(prompts[1:]):
+        try:
+            submitted.append(
+                srv.submit(p, max_new_tokens=24,
+                           priority=PRIORITY_LOW if i % 2 else 1)
+            )
+        except ServingQueueFull:
+            pass
+        srv.step()
+        shed_ids = [r.request_id for r in srv.scheduler._finished.values()
+                    if r.finish_reason == "shed"]
+        if shed_ids:
+            break
+    assert shed_ids, "the ladder must reach the shed rung"
+    for rid in shed_ids:
+        assert srv.result(rid).retry_after > 0  # hint rides the verdict
+    # crash NOW: no drain, no final commit — only what the shed itself
+    # committed survives (the bug was a reject record that only reached
+    # the journal on the next unrelated commit)
+    del srv
+    srv2 = _srv(eng, tmp_path=tmp_path, num_slots=1)
+    replayed = srv2.recover()
+    assert not set(replayed) & set(shed_ids), (replayed, shed_ids)
+    res = srv2.drain(max_steps=3000)
+    assert all(res[r].finish_reason != "shed" for r in replayed)
+
+
 def test_expired_via_sweep_is_durable_in_journal(eng, tmp_path):
     srv = _srv(eng, tmp_path=tmp_path, num_slots=1)
     p = _prompts(2, 4, 4, seed=19)

@@ -47,13 +47,13 @@ def log(msg):
     print(f"[bench_serving] {msg}", file=sys.stderr, flush=True)
 
 
-def emit(rec):
+def emit(rec, rung="serving"):
     print(json.dumps(rec), flush=True)
     from deepspeed_tpu.telemetry.regression import tool_history_emit
 
     # standalone runs feed the persistent bench history too (no-op when
     # the bench.py driver parent is the history writer)
-    tool_history_emit(rec, rung="serving",
+    tool_history_emit(rec, rung=rung,
                       base_dir=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
@@ -185,6 +185,186 @@ def run_load(make_serving, workload, offered_rps, seed):
     }
 
 
+def run_fleet_load(router, reps, workload, offered_rps, seed, kill_at_frac=None):
+    """Open-loop seeded Poisson run through the FleetRouter; with
+    ``kill_at_frac`` the busiest replica is killed once that fraction of
+    the arrival schedule has elapsed (the failover measurement)."""
+    from deepspeed_tpu.serving.fleet import FleetOverloaded
+
+    rng = np.random.default_rng(seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / offered_rps, size=len(workload)))
+    kill_at = (
+        float(arrivals[max(int(len(arrivals) * kill_at_frac) - 1, 0)])
+        if kill_at_frac is not None else None
+    )
+    t0 = time.monotonic()
+    pending = list(zip(arrivals, workload))
+    handles = {}  # handle_id -> scheduled arrival offset
+    finished = {}
+    rejected = 0
+    while pending or router.has_work():
+        now = time.monotonic() - t0
+        if kill_at is not None and now >= kill_at:
+            victim = max((r for r in reps if r.alive()),
+                         key=lambda r: r.queue_depth())
+            victim.kill("bench chaos: kill mid-run")
+            kill_at = None
+        while pending and pending[0][0] <= now:
+            arr, w = pending.pop(0)
+            try:
+                hid = router.submit(w["prompt"], max_new_tokens=w["max_new"])
+                handles[hid] = arr
+            except FleetOverloaded:
+                rejected += 1
+        if router.has_work():
+            router.step()
+        elif pending:
+            time.sleep(min(0.005, max(0.0, pending[0][0] - now)))
+        finished.update(router.pop_results())
+    makespan = time.monotonic() - t0
+    # quiesce: a background restart may still be rebuilding after the
+    # last result lands — step it to completion so the record carries
+    # the restart count and the process doesn't exit mid-compile
+    sup = getattr(router, "_supervisor", None)
+    if sup is not None:
+        while sup.pending():
+            router.step()
+        router.step()  # absorb a completion that landed after the last poll
+    finished.update(router.pop_results())
+    ttft, toks = [], 0
+    for hid, arr in handles.items():
+        r = finished.get(hid)
+        if r is None or r.first_token_time is None:
+            continue
+        toks += len(r.generated)
+        # submit-anchored admitted TTFT — a refired/replayed request's
+        # clock restarts with its re-admission, which is exactly the
+        # replica-local latency the failover SLO is about
+        ttft.append((r.first_token_time - r.submit_time) * 1e3)
+    pct = lambda a, q: round(float(np.percentile(a, q)), 2) if a else None
+    st = router.stats()
+    return {
+        "tokens_per_s": round(toks / max(makespan, 1e-9), 1),
+        "ttft_submit_p50_ms": pct(ttft, 50),
+        "ttft_submit_p99_ms": pct(ttft, 99),
+        "completed": len(ttft),
+        "offered": len(workload),
+        "availability": round(len(ttft) / max(len(workload), 1), 3),
+        "rejected": rejected,
+        "deaths": st["deaths"],
+        "restarts": st["restarts"],
+        "failovers": st["failovers"],
+        "refired": st["refired"],
+        "offered_rps": round(offered_rps, 3),
+    }
+
+
+def run_fleet_bench(engine, args, slots, chunk, max_len, max_new, workload, model):
+    """The ``fleet`` bench rung: a 3-replica FleetRouter under seeded
+    Poisson load, measured twice with the SAME arrival schedule —
+    steady-state, then with one replica killed mid-run and supervised
+    back to life.  The PR 11 perf sentinel gates the emitted record;
+    its headline ratio is failover-p99 TTFT over steady-state p99 (the
+    fleet proof bound: <= 2x)."""
+    import tempfile
+
+    from deepspeed_tpu.serving import ServingEngine
+    from deepspeed_tpu.serving.fleet import (
+        FleetRouter,
+        LocalReplica,
+        ReplicaSupervisor,
+    )
+
+    n_replicas = 3
+    # 4x the serving workload: p99 over a dozen samples is just the max
+    # sample, which makes the failover ratio a coin flip on whichever
+    # request happened to straddle the kill
+    workload = workload * 4
+
+    with tempfile.TemporaryDirectory(prefix="bench_fleet_") as root:
+        def build_fleet(tag):
+            def factory(name):
+                d = os.path.join(root, tag, name, "journal")
+                return lambda: ServingEngine(
+                    engine, num_slots=slots, prefill_chunk=chunk,
+                    max_len=max_len, max_queue=args.max_queue,
+                    max_new_tokens=max_new, journal_dir=d,
+                )
+            # the warm hook compiles both executables per engine build —
+            # INCLUDING supervised restarts, so the rebuilt replica's jit
+            # trace never lands on the replayed requests' TTFT
+            reps = [
+                LocalReplica(f"r{i}", factory(f"r{i}"),
+                             warm=lambda e: warm(e, workload))
+                for i in range(n_replicas)
+            ]
+            # background=True: the supervised restart (rebuild + warm +
+            # replay) runs on a thread while the survivors keep serving —
+            # a synchronous restart would block the routing loop for the
+            # whole rebuild and charge it to every in-flight TTFT
+            router = FleetRouter(
+                reps,
+                supervisor=ReplicaSupervisor(max_restarts=n_replicas,
+                                             background=True),
+                seed=args.seed,
+            )
+            return router, reps
+
+        # capacity anchor: one replica's closed-loop service rate
+        def make_one():
+            return ServingEngine(engine, num_slots=slots, prefill_chunk=chunk,
+                                 max_len=max_len, max_queue=args.max_queue,
+                                 max_new_tokens=max_new)
+
+        _, req_s, _ = run_closed_loop(make_one, workload)
+        # 1.5x one replica's capacity (50% fleet utilization), but the
+        # arrival schedule must SPAN the kill + supervised restart —
+        # a rate that drains the whole workload in a fraction of a
+        # second turns the failover run into a burst test where queue
+        # depth, not failover, sets the tail
+        offered = max(min(req_s * 1.5, len(workload) / 5.0), 1e-3)
+        log(f"[fleet] single-replica capacity {req_s:.2f} req/s; "
+            f"offering {offered:.2f} req/s to {n_replicas} replicas "
+            f"over ~{len(workload) / offered:.1f}s")
+
+        router, reps = build_fleet("steady")
+        steady = run_fleet_load(router, reps, workload, offered, args.seed)
+        log(f"[fleet] steady-state: {steady['tokens_per_s']} tok/s, "
+            f"admitted p99 {steady['ttft_submit_p99_ms']} ms")
+
+        router, reps = build_fleet("chaos")
+        chaos = run_fleet_load(router, reps, workload, offered, args.seed,
+                               kill_at_frac=0.4)
+        if chaos["deaths"] < 1:
+            log("[fleet] WARNING: the kill never fired (run too short?)")
+
+    ratio = None
+    if steady["ttft_submit_p99_ms"] and chaos["ttft_submit_p99_ms"]:
+        ratio = round(
+            chaos["ttft_submit_p99_ms"] / steady["ttft_submit_p99_ms"], 3
+        )
+    rec = {
+        "metric": f"serving_fleet_{model.replace('-', '_')}_3rep_kill1",
+        "value": chaos.pop("tokens_per_s"),
+        "unit": "tokens/s",
+        "replicas": n_replicas,
+        "num_slots": slots,
+        "prefill_chunk": chunk,
+        "max_len": max_len,
+        "requests": len(workload),
+        "failover_over_steady_p99": ratio,
+        "steady_tokens_per_s": steady["tokens_per_s"],
+        "steady_ttft_submit_p99_ms": steady["ttft_submit_p99_ms"],
+        **chaos,
+    }
+    emit(rec, rung="fleet")
+    log(f"[fleet] kill-1-of-3: {rec['value']} tok/s "
+        f"(steady {rec['steady_tokens_per_s']}), admitted p99 "
+        f"{rec['ttft_submit_p99_ms']} ms = {ratio}x steady, "
+        f"availability {rec['availability']:.1%}, deaths {rec['deaths']}, "
+        f"restarts {rec['restarts']}")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--dryrun", action="store_true", help="tiny model on CPU")
@@ -197,6 +377,11 @@ def main():
     ap.add_argument("--num-slots", type=int, default=None)
     ap.add_argument("--prefill-chunk", type=int, default=None)
     ap.add_argument("--max-queue", type=int, default=256)
+    ap.add_argument("--fleet", action="store_true",
+                    help="fleet-failover mode (docs/serving.md §Fleet): a "
+                         "3-replica FleetRouter under seeded Poisson load, "
+                         "one replica killed mid-run and supervised back — "
+                         "records availability + failover-p99-over-steady")
     ap.add_argument("--overload", action="store_true",
                     help="overload-resilience mode: arm the estimated-TTFT "
                          "shedder (--slo-ttft-ms) and run 2x/4x offered load, "
@@ -249,6 +434,14 @@ def main():
     workload = build_workload(
         n_req, lo, hi, max_new, args.seed, engine.model_config.vocab_size
     )
+
+    if args.fleet:
+        run_fleet_bench(engine, args, slots, chunk, max_len, max_new,
+                        workload, model)
+        if args.trace:
+            path = telemetry.export_trace(args.trace)
+            log(f"trace exported -> {path}")
+        return
 
     kvs = ("model", "int8") if args.kv == "both" else (args.kv,)
     for kv in kvs:

@@ -1,0 +1,430 @@
+"""Fleet chaos smoke: 3 REAL replica processes, one SIGKILL'd
+mid-decode under load, supervised restart + journal replay — asserted
+zero acknowledged loss and bit-identical outputs (the ``fleet-chaos``
+CI job; docs/serving.md §Fleet).
+
+The in-process form of this proof lives in tests/test_fleet.py (the
+engine object is dropped without drain).  This tool runs the real
+thing: each replica is a CHILD PROCESS serving a JSONL command pipe —
+the replica surface the :class:`~deepspeed_tpu.serving.fleet.router.
+FleetRouter` routes against, duck-typed over stdin/stdout — and the
+victim carries a seeded ``DS_FAULT_PLAN`` that ``SIGKILL``\\ s it at its
+Nth decode dispatch.  No Python unwinding, no atexit: the pipe EOF the
+parent observes is exactly what the PR 5 heartbeat channel sees when a
+rank dies.
+
+    python tools/fleet_chaos.py --dryrun        # tiny model, CPU
+
+Flow: the parent builds the router over three :class:`ProcessReplica`
+handles + a :class:`~deepspeed_tpu.serving.fleet.supervisor.
+ReplicaSupervisor` whose ``restart()`` respawns the child over the SAME
+journal directory (without the fault plan) and replays.  A seeded
+workload routes through ``router.submit``; the victim dies mid-stream;
+the router fails over, the supervisor restarts, the journal replays
+under original ids — and the parent asserts:
+
+* the victim's first incarnation died to SIGKILL (rc == -9);
+* ZERO acknowledged loss — every routed handle resolves;
+* every output is bit-identical to an uninterrupted solo
+  ``generate()`` of the same prompt (deterministic serving contract).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+if "--dryrun" in sys.argv or os.environ.get("JAX_PLATFORMS") is None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_REPLICAS = 3
+N_REQUESTS = 9
+MAX_NEW = 6
+KILL_AFTER_DECODES = 5
+
+
+def log(msg):
+    print(f"[fleet_chaos] {msg}", file=sys.stderr, flush=True)
+
+
+def build_prompts(seed, vocab):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    return [
+        rng.integers(1, vocab, int(rng.integers(4, 20)), dtype=np.int32)
+        for _ in range(N_REQUESTS)
+    ]
+
+
+def make_engine(journal_dir):
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt2
+    from deepspeed_tpu.serving import ServingEngine
+
+    cfg = dataclasses.replace(gpt2.GPT2_TINY, remat=False)
+    params = gpt2.init_params(cfg, seed=7)
+    params["wpe"] = params["wpe"] * 40.0
+    eng = deepspeed_tpu.init_inference(
+        model_config=cfg, params=params, dtype=jnp.float32,
+        max_out_tokens=cfg.n_positions,
+    )
+    srv = ServingEngine(
+        eng, num_slots=2, prefill_chunk=8, max_len=64, journal_dir=journal_dir,
+    )
+    return cfg, eng, srv
+
+
+# ---------------------------------------------------------------------------
+# worker child: a replica process serving the JSONL command pipe
+# ---------------------------------------------------------------------------
+
+def run_worker(journal_dir):
+    """One replica process: engine over ``journal_dir``, commands in on
+    stdin, one JSON response line out per command.  A planned SIGKILL
+    (DS_FAULT_PLAN, site ``serving.decode``) simply never answers — the
+    parent's read hits EOF, which IS the death signal."""
+    # claim fd 1 as the private JSON channel BEFORE the framework loads:
+    # the deepspeed_tpu logger writes to stdout, which would corrupt the
+    # line framing — re-point fd 1 (and sys.stdout) at stderr instead
+    out = os.fdopen(os.dup(1), "w")
+    os.dup2(2, 1)
+    sys.stdout = sys.stderr
+
+    import numpy as np
+
+    from deepspeed_tpu.resilience import faults
+
+    faults.install_from_env(rank=0)
+    _, _, srv = make_engine(journal_dir)
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        cmd = json.loads(line)
+        op = cmd["op"]
+        try:
+            if op == "submit":
+                rid = srv.submit(
+                    np.asarray(cmd["prompt"], np.int32),
+                    client_key=cmd.get("client_key"),
+                    **cmd.get("kw", {}),
+                )
+                resp = {"ok": rid}
+            elif op == "step":
+                resp = {"ok": bool(srv.step())}
+            elif op == "has_work":
+                resp = {"ok": bool(srv.scheduler.has_work())}
+            elif op == "pop":
+                resp = {"ok": {
+                    str(rid): {
+                        "tokens": [int(t) for t in r.tokens()],
+                        "finish_reason": r.finish_reason,
+                        "first_token_time": r.first_token_time,
+                        "submit_time": r.submit_time,
+                        "retry_after": r.retry_after,
+                    }
+                    for rid, r in srv.pop_results().items()
+                }}
+            elif op == "cancel":
+                resp = {"ok": bool(srv.cancel(int(cmd["id"])))}
+            elif op == "result":
+                r = srv.result(int(cmd["id"]))
+                resp = {"ok": None if r is None
+                        else {"first_token": r.first_token_time is not None,
+                              "finished": r.finish_time is not None}}
+            elif op == "ck":
+                resp = {"ok": srv.client_request_id(str(cmd["key"]))}
+            elif op == "recover":
+                resp = {"ok": [int(r) for r in srv.recover()]}
+            elif op == "health":
+                resp = {"ok": {
+                    "depth": srv.scheduler.queue_depth,
+                    "level": srv.scheduler.ladder.level,
+                    "est": srv.scheduler.admission.estimate_ttft_seconds(
+                        int(cmd.get("len", 8))
+                    ),
+                }}
+            elif op == "exit":
+                break
+            else:
+                resp = {"err": f"unknown op {op!r}", "type": "ValueError"}
+        except Exception as e:
+            resp = {"err": str(e), "type": type(e).__name__,
+                    "retry_after": getattr(e, "retry_after", None)}
+        out.write(json.dumps(resp) + "\n")
+        out.flush()
+
+
+# ---------------------------------------------------------------------------
+# parent-side process replica: the router's duck-typed surface
+# ---------------------------------------------------------------------------
+
+class _WireResult:
+    """Parent-side view of a worker's retired request."""
+
+    def __init__(self, d):
+        self._tokens = d["tokens"]
+        self.finish_reason = d["finish_reason"]
+        self.first_token_time = d["first_token_time"]
+        self.submit_time = d["submit_time"]
+        self.retry_after = d.get("retry_after")
+
+    def tokens(self):
+        return self._tokens
+
+
+class ProcessReplica:
+    """The fleet replica surface over a child process + JSONL pipe.
+    EOF on the pipe raises :class:`ReplicaDeadError` — the parent-side
+    shape of a SIGKILL'd replica.  ``restart()`` respawns the child
+    over the same journal directory (sans fault plan) and replays."""
+
+    def __init__(self, name, journal_dir, fault_plan=None):
+        self.name = name
+        self.journal_dir = journal_dir
+        self.kills = 0
+        self.first_rc = None
+        self.proc = None
+        self._spawn(fault_plan)
+
+    def _spawn(self, fault_plan=None):
+        env = dict(os.environ)
+        env.pop("DS_FAULT_PLAN", None)
+        if fault_plan is not None:
+            env["DS_FAULT_PLAN"] = fault_plan
+        self.proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--role", "worker",
+             "--journal", self.journal_dir, "--dryrun"],
+            env=env, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True,
+        )
+
+    def _rpc(self, **cmd):
+        from deepspeed_tpu.serving.fleet import ReplicaDeadError
+
+        if self.proc is None or self.proc.poll() is not None:
+            raise ReplicaDeadError(f"replica {self.name} process is gone")
+        try:
+            self.proc.stdin.write(json.dumps(cmd) + "\n")
+            self.proc.stdin.flush()
+            line = self.proc.stdout.readline()
+        except (BrokenPipeError, OSError):
+            line = ""
+        if not line:  # EOF: the process died mid-command
+            if self.first_rc is None:
+                self.first_rc = self.proc.wait()
+            self.kills += 1
+            raise ReplicaDeadError(
+                f"replica {self.name} pipe EOF (rc={self.proc.poll()})"
+            )
+        resp = json.loads(line)
+        if "err" in resp:
+            self._raise_wire(resp)
+        return resp["ok"]
+
+    @staticmethod
+    def _raise_wire(resp):
+        from deepspeed_tpu.serving import ServingQueueFull
+
+        if resp["type"] in ("ServingQueueFull", "ServingOverloaded",
+                            "ServingDraining"):
+            raise ServingQueueFull(resp["err"],
+                                   retry_after=resp.get("retry_after"))
+        raise RuntimeError(f"{resp['type']}: {resp['err']}")
+
+    # -- the replica surface ------------------------------------------------
+    def alive(self):
+        return self.proc is not None and self.proc.poll() is None
+
+    def restart(self):
+        if self.proc is not None and self.first_rc is None:
+            self.first_rc = self.proc.poll()
+        self._spawn()  # same journal dir, no fault plan
+        return self._rpc(op="recover")
+
+    def submit(self, prompt, client_key=None, **kw):
+        return self._rpc(op="submit", prompt=[int(t) for t in prompt],
+                         client_key=client_key, kw=kw)
+
+    def cancel(self, request_id):
+        try:
+            return self._rpc(op="cancel", id=int(request_id))
+        except Exception:
+            return False
+
+    def step(self):
+        return self._rpc(op="step")
+
+    def has_work(self):
+        if not self.alive():
+            return False
+        return self._rpc(op="has_work")
+
+    def pop_results(self):
+        if not self.alive():
+            return {}
+        return {int(rid): _WireResult(d)
+                for rid, d in self._rpc(op="pop").items()}
+
+    def result(self, request_id):
+        if not self.alive():
+            return None
+        return self._rpc(op="result", id=int(request_id))
+
+    def first_token_seen(self, request_id):
+        r = self.result(request_id)
+        return bool(r and r["first_token"])
+
+    def client_request_id(self, client_key):
+        if not self.alive():
+            return None
+        return self._rpc(op="ck", key=client_key)
+
+    def estimate_ttft(self, prompt_len):
+        if not self.alive():
+            return None
+        return self._rpc(op="health", len=prompt_len)["est"]
+
+    def queue_depth(self):
+        if not self.alive():
+            return 0
+        return self._rpc(op="health")["depth"]
+
+    def degrade_level(self):
+        return 0  # health op is polled for placement; ladder rows n/a here
+
+    def draining(self):
+        return False
+
+    def close(self):
+        if self.alive():
+            try:
+                self._rpc(op="exit")
+            except Exception:
+                pass
+            self.proc.wait(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# parent: route, kill, recover, assert
+# ---------------------------------------------------------------------------
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dryrun", action="store_true", help="tiny model on CPU")
+    ap.add_argument("--role", default=None, choices=(None, "worker"))
+    ap.add_argument("--journal", default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    if args.role == "worker":
+        run_worker(args.journal)
+        return
+
+    import numpy as np
+
+    from deepspeed_tpu.resilience.faults import plan_json
+    from deepspeed_tpu.serving.fleet import FleetRouter, ReplicaSupervisor
+
+    t0 = time.monotonic()
+    rng = np.random.default_rng(args.seed)
+    with tempfile.TemporaryDirectory(prefix="fleet_chaos_") as root:
+        # the reference: uninterrupted solo generate() in the parent —
+        # the deterministic-serving contract says every fleet output
+        # must bit-match it regardless of batching, failover, or replay
+        cfg, eng, _ = make_engine(os.path.join(root, "ref-journal"))
+        prompts = build_prompts(args.seed, cfg.vocab_size)
+        expect = [
+            [int(t) for t in
+             np.asarray(eng.generate(p[None, :], max_new_tokens=MAX_NEW))[0]]
+            for p in prompts
+        ]
+
+        plan = plan_json([
+            {"site": "serving.decode", "action": "sigkill",
+             "after": KILL_AFTER_DECODES},
+        ])
+        reps = [
+            ProcessReplica(
+                f"r{i}", os.path.join(root, f"r{i}", "journal"),
+                fault_plan=plan if i == 0 else None,
+            )
+            for i in range(N_REPLICAS)
+        ]
+        log(f"{N_REPLICAS} replica processes up; r0 armed to SIGKILL at "
+            f"decode dispatch {KILL_AFTER_DECODES + 1}")
+        router = FleetRouter(
+            reps, supervisor=ReplicaSupervisor(max_restarts=2),
+        )
+        try:
+            hids = []
+            for i, p in enumerate(prompts):
+                hids.append(router.submit(p, max_new_tokens=MAX_NEW,
+                                          client_key=f"chaos-{i}"))
+                for _ in range(int(rng.poisson(1.0))):
+                    router.step()
+            res = router.drain(max_steps=3000)
+        finally:
+            for rep in reps:
+                rep.close()
+
+        victim = reps[0]
+        if victim.first_rc != -signal.SIGKILL:
+            log(f"victim first incarnation rc={victim.first_rc}, expected "
+                f"{-signal.SIGKILL} — the fault plan did not fire")
+            sys.exit(1)
+        log(f"victim r0 died to SIGKILL mid-decode (rc={victim.first_rc}) "
+            f"and was restarted {victim.kills} time(s)")
+
+        missing = sorted(set(hids) - set(res))
+        if missing:
+            log(f"ACKNOWLEDGED LOSS: handles {missing} never resolved")
+            sys.exit(1)
+        mismatches = [
+            i for i, hid in enumerate(hids)
+            if list(res[hid].tokens()) != expect[i]
+        ]
+        if mismatches:
+            log(f"outputs DIVERGED from solo generate() for requests "
+                f"{mismatches}")
+            sys.exit(1)
+        st = router.stats()
+        if st["deaths"] < 1 or st["restarts"] < 1:
+            log(f"router saw no death/restart cycle: {st}")
+            sys.exit(1)
+
+    record = {
+        "metric": "fleet_chaos_kill9_zero_loss",
+        "value": len(hids),
+        "unit": "requests_resolved_bit_identical",
+        "replicas": N_REPLICAS,
+        "kill_after_decodes": KILL_AFTER_DECODES,
+        "victim_rc": victim.first_rc,
+        "deaths": st["deaths"],
+        "restarts": st["restarts"],
+        "failovers": st["failovers"],
+        "refired": st["refired"],
+        "wall_s": round(time.monotonic() - t0, 1),
+    }
+    print(json.dumps(record), flush=True)
+    log(
+        f"OK: SIGKILL'd 1/{N_REPLICAS} replicas mid-decode -> zero "
+        f"acknowledged loss, {len(hids)}/{len(hids)} outputs bit-identical "
+        f"({record['wall_s']}s)"
+    )
+
+
+if __name__ == "__main__":
+    main()
