@@ -548,6 +548,26 @@ def run_rung(name: str):
                   "reason": f"bench_serving --fleet child rc={proc.returncode}"})
         for rec in recs:
             emit(rec)
+    elif name == "kvcache":
+        # paged-KV rung (docs/serving.md §Paged KV & prefix caching):
+        # an 80%-shared system-prompt batch plus 3-turn sessions run
+        # with the cache on vs off under the same schedule — the
+        # emitted x_prefill_flops reduction is the dedup proof bound
+        # (>= 2x at bit-identical greedy outputs, lower TTFT p50).
+        # Grandchild like the serving rung.
+        import subprocess as sp
+
+        cmd = [sys.executable, os.path.join(HERE, "tools", "bench_serving.py"),
+               "--kvcache"]
+        if not on_tpu:
+            cmd.append("--dryrun")
+        proc = sp.run(cmd, stdout=sp.PIPE, cwd=HERE)
+        recs = _parse_records(proc.stdout.decode(errors="replace"))
+        if proc.returncode != 0 and not recs:
+            emit({"metric": "kvcache", "skipped": True,
+                  "reason": f"bench_serving --kvcache child rc={proc.returncode}"})
+        for rec in recs:
+            emit(rec)
     elif name == "sharding":
         # weight-update-sharding sweep (docs/sharding.md): replicated vs
         # cross-replica ZeRO-1 (vs the composed data x fsdp grid) —
@@ -654,6 +674,11 @@ RUNGS = [
     # 1 capacity anchor + 1 supervised rebuild in a grandchild; the
     # record carries failover_over_steady_p99 for the <=2x bound
     ("fleet", 240, 480),
+    # paged-KV dedup proof (docs/serving.md §Paged KV & prefix caching):
+    # the same shared-prefix + session schedule with the cache on vs
+    # off in a grandchild; the record carries x_prefill_flops for the
+    # >=2x bound at bit-identical greedy outputs
+    ("kvcache", 240, 480),
 ]
 
 # Plausibility floors for each rung's PRIMARY record on REAL TPU —

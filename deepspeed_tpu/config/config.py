@@ -855,6 +855,76 @@ class FleetConfig:
 
 
 @dataclass
+class KVCacheConfig:
+    """``serving.kvcache`` block (docs/serving.md §Paged KV & prefix
+    caching): the paged KV pool — fixed-shape page buffers with a host
+    page allocator, shared-prefix dedup via a radix index, copy-on-write
+    for partially filled shared pages, and durable per-``session_id`` KV
+    reuse (warm in-pool, spilled to ``spill_dir`` when cold / at drain)."""
+
+    enabled: bool = C.SERVING_KVCACHE_ENABLED_DEFAULT
+    page_len: int = C.SERVING_KVCACHE_PAGE_LEN_DEFAULT
+    num_pages: int = C.SERVING_KVCACHE_NUM_PAGES_DEFAULT  # 0 = derive
+    # prompt prefixes (token-id lists) pre-registered in the radix index
+    # at engine start; pinned entries are never evicted under pressure
+    pinned_prefixes: Tuple[Tuple[int, ...], ...] = ()
+    session_ttl_seconds: float = C.SERVING_KVCACHE_SESSION_TTL_SECONDS_DEFAULT
+    spill_dir: str = C.SERVING_KVCACHE_SPILL_DIR_DEFAULT
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "KVCacheConfig":
+        if d is None:
+            return cls()
+        if isinstance(d, KVCacheConfig):
+            d = dataclasses.asdict(d)
+        d = dict(d)
+        block = f"{C.SERVING}.{C.SERVING_KVCACHE}"
+        raw_pins = _pop(d, "pinned_prefixes", ())
+        if raw_pins is None:
+            raw_pins = ()
+        if not isinstance(raw_pins, (list, tuple)):
+            raise DeepSpeedConfigError(
+                f"'{block}.pinned_prefixes' must be a list of token-id "
+                f"lists, got {type(raw_pins).__name__}"
+            )
+        pins: List[Tuple[int, ...]] = []
+        for i, spec in enumerate(raw_pins):
+            if not isinstance(spec, (list, tuple)) or not spec:
+                raise DeepSpeedConfigError(
+                    f"'{block}.pinned_prefixes[{i}]' must be a non-empty "
+                    f"list of token ids"
+                )
+            pins.append(tuple(int(t) for t in spec))
+        out = cls(
+            enabled=bool(_pop(d, "enabled", C.SERVING_KVCACHE_ENABLED_DEFAULT)),
+            page_len=int(_pop(d, "page_len", C.SERVING_KVCACHE_PAGE_LEN_DEFAULT)),
+            num_pages=int(_pop(d, "num_pages", C.SERVING_KVCACHE_NUM_PAGES_DEFAULT)),
+            pinned_prefixes=tuple(pins),
+            session_ttl_seconds=float(
+                _pop(d, "session_ttl_seconds",
+                     C.SERVING_KVCACHE_SESSION_TTL_SECONDS_DEFAULT)
+            ),
+            spill_dir=str(_pop(d, "spill_dir", C.SERVING_KVCACHE_SPILL_DIR_DEFAULT) or ""),
+        )
+        _check_empty(d, block, _known_keys(cls))
+        if out.page_len < 1:
+            raise DeepSpeedConfigError(
+                f"'{block}.page_len' must be >= 1, got {out.page_len}"
+            )
+        if out.num_pages < 0:
+            raise DeepSpeedConfigError(
+                f"'{block}.num_pages' must be >= 0 (0 derives it from the "
+                f"slot capacity), got {out.num_pages}"
+            )
+        if out.session_ttl_seconds < 0:
+            raise DeepSpeedConfigError(
+                f"'{block}.session_ttl_seconds' must be >= 0, "
+                f"got {out.session_ttl_seconds}"
+            )
+        return out
+
+
+@dataclass
 class ServingConfig:
     """``serving`` block (TPU-native extension; docs/serving.md): the
     continuous-batching slot-pool engine.  ``num_slots`` concurrent
@@ -899,6 +969,9 @@ class ServingConfig:
     # fleet front-door (docs/serving.md §Fleet): router + breaker +
     # hedging + supervised replica restart over N engine replicas
     fleet: FleetConfig = field(default_factory=FleetConfig)
+    # paged KV pool with prefix dedup + COW + session reuse
+    # (docs/serving.md §Paged KV & prefix caching)
+    kvcache: KVCacheConfig = field(default_factory=KVCacheConfig)
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ServingConfig":
@@ -906,8 +979,10 @@ class ServingConfig:
             return cls()
         d = dict(d)
         fleet = FleetConfig.from_dict(_pop(d, C.SERVING_FLEET, None))
+        kvcache = KVCacheConfig.from_dict(_pop(d, C.SERVING_KVCACHE, None))
         out = cls(
             fleet=fleet,
+            kvcache=kvcache,
             num_slots=int(_pop(d, "num_slots", C.SERVING_NUM_SLOTS_DEFAULT)),
             max_len=int(_pop(d, "max_len", C.SERVING_MAX_LEN_DEFAULT)),
             kv_cache_dtype=str(

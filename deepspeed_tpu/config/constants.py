@@ -272,6 +272,13 @@ SERVING_DRAIN_DEADLINE_SECONDS_DEFAULT = 30.0  # SIGTERM in-flight drain budget
 SERVING_JOURNAL_DIR_DEFAULT = ""  # "" = request journaling off
 SERVING_JOURNAL_SEGMENT_RECORDS_DEFAULT = 512  # records per WAL segment
 SERVING_JOURNAL_KEEP_SEGMENTS_DEFAULT = 4  # sealed segments before compaction
+# -- paged KV cache (serving.kvcache.*; docs/serving.md §Paged KV) ----
+SERVING_KVCACHE = "kvcache"
+SERVING_KVCACHE_ENABLED_DEFAULT = False  # paged pool off = slot-contiguous pool
+SERVING_KVCACHE_PAGE_LEN_DEFAULT = 128  # tokens per KV page (kernel wants %128)
+SERVING_KVCACHE_NUM_PAGES_DEFAULT = 0  # 0 = derive (garbage page + 2x slot capacity)
+SERVING_KVCACHE_SESSION_TTL_SECONDS_DEFAULT = 0.0  # 0 = warm sessions never expire
+SERVING_KVCACHE_SPILL_DIR_DEFAULT = ""  # "" = cold sessions drop instead of spill
 # -- fleet front-door (serving.fleet.*; docs/serving.md §Fleet) -------
 SERVING_FLEET = "fleet"
 SERVING_FLEET_REPLICAS_DEFAULT = 1  # engine replicas behind the router

@@ -434,6 +434,40 @@ def serving_report(config=None) -> None:
             else "off (journal_dir unset; a crash loses queued+in-flight work)",
         ),
     ]
+    # paged KV rows (docs/serving.md §Paged KV & prefix caching)
+    kv = getattr(s, "kvcache", None)
+    if kv is not None:
+        if not kv.enabled:
+            rows.append((
+                "paged kv cache",
+                "off (serving.kvcache.enabled=false; slot-contiguous pool)",
+            ))
+        else:
+            rows += [
+                (
+                    "paged kv cache",
+                    f"on: {kv.page_len}-token pages, "
+                    + (f"{kv.num_pages} pages"
+                       if kv.num_pages
+                       else "pages derived (garbage + 2x slot capacity)")
+                    + "; shared prefixes dedup via radix index + COW tails",
+                ),
+                (
+                    "pinned prefixes",
+                    f"{len(kv.pinned_prefixes)} pinned "
+                    f"({sum(len(p) for p in kv.pinned_prefixes)} tokens, never evicted)"
+                    if kv.pinned_prefixes
+                    else "none (prefixes learned from traffic, LRU-evicted)",
+                ),
+                (
+                    "session kv reuse",
+                    (f"warm park, ttl {kv.session_ttl_seconds:g}s"
+                     if kv.session_ttl_seconds else "warm park, no ttl")
+                    + (f"; cold spill -> {kv.spill_dir} (manifest-gated, "
+                       "recover() re-pins)"
+                       if kv.spill_dir else "; no spill dir (cold sessions drop)"),
+                ),
+            ]
     # fleet front-door rows (docs/serving.md §Fleet)
     f = getattr(s, "fleet", None)
     if f is not None:

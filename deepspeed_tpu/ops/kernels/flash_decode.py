@@ -266,6 +266,175 @@ def flash_decode(
     return out
 
 
+def decode_paged_supported(B: int, H: int, P: int, page_len: int, d: int) -> bool:
+    """Paged-grid shapes the kernel can serve: each page is one kv block,
+    so ``page_len`` must be a lane-aligned >=128 run; head_dim must be
+    layout friendly.  Small-page pools (unit tests) fall back to the
+    gather + lax path, which is the numerics ground truth."""
+    return page_len >= 128 and page_len % 128 == 0 and d >= 8 and B >= 1 and H >= 1 and P >= 1
+
+
+def _flash_decode_paged_kernel(
+    pt_ref,           # SMEM (B, P) int32 — per-slot page table (scalar prefetch)
+    pos_ref,          # SMEM (B,) int32 — per-slot query position (scalar prefetch)
+    q_ref,            # (1, 1, 1, d)
+    k_ref,            # (1, 1, page_len, d)  — THE page pt[b, p], codes or bf16/f32
+    v_ref,            # (1, 1, page_len, d)
+    *rest,            # [ks_ref, vs_ref (1,1,1,page_len)]; o_ref; scratch m, l, acc
+    sm_scale: float,
+    page_len: int,
+    quant: bool,
+):
+    refs = list(rest)
+    ks_ref = refs.pop(0) if quant else None
+    vs_ref = refs.pop(0) if quant else None
+    o_ref, m_ref, l_ref, acc_ref = refs
+
+    b = pl.program_id(0)
+    p_idx = pl.program_id(2)
+    num_p = pl.num_programs(2)
+
+    @pl.when(p_idx == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # logical position of this page's rows within the slot: the page
+    # table indirection happened in the BlockSpec index_map (the k/v
+    # blocks ARE page pt[b, p]), so the mask math is position-space —
+    # unmapped table entries point at the garbage page, whose logical
+    # positions always exceed pos[b]
+    key_idx = p_idx * page_len + jax.lax.broadcasted_iota(
+        jnp.int32, (1, page_len), 1
+    )
+
+    q = q_ref[0, 0].astype(jnp.float32)                          # (1, d)
+    k = k_ref[0, 0].astype(jnp.float32)                          # (page_len, d)
+    scores = jax.lax.dot_general(
+        q, k,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * sm_scale                                                 # (1, page_len)
+    if quant:
+        scores = scores * ks_ref[0, 0]                           # in-register dequant
+    allowed = key_idx <= pos_ref[b]
+    scores = jnp.where(allowed, scores, NEG_INF)
+
+    m_prev = m_ref[:]                                            # (1, 1)
+    l_prev = l_ref[:]
+    m_cur = jnp.max(scores, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    p = jnp.exp(scores - m_new)
+    alpha = jnp.exp(m_prev - m_new)
+    l_ref[:] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+    m_ref[:] = m_new
+    if quant:
+        p = p * vs_ref[0, 0]
+    v = v_ref[0, 0].astype(jnp.float32)
+    acc_ref[:] = acc_ref[:] * alpha + jnp.dot(
+        p, v, preferred_element_type=jnp.float32
+    )
+
+    @pl.when(p_idx == num_p - 1)
+    def _emit():
+        l = jnp.where(l_ref[:] == 0.0, 1.0, l_ref[:])
+        o_ref[:] = (acc_ref[:] / l)[:, None, None, :].astype(o_ref.dtype)
+
+
+def flash_decode_paged(
+    q: jnp.ndarray,
+    k_cache,
+    v_cache,
+    page_table: jnp.ndarray,
+    pos,
+    sm_scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Single-query attention against a PAGED pool (docs/serving.md
+    §Paged KV & prefix caching): caches are ``(num_pages, H, page_len,
+    d)`` (or the int8 code+scale pair), ``page_table`` (B,
+    pages_per_slot) maps each slot's logical positions onto pages.
+
+    The page table rides the grid as a **prefetched scalar**
+    (``PrefetchScalarGridSpec``): the k/v BlockSpec index_map reads
+    ``pt[b, p]``, so each program's K/V page streams HBM→VMEM directly
+    — the gather the lax path materializes never exists.  Grid
+    ``(B, H, pages_per_slot)`` with the page axis sequential; one page
+    is one kv block (``decode_paged_supported`` demands page_len be
+    lane-aligned), and the online softmax state lives in VMEM scratch
+    exactly like :func:`flash_decode`."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    quant = isinstance(k_cache, dict)
+    k_op = k_cache["q"] if quant else k_cache
+    v_op = v_cache["q"] if quant else v_cache
+    B, H, T, d = q.shape
+    NP, _, page_len, _ = k_op.shape
+    P = page_table.shape[1]
+    if T != 1:
+        raise ValueError(f"flash_decode_paged serves exactly one query per slot, got T={T}")
+    if not decode_paged_supported(B, H, P, page_len, d):
+        raise ValueError(
+            f"flash_decode_paged grid cannot serve (B={B}, H={H}, P={P}, "
+            f"page_len={page_len}, d={d}); callers must dispatch through "
+            "decode_paged_supported()"
+        )
+    if sm_scale is None:
+        sm_scale = 1.0 / (d ** 0.5)
+    if interpret is None:
+        interpret = not _on_tpu()
+
+    table = jnp.asarray(page_table, jnp.int32)
+    pos_vec = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (B,))
+
+    # index maps receive (*grid_ids, *scalar_prefetch_refs)
+    in_specs = [
+        pl.BlockSpec((1, 1, 1, d), lambda b, h, p, pt, pv: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, page_len, d), lambda b, h, p, pt, pv: (pt[b, p], h, 0, 0)),
+        pl.BlockSpec((1, 1, page_len, d), lambda b, h, p, pt, pv: (pt[b, p], h, 0, 0)),
+    ]
+    args = [q, k_op, v_op]
+    if quant:
+        # (NP, H, page_len, 1) scales -> (NP, H, 1, page_len) row
+        # vectors (contiguous reshape) sharing the score-row layout
+        ks = k_cache["s"].reshape(NP, H, 1, page_len)
+        vs = v_cache["s"].reshape(NP, H, 1, page_len)
+        spec = pl.BlockSpec(
+            (1, 1, 1, page_len), lambda b, h, p, pt, pv: (pt[b, p], h, 0, 0)
+        )
+        in_specs += [spec, spec]
+        args += [ks, vs]
+
+    kern = functools.partial(
+        _flash_decode_paged_kernel,
+        sm_scale=sm_scale,
+        page_len=page_len,
+        quant=quant,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, H, P),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, 1, d), lambda b, h, p, pt, pv: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, 1), jnp.float32),   # m
+            pltpu.VMEM((1, 1), jnp.float32),   # l
+            pltpu.VMEM((1, d), jnp.float32),   # acc
+        ],
+    )
+    out = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, 1, d), q.dtype),
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(table, pos_vec, *args)
+    return out
+
+
 def flash_decode_reference(q, k_cache, v_cache, pos, sm_scale=None, key_padding_mask=None):
     """The lax ground truth — literally ``cache_attention`` (kept as an
     alias so the parity tests and the bench name one seam)."""

@@ -120,6 +120,93 @@ def slot_cache_write(cache, t, pos):
     )(cache, t, pos)
 
 
+def paged_gather(cache, page_table):
+    """Materialize the logical slot view of a page pool: gather
+    ``cache`` (num_pages, H, page_len, d) — or the int8 code+scale dict
+    — through ``page_table`` (B, pages_per_slot) into the contiguous
+    (B, H, pages_per_slot*page_len, d) layout :func:`cache_attention`
+    consumes.  Unused table entries point at the reserved garbage page;
+    their rows are never attendable (position mask), so the gathered
+    view is value-identical to the slot-contiguous cache at every
+    attendable position — the bit-match lever of the paged design
+    (docs/serving.md §Paged KV & prefix caching)."""
+
+    def g(buf):
+        B, P = page_table.shape
+        t = jnp.take(buf, page_table.reshape(-1), axis=0)
+        t = t.reshape(B, P, buf.shape[1], buf.shape[2], buf.shape[3])
+        return t.transpose(0, 2, 1, 3, 4).reshape(
+            B, buf.shape[1], P * buf.shape[2], buf.shape[3]
+        )
+
+    if isinstance(cache, dict):
+        return {name: g(buf) for name, buf in cache.items()}
+    return g(cache)
+
+
+def paged_cache_write(cache, t, page_table, pos, write_mask=None):
+    """Per-slot token write through a page table: row ``b`` of ``t``
+    (B, H, T, d) lands at logical positions ``pos[b]:pos[b]+T`` of slot
+    ``b``, scattered into ``cache`` (num_pages, H, page_len, d) via
+    ``page_table[b]``.  ``write_mask`` (B,) False redirects a row's
+    writes to (garbage page, row 0) — how a fixed-shape decode step
+    keeps non-decoding slots from touching real pages (the paged
+    analogue of the safe-position invariant).  int8 caches quantize
+    rows exactly like :func:`slot_cache_write`."""
+    quant = isinstance(cache, dict)
+    page_len = (cache["q"] if quant else cache).shape[2]
+    B, H, T, _ = t.shape
+    idx = pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]  # (B, T)
+    idx = jnp.clip(idx, 0, page_table.shape[1] * page_len - 1)
+    pid = jnp.take_along_axis(page_table, idx // page_len, axis=1)
+    off = idx % page_len
+    if write_mask is not None:
+        keep = write_mask[:, None].astype(bool)
+        pid = jnp.where(keep, pid, 0)
+        off = jnp.where(keep, off, 0)
+    pid_f, off_f = pid.reshape(-1), off.reshape(-1)
+
+    def scat(buf, vals):  # vals (B, H, T, x) -> rows (B*T, H, x)
+        rows = vals.transpose(0, 2, 1, 3).reshape(B * T, H, vals.shape[-1])
+        return buf.at[pid_f, :, off_f, :].set(rows.astype(buf.dtype))
+
+    if quant:
+        cq, cs = _kv_quant(t)
+        return {"q": scat(cache["q"], cq), "s": scat(cache["s"], cs)}
+    return scat(cache, t)
+
+
+def paged_cache_attention(q, k_cache, v_cache, page_table, pos,
+                          sm_scale: Optional[float] = None,
+                          use_kernel: Optional[bool] = None):
+    """Attend (B,H,T,d) queries against a paged cache.  Single-query
+    steps dispatch to the fused paged flash-decode kernel when the
+    kernel suite is armed and the page geometry qualifies (the page
+    table rides the grid as a prefetched scalar, so k/v pages stream
+    straight from HBM without materializing the gather); otherwise the
+    gather + :func:`cache_attention` lax path below is the numerics
+    ground truth, bit-matching the slot-contiguous cache."""
+    quant = isinstance(k_cache, dict)
+    if use_kernel is None:
+        from deepspeed_tpu.ops import kernels as _kernels
+
+        use_kernel = _kernels.flash_decode_armed()
+    if use_kernel and q.shape[2] == 1:
+        from deepspeed_tpu.ops.kernels.flash_decode import (
+            decode_paged_supported, flash_decode_paged,
+        )
+
+        B, H, _, d = q.shape
+        page_len = (k_cache["q"] if quant else k_cache).shape[2]
+        if decode_paged_supported(B, H, page_table.shape[1], page_len, d):
+            return flash_decode_paged(
+                q, k_cache, v_cache, page_table, pos, sm_scale=sm_scale
+            )
+    gk = paged_gather(k_cache, page_table)
+    gv = paged_gather(v_cache, page_table)
+    return cache_attention(q, gk, gv, pos, sm_scale=sm_scale, use_kernel=False)
+
+
 def cache_attention(q, k_cache, v_cache, pos, sm_scale: Optional[float] = None,
                     key_padding_mask=None, use_kernel: Optional[bool] = None):
     """Attend queries (B,H,T,d) against a static cache (B,H,S,d).
@@ -207,6 +294,8 @@ def inference_block(
     v_cache: jnp.ndarray,
     pos: jnp.ndarray,
     key_padding_mask=None,
+    page_table=None,
+    write_mask=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One transformer layer with cache update.
 
@@ -216,7 +305,12 @@ def inference_block(
     continuation, speculative multi-token steps) attends against the
     whole cache with the position mask.  A per-example (B,) ``pos``
     vector selects the slot-pool form: each row reads/writes its own
-    position (continuous batching, serving/).  Returns
+    position (continuous batching, serving/).  ``page_table`` (B,
+    pages_per_slot) selects the PAGED form instead: the caches are
+    page pools (num_pages, H, page_len, d), writes scatter through the
+    table (``write_mask`` redirecting masked rows to the garbage page)
+    and attention reads the gathered logical view — requires a
+    per-slot ``pos`` and no ``key_padding_mask``.  Returns
     (y, new_k_cache, new_v_cache).  Mirrors the reference's fused
     attention+MLP inference module (``transformer_inference.py``
     DeepSpeedTransformerInference.forward).
@@ -232,6 +326,15 @@ def inference_block(
         return t.reshape(B, T, H, hd).transpose(0, 2, 1, 3)
 
     q, k, v = heads(q), heads(k), heads(v)
+    if page_table is not None:
+        if key_padding_mask is not None:
+            raise ValueError("paged caches do not support key_padding_mask")
+        k_cache = paged_cache_write(k_cache, k, page_table, pos, write_mask)
+        v_cache = paged_cache_write(v_cache, v, page_table, pos, write_mask)
+        attn = paged_cache_attention(q, k_cache, v_cache, page_table, pos)
+        attn = attn.transpose(0, 2, 1, 3).reshape(B, T, D)
+        attn = _wmm(attn, lp["proj_w"]) + lp["proj_b"].astype(attn.dtype)
+        return _block_mlp(cfg, lp, x + attn), k_cache, v_cache
     # in-place cache write at [.., pos:pos+T, ..] (per-row positions in
     # the slot-pool form)
     slotted = _per_slot(pos)
@@ -277,8 +380,13 @@ def inference_block(
         attn = cache_attention(q, k_cache, v_cache, pos, key_padding_mask=key_padding_mask)
     attn = attn.transpose(0, 2, 1, 3).reshape(B, T, D)
     attn = _wmm(attn, lp["proj_w"]) + lp["proj_b"].astype(attn.dtype)
-    x = x + attn
+    return _block_mlp(cfg, lp, x + attn), k_cache, v_cache
 
+
+def _block_mlp(cfg: DeepSpeedInferenceConfig, lp: Dict[str, jnp.ndarray],
+               x: jnp.ndarray) -> jnp.ndarray:
+    """Post-attention half of the block: LN2 + (MoE | dense) MLP +
+    residual — shared by the slot-pool and paged attention paths."""
     h = _ln(x, lp["ln2_g"], lp["ln2_b"], cfg.layer_norm_eps)
     if "gate_w" in lp:
         # MoE block: route through the expert layer (eval mode — no
@@ -296,7 +404,7 @@ def inference_block(
         h = _wmm(h, lp["fc_w"]) + lp["fc_b"].astype(h.dtype)
         h = jax.nn.gelu(h, approximate=True)  # fused bias+gelu (gelu.cu analog)
         h = _wmm(h, lp["fc_proj_w"]) + lp["fc_proj_b"].astype(h.dtype)
-    return x + h, k_cache, v_cache
+    return x + h
 
 
 def forward_with_cache(
@@ -308,6 +416,8 @@ def forward_with_cache(
     cfg: DeepSpeedInferenceConfig,
     key_padding_mask=None,
     position_ids=None,
+    page_table=None,
+    write_mask=None,
 ):
     """Full GPT-2-layout network step with cache: embeddings → scanned
     cached blocks → final LN → tied-embedding logits.
@@ -318,7 +428,9 @@ def forward_with_cache(
     ``key_padding_mask`` (B, cache_len) True=attendable masks
     left-padded prompt slots; ``position_ids`` (B, T) overrides the
     default ``pos + arange(T)`` positions (per-example real positions
-    under left padding).  Returns (logits (B,T,V), new_k, new_v).
+    under left padding).  ``page_table`` (B, pages_per_slot) +
+    ``write_mask`` (B,) select the paged-cache form (see
+    :func:`inference_block`).  Returns (logits (B,T,V), new_k, new_v).
     """
     B, T = tokens.shape
     d = params["wte"].shape[1]
@@ -350,7 +462,9 @@ def forward_with_cache(
         for i in range(n_layer):
             lp = jax.tree.map(lambda a: a[i], params["blocks"])
             x, ck, cv = inference_block(
-                cfg, lp, x, k_cache[i], v_cache[i], pos, key_padding_mask=key_padding_mask
+                cfg, lp, x, k_cache[i], v_cache[i], pos,
+                key_padding_mask=key_padding_mask,
+                page_table=page_table, write_mask=write_mask,
             )
             new_k.append(ck)
             new_v.append(cv)
@@ -359,7 +473,11 @@ def forward_with_cache(
 
         def body(carry, xs):
             lp, ck, cv = xs
-            y, ck, cv = inference_block(cfg, lp, carry, ck, cv, pos, key_padding_mask=key_padding_mask)
+            y, ck, cv = inference_block(
+                cfg, lp, carry, ck, cv, pos,
+                key_padding_mask=key_padding_mask,
+                page_table=page_table, write_mask=write_mask,
+            )
             return y, (ck, cv)
 
         n_layer = jax.tree.leaves(k_cache)[0].shape[0]
@@ -388,4 +506,7 @@ def _load_transformer_inference():
         "cache_attention": cache_attention,
         "init_kv_cache": init_kv_cache,
         "slot_cache_write": slot_cache_write,
+        "paged_gather": paged_gather,
+        "paged_cache_write": paged_cache_write,
+        "paged_cache_attention": paged_cache_attention,
     }

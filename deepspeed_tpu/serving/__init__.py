@@ -28,6 +28,7 @@ from deepspeed_tpu.serving.fleet import (
     ReplicaSupervisor,
 )
 from deepspeed_tpu.serving.journal import JournalError, RequestJournal
+from deepspeed_tpu.serving.kvcache import PagedKVPool
 from deepspeed_tpu.serving.pool import SlotKVPool, SlotPoolError
 from deepspeed_tpu.serving.scheduler import (
     PRIORITY_HIGH,
@@ -51,6 +52,7 @@ __all__ = [
     "ReplicaSupervisor",
     "SlotKVPool",
     "SlotPoolError",
+    "PagedKVPool",
     "ContinuousScheduler",
     "DegradationLadder",
     "Request",

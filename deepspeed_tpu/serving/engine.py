@@ -41,6 +41,7 @@ from deepspeed_tpu import telemetry as _telemetry
 from deepspeed_tpu.config.config import ServingConfig
 from deepspeed_tpu.resilience import faults
 from deepspeed_tpu.serving.journal import JournalError, RequestJournal
+from deepspeed_tpu.serving.kvcache import PagedKVPool
 from deepspeed_tpu.serving.pool import SlotKVPool
 from deepspeed_tpu.serving.scheduler import (
     PRIORITY_NORMAL,
@@ -137,10 +138,53 @@ class ServingEngine:
         from deepspeed_tpu.sharding.layout import replicated_sharding
 
         self._replicated = replicated_sharding(engine.mesh)
-        self.pool = SlotKVPool(
-            mcfg.n_layer, config.num_slots, mcfg.n_head, max_len, mcfg.head_dim,
-            kv_dtype, sharding=self._replicated,
-        )
+        kvc = config.kvcache
+        self._paged = bool(kvc.enabled)
+        if self._paged:
+            import math
+
+            if config.max_len:
+                if max_len % kvc.page_len:
+                    raise ValueError(
+                        f"serving.max_len={max_len} must be a multiple of "
+                        f"serving.kvcache.page_len={kvc.page_len} — the paged "
+                        "pool maps slots as whole pages (docs/serving.md "
+                        "§Paged KV & prefix caching)"
+                    )
+            else:
+                # re-floor the derived capacity to a (chunk, page_len)
+                # common multiple: chunk-multiple keeps the last prefill
+                # write from clamping, page-multiple keeps slots whole
+                step = math.lcm(config.prefill_chunk, kvc.page_len)
+                aligned = (capacity // step) * step
+                if aligned < config.prefill_chunk:
+                    raise ValueError(
+                        f"serving.kvcache.page_len={kvc.page_len} cannot align "
+                        f"to the engine capacity {capacity} within "
+                        f"lcm(prefill_chunk={config.prefill_chunk}, page_len)="
+                        f"{step}; lower page_len or raise max_out_tokens"
+                    )
+                if aligned != max_len:
+                    log_dist(
+                        f"serving: derived max_len {max_len} -> {aligned} "
+                        f"(floored to lcm(chunk={config.prefill_chunk}, "
+                        f"page_len={kvc.page_len})={step} for the paged pool)"
+                    )
+                    max_len = aligned
+            self.pool = PagedKVPool(
+                mcfg.n_layer, config.num_slots, mcfg.n_head, max_len,
+                mcfg.head_dim, kv_dtype, page_len=kvc.page_len,
+                num_pages=(kvc.num_pages or None), sharding=self._replicated,
+                prefill_chunk=config.prefill_chunk,
+                pinned_prefixes=kvc.pinned_prefixes,
+                session_ttl_seconds=kvc.session_ttl_seconds,
+                spill_dir=(kvc.spill_dir or None),
+            )
+        else:
+            self.pool = SlotKVPool(
+                mcfg.n_layer, config.num_slots, mcfg.n_head, max_len, mcfg.head_dim,
+                kv_dtype, sharding=self._replicated,
+            )
         self.scheduler = ContinuousScheduler(
             self.pool,
             prefill_chunk=config.prefill_chunk,
@@ -223,6 +267,8 @@ class ServingEngine:
         self.prefill_compiles = 0
         self.decode_compiles = 0
         self._step_count = 0
+        # kvcache event watermarks: deltas become Perfetto instants
+        self._kv_evt_seen = {"evictions": 0, "session_spills": 0}
         log_dist(
             f"serving engine: {config.num_slots} slots x {max_len} positions "
             f"(kv={'int8' if kv_dtype == 'int8' else jnp.dtype(kv_dtype).name}, "
@@ -267,32 +313,63 @@ class ServingEngine:
                     c, cs,
                 )
 
-            def fn(params, toks, slot, pos, take_idx, flag, temp, topk, seed, k_pool, v_pool):
-                ks, vs = _take_slot(k_pool, slot), _take_slot(v_pool, slot)
-                # explicit clipped position ids: the zero-padded chunk
-                # tail must not clamp the wpe slice and shift real rows
-                position_ids = jnp.clip(
-                    pos + jnp.arange(chunk, dtype=jnp.int32), 0, n_pos - 1
-                )[None, :]
-                logits, ks, vs = forward_with_cache(
-                    params, toks, ks, vs, pos, icfg, position_ids=position_ids
-                )
-                # the first generated token samples with the request's
-                # params (the same key schedule as decode: key = seed
-                # folded with the fed token's cache position)
-                key = jax.random.fold_in(jax.random.PRNGKey(seed), pos + take_idx)
-                first = sample_logits_pooled(
-                    logits[0, take_idx].astype(jnp.float32)[None, :],
-                    key[None],
-                    flag[None],
-                    temp[None],
-                    topk[None],
-                    max_top_k,
-                )[0]
-                return first, _put_slot(k_pool, ks, slot), _put_slot(v_pool, vs, slot)
+            if self._paged:
+                def fn(params, toks, table, pos, take_idx, cow_src, cow_dst,
+                       flag, temp, topk, seed, k_pool, v_pool):
+                    # the slot's pending copy-on-write lands BEFORE this
+                    # chunk's writes: a traced (src, dst) page pair rides
+                    # the request's first chunk ((0, 0) — garbage page
+                    # onto itself — is the identity when nothing pends)
+                    cow = lambda b: b.at[:, cow_dst].set(b[:, cow_src])  # noqa: E731
+                    k_pool = jax.tree.map(cow, k_pool)
+                    v_pool = jax.tree.map(cow, v_pool)
+                    position_ids = jnp.clip(
+                        pos + jnp.arange(chunk, dtype=jnp.int32), 0, n_pos - 1
+                    )[None, :]
+                    logits, k_pool, v_pool = forward_with_cache(
+                        params, toks, k_pool, v_pool, pos[None], icfg,
+                        position_ids=position_ids, page_table=table[None, :],
+                    )
+                    key = jax.random.fold_in(
+                        jax.random.PRNGKey(seed), pos + take_idx
+                    )
+                    first = sample_logits_pooled(
+                        logits[0, take_idx].astype(jnp.float32)[None, :],
+                        key[None], flag[None], temp[None], topk[None],
+                        max_top_k,
+                    )[0]
+                    return first, k_pool, v_pool
+
+                donate = (11, 12)
+            else:
+                def fn(params, toks, slot, pos, take_idx, flag, temp, topk, seed, k_pool, v_pool):
+                    ks, vs = _take_slot(k_pool, slot), _take_slot(v_pool, slot)
+                    # explicit clipped position ids: the zero-padded chunk
+                    # tail must not clamp the wpe slice and shift real rows
+                    position_ids = jnp.clip(
+                        pos + jnp.arange(chunk, dtype=jnp.int32), 0, n_pos - 1
+                    )[None, :]
+                    logits, ks, vs = forward_with_cache(
+                        params, toks, ks, vs, pos, icfg, position_ids=position_ids
+                    )
+                    # the first generated token samples with the request's
+                    # params (the same key schedule as decode: key = seed
+                    # folded with the fed token's cache position)
+                    key = jax.random.fold_in(jax.random.PRNGKey(seed), pos + take_idx)
+                    first = sample_logits_pooled(
+                        logits[0, take_idx].astype(jnp.float32)[None, :],
+                        key[None],
+                        flag[None],
+                        temp[None],
+                        topk[None],
+                        max_top_k,
+                    )[0]
+                    return first, _put_slot(k_pool, ks, slot), _put_slot(v_pool, vs, slot)
+
+                donate = (9, 10)
 
             self._prefill_fn = self._wrap(
-                jax.jit(self.engine._scoped(fn), donate_argnums=(9, 10)),
+                jax.jit(self.engine._scoped(fn), donate_argnums=donate),
                 "serving.prefill",
             )
             self.prefill_compiles += 1
@@ -306,24 +383,47 @@ class ServingEngine:
             icfg = self.engine.inference_config(self.pool.max_len)
             max_top_k = self.config.max_top_k
 
-            def fn(params, toks, pos, flags, temps, topks, seeds, k_pool, v_pool):
-                # per-slot pos: slot-indexed cache write + position mask
-                # (ops/transformer/inference.py), auto-clipped position ids
-                logits, k_pool, v_pool = forward_with_cache(
-                    params, toks[:, None], k_pool, v_pool, pos, icfg
-                )
-                # per-(request seed, position) keys: reproducible per
-                # request regardless of slot assignment or pool churn
-                keys = jax.vmap(
-                    lambda s, p: jax.random.fold_in(jax.random.PRNGKey(s), p)
-                )(seeds, pos)
-                nxt = sample_logits_pooled(
-                    logits[:, -1].astype(jnp.float32), keys, flags, temps, topks,
-                    max_top_k,
-                )
-                return nxt, k_pool, v_pool
+            if self._paged:
+                def fn(params, toks, pos, flags, temps, topks, seeds,
+                       page_table, write_mask, k_pool, v_pool):
+                    # per-slot page tables are traced values of the one
+                    # fixed signature; write_mask redirects non-decoding
+                    # slots' writes to the garbage page (pages.py)
+                    logits, k_pool, v_pool = forward_with_cache(
+                        params, toks[:, None], k_pool, v_pool, pos, icfg,
+                        page_table=page_table, write_mask=write_mask,
+                    )
+                    keys = jax.vmap(
+                        lambda s, p: jax.random.fold_in(jax.random.PRNGKey(s), p)
+                    )(seeds, pos)
+                    nxt = sample_logits_pooled(
+                        logits[:, -1].astype(jnp.float32), keys, flags, temps,
+                        topks, max_top_k,
+                    )
+                    return nxt, k_pool, v_pool
 
-            self._decode_jit = jax.jit(self.engine._scoped(fn), donate_argnums=(7, 8))
+                donate = (9, 10)
+            else:
+                def fn(params, toks, pos, flags, temps, topks, seeds, k_pool, v_pool):
+                    # per-slot pos: slot-indexed cache write + position mask
+                    # (ops/transformer/inference.py), auto-clipped position ids
+                    logits, k_pool, v_pool = forward_with_cache(
+                        params, toks[:, None], k_pool, v_pool, pos, icfg
+                    )
+                    # per-(request seed, position) keys: reproducible per
+                    # request regardless of slot assignment or pool churn
+                    keys = jax.vmap(
+                        lambda s, p: jax.random.fold_in(jax.random.PRNGKey(s), p)
+                    )(seeds, pos)
+                    nxt = sample_logits_pooled(
+                        logits[:, -1].astype(jnp.float32), keys, flags, temps, topks,
+                        max_top_k,
+                    )
+                    return nxt, k_pool, v_pool
+
+                donate = (7, 8)
+
+            self._decode_jit = jax.jit(self.engine._scoped(fn), donate_argnums=donate)
             self._decode_fn = self._wrap(self._decode_jit, "serving.decode")
             self.decode_compiles += 1
         return self._decode_fn
@@ -343,7 +443,7 @@ class ServingEngine:
         abstract = lambda tree: jax.tree.map(  # noqa: E731
             lambda a: jax.ShapeDtypeStruct(np.shape(a), a.dtype), tree
         )
-        compiled = self._decode_jit.lower(
+        args = [
             abstract(self.engine.params),
             jax.ShapeDtypeStruct((S,), jnp.int32),   # toks
             jax.ShapeDtypeStruct((S,), jnp.int32),   # pos
@@ -351,9 +451,14 @@ class ServingEngine:
             jax.ShapeDtypeStruct((S,), jnp.float32),  # temps
             jax.ShapeDtypeStruct((S,), jnp.int32),   # topks
             jax.ShapeDtypeStruct((S,), jnp.uint32),  # seeds
-            abstract(self.pool.k),
-            abstract(self.pool.v),
-        ).compile()
+        ]
+        if self._paged:
+            args += [
+                jax.ShapeDtypeStruct((S, self.pool.pages_per_slot), jnp.int32),
+                jax.ShapeDtypeStruct((S,), jnp.bool_),  # write_mask
+            ]
+        args += [abstract(self.pool.k), abstract(self.pool.v)]
+        compiled = self._decode_jit.lower(*args).compile()
         return attribute_executable(compiled, label="serving_decode")
 
     # ------------------------------------------------------------------
@@ -428,6 +533,7 @@ class ServingEngine:
         seed: int = 0,
         priority: int = PRIORITY_NORMAL,
         client_key: Optional[str] = None,
+        session_id: Optional[str] = None,
     ) -> int:
         """Enqueue one request; returns its id.  Raises
         :class:`ServingQueueFull` when the queue is at its bound,
@@ -451,7 +557,13 @@ class ServingEngine:
         ``client_key`` is an idempotency key (docs/serving.md §Fleet):
         a resubmit carrying a key this engine has already acknowledged
         — in memory or in the journal, i.e. across a crash/restart —
-        returns the ORIGINAL id without a second admission."""
+        returns the ORIGINAL id without a second admission.
+
+        ``session_id`` (paged pool only; docs/serving.md §Paged KV &
+        prefix caching): a finished turn's KV pages park under this id,
+        and the next turn whose prompt extends the parked history
+        rebinds them — prefill restarts at the first uncached chunk.
+        Ignored (beyond journaling) on the slot-contiguous pool."""
         if client_key is not None:
             known = self._client_keys.get(client_key)
             if known is not None:
@@ -492,6 +604,7 @@ class ServingEngine:
                 seed=seed,
                 priority=priority,
                 client_key=client_key,
+                session_id=session_id,
                 now=time.monotonic(),
                 step=self._step_count,
             )
@@ -532,6 +645,19 @@ class ServingEngine:
         scheduler).  Greedy and seeded-sampling replays bit-match the
         uninterrupted run (docs/serving.md §Resilience).  Returns the
         replayed ids, oldest first."""
+        if self._paged:
+            # re-register manifest-verified session spills FIRST, so a
+            # replayed turn-N+1 rebinds its session exactly like the
+            # uninterrupted run would have
+            try:
+                sids = self.pool.recover()
+                if sids:
+                    log_dist(
+                        f"serving: kvcache re-registered {len(sids)} spilled "
+                        f"session(s) from {self.pool.sessions.spill_dir!r}"
+                    )
+            except OSError as e:
+                logger.warning(f"serving: kvcache session recovery failed: {e!r}")
         if self._journal is None:
             return []
         try:
@@ -560,6 +686,7 @@ class ServingEngine:
                 request_id=rid,
                 bypass_admission=True,  # accepted before the crash
                 client_key=e.get("ck"),
+                session_id=e.get("sid"),
                 now=time.monotonic(),
                 step=self._step_count,
             )
@@ -595,6 +722,10 @@ class ServingEngine:
         self._step_count += 1
         compiles0 = self.prefill_compiles + self.decode_compiles
         t0 = time.monotonic()
+        if self._paged:
+            # TTL sweep BEFORE admission: pages a cold session releases
+            # this tick are available to the requests admitted in it
+            self.pool.sweep(t0)
         with tl.phase("sched"):
             plan = self.scheduler.tick(t0, self._step_count, admit=admit)
         with tl.phase("prefill"):
@@ -621,6 +752,7 @@ class ServingEngine:
             )
         # retirements this step become durable at the boundary
         self._journal_commit()
+        self._publish_kvcache()
         return self.scheduler.has_work()
 
     def drain(self, max_steps: Optional[int] = None) -> Dict[int, Request]:
@@ -692,6 +824,21 @@ class ServingEngine:
                 f"off {len(undone_live)} in-flight request(s) {undone_live}; "
                 "they replay from the journal"
             )
+        if self._paged:
+            # persist every warm session before the process dies: the
+            # restarted engine's recover() re-registers the spills and
+            # turn N+1 rebinds across the restart (no-op w/o spill_dir)
+            try:
+                n_spilled = self.pool.spill_sessions(time.monotonic())
+                if n_spilled:
+                    log_dist(
+                        f"serving: kvcache spilled {n_spilled} warm "
+                        f"session(s) at drain"
+                    )
+            except OSError as e:
+                logger.error(
+                    f"serving: kvcache session spill at drain failed: {e!r}"
+                )
         undone = self.scheduler.pending_ids()
         if self._journal is not None:
             self._journal_record("record_drain", undone)
@@ -834,6 +981,32 @@ class ServingEngine:
                           "retry_after_s": r.retry_after},
                 )
 
+    def _publish_kvcache(self) -> None:
+        """Paged-pool counters → ``kvcache/*`` registry gauges, plus
+        Perfetto instants for eviction/spill deltas since the last
+        publish (step-boundary granularity; host dict reads only)."""
+        if not self._paged:
+            return
+        st = self.pool.stats()
+        tm = self.telemetry
+        if tm.collect:
+            for key in ("pages_live", "pages_free", "hit_rate", "tokens_saved",
+                        "cow_copies", "evictions", "session_rebinds",
+                        "session_spills", "session_restores", "prefix_entries",
+                        "sessions_warm", "sessions_spilled"):
+                tm.gauge(f"kvcache/{key}").set(float(st[key]))
+        tracer = tm.tracer if tm.tracer.enabled else None
+        for key, name in (("evictions", "kvcache_evict"),
+                          ("session_spills", "kvcache_spill")):
+            delta = int(st[key]) - self._kv_evt_seen[key]
+            if delta > 0 and tracer is not None:
+                tracer.add_instant(
+                    name, "serving.kvcache",
+                    args={"count": delta, "pages_free": st["pages_free"],
+                          "pages_live": st["pages_live"]},
+                )
+            self._kv_evt_seen[key] = int(st[key])
+
     def telemetry_summary(self) -> Dict[str, Any]:
         """Compact roll-up for bench records — MODEL-derived, unlike the
         train engine's compiled-cost gauges (the serving executables are
@@ -876,26 +1049,41 @@ class ServingEngine:
         # explicit staging of the host-side chunk + scalars onto the
         # serving mesh (transfer-guard clean: device_put is sanctioned,
         # and pre-placing on the mesh means the jit has nothing to move)
-        toks, slot, pos, take, flag, temp, topk, seed = jax.device_put(
-            (job.tokens[None, :], np.int32(r.slot), np.int32(job.start),
-             np.int32(job.take_idx), np.bool_(r.do_sample),
-             np.float32(r.temperature), np.int32(r.top_k),
-             np.uint32(r.seed & 0xFFFFFFFF)),
-            self._replicated,
-        )
+        if self._paged:
+            cow_src, cow_dst = self.pool.consume_cow(r.slot)
+            staged = jax.device_put(
+                (job.tokens[None, :], self.pool.table(r.slot),
+                 np.int32(job.start), np.int32(job.take_idx),
+                 np.int32(cow_src), np.int32(cow_dst),
+                 np.bool_(r.do_sample), np.float32(r.temperature),
+                 np.int32(r.top_k), np.uint32(r.seed & 0xFFFFFFFF)),
+                self._replicated,
+            )
+        else:
+            staged = jax.device_put(
+                (job.tokens[None, :], np.int32(r.slot), np.int32(job.start),
+                 np.int32(job.take_idx), np.bool_(r.do_sample),
+                 np.float32(r.temperature), np.int32(r.top_k),
+                 np.uint32(r.seed & 0xFFFFFFFF)),
+                self._replicated,
+            )
         tracer = self.telemetry.tracer if self.telemetry.tracer.enabled else None
         t0 = tracer.now() if tracer is not None else 0.0
         guard = san.transfer.guard("serving.prefill") if san is not None else nullcontext()
         with guard:
             first, k, v = fn(
-                self.engine.params, toks, slot, pos, take, flag, temp, topk, seed,
-                self.pool.k, self.pool.v,
+                self.engine.params, *staged, self.pool.k, self.pool.v,
             )
         self.pool.swap(k, v)
         # explicit d2h read doubles as the fence that keeps prefill_ms
         # honest; the value is the first generated token on final chunks
         tok = int(jax.device_get(first))
         now = time.monotonic()
+        if self._paged and job.final:
+            # the whole prompt's KV is paged in: learn it as a shared
+            # prefix (before note_prefill — a 1-token budget can retire
+            # the request, releasing the slot, inside that call)
+            self.pool.learn_prefix(r, now=now)
         if tracer is not None:
             # chunk-level detail on the request's own lane, between its
             # queue and prefill spans (the fenced read above makes the
@@ -915,14 +1103,25 @@ class ServingEngine:
         san = self._sanitizer
         fn = self._get_decode()
         flags, temps, topks, seeds = self.scheduler.sampling_inputs()
-        toks_d, pos_d, fl_d, t_d, k_d, s_d = jax.device_put(
-            (toks, pos, flags, temps, topks, seeds), self._replicated
-        )
+        if self._paged:
+            # non-decoding slots write to the garbage page; their reads
+            # were already safe behind the position mask
+            wmask = np.zeros((self.pool.num_slots,), np.bool_)
+            for r in decoding:
+                wmask[r.slot] = True
+            staged = jax.device_put(
+                (toks, pos, flags, temps, topks, seeds,
+                 self.pool.tables(), wmask),
+                self._replicated,
+            )
+        else:
+            staged = jax.device_put(
+                (toks, pos, flags, temps, topks, seeds), self._replicated
+            )
         guard = san.transfer.guard("serving.decode") if san is not None else nullcontext()
         with guard:
             nxt, k, v = fn(
-                self.engine.params, toks_d, pos_d, fl_d, t_d, k_d, s_d,
-                self.pool.k, self.pool.v,
+                self.engine.params, *staged, self.pool.k, self.pool.v,
             )
         self.pool.swap(k, v)
         out = np.asarray(jax.device_get(nxt))
@@ -975,6 +1174,9 @@ class ServingEngine:
                 np.dtype(jax.tree.leaves(self.pool.k)[0].dtype)
             ),
         }
+        if self._paged:
+            out["kvcache"] = self.pool.stats()
+            self._publish_kvcache()
         out.update(self.timeline.summary())
         return out
 

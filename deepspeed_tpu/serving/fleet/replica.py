@@ -139,6 +139,19 @@ class LocalReplica:
             return None
         return self.engine.scheduler.admission.estimate_ttft_seconds(prompt_len)
 
+    def kv_affinity(self, prompt, session_id: Optional[str] = None) -> int:
+        """Prompt tokens this replica could serve from its paged KV —
+        a parked session for ``session_id`` or a cached prefix — the
+        router's placement-affinity signal (docs/serving.md §Paged KV &
+        prefix caching).  Side-effect-free; 0 on the slot-contiguous
+        pool, a dead replica, or a miss."""
+        if self._dead or self.engine is None:
+            return 0
+        hint = getattr(self.engine.pool, "prefix_hint_tokens", None)
+        if hint is None:
+            return 0
+        return int(hint(prompt, session_id=session_id))
+
     def queue_depth(self) -> int:
         if self._dead or self.engine is None:
             return 0

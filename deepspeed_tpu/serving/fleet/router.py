@@ -151,6 +151,7 @@ class FleetRouter:
         self.hedge_wins = 0  # hedge leg beat the primary
         self.hedge_cancelled = 0  # loser legs retired
         self.refired = 0
+        self.affinity_routes = 0  # placements won by KV affinity
         self.last_failover: Optional[Dict[str, Any]] = None
         self.telemetry = _telemetry.manager_for("fleet")
         log_dist(
@@ -163,9 +164,17 @@ class FleetRouter:
     # ------------------------------------------------------------------
     # placement
     # ------------------------------------------------------------------
-    def _pick(self, prompt_len: int, exclude: Set[str], now: float) -> Optional[str]:
+    def _pick(self, prompt_len: int, exclude: Set[str], now: float,
+              prompt: Optional[np.ndarray] = None,
+              session_id: Optional[str] = None) -> Optional[str]:
         """Least-estimated-TTFT over routable, non-backpressured
-        replicas; degraded states rank after healthy; ties rotate."""
+        replicas; degraded states rank after healthy; ties rotate.
+        When ``prompt`` is given, KV affinity dominates within a health
+        tier: the replica holding the request's parked session or
+        longest cached prefix wins placement (docs/serving.md §Paged KV
+        & prefix caching).  Hedge legs pass no prompt — a hedge exists
+        to ESCAPE the primary, so it must not be pulled back by the
+        primary's warm cache."""
         scored = []
         n = len(self._order)
         for i, name in enumerate(self._order):
@@ -177,9 +186,18 @@ class FleetRouter:
                 continue
             if self._backpressure.get(name, 0.0) > now:
                 continue  # honoring the replica's own retry_after
+            aff = 0
+            if prompt is not None:
+                probe = getattr(rep, "kv_affinity", None)
+                if probe is not None:
+                    try:
+                        aff = int(probe(prompt, session_id=session_id))
+                    except Exception:  # a probe failure must not unroute
+                        aff = 0
             est = rep.estimate_ttft(prompt_len)
             scored.append((
                 0 if h.state == HEALTHY else 1,
+                -aff,
                 est if est is not None else 0.0,
                 rep.queue_depth(),
                 (i - self._rr) % n,
@@ -188,7 +206,12 @@ class FleetRouter:
         if not scored:
             return None
         self._rr += 1
-        return min(scored)[-1]
+        best = min(scored)
+        if best[1] < 0:
+            self.affinity_routes += 1
+            if self.telemetry.collect:
+                self.telemetry.counter("fleet/affinity_routes").inc()
+        return best[-1]
 
     def _route(
         self,
@@ -206,7 +229,8 @@ class FleetRouter:
         tried: Set[str] = set(exclude)
         attempts = 0
         while attempts <= self.config.route_retries:
-            name = self._pick(len(prompt), tried, now)
+            name = self._pick(len(prompt), tried, now, prompt=prompt,
+                              session_id=kwargs.get("session_id"))
             if name is None:
                 break
             attempts += 1
@@ -705,6 +729,7 @@ class FleetRouter:
             "deaths": self.deaths,
             "restarts": sum(h.restarts for h in self._health.values()),
             "refired": self.refired,
+            "affinity_routes": self.affinity_routes,
             "hedges": self.hedges,
             "hedge_wins": self.hedge_wins,
             "hedge_cancelled": self.hedge_cancelled,

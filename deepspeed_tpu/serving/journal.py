@@ -285,13 +285,15 @@ class RequestJournal:
             "top_k": int(req.top_k),
             "seed": int(req.seed),
             **({"ck": str(req.client_key)} if getattr(req, "client_key", None) else {}),
+            **({"sid": str(req.session_id)} if getattr(req, "session_id", None) else {}),
         })
         if getattr(req, "client_key", None):
             self.client_keys[str(req.client_key)] = int(req.request_id)
 
     def record_admit(self, req) -> None:
         self._append({"t": ADMIT, "id": int(req.request_id),
-                      "max_new": int(req.max_new_tokens)})
+                      "max_new": int(req.max_new_tokens),
+                      "hit": int(getattr(req, "prefix_hint", 0))})
 
     def record_first_token(self, req) -> None:
         self._append({"t": FIRST, "id": int(req.request_id),

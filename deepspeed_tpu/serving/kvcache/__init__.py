@@ -1,0 +1,15 @@
+"""Paged KV subsystem: page pool + allocator, shared-prefix dedup,
+copy-on-write pages, and durable session KV (docs/serving.md §Paged KV
+& prefix caching)."""
+from deepspeed_tpu.serving.kvcache.pages import GARBAGE_PAGE, PagedKVPool
+from deepspeed_tpu.serving.kvcache.prefix import PrefixEntry, PrefixIndex
+from deepspeed_tpu.serving.kvcache.sessions import Session, SessionStore
+
+__all__ = [
+    "GARBAGE_PAGE",
+    "PagedKVPool",
+    "PrefixEntry",
+    "PrefixIndex",
+    "Session",
+    "SessionStore",
+]

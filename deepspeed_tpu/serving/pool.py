@@ -82,7 +82,15 @@ class SlotKVPool:
         return dict(self._owner)
 
     def alloc(self, request_id: Any) -> Optional[int]:
-        """Claim a slot for ``request_id``; None when the pool is full."""
+        """Claim a slot for ``request_id``; None when the pool is full.
+        A request id may own at most one slot — a second alloc under the
+        same id would orphan the first slot's bookkeeping (its free()
+        could land on either slot), so it raises instead."""
+        if request_id in self._owner.values():
+            raise SlotPoolError(
+                f"request {request_id!r} already owns a slot; "
+                f"free it before re-allocating"
+            )
         if not self._free:
             return None
         slot = self._free.popleft()

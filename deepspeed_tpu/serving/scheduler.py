@@ -133,6 +133,13 @@ class Request:
     # caller-chosen idempotency key (the fleet router's at-most-once
     # admission contract; journaled in the submit record)
     client_key: Optional[str] = None
+    # durable session KV (serving/kvcache): requests sharing a
+    # session_id rebind the previous turn's parked pages instead of
+    # re-prefilling; journaled so replay reuses the same session
+    session_id: Optional[str] = None
+    # tokens already cached at admission (prefix/session hit) — prefill
+    # starts here; 0 on the slot pool and on kvcache misses
+    prefix_hint: int = 0
 
     status: str = QUEUED
     slot: Optional[int] = None
@@ -270,19 +277,39 @@ class AdmissionController:
         self.shed = 0  # TTFT-shed submit rejections
 
     def estimate_ttft_seconds(self, prompt_len: int,
-                              in_queue: bool = False) -> Optional[float]:
+                              in_queue: bool = False,
+                              prompt=None,
+                              session_id: Optional[str] = None) -> Optional[float]:
         """``in_queue=True`` when the candidate already sits in the
         queue (the rung-3 shed path pricing a waiter's retry_after):
         its chunks are then inside the queue sum and its queue slot
-        inside ``len(_queue)`` — adding them again would double-count."""
+        inside ``len(_queue)`` — adding them again would double-count.
+
+        With a paged kvcache pool, prefill work is priced at the
+        **post-hit budget**: the pool's side-effect-free
+        ``prefix_hint_tokens`` probe subtracts the expected prefix /
+        session hit from every queued prompt (and from the candidate,
+        when its tokens are given), so shed decisions track the work
+        the engine will actually do."""
         s = self.scheduler
         step_s = s.step_seconds_fn() if s.step_seconds_fn is not None else None
         if not step_s or step_s <= 0:
             return None
         chunk = s.prefill_chunk
-        chunks = sum(
-            math.ceil(max(r.prompt_len - r.prefill_pos, 0) / chunk) for r in s._queue
-        ) + (0 if in_queue else math.ceil(prompt_len / chunk))
+        hint_fn = getattr(s.pool, "prefix_hint_tokens", None)
+
+        def _remaining(r: "Request") -> int:
+            left = max(r.prompt_len - r.prefill_pos, 0)
+            if hint_fn is not None and r.prefill_pos == 0 and left > 0:
+                left = max(left - hint_fn(r.prompt, r.session_id), 1)
+            return left
+
+        chunks = sum(math.ceil(_remaining(r) / chunk) for r in s._queue)
+        if not in_queue:
+            cand = int(prompt_len)
+            if hint_fn is not None and prompt is not None and cand > 0:
+                cand = max(cand - hint_fn(prompt, session_id), 1)
+            chunks += math.ceil(cand / chunk)
         steps = math.ceil(chunks / s.effective_chunks_per_step())
         if not s.pool.free_slots:
             live = [r for r in s._active.values()]
@@ -303,12 +330,15 @@ class AdmissionController:
             return max(self.retry_after_min, 1.0)
         return max(self.retry_after_min, est_s - self.slo_ttft_ms / 1e3)
 
-    def check(self, prompt_len: int, priority: int) -> None:
+    def check(self, prompt_len: int, priority: int, prompt=None,
+              session_id: Optional[str] = None) -> None:
         """Raise :class:`ServingOverloaded` when the candidate's
         estimated TTFT exceeds the SLO (normal/low priority only)."""
         if self.slo_ttft_ms <= 0 or priority <= PRIORITY_HIGH:
             return
-        est = self.estimate_ttft_seconds(prompt_len)
+        est = self.estimate_ttft_seconds(
+            prompt_len, prompt=prompt, session_id=session_id
+        )
         if est is not None and est * 1e3 > self.slo_ttft_ms:
             self.shed += 1
             retry = self.retry_after_seconds(est)
@@ -433,6 +463,7 @@ class ContinuousScheduler:
         request_id: Optional[int] = None,
         bypass_admission: bool = False,
         client_key: Optional[str] = None,
+        session_id: Optional[str] = None,
     ) -> Request:
         """``priority``: 0 high (never TTFT-shed) / 1 normal / 2 low
         (first shed when the ladder tops out).  ``request_id`` +
@@ -488,7 +519,10 @@ class ContinuousScheduler:
                 )
             # estimated-TTFT admission test (high priority bypasses)
             try:
-                self.admission.check(prompt.shape[0], priority)
+                self.admission.check(
+                    prompt.shape[0], priority, prompt=prompt,
+                    session_id=session_id,
+                )
             except ServingOverloaded:
                 self.rejected += 1
                 raise
@@ -504,6 +538,7 @@ class ContinuousScheduler:
             seed=int(seed),
             priority=int(priority),
             client_key=client_key,
+            session_id=session_id,
             submit_time=now,
             submit_step=step,
         )
@@ -600,10 +635,20 @@ class ContinuousScheduler:
         for slot, r in list(self._active.items()):
             if r.request_id == request_id:
                 del self._active[slot]
-                self.pool.free(slot)
+                self._release_slot(slot, r, now)
                 self._retire_cancelled(r, now, step)
                 return True
         return False
+
+    def _release_slot(self, slot: int, r: Request, now: float) -> None:
+        """Return a slot to the pool: the paged pool's ``retire`` hook
+        sees the request (so a finished turn can park under its
+        session); the slot pool just frees."""
+        retire = getattr(self.pool, "retire", None)
+        if retire is not None:
+            retire(slot, r, now=now)
+        else:
+            self.pool.free(slot)
 
     def _retire_cancelled(self, r: Request, now: float, step: int) -> None:
         r.status = CANCELLED
@@ -647,9 +692,24 @@ class ContinuousScheduler:
                 if r.max_new_tokens > self.degrade_max_new_tokens:
                     r.max_new_tokens = self.degrade_max_new_tokens
                     r.degraded = True
-            r.slot = self.pool.alloc(r.request_id)
+            alloc_request = getattr(self.pool, "alloc_request", None)
+            if alloc_request is not None:
+                # hit-aware paged allocation: the pool resolves the
+                # longest cached prefix / session rebind and sets
+                # r.prefill_pos past it (serving/kvcache)
+                r.prefill_pos = 0
+                slot = alloc_request(r, now=now)
+            else:
+                r.prefill_pos = 0
+                slot = self.pool.alloc(r.request_id)
+            if slot is None:
+                # out of pages (paged pool under sharing pressure):
+                # park the request back at the queue head — retiring
+                # slots free pages and the next tick retries
+                self._queue.appendleft(r)
+                break
+            r.slot = slot
             r.status = PREFILL
-            r.prefill_pos = 0
             r.admit_time = now
             r.admit_step = step
             self._active[r.slot] = r
@@ -761,7 +821,7 @@ class ContinuousScheduler:
         r.finish_time = now
         r.finish_step = step
         del self._active[r.slot]
-        self.pool.free(r.slot)
+        self._release_slot(r.slot, r, now)
         self._finished[r.request_id] = r
         self.finished_count += 1
         self._emit("finished", r, now, step)

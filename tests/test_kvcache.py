@@ -1,0 +1,656 @@
+"""Paged KV subsystem tests (ISSUE 15; docs/serving.md §Paged KV &
+prefix caching).
+
+Coverage matrix: radix prefix-index units (insert / deepest lookup /
+mid-edge split learning / LRU eviction order); page-pool refcount
+accounting (COW pairs, garbage-page invariants, leak sweeps where every
+live page must be accounted for by an index entry, a parked session, or
+a mapped slot); the SlotKVPool double-free / duplicate-alloc
+regressions; engine-level bit-match proofs (paged vs solo ``generate``
+AND vs the kvcache-off slot pool, shared-prefix dedup, 3-turn session
+rebind, spill → restore parity); the kill -9 mid-session chaos with
+``recover()`` replaying bit-identically off re-registered spills;
+compile stability under an armed ds_san churn (exactly one executable
+per serving site, zero findings); paged flash-decode kernel parity in
+interpret mode; and the fleet-affinity placement satellite (3-turn
+session stickiness; hedge legs ignore affinity).
+"""
+import dataclasses
+import os
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.analysis.sanitizer import core as san_core
+from deepspeed_tpu.analysis.sanitizer.core import Sanitizer
+from deepspeed_tpu.config.config import SanitizerConfig
+from deepspeed_tpu.models import gpt2
+from deepspeed_tpu.resilience import faults
+from deepspeed_tpu.serving import (
+    PagedKVPool,
+    ServingEngine,
+    SlotKVPool,
+    SlotPoolError,
+)
+from deepspeed_tpu.serving.fleet import FleetRouter, LocalReplica
+from deepspeed_tpu.serving.kvcache.pages import GARBAGE_PAGE
+from deepspeed_tpu.serving.kvcache.prefix import PrefixEntry, PrefixIndex
+
+pytestmark = pytest.mark.serving
+
+TINY = dataclasses.replace(gpt2.GPT2_TINY, remat=False)
+
+
+@pytest.fixture(scope="module")
+def eng():
+    """Position-sensitive engine (wpe scaled) shared across the module —
+    slot/position/page bugs change generations instead of hiding."""
+    params = gpt2.init_params(TINY, seed=7)
+    params["wpe"] = params["wpe"] * 40.0
+    return deepspeed_tpu.init_inference(
+        model_config=TINY, params=params, dtype=jnp.float32,
+        max_out_tokens=TINY.n_positions,
+    )
+
+
+def _prompts(n, lo, hi, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        rng.integers(1, TINY.vocab_size, rng.integers(lo, hi + 1), dtype=np.int32)
+        for _ in range(n)
+    ]
+
+
+def _solo(eng, prompt, max_new):
+    return np.asarray(eng.generate(prompt[None, :], max_new_tokens=max_new))[0]
+
+
+def _srv(eng, tmp_path=None, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("prefill_chunk", 8)
+    kw.setdefault("max_len", 64)
+    kv = kw.pop("kvcache", {})
+    kv.setdefault("enabled", True)
+    kv.setdefault("page_len", 16)
+    if tmp_path is not None:
+        kw.setdefault("journal_dir", str(tmp_path / "journal"))
+    return ServingEngine(eng, kvcache=kv, **kw)
+
+
+class _KReq:
+    """Duck-typed scheduler Request for pool-level tests."""
+
+    def __init__(self, rid, prompt, max_new=4, sid=None, **kw):
+        self.request_id = rid
+        self.prompt = np.asarray(prompt, np.int32)
+        self.max_new_tokens = max_new
+        self.session_id = sid
+        self.prefill_pos = 0
+        self.prefix_hint = 0
+        self.slot = None
+        self.generated = kw.get("generated", [])
+        self.finish_reason = kw.get("finish_reason")
+
+
+def _accounted_pages(pool):
+    """Every page the host bookkeeping still has a claim on — the leak
+    sweep asserts ``pages_live`` equals exactly this set's size."""
+    pages = set()
+    for e in pool.index.entries():
+        pages.update(e.pages)
+    for s in pool.sessions.warm():
+        pages.update(s.pages)
+    for ps in pool._slot_pages.values():
+        pages.update(ps)
+    return pages
+
+
+def _assert_no_leaks(pool):
+    acc = _accounted_pages(pool)
+    assert pool.pages_live == len(acc), (
+        f"pages_live={pool.pages_live} but only {len(acc)} pages are "
+        "accounted for by entries/sessions/slots (leak or double-free)"
+    )
+    for p in range(1, pool.num_pages):
+        assert (pool.refcount(p) > 0) == (p in acc), f"page {p} refcount drift"
+
+
+# ---------------------------------------------------------------------------
+# radix prefix index (no pool)
+# ---------------------------------------------------------------------------
+
+def test_prefix_index_insert_lookup_deepest():
+    idx = PrefixIndex()
+    a = idx.insert(PrefixEntry(tokens=np.array([1, 2, 3]), pages=[5]))
+    b = idx.insert(PrefixEntry(tokens=np.array([1, 2, 3, 4, 5]), pages=[5, 6]))
+    assert len(idx) == 2
+    # deepest entry that prefixes the query wins
+    hit = idx.lookup(np.array([1, 2, 3, 4, 5, 9, 9]), now=1.0)
+    assert hit is b and b.hits == 1 and b.last_used == 1.0
+    assert idx.lookup(np.array([1, 2, 3, 9])) is a
+    assert idx.lookup(np.array([7, 7])) is None
+    # stamp=False is the admission controller's side-effect-free path
+    before = b.hits
+    idx.lookup(np.array([1, 2, 3, 4, 5]), stamp=False)
+    assert b.hits == before
+    # first writer wins on a duplicate key; caller must release its pages
+    dup = PrefixEntry(tokens=np.array([1, 2, 3]), pages=[99])
+    assert idx.insert(dup) is a
+
+
+def test_prefix_index_common_prefix_len_counts_mid_edge():
+    """The split-point lever: two prompts sharing a system prompt never
+    prefix each other, but their common run must still be discoverable
+    (lookup can't see it — no entry terminates mid-edge)."""
+    idx = PrefixIndex()
+    idx.insert(PrefixEntry(tokens=np.array([1, 2, 3, 4, 10, 11]), pages=[2, 3]))
+    q = np.array([1, 2, 3, 4, 20, 21])
+    assert idx.lookup(q) is None
+    assert idx.common_prefix_len(q) == 4
+    assert idx.common_prefix_len(np.array([1, 2, 3, 4, 10, 11, 12])) == 6
+    assert idx.common_prefix_len(np.array([9, 9])) == 0
+    # inserting the shared run makes it a real (lookup-able) entry
+    shared = idx.insert(PrefixEntry(tokens=np.array([1, 2, 3, 4]), pages=[2]))
+    assert idx.lookup(q) is shared
+
+
+def test_prefix_index_remove_and_evict_order():
+    idx = PrefixIndex()
+    cold = idx.insert(PrefixEntry(tokens=np.array([1, 2]), pages=[2],
+                                  last_used=1.0))
+    warm = idx.insert(PrefixEntry(tokens=np.array([3, 4]), pages=[3],
+                                  last_used=9.0))
+    pin = idx.insert(PrefixEntry(tokens=np.array([5, 6]), pages=[4],
+                                 pinned=True, last_used=0.0))
+    assert idx.evict_candidates() == [cold, warm]  # pinned never offered
+    assert idx.remove(cold) and not idx.remove(cold)
+    assert idx.lookup(np.array([1, 2, 9])) is None
+    assert idx.lookup(np.array([5, 6, 9])) is pin
+
+
+# ---------------------------------------------------------------------------
+# paged pool: refcounts, COW, sessions, leak sweep (real device arrays)
+# ---------------------------------------------------------------------------
+
+def _pool(**kw):
+    kw.setdefault("page_len", 8)
+    kw.setdefault("prefill_chunk", 4)
+    kw.setdefault("kv_dtype", jnp.float32)
+    return PagedKVPool(2, 2, 2, 32, 4, **kw)
+
+
+def test_paged_pool_shape_math_and_garbage_page():
+    pool = _pool()
+    assert pool.pages_per_slot == 4
+    assert pool.num_pages == 1 + 2 * 2 * 4
+    assert pool.refcount(GARBAGE_PAGE) == 1  # permanently held
+    assert pool.pages_live == 0
+    s = pool.alloc("ra")
+    assert s is not None and pool.pages_live == pool.pages_per_slot
+    assert GARBAGE_PAGE not in pool._slot_pages[s]
+    with pytest.raises(SlotPoolError):
+        pool.alloc("ra")  # duplicate owner
+    pool.free(s)
+    assert pool.pages_live == 0
+    with pytest.raises(SlotPoolError):
+        pool.free(s)  # double free
+    _assert_no_leaks(pool)
+
+
+def test_paged_pool_prefix_hit_cow_and_leak_sweep():
+    pool = _pool()
+    r0 = _KReq("r0", [1, 2, 3, 4, 5, 6], max_new=2)
+    r0.slot = pool.alloc_request(r0)
+    assert r0.slot is not None and r0.prefill_pos == 0
+    pool.learn_prefix(r0)  # 6 tokens -> entry holds its ref on page 1
+    entry_pages = pool.index.lookup(np.array([1, 2, 3, 4, 5, 6, 7])).pages
+    pool.retire(r0.slot, r0)
+    # reader with the same 6-token start: aligned hit = 4 (chunk=4),
+    # tail page is partially filled and shared -> COW
+    r1 = _KReq("r1", [1, 2, 3, 4, 5, 6, 9, 9], max_new=2)
+    r1.slot = pool.alloc_request(r1)
+    assert (r1.prefill_pos, r1.prefix_hint) == (4, 4)
+    cow = pool.consume_cow(r1.slot)
+    assert cow != (GARBAGE_PAGE, GARBAGE_PAGE)
+    assert cow[0] == entry_pages[0] and cow[1] == pool._slot_pages[r1.slot][0]
+    assert pool.consume_cow(r1.slot) == (GARBAGE_PAGE, GARBAGE_PAGE)  # consumed
+    assert pool.cow_copies == 1 and pool.tokens_saved == 4
+    # the entry still holds its page after the reader retires
+    pool.retire(r1.slot, r1)
+    assert pool.refcount(entry_pages[0]) == 1
+    _assert_no_leaks(pool)
+    # a fresh reader re-hits without any COW source still mapped
+    r2 = _KReq("r2", [1, 2, 3, 4, 5, 6, 7, 8], max_new=2)
+    r2.slot = pool.alloc_request(r2)
+    assert r2.prefix_hint == 4
+    pool.retire(r2.slot, r2)
+    _assert_no_leaks(pool)
+
+
+def test_paged_pool_hit_alignment_respects_chunk_and_first_token():
+    pool = _pool()  # chunk=4
+    r0 = _KReq("r0", list(range(1, 13)), max_new=2)  # 12 tokens
+    r0.slot = pool.alloc_request(r0)
+    pool.learn_prefix(r0)
+    pool.retire(r0.slot, r0)
+    # full-prompt re-submit: hit caps at plen-1 then floors to chunk
+    r1 = _KReq("r1", list(range(1, 13)), max_new=2)
+    r1.slot = pool.alloc_request(r1)
+    assert r1.prefix_hint == 8  # min(12, 11) -> 8
+    pool.retire(r1.slot, r1)
+    # sub-chunk overlap is not a hit (prefill restarts on chunk bounds)
+    r2 = _KReq("r2", [1, 2, 3, 99], max_new=2)
+    r2.slot = pool.alloc_request(r2)
+    assert r2.prefix_hint == 0
+    pool.retire(r2.slot, r2)
+    _assert_no_leaks(pool)
+
+
+def test_paged_pool_session_park_rebind_and_ttl_drop():
+    pool = _pool(session_ttl_seconds=5.0)
+    r0 = _KReq("r0", [1, 2, 3, 4], max_new=3, sid="chat",
+               generated=[7, 8, 9], finish_reason="eos")
+    r0.slot = pool.alloc_request(r0, now=0.0)
+    pool.retire(r0.slot, r0, now=0.0)
+    sess = pool.sessions.peek("chat")
+    assert sess is not None and sess.cached_len == 6  # prompt + gen[:-1]
+    # turn 2 extends the parked history -> rebind consumes the session
+    t2 = [1, 2, 3, 4, 7, 8, 30, 31]
+    r1 = _KReq("r1", t2, max_new=2, sid="chat")
+    r1.slot = pool.alloc_request(r1, now=1.0)
+    assert r1.prefix_hint == 4  # aligned_hit(6, 8) with chunk=4
+    assert pool.session_rebinds == 1 and pool.sessions.peek("chat") is None
+    # divergent history parks untouched, misses
+    pool.retire(r1.slot, r1, now=1.0)
+    r2 = _KReq("r2", [1, 2, 3, 4], max_new=3, sid="other",
+               generated=[5, 6], finish_reason="length")
+    r2.slot = pool.alloc_request(r2, now=1.0)
+    pool.retire(r2.slot, r2, now=1.0)
+    r3 = _KReq("r3", [9, 9, 9, 9, 9], max_new=2, sid="other")
+    r3.slot = pool.alloc_request(r3, now=2.0)
+    assert r3.prefix_hint == 0 and pool.sessions.peek("other") is not None
+    pool.retire(r3.slot, r3, now=2.0)
+    # TTL sweep drops the cold session (no spill dir) and frees pages
+    assert pool.sweep(now=100.0) == 1
+    assert pool.sessions.peek("other") is None
+    _assert_no_leaks(pool)
+
+
+def test_paged_pool_spill_restore_roundtrip(tmp_path):
+    """Cold-session spill → fresh pool → recover() → rebind restores
+    page CONTENT bit-identically (the uint16-view bfloat16 round trip)."""
+    spill = str(tmp_path / "spill")
+    pool = _pool(spill_dir=spill, kv_dtype=jnp.bfloat16)
+    rng = np.random.default_rng(3)
+    fill = rng.standard_normal(
+        (pool.n_layer, pool.num_pages, pool.heads, pool.page_len, pool.head_dim)
+    ).astype(jnp.bfloat16)
+    pool.swap(jnp.asarray(fill), jnp.asarray((fill * 2).astype(fill.dtype)))
+    r0 = _KReq("r0", [1, 2, 3, 4, 5], max_new=3, sid="chat",
+               generated=[6, 7], finish_reason="eos")
+    r0.slot = pool.alloc_request(r0)
+    kept = list(pool._slot_pages[r0.slot][:1])  # 6 cached tokens -> 1 page
+    want_k = np.asarray(fill[:, kept])
+    pool.retire(r0.slot, r0)
+    assert pool.spill_sessions(now=0.0) == 1
+    assert pool.sessions.is_spilled("chat")
+    # fresh pool over the same spill dir (the kill -9 shape: device
+    # pages and host index died; only the manifest-gated spill survives)
+    pool2 = _pool(spill_dir=spill, kv_dtype=jnp.bfloat16)
+    assert pool2.recover() == ["chat"]
+    r1 = _KReq("r1", [1, 2, 3, 4, 5, 6, 30, 31], max_new=2, sid="chat")
+    r1.slot = pool2.alloc_request(r1)
+    assert r1.prefix_hint == 4 and pool2.stats()["session_restores"] == 1
+    got_k = np.asarray(
+        jnp.take(pool2.k, jnp.asarray(pool2._slot_pages[r1.slot][:1]), axis=1)
+    )
+    np.testing.assert_array_equal(got_k, want_k)
+    pool2.retire(r1.slot, r1)
+    _assert_no_leaks(pool2)
+
+
+def test_paged_pool_reclaims_cold_entries_under_pressure():
+    # 5 usable pages (1 garbage + 5): learned entries must be evicted,
+    # coldest first, when a new request needs their pages
+    pool = _pool(num_pages=6)
+    for i, rid in enumerate(("r0", "r1")):
+        r = _KReq(rid, [10 * i + 1, 10 * i + 2, 10 * i + 3, 10 * i + 4,
+                        10 * i + 5], max_new=2)
+        r.slot = pool.alloc_request(r, now=float(i))
+        pool.learn_prefix(r, now=float(i))
+        pool.retire(r.slot, r, now=float(i))
+    assert pool.stats()["prefix_entries"] == 2
+    big = _KReq("big", list(range(200, 224)), max_new=8)  # wants all 4 pages
+    big.slot = pool.alloc_request(big, now=5.0)
+    assert big.slot is not None
+    assert pool.evictions >= 1
+    pool.retire(big.slot, big, now=5.0)
+    _assert_no_leaks(pool)
+
+
+# ---------------------------------------------------------------------------
+# SlotKVPool regressions (the satellite bugfix)
+# ---------------------------------------------------------------------------
+
+def test_slot_pool_duplicate_request_id_raises():
+    pool = SlotKVPool(2, 2, 4, 32, 16, jnp.float32)
+    pool.alloc("ra")
+    with pytest.raises(SlotPoolError, match="already owns"):
+        pool.alloc("ra")
+    pool.alloc("rb")  # distinct id still fine
+    assert pool.free_slots == 0 and pool.alloc("rc") is None
+
+
+def test_slot_pool_double_free_raises():
+    pool = SlotKVPool(2, 2, 4, 32, 16, jnp.float32)
+    s = pool.alloc("ra")
+    pool.free(s)
+    with pytest.raises(SlotPoolError):
+        pool.free(s)
+    assert pool.alloc("ra") is not None  # freed id may re-alloc
+
+
+# ---------------------------------------------------------------------------
+# engine-level bit-match: shared-prefix dedup + two-executable contract
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow  # ~11s: 3 engine builds + 8-prompt solo sweep (kvcache CI job)
+def test_paged_engine_bitmatch_solo_and_slot_pool(eng):
+    """The tentpole proof: shared-prefix traffic through the paged
+    engine produces greedy outputs bit-matching BOTH solo ``generate``
+    and a kvcache-off engine, with real dedup (hits, tokens saved) and
+    exactly one executable per serving site."""
+    shared = _prompts(1, 24, 24, seed=11)[0]
+    tails = _prompts(6, 4, 12, seed=12)
+    prompts = [np.concatenate([shared, t]) for t in tails] + _prompts(2, 6, 14, seed=13)
+    srv = _srv(eng)
+    off = ServingEngine(eng, num_slots=2, prefill_chunk=8, max_len=64)
+    assert isinstance(srv.pool, PagedKVPool)
+    rids = [srv.submit(p, max_new_tokens=4) for p in prompts]
+    rids_off = [off.submit(p, max_new_tokens=4) for p in prompts]
+    res = srv.drain(max_steps=600)
+    res_off = off.drain(max_steps=600)
+    for p, rid, rid_off in zip(prompts, rids, rids_off):
+        exp = _solo(eng, p, 4)
+        np.testing.assert_array_equal(res[rid].tokens(), exp)
+        np.testing.assert_array_equal(res_off[rid_off].tokens(), exp)
+    kv = srv.stats()["kvcache"]
+    # the first two shared prompts fill both slots before any learning
+    # lands, so they can't hit; most of the rest must
+    assert kv["prefix_hits"] >= 3 and kv["tokens_saved"] >= 3 * 16
+    assert kv["cow_copies"] >= 1
+    assert srv.prefill_compiles == 1 and srv.decode_compiles == 1
+    assert srv.pool.live_slots == 0
+    _assert_no_leaks(srv.pool)
+
+
+def test_paged_engine_pinned_prefix_hits_first_traffic(eng):
+    """A pinned system prompt is seeded by the FIRST request that
+    carries it and never evicted; admission sees the hint."""
+    pin = _prompts(1, 16, 16, seed=21)[0]
+    srv = _srv(eng, kvcache={"enabled": True, "page_len": 16,
+                             "pinned_prefixes": [pin.tolist()]})
+    p1 = np.concatenate([pin, _prompts(1, 6, 6, seed=22)[0]])
+    r1 = srv.submit(p1, max_new_tokens=3)
+    res1 = srv.drain(max_steps=300)
+    entry = srv.pool.index.lookup(np.concatenate([pin, [1]]))
+    assert entry is not None and entry.pinned
+    p2 = np.concatenate([pin, _prompts(1, 8, 8, seed=23)[0]])
+    assert srv.pool.prefix_hint_tokens(p2) == 16
+    r2 = srv.submit(p2, max_new_tokens=3)
+    res = srv.drain(max_steps=300)
+    np.testing.assert_array_equal(res[r2].tokens(), _solo(eng, p2, 3))
+    assert res1[r1].finish_reason and srv.stats()["kvcache"]["prefix_hits"] >= 1
+
+
+@pytest.mark.slow  # ~5s: 3 chained turns x (serving + solo) (kvcache CI job)
+def test_paged_engine_session_three_turns_bitmatch(eng):
+    """Durable-session tentpole: three chat turns under one session_id
+    each rebind the previous turn's pages; every turn bit-matches a solo
+    run over the full transcript prompt."""
+    srv = _srv(eng, prefill_chunk=4, max_len=64)
+    history = _prompts(1, 8, 8, seed=31)[0]
+    for turn in range(3):
+        rid = srv.submit(history, max_new_tokens=4, session_id="chat")
+        res = srv.drain(max_steps=300)
+        got = np.asarray(res[rid].tokens())  # full sequence: prompt + gen
+        np.testing.assert_array_equal(got, _solo(eng, history, 4))
+        history = np.concatenate([got, _prompts(1, 3, 5, seed=40 + turn)[0]])
+    kv = srv.stats()["kvcache"]
+    assert kv["session_rebinds"] == 2 and kv["session_parks"] == 3
+    assert kv["tokens_saved"] > 0
+    assert srv.prefill_compiles == 1 and srv.decode_compiles == 1
+
+
+def test_paged_engine_session_spill_restore_bitmatch(eng, tmp_path):
+    """Cold session spilled to disk (stage → manifest protocol), then a
+    later turn restores it on demand — still bit-identical."""
+    srv = _srv(eng, prefill_chunk=4, max_len=64,
+               kvcache={"enabled": True, "page_len": 16,
+                        "spill_dir": str(tmp_path / "spill")})
+    p1 = _prompts(1, 8, 8, seed=51)[0]
+    r1 = srv.submit(p1, max_new_tokens=4, session_id="s")
+    res = srv.drain(max_steps=300)
+    t1 = np.asarray(res[r1].tokens())  # full sequence: prompt + gen
+    assert srv.pool.spill_sessions(time.monotonic()) == 1
+    assert srv.pool.sessions.is_spilled("s")
+    p2 = np.concatenate([t1, _prompts(1, 4, 4, seed=52)[0]])
+    r2 = srv.submit(p2, max_new_tokens=4, session_id="s")
+    res = srv.drain(max_steps=300)
+    np.testing.assert_array_equal(res[r2].tokens(), _solo(eng, p2, 4))
+    kv = srv.stats()["kvcache"]
+    assert kv["session_spills"] == 1 and kv["session_restores"] == 1
+    assert kv["session_rebinds"] == 1
+
+
+# ---------------------------------------------------------------------------
+# chaos: kill -9 mid-session -> recover() replays bit-identically
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow  # ~6s: crash + full rebuild over the same dirs (kvcache CI job)
+def test_kill9_mid_session_recover_bit_identical(eng, tmp_path):
+    """The crash-safety satellite: turn 1 of a session completes and its
+    spill lands; the process dies mid-decode on turn 2.  A fresh engine
+    over the same journal + spill dirs must re-register the spill and
+    replay turn 2 bit-identically to an uninterrupted run."""
+    p1 = _prompts(1, 8, 8, seed=61)[0]
+    t1 = _solo(eng, p1, 4)  # full sequence: prompt + gen
+    p2 = np.concatenate([t1, _prompts(1, 4, 4, seed=62)[0]])
+    expect2 = _solo(eng, p2, 6)
+    extra = _prompts(2, 6, 12, seed=63)
+    expect_extra = [_solo(eng, p, 3) for p in extra]
+
+    def build():
+        return _srv(eng, tmp_path=tmp_path, prefill_chunk=4, max_len=64,
+                    kvcache={"enabled": True, "page_len": 16,
+                             "spill_dir": str(tmp_path / "spill")})
+
+    srv1 = build()
+    r1 = srv1.submit(p1, max_new_tokens=4, session_id="chat")
+    res = srv1.drain(max_steps=300)
+    np.testing.assert_array_equal(res[r1].tokens(), t1)
+    srv1.pool.spill_sessions(time.monotonic())
+    rid2 = srv1.submit(p2, max_new_tokens=6, session_id="chat")
+    rids_x = [srv1.submit(p, max_new_tokens=3) for p in extra]
+    inj = faults.FaultInjector(seed=0).kill("serving.decode", after=1)
+    with pytest.raises(faults.InjectedKill):
+        with inj:
+            srv1.drain(max_steps=500)
+
+    srv2 = build()
+    replayed = srv2.recover()
+    assert rid2 in replayed
+    assert srv2.pool.sessions.is_spilled("chat")  # spill re-registered
+    res2 = srv2.drain(max_steps=500)
+    np.testing.assert_array_equal(res2[rid2].tokens(), expect2)
+    for rid, exp in zip(rids_x, expect_extra):
+        if rid in replayed:
+            np.testing.assert_array_equal(res2[rid].tokens(), exp)
+    assert srv2.stats()["kvcache"]["session_rebinds"] >= 1
+    assert srv2.pool.live_slots == 0
+    _assert_no_leaks(srv2.pool)
+
+
+# ---------------------------------------------------------------------------
+# compile stability under an armed ds_san churn
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def san():
+    cfg = SanitizerConfig.from_dict(
+        {"enabled": True, "checkers": ["recompile", "transfer"], "compile_budget": 2}
+    )
+    s = san_core.install(Sanitizer(cfg))
+    try:
+        yield s
+    finally:
+        san_core.uninstall()
+
+
+def test_paged_compile_stability_churn_ds_san_clean(eng, san):
+    """The two-executable contract survives paged churn: prefix hits,
+    COW pairs, session rebinds and table rebinds are all traced values —
+    one compiled prefill + one compiled decode, zero ds_san findings."""
+    srv = _srv(eng, prefill_chunk=8, max_len=64)
+    assert srv._sanitizer is san
+    shared = _prompts(1, 16, 16, seed=71)[0]
+    rids = [srv.submit(np.concatenate([shared, t]), max_new_tokens=3)
+            for t in _prompts(3, 4, 10, seed=72)]
+    rids.append(srv.submit(_prompts(1, 30, 30, seed=73)[0], max_new_tokens=3))
+    srv.step()
+    srv.step()
+    rids.append(srv.submit(shared, max_new_tokens=3, session_id="s"))
+    res = srv.drain(max_steps=500)
+    # turn 2: tokens() (prompt + gen) extends the parked session by one
+    rids.append(srv.submit(np.asarray(res[rids[-1]].tokens()),
+                           max_new_tokens=3, session_id="s"))
+    res.update(srv.drain(max_steps=500))
+    assert sorted(res) == sorted(rids)
+    assert srv.prefill_compiles == 1 and srv.decode_compiles == 1
+    counts = san.recompile.compile_counts()
+    assert counts.get("serving.prefill") == 1, counts
+    assert counts.get("serving.decode") == 1, counts
+    assert san.findings == [], [f.format() for f in san.findings]
+
+
+# ---------------------------------------------------------------------------
+# paged flash-decode kernel parity (interpret mode off-TPU)
+# ---------------------------------------------------------------------------
+
+def test_flash_decode_paged_matches_gather_reference():
+    from deepspeed_tpu.ops.kernels import flash_decode as fd
+    from deepspeed_tpu.ops.transformer import inference as inf
+
+    B, H, P, page_len, d = 2, 2, 3, 128, 16
+    num_pages = 1 + B * P
+    rng = np.random.default_rng(5)
+    q = jnp.asarray(rng.standard_normal((B, H, 1, d)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((num_pages, H, page_len, d)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((num_pages, H, page_len, d)), jnp.float32)
+    table = jnp.asarray(
+        np.arange(1, num_pages, dtype=np.int32).reshape(B, P))
+    pos = jnp.asarray(np.array([37, 2 * page_len + 5], np.int32))
+    assert fd.decode_paged_supported(B, H, P, page_len, d)
+    out = fd.flash_decode_paged(q, kc, vc, table, pos)
+    gk = inf.paged_gather(kc, table)
+    gv = inf.paged_gather(vc, table)
+    ref = inf.cache_attention(q, gk, gv, pos, use_kernel=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_cache_write_respects_write_mask():
+    from deepspeed_tpu.ops.transformer import inference as inf
+
+    B, H, page_len, d = 2, 2, 8, 4
+    num_pages, P = 5, 2
+    cache = jnp.zeros((num_pages, H, page_len, d), jnp.float32)
+    t = jnp.ones((B, H, 1, d), jnp.float32)
+    table = jnp.asarray(np.array([[1, 2], [3, 4]], np.int32))
+    pos = jnp.asarray(np.array([3, 9], np.int32))
+    mask = jnp.asarray(np.array([True, False]))
+    out = inf.paged_cache_write(cache, t, table, pos, write_mask=mask)
+    got = np.asarray(out)
+    assert got[1, :, 3].all()  # slot 0 wrote page 1 row 3
+    assert not got[3:5].any()  # masked slot 1 touched nothing real
+    # the redirected write lands only on the garbage page
+    assert got[1:, :, :].sum() == got[1, :, 3].sum()
+
+
+# ---------------------------------------------------------------------------
+# fleet affinity (the router satellite)
+# ---------------------------------------------------------------------------
+
+class _FakeRep:
+    """Minimal router-facing replica for placement unit tests."""
+
+    def __init__(self, name, ttft, affinity=0):
+        self.name = name
+        self._ttft = ttft
+        self._aff = affinity
+
+    def alive(self):
+        return True
+
+    def estimate_ttft(self, prompt_len):
+        return self._ttft
+
+    def kv_affinity(self, prompt, session_id=None):
+        return self._aff
+
+    def queue_depth(self):
+        return 0
+
+    def degrade_level(self):
+        return 0
+
+    def draining(self):
+        return False
+
+
+def test_pick_prefers_affinity_but_hedge_ignores_it():
+    fast = _FakeRep("fast", ttft=0.01)
+    warm = _FakeRep("warm", ttft=0.5, affinity=32)
+    router = FleetRouter([fast, warm], clock=lambda: 0.0)
+    prompt = np.arange(40, dtype=np.int32)
+    # routed placement: the warm cache beats the faster queue
+    assert router._pick(len(prompt), set(), 0.0, prompt=prompt,
+                        session_id="s") == "warm"
+    assert router.affinity_routes == 1
+    # the hedge shape (no prompt): pure least-TTFT, affinity invisible
+    assert router._pick(len(prompt), set(), 0.0) == "fast"
+    assert router.affinity_routes == 1
+    # an excluded affinity winner falls back cleanly
+    assert router._pick(len(prompt), {"warm"}, 0.0, prompt=prompt) == "fast"
+
+
+def test_fleet_session_stickiness_three_turns(eng, tmp_path):
+    """3-turn session against a 2-replica fleet: after turn 1 lands
+    somewhere, affinity pins every later turn to that replica, and the
+    final turn still bit-matches solo."""
+    def factory(name):
+        d = str(tmp_path / name / "journal")
+
+        def build():
+            return _srv(eng, prefill_chunk=4, max_len=64, journal_dir=d)
+
+        return build
+
+    reps = [LocalReplica(f"r{i}", factory(f"r{i}")) for i in range(2)]
+    router = FleetRouter(reps)
+    history = _prompts(1, 8, 8, seed=81)[0]
+    homes = []
+    for turn in range(3):
+        h = router.submit(history, max_new_tokens=4, session_id="chat")
+        homes.append(router.handle(h).replica)  # before drain pops it
+        res = router.drain(max_steps=400)
+        got = np.asarray(res[h].tokens())  # full sequence: prompt + gen
+        np.testing.assert_array_equal(got, _solo(eng, history, 4))
+        history = np.concatenate([got, _prompts(1, 3, 4, seed=90 + turn)[0]])
+    assert homes[1] == homes[0] and homes[2] == homes[0], homes
+    assert router.affinity_routes >= 2
+    home = router._replicas[homes[0]].engine
+    assert home.stats()["kvcache"]["session_rebinds"] == 2
