@@ -47,10 +47,11 @@ def assign_fingerprints(findings: List[Finding], root: str, sources: Dict[str, s
         f.fingerprint = fingerprint(f.rule, rel, line_text, occ)
 
 
-def discover(paths: Iterable[str]) -> Optional[str]:
-    """Find the nearest ``.ds_lint_baseline.json``: cwd first, then
-    walking up from the first linted path."""
-    cand = os.path.join(os.getcwd(), BASELINE_NAME)
+def discover(paths: Iterable[str], name: str = BASELINE_NAME) -> Optional[str]:
+    """Find the nearest baseline file (``name``, default ds_lint's):
+    cwd first, then walking up from the first linted path.  ds_race and
+    ds_san pass their own baseline filenames through ``name``."""
+    cand = os.path.join(os.getcwd(), name)
     if os.path.isfile(cand):
         return cand
     for p in paths:
@@ -58,7 +59,7 @@ def discover(paths: Iterable[str]) -> Optional[str]:
         if os.path.isfile(d):
             d = os.path.dirname(d)
         while True:
-            cand = os.path.join(d, BASELINE_NAME)
+            cand = os.path.join(d, name)
             if os.path.isfile(cand):
                 return cand
             parent = os.path.dirname(d)

@@ -1,6 +1,6 @@
 """``ds_lint`` command-line interface (and the ``deepspeed_tpu.analysis``
-subcommand router: ``sanitize`` dispatches to ds_san, ``lint``/bare
-paths run the AST linter).
+subcommand router: ``sanitize`` dispatches to ds_san, ``race`` to
+ds_race, ``lint``/bare paths run the AST linter).
 
 Exit codes: 0 clean (or only findings below the failing tier), 1 new
 findings at/above the failing tier (default: tier A), 2 usage error.
@@ -80,6 +80,12 @@ def cli_main(argv: Optional[List[str]] = None) -> int:
         from deepspeed_tpu.analysis.sanitizer.cli import sanitize_main
 
         return sanitize_main(argv[1:])
+    if argv and argv[0] == "race":
+        # lock-discipline analysis + stress harness; its static mode is
+        # jax-free like lint, --stress imports the runtime
+        from deepspeed_tpu.analysis.race.cli import cli_main as race_main
+
+        return race_main(argv[1:])
     if argv and argv[0] == "lint":
         argv = argv[1:]
     args = _build_parser().parse_args(argv)

@@ -1,0 +1,13 @@
+"""ds_race: lock-discipline static analysis + schedule-perturbing race
+harness for deepspeed_tpu's threaded runtime.
+
+Third analysis surface next to ds_lint (AST hygiene) and ds_san
+(numerics): shares their Finding/severity/baseline/suppression
+machinery, adds a per-class lockset model (``lockset``), four race
+rules (``rules``), and a seeded stress harness (``stress``) built on
+the resilience FaultInjector's ``race.yield``/``race.stall`` actions.
+"""
+from deepspeed_tpu.analysis.race.rules import all_race_rules
+from deepspeed_tpu.analysis.race.runner import RACE_BASELINE_NAME, race_paths
+
+__all__ = ["all_race_rules", "race_paths", "RACE_BASELINE_NAME"]

@@ -1,0 +1,231 @@
+"""``ds_race`` command-line interface.
+
+Two modes, mirroring the ds_lint UX (same flags, same exit codes:
+0 clean, 1 failing findings / failed scenarios, 2 usage error):
+
+* static (default): the lockset pass over the given paths, filtered by
+  ``# ds-race: disable=`` suppressions and ``.ds_race_baseline.json``;
+* ``--stress``: the schedule-perturbing scenario sweep (no paths
+  needed); ``--seeds`` controls how many schedules each scenario
+  explores and ``--scenario`` narrows the set.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+from deepspeed_tpu.analysis import baseline as baseline_mod
+from deepspeed_tpu.analysis.core import Severity
+from deepspeed_tpu.analysis.race.rules import all_race_rules
+from deepspeed_tpu.analysis.race.runner import RACE_BASELINE_NAME, race_paths
+from deepspeed_tpu.analysis.runner import LintResult
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ds_race",
+        description="Lock-discipline static analysis + schedule-perturbing "
+        "race harness for deepspeed_tpu's threaded runtime "
+        "(static mode is AST-based and never imports the analyzed code).",
+    )
+    p.add_argument("paths", nargs="*", help="files or directories to analyze")
+    p.add_argument("--baseline", metavar="PATH",
+                   help=f"baseline file (default: nearest {RACE_BASELINE_NAME})")
+    p.add_argument("--no-baseline", action="store_true", help="ignore any baseline file")
+    p.add_argument(
+        "--write-baseline", action="store_true",
+        help="record all current findings as the new baseline and exit 0",
+    )
+    p.add_argument("--select", metavar="RULES", help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--disable", metavar="RULES", help="comma-separated rule ids to skip")
+    p.add_argument(
+        "--fail-on", default="A", choices=["A", "B", "C"],
+        help="lowest tier that fails the run (default: A)",
+    )
+    p.add_argument("--format", default="text", choices=["text", "json"], dest="fmt")
+    p.add_argument("--list-rules", action="store_true", help="print the rule catalog and exit")
+    p.add_argument("-q", "--quiet", action="store_true", help="findings only, no summary")
+    # -- stress mode ----------------------------------------------------
+    p.add_argument("--stress", action="store_true",
+                   help="run the seeded schedule-perturbation scenarios instead "
+                   "of the static pass")
+    p.add_argument("--seeds", type=int, default=50, metavar="N",
+                   help="schedules per scenario in --stress (default: 50)")
+    p.add_argument("--scenario", metavar="NAMES",
+                   help="comma-separated scenario names to run (default: all)")
+    p.add_argument("--plan", metavar="PATH",
+                   help="DS_FAULT_PLAN-format JSON file overriding the default "
+                   "race.yield/race.stall perturbation plan")
+    p.add_argument("--list-scenarios", action="store_true",
+                   help="print the stress scenario catalog and exit")
+    return p
+
+
+def _split(raw: Optional[str]) -> Optional[List[str]]:
+    if not raw:
+        return None
+    return [part.strip() for part in raw.split(",") if part.strip()]
+
+
+def _print_catalog() -> None:
+    rules = all_race_rules()
+    width = max(len(r) for r in rules)
+    for rid in sorted(rules, key=lambda r: (-rules[r].tier, r)):
+        rule = rules[rid]
+        print(f"[{rule.tier.name}] {rid.ljust(width)}  {rule.description}")
+
+
+def _print_scenarios() -> None:
+    from deepspeed_tpu.analysis.race.stress import all_scenarios
+
+    scenarios = all_scenarios()
+    width = max(len(n) for n in scenarios)
+    for name in sorted(scenarios):
+        sc = scenarios[name]
+        tags = "".join(
+            f" [{t}]" for t, on in (("must-fire", sc.must_fire),
+                                    ("jax", sc.requires_jax)) if on
+        )
+        print(f"{name.ljust(width)}  {sc.description}{tags}")
+
+
+def _summarize(result: LintResult, elapsed: float, fail_on: Severity, quiet: bool) -> None:
+    if quiet:
+        return
+    tiers = ", ".join(f"{result.count(t)} tier-{t.name}" for t in (Severity.A, Severity.B, Severity.C))
+    bits = [f"{len(result.findings)} finding(s) ({tiers})", f"{result.files} file(s)"]
+    if result.baselined:
+        bits.append(f"{len(result.baselined)} baselined")
+    if result.suppressed:
+        bits.append(f"{result.suppressed} suppressed")
+    if result.parse_errors:
+        bits.append(f"{len(result.parse_errors)} unparsable")
+    print(f"ds_race: {', '.join(bits)} in {elapsed:.2f}s (failing tier: {fail_on.name}+)")
+
+
+def _stress_main(args) -> int:
+    from deepspeed_tpu.analysis.race.stress import run_stress
+
+    plan_spec = None
+    if args.plan:
+        try:
+            with open(args.plan) as f:
+                plan_spec = f.read()
+            json.loads(plan_spec)
+        except (OSError, ValueError) as e:
+            print(f"ds_race: error: cannot read plan {args.plan!r}: {e}", file=sys.stderr)
+            return 2
+    try:
+        report = run_stress(seeds=max(1, args.seeds),
+                            names=_split(args.scenario),
+                            plan_spec=plan_spec)
+    except KeyError as e:
+        print(f"ds_race: error: {e}", file=sys.stderr)
+        return 2
+    if args.fmt == "json":
+        print(json.dumps(report, indent=1))
+    else:
+        for e in report["scenarios"]:
+            if e["skipped"]:
+                line = f"SKIP {e['name']}: {e['skipped']}"
+            else:
+                n_fail = len(e["failures"])
+                if e["must_fire"]:
+                    verdict = "ok" if e["ok"] else "FAIL"
+                    detail = (f"fired on {n_fail}/{report['seeds']} seed(s)"
+                              if n_fail else "never fired")
+                else:
+                    verdict = "ok" if e["ok"] else "FAIL"
+                    detail = (f"{report['seeds']} seed(s) clean" if e["ok"]
+                              else f"{n_fail} seed(s) failed")
+                line = f"{verdict:4s} {e['name']}: {detail} [{e['elapsed_s']}s]"
+                if not e["ok"] and e["failures"] and not args.quiet:
+                    first = e["failures"][0]
+                    line += f"\n     seed {first['seed']}: {first['error']}"
+            print(line)
+        if not args.quiet:
+            n_ok = sum(1 for e in report["scenarios"] if e["ok"])
+            print(f"ds_race --stress: {n_ok}/{len(report['scenarios'])} "
+                  f"scenario(s) ok over {report['seeds']} seed(s) each")
+    return 0 if report["ok"] else 1
+
+
+def cli_main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    args = _build_parser().parse_args(argv)
+    if args.list_rules:
+        _print_catalog()
+        return 0
+    if args.list_scenarios:
+        _print_scenarios()
+        return 0
+    if args.stress:
+        return _stress_main(args)
+    if not args.paths:
+        print("ds_race: no paths given (try `ds_race deepspeed_tpu/` or "
+              "`ds_race --stress`)", file=sys.stderr)
+        return 2
+    fail_on = Severity.parse(args.fail_on)
+    baseline_path = args.baseline
+    if args.write_baseline and baseline_path is None:
+        # resolve BEFORE analyzing so fingerprints root at its directory
+        # (same first-write subtlety as ds_lint)
+        baseline_path = baseline_mod.discover(
+            args.paths, name=RACE_BASELINE_NAME
+        ) or os.path.join(os.getcwd(), RACE_BASELINE_NAME)
+    start = time.monotonic()
+    try:
+        result = race_paths(
+            args.paths,
+            select=_split(args.select),
+            disable=_split(args.disable),
+            baseline_path=baseline_path,
+            use_baseline=not args.no_baseline,
+        )
+    except (FileNotFoundError, KeyError, ValueError) as e:
+        print(f"ds_race: error: {e}", file=sys.stderr)
+        return 2
+    elapsed = time.monotonic() - start
+
+    if args.write_baseline:
+        baseline_mod.save(baseline_path, result.all_current, tool="ds_race")
+        print(f"ds_race: wrote {len(result.all_current)} finding(s) to {baseline_path}")
+        return 0
+
+    if args.fmt == "json":
+        print(
+            json.dumps(
+                {
+                    "findings": [
+                        {
+                            "rule": f.rule, "path": f.path, "line": f.line, "col": f.col,
+                            "severity": f.severity.name, "message": f.message,
+                            "fingerprint": f.fingerprint,
+                        }
+                        for f in result.findings + result.parse_errors
+                    ],
+                    "baselined": len(result.baselined),
+                    "suppressed": result.suppressed,
+                    "files": result.files,
+                },
+                indent=1,
+            )
+        )
+    else:
+        for f in result.parse_errors + result.findings:
+            print(f.format())
+        _summarize(result, elapsed, fail_on, args.quiet)
+
+    return 1 if result.failing(fail_on) else 0
+
+
+def main() -> None:
+    sys.exit(cli_main())
+
+
+if __name__ == "__main__":
+    main()

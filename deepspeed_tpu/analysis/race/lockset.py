@@ -1,0 +1,536 @@
+"""Per-class lockset model for the ds_race static pass.
+
+The analysis is class-granular because that is how the threaded runtime
+is written: every thread-crossing object in this tree (prefetcher,
+AsyncCheckpointWriter, supervision monitor, fleet supervisor, metrics
+registry, autotuner, serving scheduler) is a class holding its own
+``threading.Lock``/``RLock``/``Condition`` next to the state it guards.
+For each class we build:
+
+* **lock attributes** — ``self.X = threading.Lock()`` (or RLock /
+  Condition / a name matching ``lock|mutex|cond``) assigned anywhere in
+  the class;
+* **per-method accesses** — every ``self.attr`` read/write with the set
+  of locks held at that point.  ``with self._lock:`` scopes a lock over
+  its body; bare ``self._lock.acquire()`` / ``.release()`` pairs are
+  tracked linearly within a block.  Writes include plain/augmented
+  assignment, subscript stores (``self.d[k] = v``), and mutating method
+  calls on the attribute (``self.q.append``, ``self.d.pop``, ...);
+* **thread entry points** — methods passed as ``threading.Thread(
+  target=self.m)`` (or in ``args=``) plus methods annotated with a
+  ``# ds-race: entry`` comment on/above their ``def`` line (for
+  cross-module callers the AST cannot see: an exporter thread calling
+  ``registry.snapshot()``, the preemption watchdog calling
+  ``writer.drain()``);
+* **reachability closures** — the self-call graph, walked from the
+  entry points (thread side) and from the public surface (main-thread
+  side).  An attribute written outside ``__init__`` and touched on both
+  sides is *shared state*, the unit the rules reason about.
+
+The model is deliberately intra-class with two cross-class seams:
+``self.attr = ClassName(...)`` records a sub-object edge (used by the
+lock-order rule to chain acquisitions across e.g. router -> supervisor),
+and the entry annotation imports thread-ness from other modules.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from deepspeed_tpu.analysis.context import FileContext
+
+# Factories whose result is a lock-like object when assigned to self.X.
+_LOCK_FACTORIES = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Semaphore", "threading.BoundedSemaphore",
+    "multiprocessing.Lock", "multiprocessing.RLock",
+}
+# Fallback: attribute NAMES that read as locks even when the factory is
+# indirect (e.g. `self._cv = threading.Condition(self._lock)` via alias).
+_LOCKY_NAME = re.compile(r"(?:^|_)(?:lock|mutex|cond|cv)$", re.IGNORECASE)
+
+_ENTRY_RE = re.compile(r"#\s*ds-race:\s*entry\b")
+
+# Decorator names that mean "the body runs under self._lock" (the
+# PagedKVPool idiom: @_locked wraps the method in `with self._lock:`).
+_LOCKED_DECORATOR = re.compile(r"(?:^|_)(?:locked|synchronized)$", re.IGNORECASE)
+
+# Method calls on an attribute that mutate the receiver in place.
+_MUTATING_METHODS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "pop", "popleft", "popitem", "remove", "discard", "clear", "update",
+    "setdefault", "sort", "reverse", "put", "put_nowait",
+}
+
+# Dunders that form part of a class's public (main-thread) surface.
+_PUBLIC_DUNDERS = {
+    "__call__", "__iter__", "__next__", "__enter__", "__exit__",
+    "__len__", "__contains__", "__getitem__", "__setitem__",
+}
+
+
+@dataclass
+class Access:
+    """One ``self.<attr>`` touch with its held lockset."""
+
+    attr: str
+    write: bool
+    method: str
+    line: int
+    col: int
+    locks: FrozenSet[str]
+    rmw: bool = False  # read-modify-write (augassign / x = f(x) shape)
+
+
+@dataclass
+class Acquisition:
+    """A lock acquired at a site, with the locks already held there —
+    the edge source for the lock-order graph."""
+
+    lock: str  # dotted path relative to self ("_lock", "sup._lock")
+    held: FrozenSet[str]
+    line: int
+    col: int
+    method: str
+
+
+@dataclass
+class MethodInfo:
+    name: str
+    line: int
+    accesses: List[Access] = field(default_factory=list)
+    calls: Set[str] = field(default_factory=set)  # self.m() targets
+    # (callee, held locks, line, col); callee is "m" for self.m() or
+    # "attr.m" for self.attr.m() — the cross-class seam.
+    calls_held: List[Tuple[str, FrozenSet[str], int, int]] = field(default_factory=list)
+    acquisitions: List[Acquisition] = field(default_factory=list)
+    entry: bool = False
+    daemon_threads: List[Tuple[int, int]] = field(default_factory=list)
+    has_join: bool = False
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    path: str
+    line: int
+    lock_attrs: Set[str] = field(default_factory=set)
+    lock_kinds: Dict[str, str] = field(default_factory=dict)  # attr -> Lock/RLock/...
+    methods: Dict[str, MethodInfo] = field(default_factory=dict)
+    subobjects: Dict[str, str] = field(default_factory=dict)  # attr -> Class
+
+    # -- reachability ---------------------------------------------------
+    def closure(self, roots: Sequence[str]) -> Set[str]:
+        seen: Set[str] = set()
+        stack = [r for r in roots if r in self.methods]
+        while stack:
+            m = stack.pop()
+            if m in seen:
+                continue
+            seen.add(m)
+            stack.extend(c for c in self.methods[m].calls if c in self.methods and c not in seen)
+        return seen
+
+    def entry_methods(self) -> List[str]:
+        return sorted(m for m, info in self.methods.items() if info.entry)
+
+    def thread_reachable(self) -> Set[str]:
+        return self.closure(self.entry_methods())
+
+    def public_reachable(self) -> Set[str]:
+        roots = [
+            m for m in self.methods
+            if (not m.startswith("_")) or m in _PUBLIC_DUNDERS
+        ]
+        return self.closure(roots)
+
+    def is_lock(self, attr: str) -> bool:
+        return attr in self.lock_attrs or bool(_LOCKY_NAME.search(attr))
+
+    def inherited_locks(self) -> Dict[str, FrozenSet[str]]:
+        """Locks a PRIVATE method can assume held because every in-class
+        call site holds them (``_page_decref`` only ever runs under the
+        pool lock).  Public methods and entries inherit nothing — an
+        external caller arrives lock-free.  Fixed point over the call
+        graph so the guarantee chains through private helpers."""
+        inh: Dict[str, FrozenSet[str]] = {m: frozenset() for m in self.methods}
+        for _ in range(len(self.methods)):
+            changed = False
+            for m, info in self.methods.items():
+                if not m.startswith("_") or m == "__init__" or info.entry:
+                    continue
+                sites = [
+                    held | inh[caller]
+                    for caller, cinfo in self.methods.items() if caller != m
+                    for callee, held, _ln, _col in cinfo.calls_held
+                    if callee == m
+                ]
+                if not sites:
+                    continue
+                new = frozenset.intersection(*sites)
+                if new != inh[m]:
+                    inh[m] = new
+                    changed = True
+            if not changed:
+                break
+        return inh
+
+
+def _self_attr_path(node: ast.AST) -> Optional[str]:
+    """Dotted attribute path rooted at ``self`` ("x", "sup._lock"), or
+    None if the chain is not self-rooted."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name) and node.id == "self" and parts:
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_lock_path(cls: ClassInfo, path: str) -> bool:
+    """Is this self-rooted path a lock?  Depth-1 paths check the class's
+    known lock attrs; any depth falls back to the name heuristic on the
+    last component (so ``self.sup._lock`` still counts)."""
+    leaf = path.split(".")[-1]
+    if "." not in path and path in cls.lock_attrs:
+        return True
+    return bool(_LOCKY_NAME.search(leaf))
+
+
+class _MethodWalker:
+    """One pass over a method body, tracking the held lockset per
+    statement block.  ``with`` scoping is exact; ``acquire()``/
+    ``release()`` are tracked linearly within each block (a release in a
+    nested branch does not leak out — the common try/finally idiom is
+    modelled by the ``with`` path anyway)."""
+
+    def __init__(self, ctx: FileContext, cls: ClassInfo, info: MethodInfo):
+        self.ctx = ctx
+        self.cls = cls
+        self.info = info
+
+    # -- expression-level collection ------------------------------------
+    def _record_access(self, attr: str, write: bool, node: ast.AST,
+                       held: FrozenSet[str], rmw: bool = False) -> None:
+        head = attr.split(".")[0]
+        if self.cls.is_lock(head) or _is_lock_path(self.cls, attr):
+            return
+        if head in self.cls.methods:  # bound-method reference, not state
+            return
+        self.info.accesses.append(Access(
+            attr=head, write=write, method=self.info.name,
+            line=node.lineno, col=node.col_offset, locks=held, rmw=rmw,
+        ))
+
+    def _thread_call(self, call: ast.Call, held: FrozenSet[str]) -> None:
+        """threading.Thread(target=self.m, args=(...)) — mark entry
+        methods and daemon-ness."""
+        resolved = self.ctx.resolve(call.func) or ""
+        if not (resolved in ("threading.Thread", "threading.Timer")
+                or resolved.endswith(".Thread") or resolved.endswith(".Timer")):
+            return
+        daemon = False
+        targets: List[str] = []
+        for kw in call.keywords:
+            if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+                daemon = bool(kw.value.value)
+            if kw.arg in ("target", "function"):
+                p = _self_attr_path(kw.value)
+                if p and "." not in p:
+                    targets.append(p)
+            if kw.arg == "args" and isinstance(kw.value, (ast.Tuple, ast.List)):
+                for elt in kw.value.elts:
+                    p = _self_attr_path(elt)
+                    if p and "." not in p and p in self.cls.methods:
+                        targets.append(p)
+        for t in targets:
+            if t in self.cls.methods:
+                self.cls.methods[t].entry = True
+        if daemon:
+            self.info.daemon_threads.append((call.lineno, call.col_offset))
+
+    def _visit_expr(self, node: ast.AST, held: FrozenSet[str]) -> None:
+        """Collect reads/calls from an expression tree (no stores)."""
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                self._thread_call(sub, held)
+                if isinstance(sub.func, ast.Attribute):
+                    if sub.func.attr == "join":
+                        self.info.has_join = True
+                    path = _self_attr_path(sub.func)
+                    if path is not None:
+                        parts = path.split(".")
+                        meth = parts[-1]
+                        if len(parts) == 1:
+                            # self.m() — self-call (or callback attr)
+                            if meth in self.cls.methods:
+                                self.info.calls.add(meth)
+                                self.info.calls_held.append(
+                                    (meth, held, sub.lineno, sub.col_offset))
+                                continue
+                        elif len(parts) == 2:
+                            head = parts[0]
+                            if meth in _MUTATING_METHODS:
+                                self._record_access(head, True, sub.func, held)
+                                continue
+                            # self.attr.m() — cross-object call seam
+                            self.info.calls_held.append(
+                                (path, held, sub.lineno, sub.col_offset))
+            elif isinstance(sub, ast.Attribute) and isinstance(sub.ctx, ast.Load):
+                path = _self_attr_path(sub)
+                if path is not None and "." not in path:
+                    # only the innermost self.x of a chain reaches here
+                    # with a one-component path
+                    self._record_access(path, False, sub, held)
+
+    def _store_targets(self, target: ast.AST, held: FrozenSet[str],
+                       rmw: bool = False) -> None:
+        for sub in ast.walk(target):
+            if isinstance(sub, ast.Attribute) and isinstance(sub.ctx, ast.Store):
+                path = _self_attr_path(sub)
+                if path is not None:
+                    self._record_access(path.split(".")[0], True, sub, held, rmw=rmw)
+            elif isinstance(sub, ast.Subscript):
+                path = _self_attr_path(sub.value)
+                if path is not None:
+                    self._record_access(path.split(".")[0], True, sub, held)
+
+    # -- statement-level walk -------------------------------------------
+    def _with_locks(self, stmt: ast.With) -> List[str]:
+        out = []
+        for item in stmt.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Call):  # e.g. self._cv (called? rare) / contextlib
+                expr = expr.func
+            path = _self_attr_path(expr)
+            if path is not None and _is_lock_path(self.cls, path):
+                out.append(path)
+        return out
+
+    def _acquire_release(self, stmt: ast.stmt) -> Optional[Tuple[str, bool]]:
+        """(lock_path, acquired?) for a bare self.X.acquire()/release()
+        expression statement."""
+        if not (isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call)):
+            return None
+        func = stmt.value.func
+        if not (isinstance(func, ast.Attribute) and func.attr in ("acquire", "release")):
+            return None
+        path = _self_attr_path(func.value)
+        if path is None or not _is_lock_path(self.cls, path):
+            return None
+        return path, func.attr == "acquire"
+
+    def walk_block(self, stmts: Sequence[ast.stmt], held: FrozenSet[str]) -> None:
+        running = set(held)
+        for stmt in stmts:
+            ar = self._acquire_release(stmt)
+            if ar is not None:
+                lock, acquired = ar
+                if acquired:
+                    self.info.acquisitions.append(Acquisition(
+                        lock, frozenset(running), stmt.lineno, stmt.col_offset,
+                        self.info.name))
+                    running.add(lock)
+                else:
+                    running.discard(lock)
+                continue
+            self._walk_stmt(stmt, frozenset(running))
+
+    def _walk_stmt(self, stmt: ast.stmt, held: FrozenSet[str]) -> None:
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            locks = self._with_locks(stmt)
+            for item in stmt.items:  # evaluate context exprs outside
+                self._visit_expr(item.context_expr, held)
+            inner = set(held)
+            for lk in locks:
+                if lk not in inner:
+                    self.info.acquisitions.append(Acquisition(
+                        lk, frozenset(inner), stmt.lineno, stmt.col_offset,
+                        self.info.name))
+                inner.add(lk)
+            self.walk_block(stmt.body, frozenset(inner))
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested function: body runs later (often on another thread);
+            # analyze with an EMPTY lockset — the enclosing with-block's
+            # lock is not held when a worker thread executes it.
+            self.walk_block(stmt.body, frozenset())
+        elif isinstance(stmt, ast.Assign):
+            self._visit_expr(stmt.value, held)
+            rmw = self._is_rmw(stmt.targets, stmt.value)
+            for t in stmt.targets:
+                self._store_targets(t, held, rmw=rmw)
+        elif isinstance(stmt, ast.AugAssign):
+            self._visit_expr(stmt.value, held)
+            self._store_targets(stmt.target, held, rmw=True)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._visit_expr(stmt.value, held)
+                self._store_targets(stmt.target, held)
+        elif isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                self._store_targets(t, held)
+        elif isinstance(stmt, (ast.If, ast.While)):
+            self._visit_expr(stmt.test, held)
+            self.walk_block(stmt.body, held)
+            self.walk_block(stmt.orelse, held)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._visit_expr(stmt.iter, held)
+            self._store_targets(stmt.target, held)
+            self.walk_block(stmt.body, held)
+            self.walk_block(stmt.orelse, held)
+        elif isinstance(stmt, ast.Try):
+            self.walk_block(stmt.body, held)
+            for h in stmt.handlers:
+                self.walk_block(h.body, held)
+            self.walk_block(stmt.orelse, held)
+            self.walk_block(stmt.finalbody, held)
+        elif isinstance(stmt, (ast.Return, ast.Expr)):
+            if getattr(stmt, "value", None) is not None:
+                self._visit_expr(stmt.value, held)
+        elif isinstance(stmt, (ast.Raise, ast.Assert)):
+            for v in (getattr(stmt, "exc", None), getattr(stmt, "test", None),
+                      getattr(stmt, "msg", None), getattr(stmt, "cause", None)):
+                if v is not None:
+                    self._visit_expr(v, held)
+        # Pass/Break/Continue/Import/Global/ClassDef: nothing shared.
+
+    @staticmethod
+    def _is_rmw(targets: Sequence[ast.AST], value: ast.AST) -> bool:
+        """``self.x = <expr mentioning self.x>`` — a read-modify-write
+        even without AugAssign (e.g. ``self.x = self.x + [item]``)."""
+        names = set()
+        for t in targets:
+            p = _self_attr_path(t) if isinstance(t, ast.Attribute) else None
+            if p:
+                names.add(p.split(".")[0])
+        if not names:
+            return False
+        for sub in ast.walk(value):
+            if isinstance(sub, ast.Attribute) and isinstance(sub.ctx, ast.Load):
+                p = _self_attr_path(sub)
+                if p and p.split(".")[0] in names:
+                    return True
+        return False
+
+
+def _method_defs(cls_node: ast.ClassDef) -> List[ast.FunctionDef]:
+    return [n for n in cls_node.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+def _collect_lock_attrs(cls: ClassInfo, ctx: FileContext,
+                        cls_node: ast.ClassDef) -> None:
+    for fn in _method_defs(cls_node):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            for t in node.targets:
+                path = _self_attr_path(t) if isinstance(t, ast.Attribute) else None
+                if path is None or "." in path:
+                    continue
+                if isinstance(node.value, ast.Call):
+                    resolved = ctx.resolve(node.value.func) or ""
+                    if resolved in _LOCK_FACTORIES:
+                        cls.lock_attrs.add(path)
+                        cls.lock_kinds[path] = resolved.split(".")[-1]
+                    else:
+                        # self.attr = ClassName(...) sub-object seam
+                        leaf = resolved.split(".")[-1] if resolved else ""
+                        if leaf and leaf[0].isupper():
+                            cls.subobjects.setdefault(path, leaf)
+
+
+def _entry_annotated(ctx: FileContext, fn: ast.FunctionDef) -> bool:
+    """``# ds-race: entry`` on the ``def`` line or the line above it
+    (above-decorator placement also honoured)."""
+    lines = ctx.source.splitlines()
+    first = fn.decorator_list[0].lineno if fn.decorator_list else fn.lineno
+    for ln in (fn.lineno, first - 1, fn.lineno - 1):
+        if 0 < ln <= len(lines) and _ENTRY_RE.search(lines[ln - 1]):
+            return True
+    return False
+
+
+def collect_classes(ctx: FileContext) -> List[ClassInfo]:
+    """Build the lockset model for every top-level class in a file."""
+    out: List[ClassInfo] = []
+    for node in ctx.tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        cls = ClassInfo(name=node.name, path=ctx.path, line=node.lineno)
+        defs = _method_defs(node)
+        for fn in defs:  # register names first so self-calls resolve
+            cls.methods[fn.name] = MethodInfo(name=fn.name, line=fn.lineno)
+        _collect_lock_attrs(cls, ctx, node)
+        for fn in defs:
+            info = cls.methods[fn.name]
+            if _entry_annotated(ctx, fn):
+                info.entry = True
+            held: FrozenSet[str] = frozenset()
+            for deco in fn.decorator_list:
+                name = deco.func if isinstance(deco, ast.Call) else deco
+                leaf = name.attr if isinstance(name, ast.Attribute) else (
+                    name.id if isinstance(name, ast.Name) else "")
+                if leaf and _LOCKED_DECORATOR.search(leaf):
+                    held = frozenset({"_lock"})
+                    info.acquisitions.append(Acquisition(
+                        "_lock", frozenset(), fn.lineno, fn.col_offset, fn.name))
+            _MethodWalker(ctx, cls, info).walk_block(fn.body, held)
+        out.append(cls)
+    return out
+
+
+@dataclass
+class SharedAttr:
+    """One shared attribute and every access to it from the two
+    closures — the input to the unguarded-write / inconsistent-lockset
+    rules."""
+
+    attr: str
+    cls: ClassInfo
+    accesses: List[Access]
+    entry_methods: List[str]
+
+    @property
+    def guarded_accesses(self) -> List[Access]:
+        return [a for a in self.accesses if a.locks]
+
+
+def shared_attrs(cls: ClassInfo) -> List[SharedAttr]:
+    entries = cls.entry_methods()
+    if not entries:
+        return []
+    thread_side = cls.thread_reachable()
+    public_side = cls.public_reachable()
+
+    inherited = cls.inherited_locks()
+    by_attr: Dict[str, List[Access]] = {}
+    touched_thread: Dict[str, bool] = {}
+    touched_public: Dict[str, bool] = {}
+    written: Dict[str, bool] = {}
+    for m, info in cls.methods.items():
+        if m == "__init__" or (m not in thread_side and m not in public_side):
+            continue
+        for raw in info.accesses:
+            a = raw
+            if inherited.get(m):
+                a = Access(attr=raw.attr, write=raw.write, method=raw.method,
+                           line=raw.line, col=raw.col,
+                           locks=raw.locks | inherited[m], rmw=raw.rmw)
+            by_attr.setdefault(a.attr, []).append(a)
+            if m in thread_side:
+                touched_thread[a.attr] = True
+            if m in public_side:
+                touched_public[a.attr] = True
+            if a.write:
+                written[a.attr] = True
+
+    out: List[SharedAttr] = []
+    for attr, accesses in sorted(by_attr.items()):
+        if written.get(attr) and touched_thread.get(attr) and touched_public.get(attr):
+            out.append(SharedAttr(attr=attr, cls=cls, accesses=accesses,
+                                  entry_methods=entries))
+    return out

@@ -1,0 +1,344 @@
+"""The four ds_race rules, evaluated over the project-wide lockset
+model (every rule is project-scope: the lock-order graph crosses files,
+and keeping one scope keeps the runner trivial).
+
+Rule catalog (docs/ds_race.md has the long-form version):
+
+* ``race-unguarded-shared-write`` (A) — a shared attribute (written
+  from a thread-entry closure AND from the public surface) is written
+  with no lock held, and the write is either a read-modify-write
+  (``self.n += 1`` — the classic lost update) or the attribute is
+  guarded at *other* sites (so the unguarded site defeats them).  A
+  plain rebind of an attribute that is never guarded anywhere is NOT
+  flagged: single-word rebinds are atomic under the GIL and the tree
+  uses that idiom deliberately (e.g. ``registry.step``).
+* ``race-inconsistent-lockset`` (B) — writes are consistently guarded
+  but some write site uses a disjoint lockset, or a read runs without
+  any lock that the writers hold (a torn read across multi-field
+  updates — the registry snapshot bug).
+* ``race-lock-order-inversion`` (B) — cycle in the project-wide lock
+  acquisition graph: node = (class, lock), edge A->B when B is acquired
+  (directly, via a self-call, or via a ``self.sub.method()``
+  cross-object call) while A is held.  A self-edge on a plain ``Lock``
+  is reported too (self-deadlock); on an ``RLock``/``Condition`` it is
+  the intended re-entrancy pattern and skipped.
+* ``race-daemon-thread-no-join`` (C) — a class spawns
+  ``Thread(daemon=True)`` and no method in the class ever joins: the
+  thread's work can be vaporized at interpreter exit mid-critical-
+  section.  Often acceptable (grandfathered in the baseline) but worth
+  an explicit decision per site.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from deepspeed_tpu.analysis.context import FileContext
+from deepspeed_tpu.analysis.core import Finding, Rule, Severity
+
+from deepspeed_tpu.analysis.race.lockset import (
+    Acquisition,
+    ClassInfo,
+    SharedAttr,
+    collect_classes,
+    shared_attrs,
+)
+
+_RACE_REGISTRY: Dict[str, Rule] = {}
+
+
+def race_register(rule_id: str, tier: str, description: str):
+    def deco(fn):
+        _RACE_REGISTRY[rule_id] = Rule(
+            id=rule_id, tier=Severity.parse(tier), description=description,
+            check=fn, scope="project")
+        return fn
+    return deco
+
+
+def all_race_rules() -> Dict[str, Rule]:
+    return dict(_RACE_REGISTRY)
+
+
+@dataclass
+class RaceModel:
+    """Project-wide input to the rules: every class's lockset model plus
+    a name index for cross-class (sub-object) resolution."""
+
+    classes: List[ClassInfo] = field(default_factory=list)
+    by_name: Dict[str, List[ClassInfo]] = field(default_factory=dict)
+
+    @classmethod
+    def build(cls, contexts: List[FileContext]) -> "RaceModel":
+        model = cls()
+        for ctx in contexts:
+            for ci in collect_classes(ctx):
+                model.classes.append(ci)
+                model.by_name.setdefault(ci.name, []).append(ci)
+        return model
+
+    def resolve_subobject(self, owner: ClassInfo, attr: str) -> Optional[ClassInfo]:
+        name = owner.subobjects.get(attr)
+        if name:
+            cands = self.by_name.get(name, [])
+            return cands[0] if cands else None
+        # fallback: an attribute named after a known class ("self.router"
+        # -> Router) — covers handles handed in via __init__ params,
+        # where no ClassName(...) construction is visible to the model
+        key = attr.lstrip("_").replace("_", "").lower()
+        for cname, cands in self.by_name.items():
+            if cands and cname.lower() == key:
+                return cands[0]
+        return None
+
+
+def _finding(rule: Rule, cls: ClassInfo, line: int, col: int, message: str) -> Finding:
+    return Finding(rule=rule.id, path=cls.path, line=line, col=col + 1,
+                   message=message, severity=rule.tier)
+
+
+def _fmt_locks(locks: FrozenSet[str]) -> str:
+    return "{" + ", ".join(sorted(locks)) + "}" if locks else "{}"
+
+
+# ---------------------------------------------------------------------------
+# race-unguarded-shared-write (A)
+# ---------------------------------------------------------------------------
+@race_register(
+    "race-unguarded-shared-write", "A",
+    "shared attribute written without a lock (lost update / defeats other "
+    "guarded sites)")
+def check_unguarded_shared_write(rule: Rule, model: RaceModel) -> List[Finding]:
+    out: List[Finding] = []
+    for cls in model.classes:
+        for sa in shared_attrs(cls):
+            guarded_elsewhere = bool(sa.guarded_accesses)
+            entries = ", ".join(f"{m}()" for m in sa.entry_methods)
+            for a in sa.accesses:
+                if not a.write or a.locks:
+                    continue
+                if a.rmw:
+                    why = "a read-modify-write (lost update under a context switch)"
+                elif guarded_elsewhere:
+                    why = ("unguarded while other sites hold "
+                           + _fmt_locks(next(iter(sa.guarded_accesses)).locks))
+                else:
+                    continue  # plain rebind, never guarded anywhere: GIL-atomic idiom
+                out.append(_finding(
+                    rule, cls, a.line, a.col,
+                    f"'{cls.name}.{sa.attr}' is shared with thread entry "
+                    f"point(s) {entries} but written lock-free in "
+                    f"{a.method}(): {why}"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# race-inconsistent-lockset (B)
+# ---------------------------------------------------------------------------
+@race_register(
+    "race-inconsistent-lockset", "B",
+    "accesses to a shared attribute disagree on which lock guards it "
+    "(torn read or split-brain locking)")
+def check_inconsistent_lockset(rule: Rule, model: RaceModel) -> List[Finding]:
+    out: List[Finding] = []
+    for cls in model.classes:
+        for sa in shared_attrs(cls):
+            writes = [a for a in sa.accesses if a.write]
+            if not writes or any(not a.locks for a in writes):
+                continue  # unguarded writes are rule-A territory
+            common: Optional[FrozenSet[str]] = None
+            for a in writes:
+                common = a.locks if common is None else common & a.locks
+            if not common:
+                # writers disagree among themselves: flag the minority
+                counts: Dict[FrozenSet[str], int] = {}
+                for a in writes:
+                    counts[a.locks] = counts.get(a.locks, 0) + 1
+                majority = max(counts, key=lambda k: (counts[k], sorted(k)))
+                seen: Set[Tuple[str, str]] = set()
+                for a in writes:
+                    if a.locks != majority and (sa.attr, a.method) not in seen:
+                        seen.add((sa.attr, a.method))
+                        out.append(_finding(
+                            rule, cls, a.line, a.col,
+                            f"'{cls.name}.{sa.attr}': write in {a.method}() "
+                            f"holds {_fmt_locks(a.locks)} but the majority of "
+                            f"writes hold {_fmt_locks(majority)} — the two "
+                            f"locksets do not exclude each other"))
+                continue
+            # consistent writers; flag reads that skip the guarding lock
+            seen_rm: Set[Tuple[str, str]] = set()
+            for a in sa.accesses:
+                if a.write or (a.locks & common) or (sa.attr, a.method) in seen_rm:
+                    continue
+                seen_rm.add((sa.attr, a.method))
+                out.append(_finding(
+                    rule, cls, a.line, a.col,
+                    f"'{cls.name}.{sa.attr}' is read in {a.method}() without "
+                    f"{_fmt_locks(common)}, which every write site holds — a "
+                    f"concurrent writer can expose a torn/mid-update value"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# race-lock-order-inversion (B)
+# ---------------------------------------------------------------------------
+def _may_acquire(cls: ClassInfo, method: str) -> List[Acquisition]:
+    """Direct acquisitions of ``method`` plus those of every same-class
+    callee (transitively)."""
+    out: List[Acquisition] = []
+    for m in sorted(cls.closure([method])):
+        out.extend(cls.methods[m].acquisitions)
+    return out
+
+
+def _lock_node(model: RaceModel, cls: ClassInfo, lock_path: str) -> Tuple[str, str]:
+    """(owner class, lock leaf) for a self-rooted lock path; a dotted
+    path like ``sup._lock`` maps to the sub-object's class when known."""
+    parts = lock_path.split(".")
+    if len(parts) > 1:
+        owner = model.resolve_subobject(cls, parts[0])
+        return ((owner.name if owner else f"{cls.name}.{parts[0]}"), parts[-1])
+    return (cls.name, lock_path)
+
+
+@race_register(
+    "race-lock-order-inversion", "B",
+    "cycle in the lock acquisition graph (potential ABBA deadlock)")
+def check_lock_order_inversion(rule: Rule, model: RaceModel) -> List[Finding]:
+    Node = Tuple[str, str]
+    edges: Dict[Node, Dict[Node, Tuple[ClassInfo, int, int]]] = {}
+
+    def add_edge(src: Node, dst: Node, cls: ClassInfo, line: int, col: int) -> None:
+        edges.setdefault(src, {}).setdefault(dst, (cls, line, col))
+
+    for cls in model.classes:
+        for info in cls.methods.values():
+            # direct nested acquisitions
+            for acq in info.acquisitions:
+                dst = _lock_node(model, cls, acq.lock)
+                for h in acq.held:
+                    add_edge(_lock_node(model, cls, h), dst, cls, acq.line, acq.col)
+            # calls made while holding a lock: the callee's acquisitions
+            # (same class, or a sub-object's class) happen under it
+            for callee, held, line, col in info.calls_held:
+                if not held:
+                    continue
+                if "." in callee:
+                    attr, meth = callee.split(".", 1)
+                    target = model.resolve_subobject(cls, attr)
+                else:
+                    meth = callee
+                    target = cls
+                if target is None or meth not in target.methods:
+                    continue
+                for acq in _may_acquire(target, meth):
+                    dst = _lock_node(model, target, acq.lock)
+                    for h in held:
+                        add_edge(_lock_node(model, cls, h), dst, cls, line, col)
+
+    # drop self-edges on re-entrant primitives (the intended pattern)
+    for src in list(edges):
+        if src in edges[src]:
+            owner_cls, leaf = src
+            kinds = {
+                ci.lock_kinds.get(leaf)
+                for ci in model.by_name.get(owner_cls, [])
+            }
+            if kinds & {"RLock", "Condition", "Semaphore", "BoundedSemaphore"}:
+                del edges[src][src]
+
+    # Tarjan SCC: any SCC of size > 1, or a surviving self-edge, is a cycle
+    index: Dict[Node, int] = {}
+    low: Dict[Node, int] = {}
+    onstack: Set[Node] = set()
+    stack: List[Node] = []
+    sccs: List[List[Node]] = []
+    counter = [0]
+
+    def strongconnect(v: Node) -> None:
+        work = [(v, iter(sorted(edges.get(v, {}))))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        onstack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    onstack.add(w)
+                    work.append((w, iter(sorted(edges.get(w, {})))))
+                    advanced = True
+                    break
+                elif w in onstack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    onstack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                sccs.append(comp)
+
+    for v in sorted(edges):
+        if v not in index:
+            strongconnect(v)
+
+    out: List[Finding] = []
+    for comp in sccs:
+        comp_set = set(comp)
+        cyclic = len(comp) > 1 or (comp[0] in edges.get(comp[0], {}))
+        if not cyclic:
+            continue
+        # anchor the finding at the smallest edge site inside the SCC
+        sites = [
+            edges[a][b] for a in comp for b in edges.get(a, {})
+            if b in comp_set
+        ]
+        cls, line, col = min(sites, key=lambda s: (s[0].path, s[1], s[2]))
+        path = " -> ".join(f"{c}.{l}" for c, l in sorted(comp_set)) or "?"
+        out.append(_finding(
+            rule, cls, line, col,
+            f"lock acquisition cycle {path} -> (back): two threads taking "
+            f"these locks in opposing order can deadlock"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# race-daemon-thread-no-join (C)
+# ---------------------------------------------------------------------------
+@race_register(
+    "race-daemon-thread-no-join", "C",
+    "daemon thread spawned by a class that never joins it")
+def check_daemon_no_join(rule: Rule, model: RaceModel) -> List[Finding]:
+    out: List[Finding] = []
+    for cls in model.classes:
+        if any(info.has_join for info in cls.methods.values()):
+            continue
+        spawns = [
+            (line, col, info.name)
+            for info in cls.methods.values()
+            for line, col in info.daemon_threads
+        ]
+        if not spawns:
+            continue
+        line, col, method = min(spawns)
+        out.append(_finding(
+            rule, cls, line, col,
+            f"{cls.name}.{method}() spawns Thread(daemon=True) and no "
+            f"method of the class joins it — interpreter exit can kill it "
+            f"mid-critical-section (join in close()/stop(), or suppress "
+            f"with a comment explaining the ownership)"))
+    return out

@@ -1,0 +1,84 @@
+"""ds_race runner: parse (shared with ds_lint) -> lockset model ->
+race rules -> suppression + baseline filtering.
+
+``race_paths`` mirrors ``lint_paths`` exactly — same LintResult shape,
+same fingerprint/baseline semantics — so the CLI, CI gate, and tests
+can treat the two tools interchangeably.  The baseline lives next to
+ds_lint's as ``.ds_race_baseline.json``.
+"""
+from __future__ import annotations
+
+import os
+from typing import Iterable, List, Optional, Sequence, Set
+
+from deepspeed_tpu.analysis import baseline as baseline_mod
+from deepspeed_tpu.analysis.context import ProjectContext
+from deepspeed_tpu.analysis.core import Finding
+from deepspeed_tpu.analysis.runner import LintResult, parse_files
+from deepspeed_tpu.analysis.race.rules import RaceModel, all_race_rules
+
+RACE_BASELINE_NAME = ".ds_race_baseline.json"
+
+
+def _select_rules(select: Optional[Iterable[str]], disable: Optional[Iterable[str]]):
+    rules = all_race_rules()
+    if select:
+        unknown = set(select) - set(rules)
+        if unknown:
+            raise KeyError(f"unknown rule(s): {sorted(unknown)}")
+        rules = {rid: r for rid, r in rules.items() if rid in set(select)}
+    if disable:
+        unknown = set(disable) - set(all_race_rules())
+        if unknown:
+            raise KeyError(f"unknown rule(s): {sorted(unknown)}")
+        rules = {rid: r for rid, r in rules.items() if rid not in set(disable)}
+    return rules
+
+
+def race_paths(
+    paths: Sequence[str],
+    select: Optional[Iterable[str]] = None,
+    disable: Optional[Iterable[str]] = None,
+    baseline_path: Optional[str] = None,
+    use_baseline: bool = True,
+) -> LintResult:
+    result = LintResult()
+
+    contexts, sources = parse_files(paths, result)
+    by_path = {fc.path: fc for fc in contexts}
+
+    root = os.path.commonpath([os.path.abspath(p) for p in paths]) if paths else os.getcwd()
+    if os.path.isfile(root):
+        root = os.path.dirname(root)
+    # ProjectContext kept for parity/debugging even though race rules
+    # consume the prebuilt lockset model instead of raw contexts.
+    ProjectContext(root=root, files=contexts)
+
+    model = RaceModel.build(contexts)
+    raw: List[Finding] = []
+    for rule in _select_rules(select, disable).values():
+        raw.extend(rule.check(rule, model))
+
+    live: List[Finding] = []
+    for f in raw:
+        fc = by_path.get(f.path)
+        if fc is not None and fc.suppressions.is_suppressed(f.rule, f.line):
+            result.suppressed += 1
+        else:
+            live.append(f)
+
+    if baseline_path is None and use_baseline:
+        baseline_path = baseline_mod.discover(paths, name=RACE_BASELINE_NAME)
+    result.baseline_path = baseline_path
+    fp_root = os.path.dirname(os.path.abspath(baseline_path)) if baseline_path else root
+    baseline_mod.assign_fingerprints(live, fp_root, sources)
+
+    known: Set[str] = set()
+    if use_baseline and baseline_path and os.path.isfile(baseline_path):
+        known = baseline_mod.load(baseline_path)
+    for f in live:
+        (result.baselined if f.fingerprint in known else result.findings).append(f)
+
+    result.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    result.baselined.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return result

@@ -1,0 +1,487 @@
+"""ds_race --stress: schedule-perturbing race scenarios.
+
+Static lockset analysis proves discipline; this module tries to break
+it.  Each scenario drives a real threaded subsystem (metrics registry,
+async checkpoint writer, fleet supervisor, paged KV pool) from multiple
+threads while a seeded :class:`FaultInjector` injects ``race.yield``
+(drop the GIL) and ``race.stall`` (hold a window open ~0.2ms) at
+instrumented lock sites — then asserts the subsystem's invariants.  A
+single seed is one schedule; the harness sweeps 50+ seeds so the
+interleaving space actually gets explored (CPython's ~5ms switch
+interval would otherwise hide almost every window).
+
+Instrumentation is :func:`instrument`: replace an object's ``_lock``
+with a :class:`TracedLock` that funnels every acquire/release through
+``faults.check_race`` under a scenario-chosen site name.  Plans target
+``<site>.acquire`` (before the lock — widens lock-contention windows)
+and ``<site>.held`` (just after acquiring and just before releasing —
+stretches critical sections), or the ``race.*`` catch-all.
+
+``must_fire`` scenarios invert the verdict: they drive a DELIBERATELY
+unguarded fixture and pass only when the harness detects the lost
+update — the seeded RED test proving the perturbation machinery can
+actually catch a race (CI gates on it).
+"""
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from deepspeed_tpu.resilience import faults
+from deepspeed_tpu.resilience.faults import FaultInjector, InjectedFault
+
+
+class TracedLock:
+    """Wraps a ``Lock``/``RLock`` so every acquire/release crosses a
+    ``check_race`` perturbation point.  Re-entrancy, ``with``, and any
+    extra methods delegate to the wrapped primitive."""
+
+    def __init__(self, inner: Any, site: str):
+        self._inner = inner
+        self.site = site
+
+    def acquire(self, *args, **kwargs):
+        faults.check_race(self.site + ".acquire")
+        got = self._inner.acquire(*args, **kwargs)
+        faults.check_race(self.site + ".held")
+        return got
+
+    def release(self):
+        faults.check_race(self.site + ".held")
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc_info):
+        self.release()
+        return False
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def instrument(obj: Any, attr: str = "_lock", site: str = "race.lock") -> TracedLock:
+    """Swap ``obj.<attr>`` for a TracedLock (idempotent)."""
+    inner = getattr(obj, attr)
+    if isinstance(inner, TracedLock):
+        return inner
+    traced = TracedLock(inner, site)
+    setattr(obj, attr, traced)
+    return traced
+
+
+def default_injector(seed: int) -> FaultInjector:
+    """The standard perturbation plan: yield at every race site with
+    p=0.25.  Scenarios layer exact-site stalls on top."""
+    inj = FaultInjector(seed=seed)
+    inj.race_yield("race.*", probability=0.25)
+    return inj
+
+
+def _run_threads(fns: Sequence[Callable[[], None]], timeout: float = 30.0) -> None:
+    """Run each fn on its own thread; re-raise the first failure."""
+    errors: List[BaseException] = []
+
+    def guarded(fn):
+        try:
+            fn()
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=guarded, args=(fn,), daemon=True)
+               for fn in fns]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+    if any(t.is_alive() for t in threads):
+        raise AssertionError("scenario wedged: worker thread did not finish")
+    if errors:
+        raise errors[0]
+
+
+# ---------------------------------------------------------------------------
+# scenario registry
+# ---------------------------------------------------------------------------
+@dataclass
+class Scenario:
+    name: str
+    fn: Callable[[int, FaultInjector], None]
+    description: str
+    must_fire: bool = False  # passes only if >= 1 seed BREAKS the invariant
+    requires_jax: bool = False
+
+
+_SCENARIOS: Dict[str, Scenario] = {}
+
+
+def scenario(name: str, description: str, must_fire: bool = False,
+             requires_jax: bool = False):
+    def deco(fn):
+        _SCENARIOS[name] = Scenario(name=name, fn=fn, description=description,
+                                    must_fire=must_fire, requires_jax=requires_jax)
+        return fn
+    return deco
+
+
+def all_scenarios() -> Dict[str, Scenario]:
+    return dict(_SCENARIOS)
+
+
+# ---------------------------------------------------------------------------
+# scenarios
+# ---------------------------------------------------------------------------
+@scenario(
+    "registry-snapshot-under-publish",
+    "export thread snapshots while two threads publish + get-or-create; "
+    "asserts untorn histogram snapshots, one handle per key, exact counts")
+def _registry_snapshot_under_publish(seed: int, inj: FaultInjector) -> None:
+    from deepspeed_tpu.telemetry.registry import MetricsRegistry
+
+    inj.race_stall("race.metric.h.lock.held", seconds=2e-4, probability=0.15)
+    reg = MetricsRegistry(enabled=True, ring=64)
+    c = reg.counter("stress/events")
+    g = reg.gauge("stress/depth")
+    h = reg.histogram("stress/lat")
+    instrument(reg, "_lock", "race.registry.lock")
+    instrument(c, "_lock", "race.metric.c.lock")
+    instrument(g, "_lock", "race.metric.g.lock")
+    instrument(h, "_lock", "race.metric.h.lock")
+
+    N = 120
+    stop = threading.Event()
+
+    def publish_a():
+        for i in range(N):
+            c.inc()
+            h.observe((i % 7) + 0.5)
+            g.set(float(i))
+
+    def publish_b():
+        for i in range(N):
+            c.inc(2.0)
+            # get-or-create under churn: the same key must yield the
+            # SAME object (two handles would silently split the count)
+            assert reg.counter("stress/events") is c, "get-or-create split"
+            reg.histogram("stress/other").observe(1.0)
+
+    def export():
+        while not stop.is_set():
+            snap = reg.snapshot()
+            for m in snap["metrics"]:
+                if m["kind"] == "histogram" and m["count"]:
+                    assert m["min"] is not None and m["max"] is not None, (
+                        f"torn histogram snapshot: {m}")
+                    lo = m["count"] * m["min"] - 1e-6
+                    hi = m["count"] * m["max"] + 1e-6
+                    assert lo <= m["sum"] <= hi, f"torn histogram snapshot: {m}"
+            reg.snapshot_compact()
+
+    exporter_errors: List[BaseException] = []
+
+    def export_guarded():
+        try:
+            export()
+        except BaseException as e:  # noqa: BLE001
+            exporter_errors.append(e)
+
+    exporter = threading.Thread(target=export_guarded, daemon=True)
+    exporter.start()
+    try:
+        _run_threads([publish_a, publish_b])
+    finally:
+        stop.set()
+        exporter.join(10)
+    if exporter_errors:
+        raise exporter_errors[0]
+    assert c.value == N * 1.0 + N * 2.0, f"lost counter increments: {c.value}"
+    assert h.count == N, f"lost histogram observations: {h.count}"
+
+
+@scenario(
+    "async-save-while-preemption",
+    "preemption watchdog drains concurrently with the trainer's "
+    "submit/drain loop; asserts each save is accounted exactly once")
+def _async_save_while_preemption(seed: int, inj: FaultInjector) -> None:
+    from deepspeed_tpu.runtime.overlap.async_writer import AsyncCheckpointWriter
+
+    inj.race_stall("race.ckpt.commit", seconds=3e-4, probability=0.3)
+    writer = AsyncCheckpointWriter(drain_timeout_seconds=10.0)
+    instrument(writer, "_lock", "race.ckpt.lock")
+    rng = random.Random(seed)
+
+    K, fail_every = 10, 4
+
+    def commit_ok():
+        faults.check_race("race.ckpt.commit")
+
+    def commit_bad():
+        faults.check_race("race.ckpt.commit")
+        raise InjectedFault("injected commit failure")
+
+    stop = threading.Event()
+
+    def watchdog():
+        while not stop.is_set():
+            writer.drain()
+
+    wd_errors: List[BaseException] = []
+
+    def watchdog_guarded():
+        try:
+            watchdog()
+        except BaseException as e:  # noqa: BLE001
+            wd_errors.append(e)
+
+    wd = threading.Thread(target=watchdog_guarded, daemon=True)
+    wd.start()
+    submitted = expected_failed = 0
+    try:
+        for i in range(K):
+            bad = i % fail_every == fail_every - 1
+            while True:
+                try:
+                    writer.submit(f"tag-{i}", f"/nonexistent/tag-{i}",
+                                  commit_bad if bad else commit_ok)
+                    submitted += 1
+                    expected_failed += 1 if bad else 0
+                    break
+                except RuntimeError:  # still in flight: trainer drains
+                    writer.drain()
+            if rng.random() < 0.5:
+                writer.drain()
+        writer.drain()
+    finally:
+        stop.set()
+        wd.join(10)
+    if wd_errors:
+        raise wd_errors[0]
+    writer.drain()  # final sweep in case the watchdog lost the last transition
+    total = writer.completed + writer.failed
+    assert total == submitted, (
+        f"save accounting raced: completed({writer.completed}) + "
+        f"failed({writer.failed}) != submitted({submitted})")
+    assert writer.failed == expected_failed, (
+        f"failed={writer.failed}, expected {expected_failed}")
+
+
+@scenario(
+    "fleet-route-while-background-restart",
+    "router keeps handling deaths while N background restart threads "
+    "complete; asserts every restart is delivered exactly once")
+def _fleet_route_while_restart(seed: int, inj: FaultInjector) -> None:
+    from deepspeed_tpu.serving.fleet.supervisor import (
+        RESTART_PENDING,
+        ReplicaSupervisor,
+    )
+
+    inj.race_stall("race.fleet.restart", seconds=2e-4, probability=0.3)
+
+    class _Replica:
+        def __init__(self, name: str, fail: bool):
+            self.name = name
+            self.fail = fail
+
+        def restart(self):
+            faults.check_race("race.fleet.restart")
+            if self.fail:
+                raise InjectedFault("injected restart failure")
+            return []
+
+    sup = ReplicaSupervisor(max_restarts=3, seed=seed,
+                            sleep=lambda s: None, background=True)
+    instrument(sup, "_lock", "race.fleet.lock")
+    K = 20
+    replicas = [_Replica(f"r{i}", fail=(i % 5 == 4)) for i in range(K)]
+
+    def router():
+        for r in replicas:
+            assert sup.handle_death(r, "injected death") is RESTART_PENDING
+
+    rt = threading.Thread(target=router, daemon=True)
+    rt.start()
+    done: List[Any] = []
+    deadline = time.monotonic() + 20
+    while len(done) < K and time.monotonic() < deadline:
+        done.extend(sup.drain_completed())
+        time.sleep(0)
+    rt.join(10)
+    done.extend(sup.drain_completed())
+    assert not rt.is_alive(), "router wedged"
+    assert len(done) == K and not sup.pending(), (
+        f"lost restart completions: {len(done)}/{K}")
+    names = sorted(r.name for r, _ in done)
+    assert names == sorted(r.name for r in replicas), "duplicate/missing delivery"
+    ok = sum(1 for _, replayed in done if replayed is not None)
+    expected_ok = sum(1 for r in replicas if not r.fail)
+    assert sup.restarts == ok == expected_ok, (
+        f"restart counter raced: counter={sup.restarts} delivered={ok} "
+        f"expected={expected_ok}")
+
+
+@scenario(
+    "prefix-index-insert-under-evict",
+    "two threads alloc/learn/retire against a small paged pool so prefix "
+    "inserts race TTL eviction pressure; asserts no refcount underflow "
+    "or double free",
+    requires_jax=True)
+def _prefix_insert_under_evict(seed: int, inj: FaultInjector) -> None:
+    import numpy as np
+
+    from deepspeed_tpu.serving.kvcache.pages import PagedKVPool
+
+    inj.race_stall("race.kvpool.lock.acquire", seconds=2e-4, probability=0.1)
+
+    class _Req:
+        def __init__(self, rid, prompt, max_new=4):
+            self.request_id = rid
+            self.prompt = prompt
+            self.max_new_tokens = max_new
+            self.prefill_pos = 0
+            self.prefix_hint = 0
+            self.slot = None
+
+    pool = PagedKVPool(n_layer=1, num_slots=4, heads=1, max_len=16,
+                       head_dim=4, kv_dtype=np.float32, page_len=4,
+                       num_pages=24)
+    instrument(pool, "_lock", "race.kvpool.lock")
+    base = list(range(1, 12))
+
+    def worker(wid: int) -> None:
+        rng = random.Random(seed * 100 + wid)
+        now = float(wid)
+        for i in range(30):
+            now += 1.0
+            plen = 4 + rng.randrange(5)
+            req = _Req((wid, i), np.asarray(base[:plen], np.int32))
+            slot = pool.alloc_request(req, now=now)
+            if slot is None:
+                continue  # page churn; the scheduler would requeue
+            req.slot = slot
+            pool.consume_cow(slot)
+            pool.learn_prefix(req, now=now)
+            pool.prefix_hint_tokens(np.asarray(base[:plen], np.int32))
+            # a SlotPoolError here IS the bug (double free / underflow)
+            pool.retire(slot, None, now=now)
+
+    _run_threads([partial(worker, 0), partial(worker, 1)])
+    assert pool.free_slots == pool.num_slots, "slot leaked across retire"
+    for entry in pool.index.evict_candidates():
+        for p in entry.pages:
+            assert pool.refcount(p) >= 1, (
+                f"page {p} held by the prefix index has refcount "
+                f"{pool.refcount(p)}")
+
+
+@scenario(
+    "fixture-torn-counter",
+    "DELIBERATELY unguarded read-modify-write; the harness must observe "
+    "a lost update under at least one seed (the dynamic RED gate)",
+    must_fire=True)
+def _fixture_torn_counter(seed: int, inj: FaultInjector) -> None:
+    class _TornCounter:
+        """The racy fixture: the yield between read and write-back is
+        exactly the window ``race.yield`` schedules another bump into."""
+
+        def __init__(self):
+            self.value = 0
+
+        def bump(self):
+            v = self.value
+            faults.check_race("race.fixture.torn")
+            self.value = v + 1
+
+    torn = _TornCounter()
+    N = 200
+
+    def bumper():
+        for _ in range(N):
+            torn.bump()
+
+    _run_threads([bumper, bumper])
+    assert torn.value == 2 * N, f"lost {2 * N - torn.value} update(s)"
+
+
+# ---------------------------------------------------------------------------
+# harness
+# ---------------------------------------------------------------------------
+def _plan_with_seed(plan_spec: str, seed: int) -> FaultInjector:
+    import json
+
+    doc = json.loads(plan_spec)
+    doc["seed"] = seed
+    return FaultInjector.from_plan(json.dumps(doc))
+
+
+def run_stress(
+    seeds: int = 50,
+    names: Optional[Sequence[str]] = None,
+    plan_spec: Optional[str] = None,
+    include_must_fire: bool = True,
+) -> Dict[str, Any]:
+    """Sweep every (selected) scenario across ``seeds`` schedules.
+    Returns the report dict the CLI renders/JSON-dumps.  A normal
+    scenario is ok when NO seed fails; a must_fire scenario is ok when
+    at least one seed fails (detection works)."""
+    picked = all_scenarios()
+    if names is not None:
+        unknown = set(names) - set(picked)
+        if unknown:
+            raise KeyError(f"unknown scenario(s): {sorted(unknown)}")
+        picked = {n: s for n, s in picked.items() if n in set(names)}
+    report: Dict[str, Any] = {"seeds": seeds, "scenarios": []}
+    # scenarios inject faults on purpose; the runtime's WARNING/ERROR
+    # lines about them would print seeds × scenarios times
+    ds_logger = logging.getLogger("deepspeed_tpu")
+    saved_level = ds_logger.level
+    ds_logger.setLevel(logging.CRITICAL)
+    try:
+        _run_scenarios(picked, seeds, plan_spec, include_must_fire, report)
+    finally:
+        ds_logger.setLevel(saved_level)
+    report["ok"] = all(e["ok"] for e in report["scenarios"])
+    return report
+
+
+def _run_scenarios(picked, seeds, plan_spec, include_must_fire, report) -> None:
+    for name in sorted(picked):
+        sc = picked[name]
+        entry: Dict[str, Any] = {
+            "name": name, "must_fire": sc.must_fire, "failures": [],
+            "skipped": None,
+        }
+        if sc.must_fire and not include_must_fire:
+            entry["skipped"] = "must-fire fixture excluded"
+            entry["ok"] = True
+            report["scenarios"].append(entry)
+            continue
+        if sc.requires_jax:
+            try:
+                import jax  # noqa: F401
+            except Exception:  # pragma: no cover - jax-less environment
+                entry["skipped"] = "jax unavailable"
+                entry["ok"] = True
+                report["scenarios"].append(entry)
+                continue
+        t0 = time.monotonic()
+        for seed in range(seeds):
+            inj = (_plan_with_seed(plan_spec, seed) if plan_spec
+                   else default_injector(seed))
+            try:
+                with inj:
+                    sc.fn(seed, inj)
+            except AssertionError as e:
+                entry["failures"].append({"seed": seed, "error": str(e)})
+            except Exception as e:  # noqa: BLE001 — a crash is a failure too
+                entry["failures"].append({"seed": seed, "error": repr(e)})
+        entry["elapsed_s"] = round(time.monotonic() - t0, 3)
+        entry["ok"] = (bool(entry["failures"]) if sc.must_fire
+                       else not entry["failures"])
+        report["scenarios"].append(entry)

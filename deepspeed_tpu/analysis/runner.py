@@ -66,16 +66,11 @@ def _select_rules(select: Optional[Iterable[str]], disable: Optional[Iterable[st
     return rules
 
 
-def lint_paths(
-    paths: Sequence[str],
-    select: Optional[Iterable[str]] = None,
-    disable: Optional[Iterable[str]] = None,
-    baseline_path: Optional[str] = None,
-    use_baseline: bool = True,
-) -> LintResult:
-    result = LintResult()
-
-    # -- parse ---------------------------------------------------------
+def parse_files(paths: Sequence[str], result: LintResult) -> tuple:
+    """Read + parse every .py under ``paths`` into FileContexts,
+    recording unreadable/unparseable files as tier-A ``parse-error``
+    findings on ``result``.  Shared by ds_lint and ds_race (the race
+    runner reuses the whole parse stage, then runs its own rules)."""
     contexts: List[FileContext] = []
     sources: Dict[str, str] = {}
     for path in collect_py_files(paths):
@@ -95,6 +90,20 @@ def lint_paths(
                 Finding("parse-error", path, e.lineno or 1, 1, f"syntax error: {e.msg}", Severity.A)
             )
     result.files = len(contexts)
+    return contexts, sources
+
+
+def lint_paths(
+    paths: Sequence[str],
+    select: Optional[Iterable[str]] = None,
+    disable: Optional[Iterable[str]] = None,
+    baseline_path: Optional[str] = None,
+    use_baseline: bool = True,
+) -> LintResult:
+    result = LintResult()
+
+    # -- parse ---------------------------------------------------------
+    contexts, sources = parse_files(paths, result)
     by_path = {fc.path: fc for fc in contexts}
 
     # -- run rules -----------------------------------------------------

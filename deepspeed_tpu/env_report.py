@@ -683,6 +683,66 @@ def bench_history_report() -> None:
         print(f"{name} " + "." * (30 - len(name)) + f" {value}")
 
 
+def analysis_report() -> None:
+    """Static-analysis suite rows: per-tool rule counts, checked-in
+    baseline sizes, and a live ds_race self-run (cheap — AST-only, no
+    jax import) so drift from the baseline shows up in the report
+    (docs/ds_lint.md / docs/ds_san.md / docs/ds_race.md)."""
+    import json
+    import time
+
+    from deepspeed_tpu.analysis.baseline import BASELINE_NAME
+    from deepspeed_tpu.analysis.core import Severity, all_rules
+    from deepspeed_tpu.analysis.race import (
+        RACE_BASELINE_NAME, all_race_rules, race_paths,
+    )
+    from deepspeed_tpu.analysis.race.stress import all_scenarios
+    from deepspeed_tpu.analysis.sanitizer.cli import SAN_BASELINE_NAME
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def baseline_size(name: str) -> str:
+        path = os.path.join(root, name)
+        if not os.path.exists(path):
+            return "no baseline"
+        try:
+            with open(path) as f:
+                return f"{len(json.load(f)['findings'])} grandfathered"
+        except (OSError, ValueError, KeyError) as e:
+            return f"baseline unreadable ({e})"
+
+    def tiers(rules) -> str:
+        counts = {t: sum(1 for r in rules.values() if r.tier == t)
+                  for t in (Severity.A, Severity.B, Severity.C)}
+        return "/".join(f"{counts[t]}{t.name}" for t in (Severity.A, Severity.B, Severity.C))
+
+    lint_rules, race_rules = all_rules(), all_race_rules()
+    print()
+    print("analysis suite:")
+    rows = [
+        ("ds_lint", f"{len(lint_rules)} rule(s) ({tiers(lint_rules)}), "
+                    f"{baseline_size(BASELINE_NAME)}"),
+        ("ds_san", f"runtime checkers (see sanitizer section), "
+                   f"{baseline_size(SAN_BASELINE_NAME)}"),
+        ("ds_race", f"{len(race_rules)} rule(s) ({tiers(race_rules)}) + "
+                    f"{len(all_scenarios())} stress scenario(s), "
+                    f"{baseline_size(RACE_BASELINE_NAME)}"),
+    ]
+    t0 = time.monotonic()
+    try:
+        res = race_paths([os.path.join(root, "deepspeed_tpu")])
+        new = len(res.findings) + len(res.parse_errors)
+        status = (f"{GREEN}GREEN{END}" if new == 0
+                  else f"{RED}RED{END} ({new} unbaselined finding(s))")
+        rows.append(("ds_race self-run",
+                     f"{status} over {res.files} file(s) in "
+                     f"{time.monotonic() - t0:.1f}s"))
+    except Exception as e:  # noqa: BLE001 — a report must not crash the report
+        rows.append(("ds_race self-run", f"{RED}failed{END}: {e!r}"))
+    for name, value in rows:
+        print(f"{name} " + "." * (30 - len(name)) + f" {value}")
+
+
 def cli_main() -> int:
     ok = op_report()
     debug_report()
@@ -694,6 +754,7 @@ def cli_main() -> int:
     serving_report()
     telemetry_report()
     kernels_report()
+    analysis_report()
     bench_history_report()
     return 0 if ok else 1
 

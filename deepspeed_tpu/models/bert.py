@@ -137,7 +137,9 @@ def init_params_device(cfg: BertConfig, seed: int = 0, dtype=jnp.float32):
             "nsp_b": z(2),
         }
 
-    return jax.jit(build)(jax.random.PRNGKey(seed))
+    # out_shardings=None: init params land unsharded; the engine shards
+    # them on first scoped step (docs/ds_lint.md, bare-jit)
+    return jax.jit(build, out_shardings=None)(jax.random.PRNGKey(seed))
 
 
 def tp_spec_fn(path: str, shape) -> Optional[P]:

@@ -209,7 +209,9 @@ def init_params_device(cfg: GPT2Config, seed: int = 0, dtype=jnp.float32):
             "lnf_b": z(d),
         }
 
-    return jax.jit(build)(jax.random.PRNGKey(seed))
+    # out_shardings=None: init params land unsharded; the engine shards
+    # them on first scoped step (docs/ds_lint.md, bare-jit)
+    return jax.jit(build, out_shardings=None)(jax.random.PRNGKey(seed))
 
 
 def tp_spec_fn(path: str, shape) -> Optional[P]:

@@ -252,7 +252,7 @@ class Autotuner:
             logger.warning(f"kernel autotune cache write failed ({e}); tuning not persisted")
 
     # -- lookup ----------------------------------------------------------
-    def lookup(self, fp: str) -> Optional[Dict[str, int]]:
+    def lookup(self, fp: str) -> Optional[Dict[str, int]]:  # ds-race: entry
         """Cached blocks for a fingerprint, or None.  Mode ``off`` never
         consults the cache (pure defaults — the CI determinism story)."""
         if self.mode == "off":
@@ -272,7 +272,7 @@ class Autotuner:
             self.misses += 1
             return None
 
-    def blocks_for(self, kind: str, **key: Any) -> Dict[str, int]:
+    def blocks_for(self, kind: str, **key: Any) -> Dict[str, int]:  # ds-race: entry
         """The trace-time entry point: cached winner when one exists,
         else the defaults table.  Never measures, never raises."""
         try:
@@ -298,7 +298,7 @@ class Autotuner:
                 self._lru.popitem(last=False)
             self._save_disk()
 
-    def tune(
+    def tune(  # ds-race: entry — a bench warmup thread tunes while the engine serves
         self,
         kind: str,
         timer: Callable[[Dict[str, int]], float],
@@ -335,7 +335,10 @@ class Autotuner:
                 f"autotune[{kind}]: all {failures} candidate(s) failed; using defaults"
             )
             return default_blocks(kind, **key)
-        self.tunes += 1
+        with self._lock:
+            # same lock stats() reads under — an unlocked += here loses
+            # counts when two warmup threads tune concurrently
+            self.tunes += 1
         self.record(fp, best[1], best[0] * 1e3)
         logger.info(
             f"autotune[{kind}] {fp.split('|topo=')[0]}: picked {best[1]} "

@@ -123,7 +123,9 @@ def _fmt(n: float, unit: str = "") -> str:
 def analyze_fn(fn: Callable, *args, static_argnums=()) -> Dict[str, float]:
     """AOT cost analysis of ``fn(*args)``: flops, HBM bytes accessed,
     peak-memory estimate — from XLA, post-fusion."""
-    lowered = jax.jit(fn, static_argnums=static_argnums).lower(*args)
+    # out_shardings=None: AOT cost analysis only — nothing executes, so
+    # no layout is imposed on real arrays
+    lowered = jax.jit(fn, static_argnums=static_argnums, out_shardings=None).lower(*args)
     compiled = lowered.compile()
     cost = compiled.cost_analysis() or {}
     if isinstance(cost, list):  # older jax returns [dict]

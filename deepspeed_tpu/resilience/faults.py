@@ -44,6 +44,21 @@ path, ``router.hedge`` — fail at hedge launch, ``replica.death`` — a
 ``flag`` plan the router polls each step to kill a live replica;
 docs/resilience.md §Fleet) drive the front-door chaos matrix in
 ``tests/test_fleet.py`` and ``tools/fleet_chaos.py``.
+
+Race sites (``race.*``; docs/ds_race.md §Stress mode): the ds_race
+schedule-perturbation harness wraps instrumented lock acquire/release
+sites with :func:`check_race`, and two recurring, probabilistic plan
+kinds widen the interleaving space a seeded run explores:
+
+* ``race.yield`` — ``time.sleep(0)``: drop the GIL so another runnable
+  thread is scheduled at this instruction;
+* ``race.stall`` — a sub-millisecond sleep: hold a lock (or a gap
+  between a read and its write-back) open long enough for a conflicting
+  thread to land inside it.
+
+``fire_race`` consults the exact site first, then the ``race.*``
+catch-all, so a plan can jitter every instrumented lock while pinning a
+heavier stall on one suspect site.
 """
 from __future__ import annotations
 
@@ -106,6 +121,19 @@ def check_latency(site: str) -> float:
     if seconds > 0:
         time.sleep(seconds)
     return seconds
+
+
+def check_race(site: str) -> None:
+    """Schedule-perturbation point for the ds_race stress harness
+    (docs/ds_race.md §Stress mode).  Instrumented lock wrappers call
+    this before and after acquiring; an armed ``race.yield`` plan drops
+    the GIL (``sleep(0)``), a ``race.stall`` plan holds the site open
+    for a sub-millisecond beat.  Free when no injector is active — one
+    global ``None`` check, same cost model as :func:`check`."""
+    if _ACTIVE is not None:
+        seconds = _ACTIVE.fire_race(site)
+        if seconds >= 0:
+            time.sleep(seconds)
 
 
 class FaultInjector:
@@ -172,6 +200,26 @@ class FaultInjector:
                    kind="latency", seconds=seconds)
         return self
 
+    def race_yield(self, site: str, probability: float = 0.5, times: int = 0,
+                   after: int = 0) -> "FaultInjector":
+        """Arm a *recurring, probabilistic* GIL yield (``sleep(0)``) at
+        ``site`` (``check_race``).  ``site`` may be the ``race.*``
+        catch-all, which matches every race site without an exact plan
+        of its own.  ``times=0`` = unbounded."""
+        self._plan(site, None, times if times > 0 else 1 << 30, after,
+                   probability, kind="race.yield", seconds=0.0)
+        return self
+
+    def race_stall(self, site: str, seconds: float = 0.0002,
+                   probability: float = 0.1, times: int = 0,
+                   after: int = 0) -> "FaultInjector":
+        """Arm a recurring, probabilistic sub-millisecond stall at a
+        race site — long enough for a conflicting thread to land inside
+        the window the stall holds open."""
+        self._plan(site, None, times if times > 0 else 1 << 30, after,
+                   probability, kind="race.stall", seconds=seconds)
+        return self
+
     # -- firing -----------------------------------------------------------
     def _triggers(self, plan: dict) -> bool:
         plan["calls"] += 1
@@ -227,6 +275,23 @@ class FaultInjector:
             return plan["seconds"]
         return 0.0
 
+    def fire_race(self, site: str) -> float:
+        """Seconds to sleep at a race site, or ``-1.0`` when nothing
+        fires (``check_race`` treats ``>= 0`` as "sleep", so a yield
+        plan returns ``0.0`` and still drops the GIL).  The exact site
+        is consulted first; sites without their own plan fall through to
+        the ``race.*`` catch-all."""
+        plan = self._plans.get(site)
+        if plan is None or not plan["kind"].startswith("race."):
+            plan = self._plans.get("race.*")
+        if plan is None or not plan["kind"].startswith("race."):
+            return -1.0
+        if self._triggers(plan):
+            if plan["fired"] == 1:  # one log line per site (see latency)
+                self.log.append((site, plan["kind"]))
+            return plan["seconds"] if plan["kind"] == "race.stall" else 0.0
+        return -1.0
+
     def calls(self, site: str) -> int:
         plan = self._plans.get(site)
         return plan["calls"] if plan else 0
@@ -261,7 +326,9 @@ class FaultInjector:
             entries.append({
                 "site": site,
                 "action": {"raise": "fail", "flag": "flag", "sigkill": "sigkill",
-                           "stall": "stall", "latency": "latency"}[p["kind"]],
+                           "stall": "stall", "latency": "latency",
+                           "race.yield": "race.yield",
+                           "race.stall": "race.stall"}[p["kind"]],
                 "times": p["times"], "after": p["after"], "seconds": p["seconds"],
                 **({"exc": p["exc"].__name__} if p["exc"] is not None and p["kind"] == "raise" else {}),
                 **({"probability": p["probability"]} if p["probability"] is not None else {}),
@@ -301,6 +368,13 @@ class FaultInjector:
                 # latency plan's natural default is "every call"
                 inj.latency(site, float(e.get("seconds", 0.01)),
                             times=int(e.get("times", 0)), after=after)
+            elif action == "race.yield":
+                inj.race_yield(site, probability=float(e.get("probability", 0.5)),
+                               times=int(e.get("times", 0)), after=after)
+            elif action == "race.stall":
+                inj.race_stall(site, seconds=float(e.get("seconds", 0.0002)),
+                               probability=float(e.get("probability", 0.1)),
+                               times=int(e.get("times", 0)), after=after)
             else:
                 raise ValueError(f"unknown fault action '{action}' for site '{site}'")
         return inj

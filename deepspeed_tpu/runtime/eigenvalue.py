@@ -73,7 +73,10 @@ class Eigenvalue:
         hvp = self._hvp_cache.get(id(loss_fn))
         if hvp is None:
             grad_fn = jax.grad(lambda p: jnp.asarray(loss_fn(p), jnp.float32))
-            hvp = jax.jit(lambda p, vec: jax.jvp(grad_fn, (p,), (vec,))[1])
+            # out_shardings=None: the HVP inherits the params' layout;
+            # power iteration runs wherever the grads live
+            hvp = jax.jit(lambda p, vec: jax.jvp(grad_fn, (p,), (vec,))[1],
+                          out_shardings=None)
             self._hvp_cache[id(loss_fn)] = hvp
 
         eig = 0.0

@@ -103,18 +103,37 @@ class AsyncCheckpointWriter:
 
     @property
     def in_flight(self) -> bool:
-        p = self._pending
+        with self._lock:
+            p = self._pending
         return p is not None and not p.done
+
+    def _settle_locked(self, pending: "PendingSave") -> None:
+        """Account one finished save.  Caller holds ``self._lock`` and
+        owns the ``_pending -> None`` (or replace) transition, so each
+        save hits completed/failed exactly once."""
+        if pending.error is not None:
+            self.failed += 1
+            self.last_error = pending.error
+        else:
+            self.completed += 1
 
     def submit(self, tag: str, final_path: str, commit_fn: Callable[[], None]) -> PendingSave:
         """Start ``commit_fn`` on a background thread.  The caller must
         :meth:`drain` first — two concurrent saves would race the
         checkpoint tree's staging/latest/GC state."""
+        settled: Optional[PendingSave] = None
         with self._lock:
             if self._pending is not None and not self._pending.done:
                 raise RuntimeError(
                     f"async save of '{self._pending.tag}' still in flight; drain() first"
                 )
+            if self._pending is not None:
+                # finished but nobody drained it (a concurrent drain read
+                # the handle, then lost the transition to us) — settle it
+                # here or the save is never counted
+                settled = self._pending
+                self._settle_locked(settled)
+                self._pending = None
             pending = PendingSave(tag, final_path)
 
             def run():
@@ -149,9 +168,14 @@ class AsyncCheckpointWriter:
             pending._thread = t
             self._pending = pending
             t.start()
-            return pending
+        if settled is not None and settled.error is not None:
+            logger.error(
+                f"async checkpoint save of '{settled.tag}' failed: {settled.error!r} "
+                "(the previously committed tag is still the durable state)"
+            )
+        return pending
 
-    def drain(self, timeout: Optional[float] = None) -> Optional[PendingSave]:
+    def drain(self, timeout: Optional[float] = None) -> Optional[PendingSave]:  # ds-race: entry
         """Wait for the in-flight save (if any) to finish and return its
         handle.  Raises ``TimeoutError`` if it does not finish within
         ``timeout`` (default: ``drain_timeout_seconds``) — callers on an
@@ -168,16 +192,19 @@ class AsyncCheckpointWriter:
             raise TimeoutError(
                 f"async save of '{pending.tag}' did not finish within {timeout:.0f}s"
             )
+        # The trainer and the preemption watchdog can drain the same
+        # handle concurrently; whichever thread wins the None-out
+        # transition owns the accounting, so completed/failed count each
+        # save exactly once.
+        accounted = False
         with self._lock:
             if self._pending is pending:
                 self._pending = None
-        if pending.error is not None:
-            self.failed += 1
-            self.last_error = pending.error
+                accounted = True
+                self._settle_locked(pending)
+        if accounted and pending.error is not None:
             logger.error(
                 f"async checkpoint save of '{pending.tag}' failed: {pending.error!r} "
                 "(the previously committed tag is still the durable state)"
             )
-        else:
-            self.completed += 1
         return pending

@@ -105,7 +105,10 @@ class ReplicaSupervisor:
         except Exception as e:
             logger.error(f"fleet: replica {replica.name} restart failed: {e!r}")
             return None
-        self.restarts += 1
+        with self._lock:
+            # both the router's sync path and N background restart
+            # threads land here — an unlocked += drops restarts
+            self.restarts += 1
         logger.warning(
             f"fleet: replica {replica.name} restarted; journal replayed "
             f"{len(replayed)} request(s) under original ids"

@@ -27,7 +27,9 @@ page (the copy rides the slot's first prefill chunk as a traced
 """
 from __future__ import annotations
 
+import functools
 import math
+import threading
 from collections import deque
 from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
 
@@ -45,6 +47,22 @@ GARBAGE_PAGE = 0
 
 def _pages_for(tokens: int, page_len: int) -> int:
     return -(-int(tokens) // int(page_len))
+
+
+def _locked(fn):
+    """Run the method under the pool's re-entrant lock.  The allocator
+    state (refcounts, free lists, tables, prefix index, sessions) is one
+    invariant-coupled unit: the serving engine, a background TTL sweep,
+    and the upcoming elastic-fleet KV migration all mutate it, and a
+    context switch between a decref and its free-list append double-
+    frees pages.  RLock because the surface nests (``free`` ->
+    ``retire``); uncontended re-entrant acquisition is tens of
+    nanoseconds — invisible next to the numpy work per call."""
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        with self._lock:
+            return fn(self, *args, **kwargs)
+    return wrapper
 
 
 class PagedKVPool:
@@ -106,7 +124,9 @@ class PagedKVPool:
         )
         if sharding is not None:
             self.k, self.v = jax.device_put((self.k, self.v), sharding)
-        # host-side allocator state
+        # host-side allocator state (every public touch goes through
+        # @_locked — see the decorator's docstring)
+        self._lock = threading.RLock()
         self._free_pages: Deque[int] = deque(range(1, self.num_pages))
         self._ref = np.zeros((self.num_pages,), np.int64)
         self._ref[GARBAGE_PAGE] = 1  # permanently held
@@ -205,6 +225,7 @@ class PagedKVPool:
     def owners(self) -> Dict[int, Any]:
         return dict(self._owner)
 
+    @_locked
     def alloc(self, request_id: Any) -> Optional[int]:
         """Plain slot claim (no request context): a fully-mapped slot
         with fresh private pages and no prefix/session reuse."""
@@ -223,6 +244,7 @@ class PagedKVPool:
         self._bind(slot, pages, cow=None)
         return slot
 
+    @_locked
     def free(self, slot: int) -> None:
         self.retire(slot, None)
 
@@ -268,6 +290,7 @@ class PagedKVPool:
             return None  # divergent history: leave parked for the TTL sweep
         return sess
 
+    @_locked
     def alloc_request(self, req: Any, now: float = 0.0) -> Optional[int]:
         """Hit-aware slot claim.  Resolves the request's longest cached
         prefix (session rebind first — it covers prior turns' generation
@@ -354,19 +377,23 @@ class PagedKVPool:
         if cow is not None:
             self._pending_cow[slot] = cow
 
+    @_locked
     def consume_cow(self, slot: int) -> Tuple[int, int]:
         """The slot's pending copy-on-write pair, consumed — staged into
         its FIRST prefill chunk.  ``(0, 0)`` (garbage page onto itself)
         is the traced identity when nothing is pending."""
         return self._pending_cow.pop(slot, (GARBAGE_PAGE, GARBAGE_PAGE))
 
+    @_locked
     def table(self, slot: int) -> np.ndarray:
         return self._tables[slot].copy()
 
+    @_locked
     def tables(self) -> np.ndarray:
         return self._tables.copy()
 
     # -- prefix learning --------------------------------------------------
+    @_locked
     def learn_prefix(self, req: Any, now: float = 0.0) -> None:
         """Called once per request when its final prefill chunk has
         landed: the slot's pages now hold KV for the whole prompt, so
@@ -403,6 +430,7 @@ class PagedKVPool:
         elif pinned and not inserted.pinned:
             inserted.pinned = True  # a learned entry graduates to pinned
 
+    @_locked
     def prefix_hint_tokens(self, prompt: np.ndarray,
                            session_id: Optional[str] = None) -> int:
         """Expected hit for a prompt *without* touching any state — the
@@ -423,6 +451,7 @@ class PagedKVPool:
         return self._aligned_hit(entry.length, plen)
 
     # -- retirement / sessions --------------------------------------------
+    @_locked
     def retire(self, slot: int, req: Any = None, now: float = 0.0) -> None:
         """Return a slot.  A finished request with a ``session_id``
         parks the pages holding its turn (prompt + generated[:-1] — the
@@ -510,6 +539,7 @@ class PagedKVPool:
         self.sessions.park(sess)
         return sess
 
+    @_locked
     def sweep(self, now: float) -> int:
         """TTL sweep: spill (or drop) sessions cold past
         ``session_ttl_seconds``.  Cheap; the engine runs it per step."""
@@ -518,6 +548,7 @@ class PagedKVPool:
             self._spill_or_drop(sess)
         return len(expired)
 
+    @_locked
     def spill_sessions(self, now: float = 0.0) -> int:
         """Drain path: persist every warm session (no-op without a
         spill_dir — the pages die with the process, which only costs
@@ -529,6 +560,7 @@ class PagedKVPool:
             self._spill_or_drop(sess)
         return len(warm)
 
+    @_locked
     def recover(self) -> List[str]:
         """Post-crash: re-register manifest-verified session spills so
         rebinds keep working across the restart.  (Device pages and the
@@ -537,9 +569,11 @@ class PagedKVPool:
         return self.sessions.recover()
 
     # -- introspection ----------------------------------------------------
+    @_locked
     def refcount(self, page: int) -> int:
         return int(self._ref[page])
 
+    @_locked
     def stats(self) -> Dict[str, Any]:
         sess = self.sessions.stats()
         return {

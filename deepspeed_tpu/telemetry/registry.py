@@ -21,8 +21,11 @@ Design constraints (the hot path pays for every byte of this):
   memory as a minute-long one;
 * **thread-safe**: the serving engine, the async checkpoint writer, and
   the supervision threads all publish concurrently.  Metric creation
-  takes the registry lock; updates rely on per-metric locks (counters)
-  or atomic-under-GIL deque appends (histograms/gauges).
+  takes the registry lock; every update AND every read path (snapshot /
+  compact / mean) takes the per-metric lock — a histogram's
+  count/sum/min/max are one logical value, and the export thread must
+  never observe a half-applied ``observe()`` (the torn-snapshot race
+  ds_race flags as ``race-inconsistent-lockset``).
 """
 from __future__ import annotations
 
@@ -80,19 +83,22 @@ class Counter(Metric):
         self._lock = threading.Lock()
         self.value = 0.0
 
-    def inc(self, n: float = 1.0) -> None:
+    def inc(self, n: float = 1.0) -> None:  # ds-race: entry
         if not self._registry.enabled:
             return
         with self._lock:
             self.value += n
             self.updated_at = time.monotonic()
 
-    def compact_value(self) -> float:
-        return self.value
+    def compact_value(self) -> float:  # ds-race: entry
+        with self._lock:
+            return self.value
 
-    def snapshot(self) -> Dict[str, Any]:
+    def snapshot(self) -> Dict[str, Any]:  # ds-race: entry
+        with self._lock:
+            value = self.value
         return {"name": self.name, "kind": self.kind, "labels": self.labels,
-                "value": self.value}
+                "value": value}
 
 
 class Gauge(Metric):
@@ -107,7 +113,7 @@ class Gauge(Metric):
         self.value: Optional[float] = None
         self._ring: deque = deque(maxlen=registry.ring)
 
-    def set(self, value: float) -> None:
+    def set(self, value: float) -> None:  # ds-race: entry
         if not self._registry.enabled:
             return
         v = float(value)
@@ -123,12 +129,15 @@ class Gauge(Metric):
             ring = list(self._ring)
         return sum(ring) / len(ring) if ring else None
 
-    def compact_value(self) -> float:
-        return self.value if self.value is not None else 0.0
+    def compact_value(self) -> float:  # ds-race: entry
+        with self._lock:
+            return self.value if self.value is not None else 0.0
 
-    def snapshot(self) -> Dict[str, Any]:
+    def snapshot(self) -> Dict[str, Any]:  # ds-race: entry
+        with self._lock:
+            value = self.value
         return {"name": self.name, "kind": self.kind, "labels": self.labels,
-                "value": self.value, "window_mean": self.window_mean()}
+                "value": value, "window_mean": self.window_mean()}
 
 
 class Histogram(Metric):
@@ -147,7 +156,7 @@ class Histogram(Metric):
         self.max: Optional[float] = None
         self._ring: deque = deque(maxlen=registry.ring)
 
-    def observe(self, value: float, n: int = 1) -> None:
+    def observe(self, value: float, n: int = 1) -> None:  # ds-race: entry
         """``n > 1`` records the value with multiplicity — a compiled
         multi-step run (``train_batches``) closes one window covering n
         identical per-step records, and the exported count/percentile
@@ -174,7 +183,8 @@ class Histogram(Metric):
         return ring[idx]
 
     def mean(self) -> Optional[float]:
-        return self.sum / self.count if self.count else None
+        with self._lock:
+            return self.sum / self.count if self.count else None
 
     def window_mean(self) -> Optional[float]:
         """Mean over the RING (recent window) — what a load-tracking
@@ -185,15 +195,21 @@ class Histogram(Metric):
             ring = list(self._ring)
         return sum(ring) / len(ring) if ring else None
 
-    def compact_value(self) -> float:
+    def compact_value(self) -> float:  # ds-race: entry
         m = self.mean()
         return m if m is not None else 0.0
 
-    def snapshot(self) -> Dict[str, Any]:
+    def snapshot(self) -> Dict[str, Any]:  # ds-race: entry
+        # count/sum/min/max are one logical value: copy them under the
+        # writer's lock so a concurrent observe() can't tear the export
+        with self._lock:
+            count, total = self.count, self.sum
+            lo, hi = self.min, self.max
         return {
             "name": self.name, "kind": self.kind, "labels": self.labels,
-            "count": self.count, "sum": self.sum, "min": self.min, "max": self.max,
-            "mean": self.mean(), "p50": self.percentile(50), "p99": self.percentile(99),
+            "count": count, "sum": total, "min": lo, "max": hi,
+            "mean": (total / count if count else None),
+            "p50": self.percentile(50), "p99": self.percentile(99),
         }
 
 
@@ -237,15 +253,17 @@ class MetricsRegistry:
         return self
 
     # -- get-or-create handles --------------------------------------------
-    def _get(self, kind: str, name: str, labels: Dict[str, Any]) -> Metric:
+    def _get(self, kind: str, name: str, labels: Dict[str, Any]) -> Metric:  # ds-race: entry
+        # Fully locked (no double-checked fast path): two threads
+        # creating the same key must agree on ONE Metric object, and a
+        # concurrent reset()/snapshot() must never see the table
+        # mid-insert.  Callers cache handles, so this is not hot.
         key = (kind, name, _label_key(labels))
-        m = self._metrics.get(key)
-        if m is None:
-            with self._lock:
-                m = self._metrics.get(key)
-                if m is None:
-                    m = _KINDS[kind](self, name, labels)
-                    self._metrics[key] = m
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = _KINDS[kind](self, name, labels)
+                self._metrics[key] = m
         return m
 
     def counter(self, name: str, **labels) -> Counter:
@@ -261,14 +279,15 @@ class MetricsRegistry:
         self.step = int(step)
 
     # -- introspection / export -------------------------------------------
-    def metrics(self) -> List[Metric]:
+    def metrics(self) -> List[Metric]:  # ds-race: entry
         with self._lock:
             return list(self._metrics.values())
 
     def size(self) -> int:
-        return len(self._metrics)
+        with self._lock:
+            return len(self._metrics)
 
-    def snapshot(self) -> Dict[str, Any]:
+    def snapshot(self) -> Dict[str, Any]:  # ds-race: entry
         """Full typed snapshot for the exporters (JSONL / Prometheus /
         TensorBoard sink)."""
         return {
@@ -278,7 +297,7 @@ class MetricsRegistry:
             "metrics": [m.snapshot() for m in self.metrics()],
         }
 
-    def snapshot_compact(self) -> Dict[str, float]:
+    def snapshot_compact(self) -> Dict[str, float]:  # ds-race: entry
         """One float per metric, keyed by the qualified name — the shape
         that piggybacks on the supervision heartbeat (counters: total;
         gauges: last; histograms: mean).  Kept deliberately small: a

@@ -5,6 +5,7 @@ fixture; plus suppression syntax, baseline round-trips, CLI exit codes,
 and the self-run gate (the linter must be clean on deepspeed_tpu/ with
 the checked-in baseline, in well under the 15s budget).
 """
+import functools
 import json
 import os
 import textwrap
@@ -29,6 +30,18 @@ def lint_src(tmp_path, src, rule=None, name="mod.py", **kw):
 
 def rule_ids(result):
     return [f.rule for f in result.findings]
+
+
+@functools.lru_cache(maxsize=1)
+def _repo_self_run():
+    """One full-package lint shared by every test that needs the repo's
+    current findings (each full pass costs ~6s of tier-1 time)."""
+    start = time.monotonic()
+    res = lint_paths(
+        [os.path.join(REPO_ROOT, "deepspeed_tpu")],
+        baseline_path=os.path.join(REPO_ROOT, ".ds_lint_baseline.json"),
+    )
+    return res, time.monotonic() - start
 
 
 # ---------------------------------------------------------------------------
@@ -729,15 +742,35 @@ class TestHandBuiltSpec:
         ]
 
     def test_baseline_shrank_not_grew(self):
-        # PR 8 satellite: rule-engine adoption retired the grandfathered
-        # missing-sharding-constraint entries — the checked-in baseline
-        # must stay at or below the post-adoption count (18; was 21)
+        # burn-down ratchet: rule-engine adoption retired the
+        # missing-sharding-constraint entries (21 -> 18), the bare-jit
+        # sweep over model init / profiler / eigenvalue retired four
+        # more (18 -> 14) — the checked-in baseline only goes down
         with open(os.path.join(REPO_ROOT, ".ds_lint_baseline.json")) as f:
             entries = json.load(f)["findings"]
-        assert len(entries) <= 18
+        assert len(entries) <= 14
         rules_present = {e["rule"] for e in entries}
         assert "missing-sharding-constraint" not in rules_present
         assert "hand-built-partition-spec" not in rules_present
+        # the burned-down files carry no grandfathered entries at all
+        burned = {"models/bert.py", "models/gpt2.py",
+                  "profiling/flops_profiler.py", "runtime/eigenvalue.py"}
+        stale = [e for e in entries
+                 if any(e["path"].endswith(b) for b in burned)]
+        assert stale == [], stale
+
+    def test_baseline_has_no_stale_entries(self):
+        # every grandfathered fingerprint must still match a live
+        # finding — dead entries mask regressions at the same site
+        # (shares the one full self-run with TestSelfRun: ~6s each)
+        res, _ = _repo_self_run()
+        with open(os.path.join(REPO_ROOT, ".ds_lint_baseline.json")) as f:
+            entries = json.load(f)["findings"]
+        live = {f.fingerprint for f in res.baselined} | {
+            f.fingerprint for f in res.findings
+        }
+        stale = [e for e in entries if e["fingerprint"] not in live]
+        assert stale == [], stale
 
 
 # ---------------------------------------------------------------------------
@@ -1428,11 +1461,7 @@ class TestSelfRun:
     def test_package_is_clean_with_baseline(self):
         baseline = os.path.join(REPO_ROOT, ".ds_lint_baseline.json")
         assert os.path.isfile(baseline), "checked-in baseline missing"
-        start = time.monotonic()
-        res = lint_paths(
-            [os.path.join(REPO_ROOT, "deepspeed_tpu")], baseline_path=baseline
-        )
-        elapsed = time.monotonic() - start
+        res, elapsed = _repo_self_run()
         new = [f.format() for f in res.findings + res.parse_errors]
         assert new == [], "new ds_lint findings:\n" + "\n".join(new)
         assert elapsed < 15.0, f"ds_lint self-run took {elapsed:.1f}s (budget 15s)"
