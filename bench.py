@@ -568,6 +568,26 @@ def run_rung(name: str):
                   "reason": f"bench_serving --kvcache child rc={proc.returncode}"})
         for rec in recs:
             emit(rec)
+    elif name == "elastic":
+        # elastic-fleet rung (docs/serving.md §Elastic fleet): an
+        # autoscaled fleet under ~10x one replica's offered load with a
+        # forced mid-surge scale-down + live KV migration — the emitted
+        # record carries aggregate tokens/s, admitted-p99 TTFT over
+        # steady state, shed rate, and scale reaction times.
+        # Grandchild like the serving rung.
+        import subprocess as sp
+
+        cmd = [sys.executable, os.path.join(HERE, "tools", "bench_serving.py"),
+               "--elastic"]
+        if not on_tpu:
+            cmd.append("--dryrun")
+        proc = sp.run(cmd, stdout=sp.PIPE, cwd=HERE)
+        recs = _parse_records(proc.stdout.decode(errors="replace"))
+        if proc.returncode != 0 and not recs:
+            emit({"metric": "elastic", "skipped": True,
+                  "reason": f"bench_serving --elastic child rc={proc.returncode}"})
+        for rec in recs:
+            emit(rec)
     elif name == "sharding":
         # weight-update-sharding sweep (docs/sharding.md): replicated vs
         # cross-replica ZeRO-1 (vs the composed data x fsdp grid) —
@@ -679,6 +699,11 @@ RUNGS = [
     # off in a grandchild; the record carries x_prefill_flops for the
     # >=2x bound at bit-identical greedy outputs
     ("kvcache", 240, 480),
+    # elastic-fleet proof (docs/serving.md §Elastic fleet): autoscaled
+    # fleet at ~10x one replica's offered load + forced mid-surge
+    # scale-down with live KV migration in a grandchild; the record
+    # carries elastic_over_steady_p99 and scale reaction times
+    ("elastic", 240, 480),
 ]
 
 # Plausibility floors for each rung's PRIMARY record on REAL TPU —

@@ -327,6 +327,135 @@ def _fleet_route_while_restart(seed: int, inj: FaultInjector) -> None:
 
 
 @scenario(
+    "scale-down-while-route",
+    "one thread routes + steps a FleetRouter while another adds, drains, "
+    "and removes replicas (the elastic autoscaler's membership churn); "
+    "asserts no crash, exact delivery, and no handle left stranded")
+def _scale_down_while_route(seed: int, inj: FaultInjector) -> None:
+    from deepspeed_tpu.serving.fleet.router import FleetOverloaded, FleetRouter
+
+    inj.race_stall("race.fleet.membership.acquire", seconds=2e-4,
+                   probability=0.2)
+
+    class _Result:
+        def __init__(self, rid, now):
+            self.request_id = rid
+            self.submit_time = now
+            self.first_token_time = now
+            self.finish_time = now + 1e-3
+            self.finish_reason = "eos"
+            self.tokens = [rid]
+
+    class _Replica:
+        """Minimal routing surface: a request finishes after 2 steps."""
+
+        def __init__(self, name):
+            self.name = name
+            self._next = 0
+            self._live: Dict[int, int] = {}  # rid -> steps remaining
+            self._done: Dict[int, _Result] = {}
+
+        def alive(self):
+            return True
+
+        def submit(self, prompt, **kw):
+            faults.check_race("race.fleet.submit")
+            rid = self._next
+            self._next += 1
+            self._live[rid] = 2
+            return rid
+
+        def cancel(self, rid):
+            return self._live.pop(rid, None) is not None
+
+        def step(self):
+            for rid in list(self._live):
+                self._live[rid] -= 1
+                if self._live[rid] <= 0:
+                    del self._live[rid]
+                    self._done[rid] = _Result(rid, time.monotonic())
+            return bool(self._live)
+
+        def has_work(self):
+            return bool(self._live)
+
+        def pop_results(self):
+            out, self._done = self._done, {}
+            return out
+
+        def result(self, rid):
+            return self._done.get(rid)
+
+        def first_token_seen(self, rid):
+            return rid in self._done
+
+        def client_request_id(self, key):
+            return None
+
+        def estimate_ttft(self, n):
+            return float(len(self._live)) * 1e-3
+
+        def queue_depth(self):
+            return len(self._live)
+
+        def degrade_level(self):
+            return 0
+
+        def draining(self):
+            return False
+
+    router = FleetRouter([_Replica("r0")])
+    instrument(router, "_mlock", "race.fleet.membership")
+    N = 60
+    submitted: List[int] = []
+    stop = threading.Event()
+
+    def route_and_step():
+        try:
+            for i in range(N):
+                try:
+                    submitted.append(router.submit([1, 2, 3]))
+                except FleetOverloaded:
+                    pass
+                router.step()
+            deadline = time.monotonic() + 10
+            while router.has_work() and time.monotonic() < deadline:
+                router.step()
+        finally:
+            stop.set()
+
+    def churn():
+        rng = random.Random(seed * 31 + 7)
+        k = 0
+        while not stop.is_set():
+            faults.check_race("race.fleet.churn")
+            k += 1
+            name = f"e{k}"
+            router.add_replica(_Replica(name))
+            time.sleep(rng.random() * 1e-3)
+            router.begin_drain(name, "stress scale-down")
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if router.inflight_on(name) == 0:
+                    try:
+                        router.remove_replica(name)
+                        break
+                    except ValueError:
+                        pass  # a handle landed between check and remove
+                time.sleep(1e-4)
+            else:
+                raise AssertionError(f"drained replica {name} never idled")
+
+    _run_threads([route_and_step, churn], timeout=30.0)
+    assert not router.has_work(), "handles stranded after membership churn"
+    results = router.pop_results()
+    assert len(results) == len(submitted), (
+        f"delivery raced: {len(results)} results for {len(submitted)} "
+        f"submits")
+    assert "r0" in router._replicas, "the permanent replica vanished"
+
+
+@scenario(
     "prefix-index-insert-under-evict",
     "two threads alloc/learn/retire against a small paged pool so prefix "
     "inserts race TTL eviction pressure; asserts no refcount underflow "

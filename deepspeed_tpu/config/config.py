@@ -738,6 +738,161 @@ class CommConfig:
 
 
 @dataclass
+class ElasticConfig:
+    """``serving.fleet.elastic`` block (docs/serving.md §Elastic
+    fleet): load-driven autoscaling — hot/cold tick hysteresis over the
+    router's own signals (queue depth, admitted-TTFT estimate, shed),
+    warm-pool scale-up, and drain-based scale-down with live KV session
+    migration to the survivors over the spill-manifest wire format."""
+
+    enabled: bool = C.SERVING_FLEET_ELASTIC_ENABLED_DEFAULT
+    min_replicas: int = C.SERVING_FLEET_ELASTIC_MIN_REPLICAS_DEFAULT
+    max_replicas: int = C.SERVING_FLEET_ELASTIC_MAX_REPLICAS_DEFAULT
+    scale_up_queue_depth: int = C.SERVING_FLEET_ELASTIC_SCALE_UP_QUEUE_DEPTH_DEFAULT
+    scale_up_ttft_seconds: float = C.SERVING_FLEET_ELASTIC_SCALE_UP_TTFT_SECONDS_DEFAULT
+    scale_down_queue_depth: int = (
+        C.SERVING_FLEET_ELASTIC_SCALE_DOWN_QUEUE_DEPTH_DEFAULT
+    )
+    engage_ticks: int = C.SERVING_FLEET_ELASTIC_ENGAGE_TICKS_DEFAULT
+    disengage_ticks: int = C.SERVING_FLEET_ELASTIC_DISENGAGE_TICKS_DEFAULT
+    scale_up_cooldown_seconds: float = (
+        C.SERVING_FLEET_ELASTIC_SCALE_UP_COOLDOWN_SECONDS_DEFAULT
+    )
+    scale_down_cooldown_seconds: float = (
+        C.SERVING_FLEET_ELASTIC_SCALE_DOWN_COOLDOWN_SECONDS_DEFAULT
+    )
+    warm_pool_size: int = C.SERVING_FLEET_ELASTIC_WARM_POOL_SIZE_DEFAULT
+    migration_deadline_seconds: float = (
+        C.SERVING_FLEET_ELASTIC_MIGRATION_DEADLINE_SECONDS_DEFAULT
+    )
+    migration_retries: int = C.SERVING_FLEET_ELASTIC_MIGRATION_RETRIES_DEFAULT
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ElasticConfig":
+        if d is None:
+            return cls()
+        if isinstance(d, ElasticConfig):
+            d = dataclasses.asdict(d)
+        d = dict(d)
+        block = f"{C.SERVING}.{C.SERVING_FLEET}.{C.SERVING_FLEET_ELASTIC}"
+        out = cls(
+            enabled=bool(_pop(d, "enabled", C.SERVING_FLEET_ELASTIC_ENABLED_DEFAULT)),
+            min_replicas=int(
+                _pop(d, "min_replicas", C.SERVING_FLEET_ELASTIC_MIN_REPLICAS_DEFAULT)
+            ),
+            max_replicas=int(
+                _pop(d, "max_replicas", C.SERVING_FLEET_ELASTIC_MAX_REPLICAS_DEFAULT)
+            ),
+            scale_up_queue_depth=int(
+                _pop(d, "scale_up_queue_depth",
+                     C.SERVING_FLEET_ELASTIC_SCALE_UP_QUEUE_DEPTH_DEFAULT)
+            ),
+            scale_up_ttft_seconds=float(
+                _pop(d, "scale_up_ttft_seconds",
+                     C.SERVING_FLEET_ELASTIC_SCALE_UP_TTFT_SECONDS_DEFAULT)
+            ),
+            scale_down_queue_depth=int(
+                _pop(d, "scale_down_queue_depth",
+                     C.SERVING_FLEET_ELASTIC_SCALE_DOWN_QUEUE_DEPTH_DEFAULT)
+            ),
+            engage_ticks=int(
+                _pop(d, "engage_ticks", C.SERVING_FLEET_ELASTIC_ENGAGE_TICKS_DEFAULT)
+            ),
+            disengage_ticks=int(
+                _pop(d, "disengage_ticks",
+                     C.SERVING_FLEET_ELASTIC_DISENGAGE_TICKS_DEFAULT)
+            ),
+            scale_up_cooldown_seconds=float(
+                _pop(d, "scale_up_cooldown_seconds",
+                     C.SERVING_FLEET_ELASTIC_SCALE_UP_COOLDOWN_SECONDS_DEFAULT)
+            ),
+            scale_down_cooldown_seconds=float(
+                _pop(d, "scale_down_cooldown_seconds",
+                     C.SERVING_FLEET_ELASTIC_SCALE_DOWN_COOLDOWN_SECONDS_DEFAULT)
+            ),
+            warm_pool_size=int(
+                _pop(d, "warm_pool_size",
+                     C.SERVING_FLEET_ELASTIC_WARM_POOL_SIZE_DEFAULT)
+            ),
+            migration_deadline_seconds=float(
+                _pop(d, "migration_deadline_seconds",
+                     C.SERVING_FLEET_ELASTIC_MIGRATION_DEADLINE_SECONDS_DEFAULT)
+            ),
+            migration_retries=int(
+                _pop(d, "migration_retries",
+                     C.SERVING_FLEET_ELASTIC_MIGRATION_RETRIES_DEFAULT)
+            ),
+        )
+        _check_empty(d, block, _known_keys(cls))
+        if out.min_replicas < 1:
+            raise DeepSpeedConfigError(
+                f"'{block}.min_replicas' must be >= 1, got {out.min_replicas}"
+            )
+        if out.max_replicas < out.min_replicas:
+            raise DeepSpeedConfigError(
+                f"'{block}.max_replicas' ({out.max_replicas}) must be >= "
+                f"min_replicas ({out.min_replicas})"
+            )
+        if out.scale_up_queue_depth < 1:
+            raise DeepSpeedConfigError(
+                f"'{block}.scale_up_queue_depth' must be >= 1, "
+                f"got {out.scale_up_queue_depth}"
+            )
+        if out.scale_up_ttft_seconds <= 0:
+            raise DeepSpeedConfigError(
+                f"'{block}.scale_up_ttft_seconds' must be > 0, "
+                f"got {out.scale_up_ttft_seconds}"
+            )
+        if out.scale_down_queue_depth < 0:
+            raise DeepSpeedConfigError(
+                f"'{block}.scale_down_queue_depth' must be >= 0, "
+                f"got {out.scale_down_queue_depth}"
+            )
+        if out.scale_down_queue_depth >= out.scale_up_queue_depth:
+            raise DeepSpeedConfigError(
+                f"'{block}.scale_down_queue_depth' "
+                f"({out.scale_down_queue_depth}) must be < "
+                f"scale_up_queue_depth ({out.scale_up_queue_depth}) — "
+                f"overlapping thresholds would flap"
+            )
+        if out.engage_ticks < 1:
+            raise DeepSpeedConfigError(
+                f"'{block}.engage_ticks' must be >= 1, got {out.engage_ticks}"
+            )
+        if out.disengage_ticks < 1:
+            raise DeepSpeedConfigError(
+                f"'{block}.disengage_ticks' must be >= 1, "
+                f"got {out.disengage_ticks}"
+            )
+        if out.scale_up_cooldown_seconds < 0:
+            raise DeepSpeedConfigError(
+                f"'{block}.scale_up_cooldown_seconds' must be >= 0, "
+                f"got {out.scale_up_cooldown_seconds}"
+            )
+        if out.scale_down_cooldown_seconds < 0:
+            raise DeepSpeedConfigError(
+                f"'{block}.scale_down_cooldown_seconds' must be >= 0, "
+                f"got {out.scale_down_cooldown_seconds}"
+            )
+        if out.warm_pool_size < 0:
+            raise DeepSpeedConfigError(
+                f"'{block}.warm_pool_size' must be >= 0, "
+                f"got {out.warm_pool_size}"
+            )
+        if out.migration_deadline_seconds <= 0:
+            raise DeepSpeedConfigError(
+                f"'{block}.migration_deadline_seconds' must be > 0, "
+                f"got {out.migration_deadline_seconds}"
+            )
+        if out.migration_retries < 0:
+            raise DeepSpeedConfigError(
+                f"'{block}.migration_retries' must be >= 0, "
+                f"got {out.migration_retries}"
+            )
+        return out
+
+
+@dataclass
 class FleetConfig:
     """``serving.fleet`` block (docs/serving.md §Fleet): the front-door
     router over N engine replicas — least-estimated-TTFT placement, a
@@ -758,6 +913,10 @@ class FleetConfig:
     hedge_min_observations: int = C.SERVING_FLEET_HEDGE_MIN_OBSERVATIONS_DEFAULT
     max_restarts: int = C.SERVING_FLEET_MAX_RESTARTS_DEFAULT
     restart_backoff_seconds: float = C.SERVING_FLEET_RESTART_BACKOFF_SECONDS_DEFAULT
+    restart_budget_reset_seconds: float = (
+        C.SERVING_FLEET_RESTART_BUDGET_RESET_SECONDS_DEFAULT
+    )
+    elastic: ElasticConfig = dataclasses.field(default_factory=ElasticConfig)
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "FleetConfig":
@@ -767,6 +926,7 @@ class FleetConfig:
             d = dataclasses.asdict(d)
         d = dict(d)
         block = f"{C.SERVING}.{C.SERVING_FLEET}"
+        elastic = ElasticConfig.from_dict(_pop(d, C.SERVING_FLEET_ELASTIC, None))
         out = cls(
             replicas=int(_pop(d, "replicas", C.SERVING_FLEET_REPLICAS_DEFAULT)),
             route_retries=int(
@@ -802,6 +962,11 @@ class FleetConfig:
                 _pop(d, "restart_backoff_seconds",
                      C.SERVING_FLEET_RESTART_BACKOFF_SECONDS_DEFAULT)
             ),
+            restart_budget_reset_seconds=float(
+                _pop(d, "restart_budget_reset_seconds",
+                     C.SERVING_FLEET_RESTART_BUDGET_RESET_SECONDS_DEFAULT)
+            ),
+            elastic=elastic,
         )
         _check_empty(d, block, _known_keys(cls))
         if out.replicas < 1:
@@ -850,6 +1015,12 @@ class FleetConfig:
             raise DeepSpeedConfigError(
                 f"'{block}.restart_backoff_seconds' must be >= 0, "
                 f"got {out.restart_backoff_seconds}"
+            )
+        if out.restart_budget_reset_seconds < 0:
+            raise DeepSpeedConfigError(
+                f"'{block}.restart_budget_reset_seconds' must be >= 0 "
+                f"(0 = budget never decays), "
+                f"got {out.restart_budget_reset_seconds}"
             )
         return out
 

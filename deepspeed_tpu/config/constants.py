@@ -301,6 +301,39 @@ SERVING_FLEET_HEDGE_MIN_OBSERVATIONS_DEFAULT = 16
 # the same RetryPolicy backoff schedule between restart attempts
 SERVING_FLEET_MAX_RESTARTS_DEFAULT = 3
 SERVING_FLEET_RESTART_BACKOFF_SECONDS_DEFAULT = 0.2
+# restart-budget decay (leaky bucket): every this-many seconds of clean
+# service since the last restart attempt forgives one consumed attempt,
+# so one bad hour does not permanently exhaust a long-lived replica's
+# budget; 0 = never decay (the pre-elastic behavior)
+SERVING_FLEET_RESTART_BUDGET_RESET_SECONDS_DEFAULT = 0.0
+# -- elastic fleet (serving.fleet.elastic.*; docs/serving.md §Elastic
+# fleet): load-driven autoscaling with warm-pool scale-up and
+# drain + live-KV-session-migration scale-down -------------------------
+SERVING_FLEET_ELASTIC = "elastic"
+SERVING_FLEET_ELASTIC_ENABLED_DEFAULT = False
+SERVING_FLEET_ELASTIC_MIN_REPLICAS_DEFAULT = 1
+SERVING_FLEET_ELASTIC_MAX_REPLICAS_DEFAULT = 4
+# scale-up pressure: a tick is HOT when mean queued-per-routable-replica
+# crosses the depth threshold, any replica's admitted-TTFT estimate
+# crosses the ttft threshold, or the router absorbed shed/rejections
+# since the last tick
+SERVING_FLEET_ELASTIC_SCALE_UP_QUEUE_DEPTH_DEFAULT = 4
+SERVING_FLEET_ELASTIC_SCALE_UP_TTFT_SECONDS_DEFAULT = 1.0
+SERVING_FLEET_ELASTIC_SCALE_DOWN_QUEUE_DEPTH_DEFAULT = 1
+# hysteresis: engage fast (consecutive hot ticks), disengage slow
+# (consecutive cold ticks) — the degradation ladder's shape
+SERVING_FLEET_ELASTIC_ENGAGE_TICKS_DEFAULT = 3
+SERVING_FLEET_ELASTIC_DISENGAGE_TICKS_DEFAULT = 12
+SERVING_FLEET_ELASTIC_SCALE_UP_COOLDOWN_SECONDS_DEFAULT = 5.0
+SERVING_FLEET_ELASTIC_SCALE_DOWN_COOLDOWN_SECONDS_DEFAULT = 30.0
+# pre-built (factory + warm hook, off the routing thread) replicas kept
+# ready so a scale-up is an O(1) attach instead of a jit compile
+SERVING_FLEET_ELASTIC_WARM_POOL_SIZE_DEFAULT = 1
+# scale-down victim drain budget: while the victim still holds
+# in-flight requests past this deadline the scale-down ABORTS (the
+# victim revives) — it never proceeds over live work
+SERVING_FLEET_ELASTIC_MIGRATION_DEADLINE_SECONDS_DEFAULT = 30.0
+SERVING_FLEET_ELASTIC_MIGRATION_RETRIES_DEFAULT = 3
 
 #############################################
 # Telemetry (unified metrics registry / trace export; docs/telemetry.md)

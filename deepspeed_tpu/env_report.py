@@ -497,10 +497,84 @@ def serving_report(config=None) -> None:
             (
                 "fleet restart",
                 f"supervised, <= {f.max_restarts} restart(s)/replica, "
-                f"{f.restart_backoff_seconds:g}s backoff; journal replay "
-                "re-binds in-flight ids (lossless)",
+                f"{f.restart_backoff_seconds:g}s backoff"
+                + (f", budget decays 1/{f.restart_budget_reset_seconds:g}s "
+                   "clean service"
+                   if f.restart_budget_reset_seconds else "")
+                + "; journal replay re-binds in-flight ids (lossless)",
             ),
         ]
+        # elastic fleet rows (docs/serving.md §Elastic fleet)
+        e = getattr(f, "elastic", None)
+        if e is not None and e.enabled:
+            rows += [
+                (
+                    "fleet autoscaler",
+                    f"{e.min_replicas}..{e.max_replicas} replicas; up at "
+                    f"queue>{e.scale_up_queue_depth} or "
+                    f"ttft>{e.scale_up_ttft_seconds:g}s "
+                    f"x{e.engage_ticks} ticks (cooldown "
+                    f"{e.scale_up_cooldown_seconds:g}s), down at "
+                    f"queue<={e.scale_down_queue_depth} "
+                    f"x{e.disengage_ticks} ticks (cooldown "
+                    f"{e.scale_down_cooldown_seconds:g}s)",
+                ),
+                (
+                    "fleet warm pool",
+                    f"{e.warm_pool_size} pre-built replica(s) "
+                    "(compiled off the routing thread)"
+                    if e.warm_pool_size
+                    else "off (scale-up builds inline)",
+                ),
+                (
+                    "fleet migration",
+                    f"live KV session migration on drain (spill wire "
+                    f"format, manifest-gated); <= {e.migration_retries} "
+                    f"retr{'y' if e.migration_retries == 1 else 'ies'}, "
+                    f"{e.migration_deadline_seconds:g}s drain deadline "
+                    "(in-flight past it aborts the scale-down)",
+                ),
+            ]
+        elif e is not None:
+            rows.append((
+                "fleet autoscaler",
+                "off (serving.fleet.elastic.enabled=false; fixed replica "
+                "count)",
+            ))
+    for name, value in rows:
+        print(f"{name} " + "." * (30 - len(name)) + f" {value}")
+
+
+def autoscaler_report(autoscaler) -> None:
+    """LIVE autoscaler rows (ds_report with a running fleet, bench
+    tools): current phase, warm pool, last scale events, migrations."""
+    s = autoscaler.stats()
+    wp = s["warm_pool"]
+    rows = [
+        ("autoscaler replicas",
+         f"{s['replicas']} (bounds {s['min_replicas']}..{s['max_replicas']})"),
+        ("autoscaler phase",
+         s["phase"] + (f" (victim {s['victim']})" if s["victim"] else "")
+         + f"; hot {s['hot_ticks']} cold {s['cold_ticks']} of "
+         f"{s['ticks']} ticks"),
+        ("warm pool",
+         f"{wp['ready']}/{wp['size']} ready ({wp['built']} built, "
+         f"{wp['build_failures']} failed)"),
+        ("scale events",
+         f"{s['scale_ups']} up / {s['scale_downs']} down "
+         f"({s['scale_downs_aborted']} aborted)"),
+        ("scale reactions",
+         "up "
+         + (f"{s['last_scale_up_reaction_s']:.3f}s"
+            if s["last_scale_up_reaction_s"] is not None else "n/a")
+         + ", down "
+         + (f"{s['last_scale_down_reaction_s']:.3f}s"
+            if s["last_scale_down_reaction_s"] is not None else "n/a")),
+        ("migrations",
+         f"{s['migrations_completed']} completed / "
+         f"{s['migrations_failed']} failed "
+         f"({s['sessions_migrated']} session(s) moved)"),
+    ]
     for name, value in rows:
         print(f"{name} " + "." * (30 - len(name)) + f" {value}")
 

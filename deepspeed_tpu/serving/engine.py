@@ -1140,6 +1140,12 @@ class ServingEngine:
         s = self.scheduler
         if s.sweep_expired(time.monotonic(), self._step_count):
             self._journal_commit()
+        if self._paged:
+            # same idle-sweep shape for parked-session TTLs: the pool's
+            # per-step sweep never runs on a replica that receives no
+            # traffic, so a drained-but-alive replica would pin its
+            # pages forever without this (docs/serving.md §Elastic fleet)
+            self.pool.sweep(time.monotonic())
         if self.telemetry.collect:
             self.telemetry.gauge("serving/queue_depth_now").set(s.queue_depth)
             self.telemetry.gauge("serving/live_slots_now").set(self.pool.live_slots)

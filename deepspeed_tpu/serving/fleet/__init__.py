@@ -13,6 +13,7 @@ from deepspeed_tpu.serving.fleet.health import (
     CircuitBreaker,
     ReplicaHealth,
 )
+from deepspeed_tpu.serving.fleet.elastic import FleetAutoscaler, WarmPool
 from deepspeed_tpu.serving.fleet.replica import LocalReplica, ReplicaDeadError
 from deepspeed_tpu.serving.fleet.router import (
     FleetHandle,
@@ -22,6 +23,8 @@ from deepspeed_tpu.serving.fleet.router import (
 from deepspeed_tpu.serving.fleet.supervisor import ReplicaSupervisor
 
 __all__ = [
+    "FleetAutoscaler",
+    "WarmPool",
     "FleetRouter",
     "FleetHandle",
     "FleetOverloaded",

@@ -156,6 +156,14 @@ class ReplicaHealth:
             self.state = DRAINING
             self.reason = reason
 
+    def mark_undrained(self) -> None:
+        """Drain abandoned (e.g. an elastic scale-down aborted at its
+        migration deadline): back into rotation.  Not a restart — the
+        process never went away, so no counter moves."""
+        if self.state == DRAINING:
+            self.state = HEALTHY
+            self.reason = None
+
     def mark_dead(self, reason: str, now: Optional[float] = None) -> None:
         if self.state != DEAD:
             self.state = DEAD

@@ -23,8 +23,10 @@ The required surface (see :class:`LocalReplica` for semantics):
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, List, Optional
 
+from deepspeed_tpu.resilience import faults
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -172,6 +174,43 @@ class LocalReplica:
         if self._dead or self.engine is None:
             return {"dead": True}
         return self.engine.stats()
+
+    # -- live migration (docs/serving.md §Elastic fleet) ------------------
+    def export_sessions(self, dest_dir: str) -> List[str]:
+        """Scale-down: write this replica's parked sessions and pinned
+        prefixes into ``dest_dir`` in the spill wire format (read-only
+        on the pool — retryable).  Empty on a slot-contiguous pool.
+        Fault site ``migrate.export`` (fail / latency / sigkill)."""
+        engine = self._require_alive()
+        faults.check("migrate.export")
+        faults.check_latency("migrate.export")
+        export = getattr(engine.pool, "export_sessions", None)
+        if export is None:
+            return []
+        return export(dest_dir, now=time.monotonic())
+
+    def import_sessions(self, src_dir: str) -> Dict[str, int]:
+        """Survivor side: adopt every manifest-verified entry under
+        ``src_dir``.  Fault site ``migrate.import``."""
+        engine = self._require_alive()
+        faults.check("migrate.import")
+        faults.check_latency("migrate.import")
+        imp = getattr(engine.pool, "import_sessions", None)
+        if imp is None:
+            return {}
+        return imp(src_dir, now=time.monotonic())
+
+    def sweep_sessions(self, now: float) -> int:
+        """TTL-sweep parked sessions host-side.  The engine sweeps per
+        step, so an idle (drained-but-alive) replica never steps and
+        never expires — the autoscaler tick calls this instead
+        (docs/serving.md §Elastic fleet)."""
+        if self._dead or self.engine is None:
+            return 0
+        sweep = getattr(self.engine.pool, "sweep", None)
+        if sweep is None:
+            return 0
+        return int(sweep(now))
 
 
 __all__ = ["LocalReplica", "ReplicaDeadError"]

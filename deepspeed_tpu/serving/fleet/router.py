@@ -39,6 +39,7 @@ latency), ``router.hedge``, ``replica.death``.
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
@@ -111,26 +112,20 @@ class FleetRouter:
         self._replicas: Dict[str, Any] = {}
         self._order: List[str] = []
         self._health: Dict[str, ReplicaHealth] = {}
-        policy = RetryPolicy(
+        self._seed = int(seed)
+        self._policy = RetryPolicy(
             backoff_seconds=config.breaker_backoff_seconds,
             backoff_max_seconds=config.breaker_backoff_max_seconds,
         )
-        for i, rep in enumerate(replicas):
-            name = rep.name
-            if name in self._replicas:
-                raise ValueError(f"duplicate replica name {name!r}")
-            self._replicas[name] = rep
-            self._order.append(name)
-            self._health[name] = ReplicaHealth(
-                name,
-                CircuitBreaker(
-                    failure_threshold=config.breaker_failures,
-                    policy=policy,
-                    halfopen_probes=config.breaker_halfopen_probes,
-                    seed=seed + i,
-                    clock=clock,
-                ),
-            )
+        # guards fleet MEMBERSHIP (_replicas/_order/_health): the
+        # autoscaler's warm-pool add and scale-down remove may race the
+        # routing thread's iteration (ds_race: scale-down-while-route).
+        # Routing itself stays single-threaded; iteration takes
+        # snapshots and tolerates names vanishing mid-walk.
+        self._mlock = threading.RLock()
+        self._added = 0  # lifetime adds (stable breaker seed offsets)
+        for rep in replicas:
+            self.add_replica(rep)
         self._rr = 0  # round-robin tie-break rotation
         self._next_handle = 0
         self._handles: Dict[int, FleetHandle] = {}
@@ -162,6 +157,76 @@ class FleetRouter:
         )
 
     # ------------------------------------------------------------------
+    # membership (docs/serving.md §Elastic fleet)
+    # ------------------------------------------------------------------
+    def add_replica(self, rep: Any) -> None:
+        """Bring a replica into rotation (elastic scale-up; also the
+        constructor's own registration path).  Safe against a concurrent
+        routing walk — membership mutates under ``_mlock`` and the walks
+        snapshot."""
+        name = rep.name
+        with self._mlock:
+            if name in self._replicas:
+                raise ValueError(f"duplicate replica name {name!r}")
+            health = ReplicaHealth(
+                name,
+                CircuitBreaker(
+                    failure_threshold=self.config.breaker_failures,
+                    policy=self._policy,
+                    halfopen_probes=self.config.breaker_halfopen_probes,
+                    seed=self._seed + self._added,
+                    clock=self._clock,
+                ),
+            )
+            self._added += 1
+            self._replicas[name] = rep
+            self._order.append(name)
+            self._health[name] = health
+
+    def remove_replica(self, name: str) -> Any:
+        """Take a replica out of the fleet entirely (elastic scale-down,
+        after drain + migration).  Refuses while any unresolved handle
+        is still bound to it — the autoscaler must drain first."""
+        with self._mlock:
+            if name not in self._replicas:
+                raise ValueError(f"unknown replica {name!r}")
+            bound = self.inflight_on(name)
+            if bound:
+                raise ValueError(
+                    f"replica {name!r} still holds {bound} in-flight "
+                    f"handle(s); drain before removing"
+                )
+            rep = self._replicas.pop(name)
+            self._order.remove(name)
+            self._health.pop(name, None)
+            self._backpressure.pop(name, None)
+            self._restarting.discard(name)
+            return rep
+
+    def begin_drain(self, name: str, reason: str = "scale-down") -> None:
+        """Stop routing NEW work at a replica; in-flight work keeps
+        stepping to completion (DRAINING is stepped but not routable)."""
+        h = self._health.get(name)
+        if h is None:
+            raise ValueError(f"unknown replica {name!r}")
+        h.mark_draining(reason)
+
+    def abort_drain(self, name: str) -> None:
+        """Put a draining replica back into rotation (scale-down aborted
+        at its migration deadline)."""
+        h = self._health.get(name)
+        if h is not None:
+            h.mark_undrained()
+
+    def inflight_on(self, name: str) -> int:
+        """Unresolved handles whose primary or hedge leg is bound to
+        ``name`` — the scale-down gate."""
+        return sum(
+            1 for hd in self._handles.values()
+            if not hd.done and (hd.replica == name or hd.hedge_replica == name)
+        )
+
+    # ------------------------------------------------------------------
     # placement
     # ------------------------------------------------------------------
     def _pick(self, prompt_len: int, exclude: Set[str], now: float,
@@ -176,12 +241,15 @@ class FleetRouter:
         to ESCAPE the primary, so it must not be pulled back by the
         primary's warm cache."""
         scored = []
-        n = len(self._order)
-        for i, name in enumerate(self._order):
+        order = list(self._order)  # snapshot: membership may mutate
+        n = len(order)
+        for i, name in enumerate(order):
             if name in exclude:
                 continue
-            rep = self._replicas[name]
-            h = self._health[name]
+            rep = self._replicas.get(name)
+            h = self._health.get(name)
+            if rep is None or h is None:
+                continue  # removed mid-walk
             if not rep.alive() or not h.routable(now):
                 continue
             if self._backpressure.get(name, 0.0) > now:
@@ -235,8 +303,10 @@ class FleetRouter:
                 break
             attempts += 1
             tried.add(name)
-            rep = self._replicas[name]
-            h = self._health[name]
+            rep = self._replicas.get(name)
+            h = self._health.get(name)
+            if rep is None or h is None:
+                continue  # removed between pick and submit
             try:
                 rid = rep.submit(prompt, client_key=client_key, **kwargs)
             except ServingQueueFull as e:
@@ -279,8 +349,8 @@ class FleetRouter:
     def _soonest_retry(self, now: float) -> float:
         """When nothing is routable and nobody handed us a hint: the
         soonest a breaker half-opens or a backpressure hold expires."""
-        candidates = [u - now for u in self._backpressure.values() if u > now]
-        for h in self._health.values():
+        candidates = [u - now for u in list(self._backpressure.values()) if u > now]
+        for h in list(self._health.values()):
             if h.state != DEAD and h.breaker.retry_at is not None:
                 candidates.append(h.breaker.retry_at - now)
         return max(min(candidates), 0.05) if candidates else 1.0
@@ -331,6 +401,15 @@ class FleetRouter:
         self._by_rid[(name, rid)] = hid
         if client_key is not None:
             self._client_handles[client_key] = hid
+        if name not in self._replicas:
+            # the replica was removed (elastic scale-down) between
+            # placement and binding: nobody will ever step or collect
+            # it, so re-fire now.  remove_replica refuses while a BOUND
+            # handle exists, so exactly one side of this race acts —
+            # either the removal saw the handle and refused, or we see
+            # the removal here and re-route (ds_race:
+            # scale-down-while-route).
+            self._refire(hd, {name}, now)
         self.routed += 1
         if self.telemetry.collect:
             self.telemetry.counter("fleet/routed", replica=name).inc()
@@ -342,9 +421,9 @@ class FleetRouter:
         """Journal-checked dedup: if any live replica already
         acknowledged this key (possibly before a crash/restart), bind a
         handle to the EXISTING admission instead of submitting again."""
-        for name in self._order:
-            rep = self._replicas[name]
-            if not rep.alive():
+        for name in list(self._order):
+            rep = self._replicas.get(name)
+            if rep is None or not rep.alive():
                 continue
             rid = rep.client_request_id(client_key)
             if rid is None:
@@ -389,9 +468,11 @@ class FleetRouter:
         self._poll_restarts(now)
         self._retry_refires(now)
         stepped = False
-        for name in self._order:
-            rep = self._replicas[name]
-            h = self._health[name]
+        for name in list(self._order):
+            rep = self._replicas.get(name)
+            h = self._health.get(name)
+            if rep is None or h is None:
+                continue  # removed mid-walk
             if h.state == DEAD:
                 continue
             if rep.alive() and faults.check_flag("replica.death"):
@@ -538,7 +619,9 @@ class FleetRouter:
             name2 = self._pick(len(hd.prompt), {hd.replica}, now)
             if name2 is None:
                 continue
-            rep2 = self._replicas[name2]
+            rep2 = self._replicas.get(name2)
+            if rep2 is None:
+                continue
             try:
                 # NB no client_key: the hedge is the router's own
                 # duplicate, not a second client admission
@@ -551,6 +634,12 @@ class FleetRouter:
                 continue
             hd.hedge_replica, hd.hedge_request_id, hd.hedged_at = name2, rid2, now
             self._by_rid[(name2, rid2)] = hd.handle_id
+            if name2 not in self._replicas:
+                # same bind-vs-remove window as submit: drop the leg
+                # (the primary is still running; re-hedging may re-arm)
+                self._by_rid.pop((name2, rid2), None)
+                hd.hedge_replica = hd.hedge_request_id = hd.hedged_at = None
+                continue
             self.hedges += 1
             if self.telemetry.collect:
                 self.telemetry.counter("fleet/hedges").inc()
@@ -592,18 +681,20 @@ class FleetRouter:
         if kind == "dead":
             self._handle_death(name, reason or "heartbeat EOF", self._clock())
         else:
-            self._health[name].on_peer_event(kind, reason)
+            h = self._health.get(name)
+            if h is not None:
+                h.on_peer_event(kind, reason)
 
     def _handle_death(self, name: str, reason: str, now: float) -> None:
-        h = self._health[name]
-        if h.state == DEAD:
+        h = self._health.get(name)
+        rep = self._replicas.get(name)
+        if h is None or rep is None or h.state == DEAD:
             return
         h.mark_dead(reason, now)
         self.deaths += 1
         self.last_failover = {"replica": name, "reason": reason, "at": now}
         if self.telemetry.collect:
             self.telemetry.counter("fleet/deaths", replica=name).inc()
-        rep = self._replicas[name]
         replayed = None
         if self._supervisor is not None:
             replayed = self._supervisor.handle_death(rep, reason)
@@ -705,7 +796,7 @@ class FleetRouter:
             hd = self._handles.get(hid)
             if hd is None or hd.done:
                 continue
-            dead = {n for n, h in self._health.items() if h.state == DEAD}
+            dead = {n for n, h in list(self._health.items()) if h.state == DEAD}
             self._refire(hd, dead, now)
 
     # ------------------------------------------------------------------
@@ -713,7 +804,7 @@ class FleetRouter:
     # ------------------------------------------------------------------
     def replicas_by_state(self) -> Dict[str, int]:
         out: Dict[str, int] = {}
-        for h in self._health.values():
+        for h in list(self._health.values()):
             out[h.state] = out.get(h.state, 0) + 1
         return out
 
@@ -721,13 +812,15 @@ class FleetRouter:
         return {
             "replicas": len(self._order),
             "replica_states": self.replicas_by_state(),
-            "replica_health": {n: h.snapshot() for n, h in self._health.items()},
+            "replica_health": {
+                n: h.snapshot() for n, h in list(self._health.items())
+            },
             "routed": self.routed,
             "rejections": self.rejections,
             "failovers": self.failovers,
             "route_failures": self.route_failures,
             "deaths": self.deaths,
-            "restarts": sum(h.restarts for h in self._health.values()),
+            "restarts": sum(h.restarts for h in list(self._health.values())),
             "refired": self.refired,
             "affinity_routes": self.affinity_routes,
             "hedges": self.hedges,

@@ -51,6 +51,8 @@ class ReplicaSupervisor:
         seed: int = 0,
         sleep: Callable[[float], None] = time.sleep,
         background: bool = False,
+        restart_budget_reset_seconds: float = 0.0,
+        clock: Callable[[], float] = time.monotonic,
     ):
         self.max_restarts = max(0, int(max_restarts))
         self.policy = policy if policy is not None else RetryPolicy(
@@ -59,20 +61,55 @@ class ReplicaSupervisor:
         self._rng = random.Random(seed)
         self._sleep = sleep
         self.background = bool(background)
+        # leaky-bucket budget: every restart_budget_reset_seconds of
+        # clean service since the last consumed attempt forgives one
+        # attempt, so a long-lived elastic fleet isn't permanently
+        # condemned by one bad hour.  0 = legacy never-decays behaviour.
+        self.restart_budget_reset_seconds = max(
+            0.0, float(restart_budget_reset_seconds)
+        )
+        self._clock = clock
         self._attempts: Dict[str, int] = {}  # name -> restarts consumed
+        self._last_attempt_at: Dict[str, float] = {}
         self.restarts = 0  # successful restarts, fleet-wide
         self._lock = threading.Lock()
         self._threads: Dict[str, threading.Thread] = {}
         self._completed: List[Tuple[Any, Optional[List[int]]]] = []
 
     def attempts(self, name: str) -> int:
+        self._decay_budget(name)
         return self._attempts.get(name, 0)
+
+    def _decay_budget(self, name: str) -> None:
+        """Forgive one consumed attempt per full reset interval of
+        service since the last consumed attempt (leaky bucket)."""
+        reset = self.restart_budget_reset_seconds
+        if reset <= 0:
+            return
+        n = self._attempts.get(name, 0)
+        if n <= 0:
+            return
+        last = self._last_attempt_at.get(name)
+        if last is None:
+            return
+        forgiven = int((self._clock() - last) // reset)
+        if forgiven <= 0:
+            return
+        remaining = max(0, n - forgiven)
+        self._attempts[name] = remaining
+        # the un-forgiven remainder keeps accruing from the same epoch
+        self._last_attempt_at[name] = last + forgiven * reset
+        logger.info(
+            f"fleet: replica {name} earned back {n - remaining} restart "
+            f"attempt(s) after clean service ({remaining} consumed remain)"
+        )
 
     def handle_death(self, replica, reason: str):
         """Restart ``replica`` (anything with ``restart() -> replayed
         ids``) under the budget.  Returns the replayed ids, None when it
         must stay dead, or :data:`RESTART_PENDING` in background mode."""
         name = replica.name
+        self._decay_budget(name)
         n = self._attempts.get(name, 0)
         if n >= self.max_restarts:
             logger.error(
@@ -81,6 +118,7 @@ class ReplicaSupervisor:
             )
             return None
         self._attempts[name] = n + 1
+        self._last_attempt_at[name] = self._clock()
         pause = self.policy.delay(n + 1, self._rng)
         logger.warning(
             f"fleet: restarting replica {name} ({reason}); attempt "

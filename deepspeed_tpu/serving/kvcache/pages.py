@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 import threading
 from collections import deque
 from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
@@ -38,7 +39,15 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.serving.kvcache.prefix import PrefixEntry, PrefixIndex
-from deepspeed_tpu.serving.kvcache.sessions import Session, SessionStore
+from deepspeed_tpu.serving.kvcache.sessions import (
+    Session,
+    SessionStore,
+    pin_dir_name,
+    read_entries,
+    read_entry,
+    session_dir_name,
+    write_entry,
+)
 from deepspeed_tpu.serving.pool import SlotPoolError
 from deepspeed_tpu.utils.logging import logger
 
@@ -567,6 +576,126 @@ class PagedKVPool:
         learned prefix index died with the process — replay re-prefills
         and re-learns, so outputs stay bit-identical.)"""
         return self.sessions.recover()
+
+    # -- live migration (docs/serving.md §Elastic fleet) ------------------
+    @_locked
+    def export_sessions(self, dest_dir: str, now: float = 0.0) -> List[str]:
+        """Scale-down export: write every parked session (warm and
+        spilled) plus every pinned prefix entry into ``dest_dir`` in the
+        spill wire format, one manifest-last directory per entry.
+
+        READ-ONLY on pool state — sessions stay parked, pins stay
+        indexed, no refcount moves — so a failed or killed export is
+        simply retried, and an abandoned one costs nothing.  A kill -9
+        mid-export leaves a manifest-verified prefix of entries the
+        importer trusts; the unverified tail is ignored."""
+        os.makedirs(dest_dir, exist_ok=True)
+        exported: List[str] = []
+        for sess in self.sessions.warm():
+            write_entry(
+                dest_dir, session_dir_name(sess.session_id),
+                {
+                    "kind": "session",
+                    "session_id": sess.session_id,
+                    "tokens": [int(t) for t in sess.tokens],
+                    "parked_at": sess.parked_at,
+                },
+                self._gather_host(sess.pages),
+            )
+            exported.append(sess.session_id)
+        for sid in self.sessions.spilled_ids():
+            src = self.sessions.spilled_dir(sid)
+            loaded = read_entry(src) if src else None
+            if loaded is None:
+                continue
+            meta, leaves = loaded
+            meta = {k: v for k, v in meta.items() if k != "leaf_dtypes"}
+            meta.setdefault("kind", "session")
+            write_entry(dest_dir, session_dir_name(sid), meta, leaves)
+            exported.append(sid)
+        for entry in self.index.entries():
+            if not entry.pinned:
+                continue  # learned entries re-learn from traffic
+            write_entry(
+                dest_dir, pin_dir_name(entry.tokens),
+                {
+                    "kind": "pinned_prefix",
+                    "tokens": [int(t) for t in entry.tokens],
+                },
+                self._gather_host(entry.pages),
+            )
+            exported.append(f"pin:{len(entry.tokens)}")
+        return exported
+
+    @_locked
+    def import_sessions(self, src_dir: str, now: float = 0.0) -> Dict[str, int]:
+        """Scale-up/survivor import: adopt every manifest-verified entry
+        under ``src_dir``.  Sessions the pool already knows are skipped
+        (the survivor's own copy wins — rebind is an optimisation, so a
+        skip only re-prefills, it never changes outputs).  When the pool
+        is out of pages a migrated session lands in this pool's own
+        spill_dir instead (or is dropped without one)."""
+        counts = {"sessions": 0, "pinned": 0, "respilled": 0, "skipped": 0}
+        for meta, leaves in read_entries(src_dir):
+            kind = meta.get("kind", "session")
+            if kind == "pinned_prefix":
+                tokens = np.asarray(meta["tokens"], np.int32)
+                if tokens.shape[0] < 1:
+                    counts["skipped"] += 1
+                    continue
+                existing = self.index.get(tokens)
+                if existing is not None:
+                    existing.pinned = True
+                    counts["skipped"] += 1
+                    continue
+                pages = self._take_pages(
+                    _pages_for(tokens.shape[0], self.page_len), now
+                )
+                if pages is None:
+                    logger.warning(
+                        "kvcache: no pages to import a pinned prefix "
+                        f"({tokens.shape[0]} tokens); dropping it"
+                    )
+                    counts["skipped"] += 1
+                    continue
+                self._scatter_device(pages, leaves)
+                # insert takes the index's own reference (ref -> 2);
+                # releasing the import's claim leaves the index as the
+                # sole holder, exactly like a learned pinned entry
+                self._insert_entry(tokens, pages, pinned=True, now=now)
+                self._page_decref(pages)
+                counts["pinned"] += 1
+                continue
+            sid = meta["session_id"]
+            if self.sessions.has(sid):
+                counts["skipped"] += 1
+                continue
+            sess = Session(
+                session_id=sid,
+                tokens=np.asarray(meta["tokens"], np.int32),
+                pages=[],
+                parked_at=now,
+            )
+            pages = self._take_pages(
+                _pages_for(sess.cached_len, self.page_len), now
+            )
+            if pages is None:
+                if self.sessions.adopt_spill(sid, meta, leaves) is not None:
+                    counts["respilled"] += 1
+                else:
+                    logger.warning(
+                        f"kvcache: no pages and no spill_dir for migrated "
+                        f"session {sid!r}; dropping it (next turn re-prefills)"
+                    )
+                    counts["skipped"] += 1
+                continue
+            self._scatter_device(pages, leaves)
+            sess.pages = pages
+            prev = self.sessions.park(sess)
+            if prev is not None:  # pragma: no cover - has() guards this
+                self._page_decref(prev.pages)
+            counts["sessions"] += 1
+        return counts
 
     # -- introspection ----------------------------------------------------
     @_locked

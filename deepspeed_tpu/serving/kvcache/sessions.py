@@ -36,6 +36,72 @@ def session_dir_name(session_id: str) -> str:
     return "sess_" + hashlib.sha256(session_id.encode("utf-8")).hexdigest()[:16]
 
 
+def pin_dir_name(tokens: "np.ndarray") -> str:
+    """Entry dir for a pinned-prefix migration entry, keyed on the token
+    run itself (pins have no session_id)."""
+    digest = hashlib.sha256(np.asarray(tokens, np.int32).tobytes()).hexdigest()
+    return "sess_pin_" + digest[:16]
+
+
+# -- migration wire format (docs/serving.md §Elastic fleet) ---------------
+# One directory per entry, identical to the spill layout: kv.npz +
+# meta.json staged first, manifest.json written LAST.  An export killed
+# mid-write leaves a prefix of manifest-verified entries plus at most
+# one unverifiable directory — the importer trusts exactly the verified
+# subset, which is what makes kill -9 mid-migration lossless.
+
+def write_entry(dest_dir: str, dir_name: str, meta: Dict,
+                leaves: Dict[str, np.ndarray]) -> str:
+    """Write one spill-format entry under ``dest_dir/dir_name`` (data +
+    meta fsynced, manifest last).  Idempotent: a stale manifest from a
+    prior attempt is invalidated before the data is rewritten, so a
+    retried export can overwrite its own partial output safely."""
+    target = os.path.join(dest_dir, dir_name)
+    os.makedirs(target, exist_ok=True)
+    stale = os.path.join(target, atomic.MANIFEST_FILE)
+    if os.path.exists(stale):
+        os.remove(stale)
+    dtypes = _save_leaves(leaves, os.path.join(target, _DATA_FILE))
+    meta = dict(meta)
+    meta["leaf_dtypes"] = dtypes
+    atomic.atomic_write_text(os.path.join(target, _META_FILE), json.dumps(meta))
+    atomic.write_manifest(target)
+    return target
+
+
+def read_entry(target: str) -> Optional[Tuple[Dict, Dict[str, np.ndarray]]]:
+    """One manifest-verified entry as ``(meta, leaves)``; None when the
+    directory is unverifiable (export killed mid-write) — never trusted,
+    never fatal."""
+    ok, _ = atomic.verify_manifest(target)
+    meta_path = os.path.join(target, _META_FILE)
+    if not ok or not os.path.exists(meta_path):
+        logger.warning(
+            f"kvcache: ignoring unverifiable migration entry at {target}"
+        )
+        return None
+    with open(meta_path) as f:
+        meta = json.load(f)
+    leaves = _load_leaves(os.path.join(target, _DATA_FILE), meta["leaf_dtypes"])
+    return meta, leaves
+
+
+def read_entries(src_dir: str) -> List[Tuple[Dict, Dict[str, np.ndarray]]]:
+    """Every manifest-verified entry under ``src_dir`` as ``(meta,
+    leaves)`` pairs, sorted by directory name."""
+    out: List[Tuple[Dict, Dict[str, np.ndarray]]] = []
+    if not os.path.isdir(src_dir):
+        return out
+    for name in sorted(os.listdir(src_dir)):
+        target = os.path.join(src_dir, name)
+        if not (name.startswith("sess_") and os.path.isdir(target)):
+            continue
+        loaded = read_entry(target)
+        if loaded is not None:
+            out.append(loaded)
+    return out
+
+
 @dataclasses.dataclass
 class Session:
     """One warm parked session: the token history whose KV the pages
@@ -165,6 +231,28 @@ class SessionStore:
 
     def spilled_ids(self) -> List[str]:
         return sorted(self._spilled)
+
+    def spilled_dir(self, session_id: str) -> Optional[str]:
+        """The registered spill directory for ``session_id`` (export
+        reads it directly — unlike :meth:`load`, nothing is consumed)."""
+        return self._spilled.get(session_id)
+
+    def adopt_spill(self, session_id: str, meta: Dict,
+                    leaves: Dict[str, np.ndarray]) -> Optional[str]:
+        """Persist an imported (migrated) session straight into this
+        store's own ``spill_dir`` and register it — the landing path for
+        migrated sessions when the survivor pool has no free pages.
+        Returns None (entry dropped) without a spill_dir."""
+        if self.spill_dir is None:
+            return None
+        meta = {k: v for k, v in meta.items() if k != "leaf_dtypes"}
+        meta["session_id"] = session_id
+        target = write_entry(self.spill_dir, session_dir_name(session_id),
+                             meta, leaves)
+        self._warm.pop(session_id, None)
+        self._spilled[session_id] = target
+        self.spills += 1
+        return target
 
     def has(self, session_id: str) -> bool:
         return session_id in self._warm or session_id in self._spilled

@@ -365,6 +365,212 @@ def run_fleet_bench(engine, args, slots, chunk, max_len, max_new, workload, mode
         f"restarts {rec['restarts']}")
 
 
+def run_elastic_load(router, auto, workload, offered_rps, seed,
+                     scale_down_at_frac=None):
+    """Open-loop seeded Poisson run through an AUTOSCALED fleet: the
+    :class:`FleetAutoscaler` ticks on the routing loop (its contract);
+    with ``scale_down_at_frac`` a forced scale-down (drain + live KV
+    migration) is requested once that fraction of the arrival schedule
+    has elapsed.  ``auto=None`` runs the same loop without elasticity
+    (the steady-state baseline)."""
+    from deepspeed_tpu.serving.fleet import FleetOverloaded
+
+    rng = np.random.default_rng(seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / offered_rps, size=len(workload)))
+    down_at = (
+        float(arrivals[max(int(len(arrivals) * scale_down_at_frac) - 1, 0)])
+        if scale_down_at_frac is not None else None
+    )
+    t0 = time.monotonic()
+    pending = list(zip(arrivals, workload))
+    handles = {}  # handle_id -> scheduled arrival offset
+    finished = {}
+    rejected = 0
+    peak_replicas = len(router._order)
+    scale_down_requested = False
+    while (pending or router.has_work()
+           or (auto is not None and auto.stats()["phase"] != "idle")):
+        now = time.monotonic() - t0
+        if down_at is not None and now >= down_at:
+            scale_down_requested = auto.request_scale_down()
+            down_at = None
+        while pending and pending[0][0] <= now:
+            arr, w = pending.pop(0)
+            try:
+                hid = router.submit(w["prompt"], max_new_tokens=w["max_new"])
+                handles[hid] = arr
+            except FleetOverloaded:
+                rejected += 1  # shed: the fleet is saturated end to end
+        if auto is not None:
+            auto.tick()
+            peak_replicas = max(peak_replicas, len(router._order))
+        if router.has_work():
+            router.step()
+        elif pending:
+            time.sleep(min(0.005, max(0.0, pending[0][0] - now)))
+        finished.update(router.pop_results())
+    makespan = time.monotonic() - t0
+    finished.update(router.pop_results())
+    ttft, toks = [], 0
+    for hid, arr in handles.items():
+        r = finished.get(hid)
+        if r is None or r.first_token_time is None:
+            continue
+        toks += len(r.generated)
+        # submit-anchored admitted-only TTFT: the autoscaler's SLO claim
+        # is about what the fleet ADMITTED while shedding the rest
+        ttft.append((r.first_token_time - r.submit_time) * 1e3)
+    pct = lambda a, q: round(float(np.percentile(a, q)), 2) if a else None
+    return {
+        "tokens_per_s": round(toks / max(makespan, 1e-9), 1),
+        "ttft_submit_p50_ms": pct(ttft, 50),
+        "ttft_submit_p99_ms": pct(ttft, 99),
+        "completed": len(ttft),
+        "offered": len(workload),
+        "admitted": len(handles),
+        "shed_rate": round(rejected / max(len(workload), 1), 3),
+        "offered_rps": round(offered_rps, 3),
+        "peak_replicas": peak_replicas,
+        "scale_down_requested": scale_down_requested,
+        "makespan_s": round(makespan, 2),
+    }
+
+
+def run_elastic_bench(engine, args, slots, chunk, max_len, max_new,
+                      workload, model):
+    """The ``elastic`` bench rung (docs/serving.md §Elastic fleet): an
+    autoscaled fleet under ~10x one replica's offered load.  One paged
+    replica + a FleetAutoscaler (warm pool pre-compiles off the routing
+    thread) absorb a seeded Poisson surge; mid-surge a FORCED scale-down
+    drains a victim and live-migrates its KV.  The record carries
+    aggregate tokens/s, admitted-p99 TTFT (and its ratio over a
+    single-replica steady state), shed rate, and the scale-up /
+    scale-down reaction times."""
+    import tempfile
+
+    from deepspeed_tpu.serving import ServingEngine
+    from deepspeed_tpu.serving.fleet import (
+        FleetAutoscaler,
+        FleetRouter,
+        LocalReplica,
+    )
+
+    base = workload
+
+    with tempfile.TemporaryDirectory(prefix="bench_elastic_") as root:
+        def mk_factory(tag):
+            def factory(name):
+                d = os.path.join(root, tag, name, "journal")
+
+                def build():
+                    return ServingEngine(
+                        engine, num_slots=slots, prefill_chunk=chunk,
+                        max_len=max_len, max_queue=args.max_queue,
+                        max_new_tokens=max_new, journal_dir=d,
+                        slo_ttft_ms=args.slo_ttft_ms,
+                        kvcache={"enabled": True, "page_len": chunk},
+                    )
+                return LocalReplica(name, build,
+                                    warm=lambda e: warm(e, base))
+            return factory
+
+        # capacity anchor: one replica's closed-loop service rate sets
+        # the offered-load scale (the shedder never engages closed-loop)
+        def make_one():
+            return ServingEngine(
+                engine, num_slots=slots, prefill_chunk=chunk,
+                max_len=max_len, max_queue=args.max_queue,
+                max_new_tokens=max_new,
+                kvcache={"enabled": True, "page_len": chunk},
+            )
+
+        _, req_s, _ = run_closed_loop(make_one, base)
+
+        # steady-state baseline: ONE replica comfortably under capacity
+        # — the denominator of the elastic p99 ratio
+        steady_factory = mk_factory("steady")
+        router = FleetRouter([steady_factory("r0")], seed=args.seed)
+        steady = run_elastic_load(router, None, base * 2,
+                                  max(req_s * 0.6, 1e-3), args.seed)
+        log(f"[elastic] single-replica capacity {req_s:.2f} req/s; steady "
+            f"admitted p99 {steady['ttft_submit_p99_ms']} ms")
+
+        # the surge: ~10x one replica's capacity, sized so the arrival
+        # window spans scale-up + mid-surge forced scale-down
+        offered = max(req_s * 10.0, 1e-3)
+        n_need = max(int(offered * 6.0) + 1, len(base))
+        surge = (base * (n_need // len(base) + 1))[:n_need]
+        elastic_factory = mk_factory("elastic")
+        router = FleetRouter([elastic_factory("r0")], seed=args.seed)
+        auto = FleetAutoscaler(
+            router, elastic_factory,
+            config={
+                "enabled": True, "min_replicas": 1, "max_replicas": 3,
+                "scale_up_queue_depth": max(slots, 4),
+                "scale_up_ttft_seconds": args.slo_ttft_ms / 1e3,
+                "scale_down_queue_depth": 1,
+                "engage_ticks": 3,
+                "disengage_ticks": 10 ** 6,  # scale-down is forced mid-run
+                "scale_up_cooldown_seconds": 1.0,
+                "scale_down_cooldown_seconds": 0.0,
+                "warm_pool_size": 1,
+                "migration_deadline_seconds": 120.0,
+                "migration_retries": 2,
+            },
+            handoff_root=root,
+        )
+        try:
+            log(f"[elastic] offering {offered:.2f} req/s "
+                f"(~{offered / max(req_s, 1e-9):.1f}x capacity, "
+                f"{len(surge)} requests over ~{len(surge) / offered:.1f}s)")
+            elastic = run_elastic_load(router, auto, surge, offered,
+                                       args.seed, scale_down_at_frac=0.55)
+            st = auto.stats()
+        finally:
+            auto.stop()
+
+    ratio = None
+    if steady["ttft_submit_p99_ms"] and elastic["ttft_submit_p99_ms"]:
+        ratio = round(
+            elastic["ttft_submit_p99_ms"] / steady["ttft_submit_p99_ms"], 3
+        )
+    rec = {
+        "metric": f"serving_elastic_{model.replace('-', '_')}_10x_autoscale",
+        "value": elastic.pop("tokens_per_s"),
+        "unit": "tokens/s",
+        "offered_x_capacity": round(offered / max(req_s, 1e-9), 2),
+        "num_slots": slots,
+        "prefill_chunk": chunk,
+        "max_len": max_len,
+        "slo_ttft_ms": args.slo_ttft_ms,
+        "elastic_over_steady_p99": ratio,
+        "steady_tokens_per_s": steady["tokens_per_s"],
+        "steady_ttft_submit_p99_ms": steady["ttft_submit_p99_ms"],
+        "scale_ups": st["scale_ups"],
+        "scale_downs": st["scale_downs"],
+        "scale_downs_aborted": st["scale_downs_aborted"],
+        "scale_up_reaction_s": (
+            round(st["last_scale_up_reaction_s"], 3)
+            if st["last_scale_up_reaction_s"] is not None else None),
+        "scale_down_reaction_s": (
+            round(st["last_scale_down_reaction_s"], 3)
+            if st["last_scale_down_reaction_s"] is not None else None),
+        "migrations_completed": st["migrations_completed"],
+        "migrations_failed": st["migrations_failed"],
+        "sessions_migrated": st["sessions_migrated"],
+        "warm_pool_built": st["warm_pool"]["built"],
+        **elastic,
+    }
+    emit(rec, rung="elastic")
+    log(f"[elastic] {rec['offered_x_capacity']}x offered: {rec['value']} "
+        f"tok/s aggregate, admitted p99 {rec['ttft_submit_p99_ms']} ms "
+        f"= {ratio}x steady, shed {rec['shed_rate']:.1%}, "
+        f"scale-up x{rec['scale_ups']} ({rec['scale_up_reaction_s']}s), "
+        f"scale-down x{rec['scale_downs']} "
+        f"({rec['scale_down_reaction_s']}s), "
+        f"{rec['sessions_migrated']} session(s) migrated")
+
+
 def run_kvcache_bench(engine, args, slots, chunk, max_len, max_new, model):
     """The ``kvcache`` bench rung (docs/serving.md §Paged KV & prefix
     caching): an 80%-shared system-prompt batch plus 3-turn chat
@@ -489,6 +695,13 @@ def main():
                          "3-replica FleetRouter under seeded Poisson load, "
                          "one replica killed mid-run and supervised back — "
                          "records availability + failover-p99-over-steady")
+    ap.add_argument("--elastic", action="store_true",
+                    help="elastic-fleet mode (docs/serving.md §Elastic "
+                         "fleet): an autoscaled fleet under ~10x one "
+                         "replica's offered load with a forced mid-surge "
+                         "scale-down + live KV migration — records "
+                         "aggregate tokens/s, admitted-p99 TTFT, shed "
+                         "rate, and scale reaction times")
     ap.add_argument("--kvcache", action="store_true",
                     help="paged-KV mode (docs/serving.md §Paged KV & prefix "
                          "caching): an 80%%-shared system-prompt batch plus "
@@ -551,6 +764,14 @@ def main():
     if args.fleet:
         run_fleet_bench(engine, args, slots, chunk, max_len, max_new,
                         workload, model)
+        if args.trace:
+            path = telemetry.export_trace(args.trace)
+            log(f"trace exported -> {path}")
+        return
+
+    if args.elastic:
+        run_elastic_bench(engine, args, slots, chunk, max_len, max_new,
+                          workload, model)
         if args.trace:
             path = telemetry.export_trace(args.trace)
             log(f"trace exported -> {path}")

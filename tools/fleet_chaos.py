@@ -27,6 +27,20 @@ under original ids — and the parent asserts:
 * ZERO acknowledged loss — every routed handle resolves;
 * every output is bit-identical to an uninterrupted solo
   ``generate()`` of the same prompt (deterministic serving contract).
+
+``--elastic`` runs the elastic-fleet proof instead (docs/serving.md
+§Elastic fleet): one paged replica + a :class:`FleetAutoscaler` whose
+warm pool pre-compiles child processes off the routing thread.  A
+burst drives queue depth over the scale-up threshold (reaction time
+recorded); multi-turn KV sessions are parked on the elastic replicas;
+a forced scale-down of a victim armed with ``DS_FAULT_PLAN`` SIGKILLs
+it INSIDE ``migrate.export`` (rc == -9, journal-replay fallback); a
+second, clean scale-down live-migrates the surviving sessions to the
+last replica over the spill wire format — and the final session turns,
+served by a replica that never saw turns 1..2, must rebind the
+migrated KV and bit-match the uninterrupted solo transcript.
+
+    python tools/fleet_chaos.py --dryrun --elastic
 """
 from __future__ import annotations
 
@@ -49,6 +63,12 @@ N_REQUESTS = 9
 MAX_NEW = 6
 KILL_AFTER_DECODES = 5
 
+# --elastic mode
+E_SESSIONS = 3
+E_TURNS = 3
+E_BURST = 8
+E_PAGE_LEN = 8
+
 
 def log(msg):
     print(f"[fleet_chaos] {msg}", file=sys.stderr, flush=True)
@@ -64,7 +84,7 @@ def build_prompts(seed, vocab):
     ]
 
 
-def make_engine(journal_dir):
+def make_engine(journal_dir, paged=False, spill_dir=None):
     import dataclasses
 
     import jax.numpy as jnp
@@ -80,8 +100,16 @@ def make_engine(journal_dir):
         model_config=cfg, params=params, dtype=jnp.float32,
         max_out_tokens=cfg.n_positions,
     )
+    kw = {}
+    if paged:
+        kw["kvcache"] = {
+            "enabled": True,
+            "page_len": E_PAGE_LEN,
+            "spill_dir": spill_dir or "",
+        }
     srv = ServingEngine(
         eng, num_slots=2, prefill_chunk=8, max_len=64, journal_dir=journal_dir,
+        **kw,
     )
     return cfg, eng, srv
 
@@ -90,11 +118,12 @@ def make_engine(journal_dir):
 # worker child: a replica process serving the JSONL command pipe
 # ---------------------------------------------------------------------------
 
-def run_worker(journal_dir):
+def run_worker(journal_dir, paged=False, spill_dir=None):
     """One replica process: engine over ``journal_dir``, commands in on
     stdin, one JSON response line out per command.  A planned SIGKILL
-    (DS_FAULT_PLAN, site ``serving.decode``) simply never answers — the
-    parent's read hits EOF, which IS the death signal."""
+    (DS_FAULT_PLAN, site ``serving.decode`` or ``migrate.export``)
+    simply never answers — the parent's read hits EOF, which IS the
+    death signal."""
     # claim fd 1 as the private JSON channel BEFORE the framework loads:
     # the deepspeed_tpu logger writes to stdout, which would corrupt the
     # line framing — re-point fd 1 (and sys.stdout) at stderr instead
@@ -107,7 +136,7 @@ def run_worker(journal_dir):
     from deepspeed_tpu.resilience import faults
 
     faults.install_from_env(rank=0)
-    _, _, srv = make_engine(journal_dir)
+    _, _, srv = make_engine(journal_dir, paged=paged, spill_dir=spill_dir)
     for line in sys.stdin:
         line = line.strip()
         if not line:
@@ -148,6 +177,34 @@ def run_worker(journal_dir):
                 resp = {"ok": srv.client_request_id(str(cmd["key"]))}
             elif op == "recover":
                 resp = {"ok": [int(r) for r in srv.recover()]}
+            elif op == "affinity":
+                hint = getattr(srv.pool, "prefix_hint_tokens", None)
+                resp = {"ok": 0 if hint is None else int(hint(
+                    np.asarray(cmd["prompt"], np.int32),
+                    session_id=cmd.get("session_id"),
+                ))}
+            elif op == "export":
+                # the fault fires IN THE CHILD: a sigkill plan at
+                # migrate.export kills this process mid-export and the
+                # parent's readline EOF is the ReplicaDeadError
+                faults.check("migrate.export")
+                faults.check_latency("migrate.export")
+                exp = getattr(srv.pool, "export_sessions", None)
+                resp = {"ok": [] if exp is None
+                        else exp(cmd["dir"], now=time.monotonic())}
+            elif op == "import":
+                faults.check("migrate.import")
+                faults.check_latency("migrate.import")
+                imp = getattr(srv.pool, "import_sessions", None)
+                resp = {"ok": {} if imp is None
+                        else imp(cmd["dir"], now=time.monotonic())}
+            elif op == "sweep":
+                swp = getattr(srv.pool, "sweep", None)
+                resp = {"ok": 0 if swp is None
+                        else int(swp(time.monotonic()))}
+            elif op == "kvstats":
+                resp = {"ok": srv.pool.stats()
+                        if hasattr(srv.pool, "sessions") else {}}
             elif op == "health":
                 resp = {"ok": {
                     "depth": srv.scheduler.queue_depth,
@@ -191,9 +248,12 @@ class ProcessReplica:
     shape of a SIGKILL'd replica.  ``restart()`` respawns the child
     over the same journal directory (sans fault plan) and replays."""
 
-    def __init__(self, name, journal_dir, fault_plan=None):
+    def __init__(self, name, journal_dir, fault_plan=None, paged=False,
+                 spill_dir=None):
         self.name = name
         self.journal_dir = journal_dir
+        self.paged = paged
+        self.spill_dir = spill_dir
         self.kills = 0
         self.first_rc = None
         self.proc = None
@@ -204,10 +264,14 @@ class ProcessReplica:
         env.pop("DS_FAULT_PLAN", None)
         if fault_plan is not None:
             env["DS_FAULT_PLAN"] = fault_plan
+        argv = [sys.executable, os.path.abspath(__file__), "--role", "worker",
+                "--journal", self.journal_dir, "--dryrun"]
+        if self.paged:
+            argv.append("--paged")
+        if self.spill_dir:
+            argv += ["--spill", self.spill_dir]
         self.proc = subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__), "--role", "worker",
-             "--journal", self.journal_dir, "--dryrun"],
-            env=env, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            argv, env=env, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
             stderr=subprocess.DEVNULL, text=True,
         )
 
@@ -297,6 +361,29 @@ class ProcessReplica:
             return None
         return self._rpc(op="health", len=prompt_len)["est"]
 
+    def kv_affinity(self, prompt, session_id=None):
+        if not self.paged or not self.alive():
+            return 0
+        return int(self._rpc(op="affinity", prompt=[int(t) for t in prompt],
+                             session_id=session_id))
+
+    # -- live migration surface (docs/serving.md §Elastic fleet) ------------
+    def export_sessions(self, dest_dir):
+        return self._rpc(op="export", dir=dest_dir)
+
+    def import_sessions(self, src_dir):
+        return self._rpc(op="import", dir=src_dir)
+
+    def sweep_sessions(self, now):
+        if not self.alive():
+            return 0
+        return self._rpc(op="sweep")
+
+    def kv_stats(self):
+        if not self.alive():
+            return {}
+        return self._rpc(op="kvstats")
+
     def queue_depth(self):
         if not self.alive():
             return 0
@@ -326,11 +413,21 @@ def main():
     ap.add_argument("--dryrun", action="store_true", help="tiny model on CPU")
     ap.add_argument("--role", default=None, choices=(None, "worker"))
     ap.add_argument("--journal", default=None)
+    ap.add_argument("--paged", action="store_true",
+                    help="worker: paged KV pool (sessions + migration)")
+    ap.add_argument("--spill", default=None,
+                    help="worker: session spill directory")
+    ap.add_argument("--elastic", action="store_true",
+                    help="run the elastic-fleet proof (autoscale + live "
+                    "KV migration + kill -9 mid-export)")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
 
     if args.role == "worker":
-        run_worker(args.journal)
+        run_worker(args.journal, paged=args.paged, spill_dir=args.spill)
+        return
+    if args.elastic:
+        run_elastic(args)
         return
 
     import numpy as np
@@ -422,6 +519,263 @@ def main():
     log(
         f"OK: SIGKILL'd 1/{N_REPLICAS} replicas mid-decode -> zero "
         f"acknowledged loss, {len(hids)}/{len(hids)} outputs bit-identical "
+        f"({record['wall_s']}s)"
+    )
+
+
+# ---------------------------------------------------------------------------
+# --elastic: autoscale + live KV migration + kill -9 mid-export
+# ---------------------------------------------------------------------------
+
+def build_session_scripts(seed, eng, vocab):
+    """``E_SESSIONS`` sessions x ``E_TURNS`` turns: turn t's prompt is
+    turn t-1's full output plus fresh tokens, and the expected output of
+    every turn is an uninterrupted solo ``generate()`` over the full
+    context — the deterministic-serving bar the fleet must meet across
+    park, migrate, and rebind."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed + 1)
+    prompts, expect = [], []
+    for _ in range(E_SESSIONS):
+        p, e = [], []
+        ctx = rng.integers(1, vocab, int(rng.integers(6, 12)), dtype=np.int32)
+        for turn in range(E_TURNS):
+            if turn:
+                ext = rng.integers(1, vocab, int(rng.integers(4, 7)),
+                                   dtype=np.int32)
+                ctx = np.concatenate([np.asarray(e[-1], np.int32), ext])
+            p.append(ctx.copy())
+            e.append([int(t) for t in np.asarray(
+                eng.generate(ctx[None, :], max_new_tokens=MAX_NEW))[0]])
+        prompts.append(p)
+        expect.append(e)
+    return prompts, expect
+
+
+def run_elastic(args):
+    import numpy as np
+
+    from deepspeed_tpu.resilience.faults import plan_json
+    from deepspeed_tpu.serving.fleet import (
+        HEALTHY,
+        FleetAutoscaler,
+        FleetOverloaded,
+        FleetRouter,
+        ReplicaSupervisor,
+    )
+
+    t0 = time.monotonic()
+    with tempfile.TemporaryDirectory(prefix="fleet_elastic_") as root:
+        cfg, eng, _ = make_engine(os.path.join(root, "ref-journal"))
+        burst = build_prompts(args.seed, cfg.vocab_size)[:E_BURST]
+        burst_expect = [
+            [int(t) for t in
+             np.asarray(eng.generate(p[None, :], max_new_tokens=MAX_NEW))[0]]
+            for p in burst
+        ]
+        sess_prompts, sess_expect = build_session_scripts(
+            args.seed, eng, cfg.vocab_size
+        )
+
+        def spawn(name, fault_plan=None):
+            return ProcessReplica(
+                name, os.path.join(root, name, "journal"),
+                fault_plan=fault_plan, paged=True,
+                spill_dir=os.path.join(root, name, "spill"),
+            )
+
+        def factory(name):
+            rep = spawn(name)
+            rep.queue_depth()  # block HERE (warm-pool thread) on compile
+            return rep
+
+        r0 = factory("r0")
+        router = FleetRouter([r0], supervisor=ReplicaSupervisor(max_restarts=2))
+        auto = FleetAutoscaler(
+            router, factory,
+            config={
+                "enabled": True, "min_replicas": 1, "max_replicas": 3,
+                "scale_up_queue_depth": 2, "scale_up_ttft_seconds": 30.0,
+                "scale_down_queue_depth": 1, "engage_ticks": 2,
+                # scale-down is FORCED in this proof, never load-driven
+                "disengage_ticks": 10 ** 6,
+                "scale_up_cooldown_seconds": 0.0,
+                "scale_down_cooldown_seconds": 0.0,
+                "warm_pool_size": 1,
+                "migration_deadline_seconds": 120.0,
+                "migration_retries": 2,
+            },
+            handoff_root=root,
+        )
+        hids, res = {}, {}
+        try:
+            # phase 1 — burst over one replica's comfort: the autoscaler
+            # must adopt a PRE-COMPILED replica off the warm pool
+            deadline = time.monotonic() + 300
+            while auto.pool.ready() < 1 and time.monotonic() < deadline:
+                time.sleep(0.2)
+            if auto.pool.ready() < 1:
+                log("warm pool never produced a replica")
+                sys.exit(1)
+            for i, p in enumerate(burst):
+                while True:
+                    try:
+                        hids[("burst", i)] = router.submit(
+                            p, max_new_tokens=MAX_NEW, client_key=f"b{i}")
+                        break
+                    except FleetOverloaded as e:
+                        time.sleep(min(e.retry_after or 0.05, 0.2))
+                        router.step()
+                auto.tick()
+                if i % 3 == 2:
+                    router.step()
+            st = auto.stats()
+            if st["scale_ups"] < 1:
+                log(f"burst never triggered a scale-up: {st}")
+                sys.exit(1)
+            log(f"scaled UP to {st['replicas']} replicas in "
+                f"{st['last_scale_up_reaction_s']:.3f}s reaction")
+            res.update(router.drain(max_steps=8000))
+
+            # phase 2 — park sessions on the ELASTIC replicas only:
+            # r0 drains through turns 1..2 so every parked session lives
+            # on a replica that is about to be scaled away
+            plan = plan_json([{"site": "migrate.export", "action": "sigkill"}])
+            v0 = spawn("v0", fault_plan=plan)
+            router.add_replica(v0)
+            router.begin_drain("r0", "pin sessions to elastic replicas")
+            for turn in range(E_TURNS - 1):
+                for s in range(E_SESSIONS):
+                    hids[("sess", s, turn)] = router.submit(
+                        sess_prompts[s][turn], max_new_tokens=MAX_NEW,
+                        client_key=f"s{s}t{turn}", session_id=f"sess-{s}")
+                res.update(router.drain(max_steps=8000))
+            router.abort_drain("r0")
+
+            # phase 3 — forced scale-down of v0, which SIGKILLs itself
+            # inside migrate.export: the autoscaler must fall back to the
+            # death path (supervisor restart + journal replay), not hang
+            # and not lose acknowledged work
+            if not auto.request_scale_down("v0"):
+                log("scale-down of v0 refused")
+                sys.exit(1)
+            deadline = time.monotonic() + 300
+            while auto.stats()["phase"] != "idle":
+                auto.tick()
+                router.step()
+                if time.monotonic() > deadline:
+                    log(f"drain of v0 never settled: {auto.stats()}")
+                    sys.exit(1)
+            if v0.first_rc != -signal.SIGKILL:
+                log(f"victim v0 rc={v0.first_rc}, expected {-signal.SIGKILL} "
+                    "— the migrate.export fault plan did not fire")
+                sys.exit(1)
+            if auto.migrations_failed < 1:
+                log(f"no failed migration recorded: {auto.stats()}")
+                sys.exit(1)
+            deadline = time.monotonic() + 300
+            while not (v0.alive()
+                       and router._health["v0"].state == HEALTHY):
+                router.step()
+                if time.monotonic() > deadline:
+                    log("v0 was never restarted after dying mid-export")
+                    sys.exit(1)
+                time.sleep(0.05)
+            res.update(router.drain(max_steps=8000))  # replayed work
+            log(f"v0 died to SIGKILL inside migrate.export "
+                f"(rc={v0.first_rc}); journal replay restored it")
+
+            # phase 4 — CLEAN scale-downs back to min_replicas: each
+            # victim's parked sessions live-migrate to a survivor over
+            # the spill wire format (v0 -> e*, then e* -> r0)
+            while len(router._order) > 1:
+                victim = [n for n in router._order if n != "r0"][-1]
+                if not auto.request_scale_down(victim):
+                    log(f"scale-down of {victim} refused: {auto.stats()}")
+                    sys.exit(1)
+                deadline = time.monotonic() + 300
+                while auto.stats()["phase"] != "idle":
+                    auto.tick()
+                    router.step()
+                    if time.monotonic() > deadline:
+                        log(f"scale-down of {victim} never settled: "
+                            f"{auto.stats()}")
+                        sys.exit(1)
+            st = auto.stats()
+            if st["migrations_completed"] < 1 or st["sessions_migrated"] < 1:
+                log(f"no live migration happened: {st}")
+                sys.exit(1)
+            log(f"scaled DOWN to {st['replicas']} replica(s); "
+                f"{st['sessions_migrated']} session(s) live-migrated")
+
+            # phase 5 — final turn on the ONE survivor, which never
+            # served turns 1..2: only the migrated KV can rebind
+            for s in range(E_SESSIONS):
+                hids[("sess", s, E_TURNS - 1)] = router.submit(
+                    sess_prompts[s][E_TURNS - 1], max_new_tokens=MAX_NEW,
+                    client_key=f"s{s}t{E_TURNS - 1}", session_id=f"sess-{s}")
+            res.update(router.drain(max_steps=8000))
+            kv = r0.kv_stats()
+            rebinds = int(kv.get("session_rebinds", 0)) + int(
+                kv.get("session_restores", 0))
+        finally:
+            auto.stop()
+            for rep in list(router._replicas.values()):
+                try:
+                    rep.close()
+                except Exception:
+                    pass
+            for rep in list(auto.pool._ready):  # built but never adopted
+                try:
+                    rep.close()
+                except Exception:
+                    pass
+
+        missing = sorted(k for k, hid in hids.items() if hid not in res)
+        if missing:
+            log(f"ACKNOWLEDGED LOSS: requests {missing} never resolved")
+            sys.exit(1)
+        mismatches = []
+        for i in range(len(burst)):
+            if list(res[hids[("burst", i)]].tokens()) != burst_expect[i]:
+                mismatches.append(("burst", i))
+        for s in range(E_SESSIONS):
+            for turn in range(E_TURNS):
+                if (list(res[hids[("sess", s, turn)]].tokens())
+                        != sess_expect[s][turn]):
+                    mismatches.append(("sess", s, turn))
+        if mismatches:
+            log(f"outputs DIVERGED from solo generate() for {mismatches}")
+            sys.exit(1)
+        if rebinds < 1:
+            log("the survivor never rebound a migrated session — the "
+                f"migration was dead weight: {kv}")
+            sys.exit(1)
+
+    record = {
+        "metric": "fleet_elastic_migration_zero_loss",
+        "value": len(hids),
+        "unit": "requests_resolved_bit_identical",
+        "sessions": E_SESSIONS,
+        "turns": E_TURNS,
+        "victim_rc": v0.first_rc,
+        "scale_ups": st["scale_ups"],
+        "scale_downs": st["scale_downs"],
+        "migrations_completed": st["migrations_completed"],
+        "migrations_failed": st["migrations_failed"],
+        "sessions_migrated": st["sessions_migrated"],
+        "scale_up_reaction_s": round(st["last_scale_up_reaction_s"] or 0, 3),
+        "scale_down_reaction_s": round(
+            st["last_scale_down_reaction_s"] or 0, 3),
+        "survivor_rebinds": rebinds,
+        "wall_s": round(time.monotonic() - t0, 1),
+    }
+    print(json.dumps(record), flush=True)
+    log(
+        f"OK: scale-up {st['scale_ups']}x, kill -9 mid-export survived, "
+        f"{st['sessions_migrated']} session(s) live-migrated, "
+        f"{len(hids)}/{len(hids)} outputs bit-identical "
         f"({record['wall_s']}s)"
     )
 
