@@ -588,6 +588,26 @@ def run_rung(name: str):
                   "reason": f"bench_serving --elastic child rc={proc.returncode}"})
         for rec in recs:
             emit(rec)
+    elif name == "kvtiers":
+        # KV-tiering rung (docs/serving.md §KV tiering): a session fleet
+        # whose parked KV working set is ~4x the device page pool vs an
+        # all-HBM reference under the same schedule — the emitted record
+        # carries tokens/s at 4x oversubscription, the T0-resident
+        # overhead ratio, and the swap-hide ratio at bit-identical
+        # greedy outputs.  Grandchild like the serving rung.
+        import subprocess as sp
+
+        cmd = [sys.executable, os.path.join(HERE, "tools", "bench_serving.py"),
+               "--kvtiers"]
+        if not on_tpu:
+            cmd.append("--dryrun")
+        proc = sp.run(cmd, stdout=sp.PIPE, cwd=HERE)
+        recs = _parse_records(proc.stdout.decode(errors="replace"))
+        if proc.returncode != 0 and not recs:
+            emit({"metric": "kvtiers", "skipped": True,
+                  "reason": f"bench_serving --kvtiers child rc={proc.returncode}"})
+        for rec in recs:
+            emit(rec)
     elif name == "sharding":
         # weight-update-sharding sweep (docs/sharding.md): replicated vs
         # cross-replica ZeRO-1 (vs the composed data x fsdp grid) —
@@ -704,6 +724,12 @@ RUNGS = [
     # scale-down with live KV migration in a grandchild; the record
     # carries elastic_over_steady_p99 and scale reaction times
     ("elastic", 240, 480),
+    # KV-tiering proof (docs/serving.md §KV tiering): a ~4x-oversubscribed
+    # session working set over HBM -> host -> disk tiers vs an all-HBM
+    # reference in a grandchild; the record carries tokens/s at 4x, the
+    # T0-resident overhead ratio, and swap_hidden_ratio at bit-identical
+    # greedy outputs with zero queue-full rejections
+    ("kvtiers", 240, 480),
 ]
 
 # Plausibility floors for each rung's PRIMARY record on REAL TPU —

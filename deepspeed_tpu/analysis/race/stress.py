@@ -510,6 +510,109 @@ def _prefix_insert_under_evict(seed: int, inj: FaultInjector) -> None:
 
 
 @scenario(
+    "demote-while-prefix-hit",
+    "multi-turn session workers race the tier manager's demotion tick "
+    "and affinity pricing probes over a paged pool with a host tier; "
+    "asserts no refcount underflow, exact page accounting, and no "
+    "promotion left in flight",
+    requires_jax=True)
+def _demote_while_prefix_hit(seed: int, inj: FaultInjector) -> None:
+    import numpy as np
+
+    from deepspeed_tpu.serving.kvcache.pages import PagedKVPool
+    from deepspeed_tpu.serving.kvcache.tiers import PageTierManager
+
+    inj.race_stall("race.kvpool.lock.acquire", seconds=2e-4, probability=0.1)
+    inj.race_stall("race.kvtiers.lock.acquire", seconds=2e-4, probability=0.1)
+
+    class _Req:
+        def __init__(self, rid, prompt, sid, max_new=2):
+            self.request_id = rid
+            self.prompt = prompt
+            self.session_id = sid
+            self.max_new_tokens = max_new
+            self.prefill_pos = 0
+            self.prefix_hint = 0
+            self.slot = None
+            # retire() parks prompt + generated[:-1] under the session
+            self.generated = [7, 8]
+            self.finish_reason = "length"
+
+    pool = PagedKVPool(n_layer=1, num_slots=4, heads=1, max_len=16,
+                       head_dim=4, kv_dtype=np.float32, page_len=4,
+                       num_pages=20)
+    # host-only tier (no T2): every demotion/promotion is a synchronous
+    # gather/scatter under the two instrumented locks, which is exactly
+    # the window a stale schedule would free a mid-promotion page in
+    mgr = PageTierManager(pool, host_pages=6, residency_window=4,
+                          demote_watermark=0.3, demote_batch=4)
+    pool.attach_tiers(mgr)
+    instrument(pool, "_lock", "race.kvpool.lock")
+    instrument(mgr, "_lock", "race.kvtiers.lock")
+    hist: Dict[str, Any] = {}
+    finished: List[int] = []
+
+    def turns(wid: int) -> None:
+        rng = random.Random(seed * 100 + wid)
+        now = float(wid)
+        try:
+            for i in range(25):
+                now += 1.0
+                sid = f"s{wid}-{i % 2}"
+                prev = hist.get(sid)
+                if prev is None or prev.shape[0] > 10:
+                    prompt = np.asarray(
+                        [wid * 50 + 1 + t for t in range(4 + rng.randrange(3))],
+                        np.int32)
+                else:  # extend the parked turn so promotion gets a hit
+                    prompt = np.concatenate(
+                        [prev, np.asarray([rng.randrange(1, 99)], np.int32)])
+                req = _Req((wid, i), prompt, sid)
+                slot = pool.alloc_request(req, now=now)
+                if slot is None:
+                    continue  # page churn; the scheduler would requeue
+                req.slot = slot
+                pool.consume_cow(slot)
+                pool.learn_prefix(req, now=now)
+                pool.affinity_tokens(prompt, session_id=sid)
+                # a SlotPoolError anywhere here IS the bug (a demotion
+                # freed a page the live slot or a promotion still holds)
+                pool.retire(slot, req, now=now)
+                hist[sid] = np.concatenate(
+                    [prompt, np.asarray(req.generated[:-1], np.int32)])
+        finally:
+            finished.append(wid)
+
+    def ticker() -> None:
+        # the migration pump an idle engine runs from stats(): demotes
+        # past the (deliberately low) watermark while turns promote
+        now = 1000.0
+        while len(finished) < 2:
+            now += 1.0
+            mgr.tick(now)
+            time.sleep(1e-4)
+
+    _run_threads([partial(turns, 0), partial(turns, 1), ticker])
+    assert pool.free_slots == pool.num_slots, "slot leaked across retire"
+    assert not mgr._promoting, f"promotion left in flight: {mgr._promoting}"
+    # exact page accounting: every live page is held by the prefix index
+    # and/or a warm session, with a refcount equal to its holder count
+    held: Dict[int, int] = {}
+    for entry in pool.index.entries():
+        for p in entry.pages:
+            held[p] = held.get(p, 0) + 1
+    for sess in pool.sessions.warm():
+        for p in sess.pages:
+            held[p] = held.get(p, 0) + 1
+    for p, n in held.items():
+        assert pool.refcount(p) == n, (
+            f"page {p} refcount {pool.refcount(p)} != {n} holders "
+            "(underflow or leaked reference)")
+    assert pool.pages_live == len(held), (
+        f"{pool.pages_live} live pages but only {len(held)} accounted for")
+
+
+@scenario(
     "fixture-torn-counter",
     "DELIBERATELY unguarded read-modify-write; the harness must observe "
     "a lost update under at least one seed (the dynamic RED gate)",

@@ -1026,6 +1026,80 @@ class FleetConfig:
 
 
 @dataclass
+class KVTiersConfig:
+    """``serving.kvcache.tiers`` block (docs/serving.md §KV tiering):
+    hierarchical page residency HBM (T0) → pinned host memory (T1) →
+    disk (T2).  Cold pages demote asynchronously past the watermark;
+    promotion is demand-driven plus scheduler-hinted prefetch."""
+
+    enabled: bool = C.SERVING_KVCACHE_TIERS_ENABLED_DEFAULT
+    host_pages: int = C.SERVING_KVCACHE_TIERS_HOST_PAGES_DEFAULT  # 0 = unbounded
+    disk_dir: str = C.SERVING_KVCACHE_TIERS_DISK_DIR_DEFAULT  # "" = no T2
+    # tokens of a parked session kept T0-resident; tail pages beyond
+    # this demote (0 keeps whole sessions resident until cold)
+    residency_window: int = C.SERVING_KVCACHE_TIERS_RESIDENCY_WINDOW_DEFAULT
+    demote_watermark: float = C.SERVING_KVCACHE_TIERS_DEMOTE_WATERMARK_DEFAULT
+    prefetch_ahead: int = C.SERVING_KVCACHE_TIERS_PREFETCH_AHEAD_DEFAULT
+    demote_batch: int = C.SERVING_KVCACHE_TIERS_DEMOTE_BATCH_DEFAULT
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "KVTiersConfig":
+        if d is None:
+            return cls()
+        if isinstance(d, KVTiersConfig):
+            d = dataclasses.asdict(d)
+        d = dict(d)
+        block = (f"{C.SERVING}.{C.SERVING_KVCACHE}"
+                 f".{C.SERVING_KVCACHE_TIERS}")
+        out = cls(
+            enabled=bool(_pop(d, "enabled",
+                              C.SERVING_KVCACHE_TIERS_ENABLED_DEFAULT)),
+            host_pages=int(_pop(d, "host_pages",
+                                C.SERVING_KVCACHE_TIERS_HOST_PAGES_DEFAULT)),
+            disk_dir=str(_pop(d, "disk_dir",
+                              C.SERVING_KVCACHE_TIERS_DISK_DIR_DEFAULT) or ""),
+            residency_window=int(_pop(
+                d, "residency_window",
+                C.SERVING_KVCACHE_TIERS_RESIDENCY_WINDOW_DEFAULT)),
+            demote_watermark=float(_pop(
+                d, "demote_watermark",
+                C.SERVING_KVCACHE_TIERS_DEMOTE_WATERMARK_DEFAULT)),
+            prefetch_ahead=int(_pop(
+                d, "prefetch_ahead",
+                C.SERVING_KVCACHE_TIERS_PREFETCH_AHEAD_DEFAULT)),
+            demote_batch=int(_pop(
+                d, "demote_batch",
+                C.SERVING_KVCACHE_TIERS_DEMOTE_BATCH_DEFAULT)),
+        )
+        _check_empty(d, block, _known_keys(cls))
+        if out.host_pages < 0:
+            raise DeepSpeedConfigError(
+                f"'{block}.host_pages' must be >= 0 (0 = unbounded), "
+                f"got {out.host_pages}"
+            )
+        if out.residency_window < 0:
+            raise DeepSpeedConfigError(
+                f"'{block}.residency_window' must be >= 0 (0 keeps whole "
+                f"sessions resident), got {out.residency_window}"
+            )
+        if not (0.0 < out.demote_watermark <= 1.0):
+            raise DeepSpeedConfigError(
+                f"'{block}.demote_watermark' must be in (0, 1], "
+                f"got {out.demote_watermark}"
+            )
+        if out.prefetch_ahead < 0:
+            raise DeepSpeedConfigError(
+                f"'{block}.prefetch_ahead' must be >= 0, "
+                f"got {out.prefetch_ahead}"
+            )
+        if out.demote_batch < 1:
+            raise DeepSpeedConfigError(
+                f"'{block}.demote_batch' must be >= 1, got {out.demote_batch}"
+            )
+        return out
+
+
+@dataclass
 class KVCacheConfig:
     """``serving.kvcache`` block (docs/serving.md §Paged KV & prefix
     caching): the paged KV pool — fixed-shape page buffers with a host
@@ -1041,6 +1115,9 @@ class KVCacheConfig:
     pinned_prefixes: Tuple[Tuple[int, ...], ...] = ()
     session_ttl_seconds: float = C.SERVING_KVCACHE_SESSION_TTL_SECONDS_DEFAULT
     spill_dir: str = C.SERVING_KVCACHE_SPILL_DIR_DEFAULT
+    # hierarchical HBM -> host -> disk page tiering (docs/serving.md
+    # §KV tiering)
+    tiers: KVTiersConfig = field(default_factory=KVTiersConfig)
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "KVCacheConfig":
@@ -1050,6 +1127,8 @@ class KVCacheConfig:
             d = dataclasses.asdict(d)
         d = dict(d)
         block = f"{C.SERVING}.{C.SERVING_KVCACHE}"
+        tiers = KVTiersConfig.from_dict(
+            _pop(d, C.SERVING_KVCACHE_TIERS, None))
         raw_pins = _pop(d, "pinned_prefixes", ())
         if raw_pins is None:
             raw_pins = ()
@@ -1067,6 +1146,7 @@ class KVCacheConfig:
                 )
             pins.append(tuple(int(t) for t in spec))
         out = cls(
+            tiers=tiers,
             enabled=bool(_pop(d, "enabled", C.SERVING_KVCACHE_ENABLED_DEFAULT)),
             page_len=int(_pop(d, "page_len", C.SERVING_KVCACHE_PAGE_LEN_DEFAULT)),
             num_pages=int(_pop(d, "num_pages", C.SERVING_KVCACHE_NUM_PAGES_DEFAULT)),

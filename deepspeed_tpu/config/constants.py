@@ -279,6 +279,16 @@ SERVING_KVCACHE_PAGE_LEN_DEFAULT = 128  # tokens per KV page (kernel wants %128)
 SERVING_KVCACHE_NUM_PAGES_DEFAULT = 0  # 0 = derive (garbage page + 2x slot capacity)
 SERVING_KVCACHE_SESSION_TTL_SECONDS_DEFAULT = 0.0  # 0 = warm sessions never expire
 SERVING_KVCACHE_SPILL_DIR_DEFAULT = ""  # "" = cold sessions drop instead of spill
+# -- hierarchical KV tiering (serving.kvcache.tiers.*; docs/serving.md
+# §KV tiering): HBM (T0) -> pinned host memory (T1) -> disk (T2) ------
+SERVING_KVCACHE_TIERS = "tiers"
+SERVING_KVCACHE_TIERS_ENABLED_DEFAULT = False
+SERVING_KVCACHE_TIERS_HOST_PAGES_DEFAULT = 0  # T1 page cap; 0 = unbounded
+SERVING_KVCACHE_TIERS_DISK_DIR_DEFAULT = ""  # "" = no T2 (host tier only)
+SERVING_KVCACHE_TIERS_RESIDENCY_WINDOW_DEFAULT = 0  # tokens kept in T0 per parked session; 0 = all
+SERVING_KVCACHE_TIERS_DEMOTE_WATERMARK_DEFAULT = 0.75  # demote when pages_live exceeds this fraction
+SERVING_KVCACHE_TIERS_PREFETCH_AHEAD_DEFAULT = 4  # queued admits prefetched per tick
+SERVING_KVCACHE_TIERS_DEMOTE_BATCH_DEFAULT = 4  # entries demoted per tick (bounds step-boundary traffic)
 # -- fleet front-door (serving.fleet.*; docs/serving.md §Fleet) -------
 SERVING_FLEET = "fleet"
 SERVING_FLEET_REPLICAS_DEFAULT = 1  # engine replicas behind the router

@@ -468,6 +468,26 @@ def serving_report(config=None) -> None:
                        if kv.spill_dir else "; no spill dir (cold sessions drop)"),
                 ),
             ]
+            # KV tiering rows (docs/serving.md §KV tiering)
+            t = getattr(kv, "tiers", None)
+            if t is not None and t.enabled:
+                rows.append((
+                    "kv tiering",
+                    f"on: T1 host <= {t.host_pages} pages"
+                    + (f", T2 disk -> {t.disk_dir}" if t.disk_dir
+                       else ", no T2 (host-only)")
+                    + f"; demote past {t.demote_watermark:g} pool watermark"
+                    + (f", tail residency {t.residency_window} tokens"
+                       if t.residency_window else "")
+                    + f", prefetch {t.prefetch_ahead} hint(s)/step",
+                ))
+                rows += _kv_tier_rows()
+            elif t is not None:
+                rows.append((
+                    "kv tiering",
+                    "off (serving.kvcache.tiers.enabled=false; "
+                    "parked sessions stay in HBM until spill/drop)",
+                ))
     # fleet front-door rows (docs/serving.md §Fleet)
     f = getattr(s, "fleet", None)
     if f is not None:
@@ -652,6 +672,52 @@ def telemetry_report(config=None) -> None:
     rows += _attribution_rows(t)
     for name, value in rows:
         print(f"{name} " + "." * (30 - len(name)) + f" {value}")
+
+
+def _kv_tier_rows() -> list:
+    """LIVE tier-state rows from the ``kvcache/tier/*`` gauges an armed
+    engine publishes each step (per-tier page counts/bytes, hit rates,
+    in-flight migrations, last swap-hide ratio).  Empty before the
+    first step — the config row above already says tiering is on."""
+    from deepspeed_tpu import telemetry as tel
+
+    g = {}
+    for m in tel.get_registry().metrics():
+        if m.name.startswith("kvcache/tier/") and m.kind == "gauge" \
+                and m.value is not None:
+            g[m.name[len("kvcache/tier/"):]] = m.value
+    if not g:
+        return []
+    hits = g.get("hits_t1", 0) + g.get("hits_t2", 0)
+    probes = hits + g.get("tier_misses", 0)
+    return [
+        (
+            "kv tier residency",
+            f"T1 {g.get('host_entries', 0):.0f} entr(ies) / "
+            f"{g.get('host_pages', 0):.0f} page(s) / "
+            f"{g.get('host_bytes', 0) / 2**20:.1f} MB, "
+            f"T2 {g.get('disk_entries', 0):.0f} entr(ies) / "
+            f"{g.get('disk_pages', 0):.0f} page(s)",
+        ),
+        (
+            "kv tier traffic",
+            f"demote {g.get('demote_t0_t1', 0):.0f}v {g.get('demote_t1_t2', 0):.0f}d, "
+            f"promote {g.get('promote_t1_t0', 0) + g.get('promote_t2_t0', 0):.0f}^ "
+            f"({g.get('promote_t2_t1', 0):.0f} prefetched), "
+            f"hit rate {hits / probes:.0%} over {probes:.0f} probe(s), "
+            f"{g.get('inflight', 0):.0f} migration(s) in flight"
+            if probes else
+            f"demote {g.get('demote_t0_t1', 0):.0f}v {g.get('demote_t1_t2', 0):.0f}d, "
+            f"no promotion probes yet, "
+            f"{g.get('inflight', 0):.0f} migration(s) in flight",
+        ),
+        (
+            "kv swap hiding",
+            f"{g.get('swap_hidden_ratio', 1.0):.0%} of "
+            f"{g.get('swap_seconds_total', 0.0):.2f}s swap IO hidden "
+            "beneath serving steps",
+        ),
+    ]
 
 
 def _attribution_rows(t) -> list:

@@ -16,7 +16,11 @@ loop plus the instrumentation to prove it:
 * :mod:`~deepspeed_tpu.runtime.overlap.timeline` —
   :class:`StepTimeline`, honest (fenced) per-step attribution of wall
   time to ``data_wait`` / ``compute`` / ``ckpt_stall`` / ``compile`` /
-  ``other``, exported through ``bench.py`` and ``ds_report``.
+  ``other``, exported through ``bench.py`` and ``ds_report``;
+* :mod:`~deepspeed_tpu.runtime.overlap.worker` —
+  :class:`BoundedWorker`, the shared bounded-queue background thread
+  (serving KV tier migration rides on it; see
+  ``deepspeed_tpu/serving/kvcache/tiers.py``).
 
 See ``docs/performance.md`` for the architecture and the config knobs.
 """
@@ -30,3 +34,4 @@ from deepspeed_tpu.runtime.overlap.prefetch import (  # noqa: F401
     inline_loader,
 )
 from deepspeed_tpu.runtime.overlap.timeline import PHASES, StepTimeline  # noqa: F401
+from deepspeed_tpu.runtime.overlap.worker import BoundedWorker  # noqa: F401

@@ -269,6 +269,26 @@ class ServingEngine:
         self._step_count = 0
         # kvcache event watermarks: deltas become Perfetto instants
         self._kv_evt_seen = {"evictions": 0, "session_spills": 0}
+        # hierarchical KV tiering (docs/serving.md §KV tiering): the
+        # tier manager's migration worker moves T1<->T2 in the
+        # background; the engine thread drives T0<->T1 through tick()
+        # at step boundaries (and from stats()/drain(), so an idle
+        # engine still drains pending demotions)
+        self._tiers = None
+        if self._paged and kvc.tiers.enabled:
+            from deepspeed_tpu.serving.kvcache.tiers import PageTierManager
+
+            self._tiers = PageTierManager(
+                self.pool,
+                host_pages=kvc.tiers.host_pages,
+                disk_dir=(kvc.tiers.disk_dir or None),
+                residency_window=kvc.tiers.residency_window,
+                demote_watermark=kvc.tiers.demote_watermark,
+                prefetch_ahead=kvc.tiers.prefetch_ahead,
+                demote_batch=kvc.tiers.demote_batch,
+            )
+            self._tiers.telemetry = self.telemetry
+            self.pool.attach_tiers(self._tiers)
         log_dist(
             f"serving engine: {config.num_slots} slots x {max_len} positions "
             f"(kv={'int8' if kv_dtype == 'int8' else jnp.dtype(kv_dtype).name}, "
@@ -726,6 +746,14 @@ class ServingEngine:
             # TTL sweep BEFORE admission: pages a cold session releases
             # this tick are available to the requests admitted in it
             self.pool.sweep(t0)
+        if self._tiers is not None:
+            # migration tick BEFORE admission: hinted prefetch pages
+            # upcoming admits/rebinds back to T0 so their prefill chunk
+            # runs against warm pages; watermark demotion batches the
+            # device_get traffic at the step boundary
+            self._tiers.tick(
+                t0, hints=self.scheduler.upcoming_hints(
+                    self._tiers.prefetch_ahead))
         with tl.phase("sched"):
             plan = self.scheduler.tick(t0, self._step_count, admit=admit)
         with tl.phase("prefill"):
@@ -752,6 +780,9 @@ class ServingEngine:
             )
         # retirements this step become durable at the boundary
         self._journal_commit()
+        if self._tiers is not None:
+            # the step's wall window feeds the swap-hide overlap ratio
+            self._tiers.note_step(t0, time.monotonic())
         self._publish_kvcache()
         return self.scheduler.has_work()
 
@@ -761,6 +792,10 @@ class ServingEngine:
         sweeps queued-deadline expiry first, so an idle engine's
         over-deadline waiters expire even when no step runs."""
         self.scheduler.sweep_expired(time.monotonic(), self._step_count)
+        if self._tiers is not None:
+            # idle-engine demotion: a drain() with no work must still
+            # turn the migration queue (mirror of the idle TTL sweep)
+            self._tiers.tick(time.monotonic())
         steps = 0
         while self.scheduler.has_work():
             self.step()
@@ -829,7 +864,12 @@ class ServingEngine:
             # restarted engine's recover() re-registers the spills and
             # turn N+1 rebinds across the restart (no-op w/o spill_dir)
             try:
-                n_spilled = self.pool.spill_sessions(time.monotonic())
+                if self._tiers is not None:
+                    # tiering path: demote every warm session and push
+                    # T1 to disk, so tiered state survives the process
+                    n_spilled = self._tiers.flush(time.monotonic())
+                else:
+                    n_spilled = self.pool.spill_sessions(time.monotonic())
                 if n_spilled:
                     log_dist(
                         f"serving: kvcache spilled {n_spilled} warm "
@@ -995,6 +1035,10 @@ class ServingEngine:
                         "session_spills", "session_restores", "prefix_entries",
                         "sessions_warm", "sessions_spilled"):
                 tm.gauge(f"kvcache/{key}").set(float(st[key]))
+        if tm.collect and self._tiers is not None and "tiers" in st:
+            for key, val in st["tiers"].items():
+                if isinstance(val, (int, float)):
+                    tm.gauge(f"kvcache/tier/{key}").set(float(val))
         tracer = tm.tracer if tm.tracer.enabled else None
         for key, name in (("evictions", "kvcache_evict"),
                           ("session_spills", "kvcache_spill")):
@@ -1146,6 +1190,10 @@ class ServingEngine:
             # traffic, so a drained-but-alive replica would pin its
             # pages forever without this (docs/serving.md §Elastic fleet)
             self.pool.sweep(time.monotonic())
+        if self._tiers is not None:
+            # idle-engine demotion: a quiescent engine must still drain
+            # pending demotions instead of holding T0 pages forever
+            self._tiers.tick(time.monotonic())
         if self.telemetry.collect:
             self.telemetry.gauge("serving/queue_depth_now").set(s.queue_depth)
             self.telemetry.gauge("serving/live_slots_now").set(self.pool.live_slots)

@@ -141,18 +141,24 @@ class LocalReplica:
             return None
         return self.engine.scheduler.admission.estimate_ttft_seconds(prompt_len)
 
-    def kv_affinity(self, prompt, session_id: Optional[str] = None) -> int:
+    def kv_affinity(self, prompt, session_id: Optional[str] = None) -> float:
         """Prompt tokens this replica could serve from its paged KV —
         a parked session for ``session_id`` or a cached prefix — the
         router's placement-affinity signal (docs/serving.md §Paged KV &
-        prefix caching).  Side-effect-free; 0 on the slot-contiguous
-        pool, a dead replica, or a miss."""
+        prefix caching).  With KV tiering armed the count is priced by
+        residency (HBM/host 1.0 > host 0.75 > disk 0.5): a replica that
+        must promote from disk offers less than one already holding the
+        pages warm.  Side-effect-free; 0 on the slot-contiguous pool, a
+        dead replica, or a miss."""
         if self._dead or self.engine is None:
-            return 0
+            return 0.0
+        priced = getattr(self.engine.pool, "affinity_tokens", None)
+        if priced is not None:
+            return float(priced(prompt, session_id=session_id))
         hint = getattr(self.engine.pool, "prefix_hint_tokens", None)
         if hint is None:
-            return 0
-        return int(hint(prompt, session_id=session_id))
+            return 0.0
+        return float(hint(prompt, session_id=session_id))
 
     def queue_depth(self) -> int:
         if self._dead or self.engine is None:

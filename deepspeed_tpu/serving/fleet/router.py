@@ -254,14 +254,17 @@ class FleetRouter:
                 continue
             if self._backpressure.get(name, 0.0) > now:
                 continue  # honoring the replica's own retry_after
-            aff = 0
+            aff = 0.0
             if prompt is not None:
                 probe = getattr(rep, "kv_affinity", None)
                 if probe is not None:
                     try:
-                        aff = int(probe(prompt, session_id=session_id))
+                        # float: tier-priced affinity (host 0.75 / disk
+                        # 0.5 per token) must keep its fraction so warm
+                        # residency outbids a disk-resident copy
+                        aff = float(probe(prompt, session_id=session_id))
                     except Exception:  # a probe failure must not unroute
-                        aff = 0
+                        aff = 0.0
             est = rep.estimate_ttft(prompt_len)
             scored.append((
                 0 if h.state == HEALTHY else 1,

@@ -147,6 +147,10 @@ class PagedKVPool:
         self.index = PrefixIndex()
         self.sessions = SessionStore(spill_dir=spill_dir,
                                      ttl_seconds=session_ttl_seconds)
+        # optional hierarchical tiering (attach_tiers); when armed,
+        # session spill/drop and cold prefix eviction route through the
+        # PageTierManager instead of dying or hitting spill_dir directly
+        self.tiers: Optional[Any] = None
         self._pinned_specs: List[np.ndarray] = [
             np.asarray(list(spec), np.int32) for spec in pinned_prefixes
             if len(list(spec)) >= 1
@@ -292,11 +296,18 @@ class PagedKVPool:
         sess = self.sessions.peek(session_id)
         if sess is None and self.sessions.is_spilled(session_id):
             sess = self._restore_session(session_id, now)
+        if sess is None and self.tiers is not None:
+            sess = self.tiers.promote_session(session_id, now)
         if sess is None:
             return None
         cl = sess.cached_len
         if cl > prompt.shape[0] or not np.array_equal(sess.tokens, prompt[:cl]):
             return None  # divergent history: leave parked for the TTL sweep
+        if self.tiers is not None and not self.tiers.promote_tail(sess, now):
+            # the tier-held tail cannot be paged back in: give the
+            # session up and re-prefill (rebind is only an optimisation)
+            self.tiers.drop_session(sess)
+            return None
         return sess
 
     @_locked
@@ -325,6 +336,10 @@ class PagedKVPool:
                 source = "session" if hit > 0 else None
         if source is None:
             entry = self.index.lookup(prompt, now=now)
+            if self.tiers is not None:
+                best = entry.length if entry is not None else 0
+                if self.tiers.promote_prefix_for(prompt, now, min_len=best):
+                    entry = self.index.lookup(prompt, now=now) or entry
             if entry is not None:
                 hit = self._aligned_hit(entry.length, plen)
                 source = "prefix" if hit > 0 else None
@@ -343,17 +358,27 @@ class PagedKVPool:
         )
         total = min(plen + int(req.max_new_tokens), self.max_len)
         need = max(_pages_for(total, self.page_len), n_cover)
+        # the slot takes its reference on every reused page BEFORE
+        # claiming fresh ones: _take_pages may reclaim under pressure,
+        # and reclaim is allowed to spill/demote the very session (or
+        # evict the very prefix entry) this rebind is consuming — the
+        # early incref keeps the reused pages (and their KV) live
+        # through that
+        self._page_incref(reuse)
         fresh = self._take_pages(need - n_cover + (1 if need_cow else 0), now)
         if fresh is None:
+            self._page_decref(reuse)
             self.alloc_waits += 1
             return None
-        # commit: slot takes a reference on every reused page; a
-        # consumed session releases all of its holds (tail pages beyond
-        # the cover free here unless shared)
-        self._page_incref(reuse)
         if source == "session":
+            # a consumed session releases all of its holds (tail pages
+            # beyond the cover free here unless shared); when reclaim
+            # spilled/demoted it mid-_take_pages its holds are already
+            # released and the off-pool copy goes stale — harmless, a
+            # later park for the sid supersedes it
             consumed = self.sessions.pop_warm(sid)
-            self._page_decref(consumed.pages)
+            if consumed is not None:
+                self._page_decref(consumed.pages)
             self.session_rebinds += 1
         mapping = list(reuse)
         cow: Optional[Tuple[int, int]] = None
@@ -454,10 +479,56 @@ class PagedKVPool:
             if (sess is not None and sess.cached_len <= plen
                     and np.array_equal(sess.tokens, prompt[: sess.cached_len])):
                 return self._aligned_hit(sess.cached_len, plen)
+            if self.tiers is not None:
+                cl, _tier = self.tiers.session_hint(prompt, session_id)
+                if cl:
+                    # a tiered session promotes on demand at alloc, so
+                    # the expected hit is as real as a warm one
+                    return self._aligned_hit(cl, plen)
         entry = self.index.lookup(prompt, stamp=False)
-        if entry is None:
-            return 0
-        return self._aligned_hit(entry.length, plen)
+        best = self._aligned_hit(entry.length, plen) if entry is not None else 0
+        if self.tiers is not None:
+            tl, _tier = self.tiers.prefix_hint(prompt)
+            if tl:
+                best = max(best, self._aligned_hit(tl, plen))
+        return best
+
+    # residency-discount weights for fleet affinity pricing: reused
+    # tokens are worth less when promoting them first costs a host
+    # scatter (T1) or a disk read + scatter (T2)
+    _TIER_WEIGHTS = {"": 1.0, "host": 0.75, "disk": 0.5}
+
+    @_locked
+    def affinity_tokens(self, prompt: np.ndarray,
+                        session_id: Optional[str] = None) -> float:
+        """Tier-aware :meth:`prefix_hint_tokens` for fleet routing:
+        cached tokens discounted by residency (T0 full, T1 3/4, T2 1/2)
+        so a session parked in host memory still beats a cold replica
+        but loses to a replica holding it in HBM."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        plen = int(prompt.shape[0])
+        if plen < 2:
+            return 0.0
+        best = 0.0
+        if session_id is not None:
+            sess = self.sessions.peek(session_id)
+            if (sess is not None and sess.cached_len <= plen
+                    and np.array_equal(sess.tokens, prompt[: sess.cached_len])):
+                best = float(self._aligned_hit(sess.cached_len, plen))
+            elif self.tiers is not None:
+                cl, tier = self.tiers.session_hint(prompt, session_id)
+                if cl:
+                    best = (self._aligned_hit(cl, plen)
+                            * self._TIER_WEIGHTS.get(tier, 0.5))
+        entry = self.index.lookup(prompt, stamp=False)
+        if entry is not None:
+            best = max(best, float(self._aligned_hit(entry.length, plen)))
+        if self.tiers is not None:
+            tl, tier = self.tiers.prefix_hint(prompt)
+            if tl:
+                best = max(best, self._aligned_hit(tl, plen)
+                           * self._TIER_WEIGHTS.get(tier, 0.5))
+        return best
 
     # -- retirement / sessions --------------------------------------------
     @_locked
@@ -489,6 +560,10 @@ class PagedKVPool:
                 ))
                 if prev is not None:
                     self._page_decref(prev.pages)
+                if self.tiers is not None:
+                    # a fresh park supersedes any tiered copy (mirror of
+                    # park() clearing a stale spill)
+                    self.tiers.discard_session(sid)
                 self._page_decref(dropped)
                 parked = True
         if not parked:
@@ -522,6 +597,11 @@ class PagedKVPool:
         self.v = put(self.v, "v")
 
     def _spill_or_drop(self, sess: Session) -> None:
+        if self.tiers is not None:
+            # tiering replaces direct spill/drop: the session parks in
+            # host memory and cascades to disk under host-cap pressure
+            self.tiers.demote_session(sess)
+            return
         if self.sessions.spill_dir is not None:
             self.sessions.spill(sess, self._gather_host(sess.pages))
         else:
@@ -570,12 +650,22 @@ class PagedKVPool:
         return len(warm)
 
     @_locked
+    def attach_tiers(self, mgr: Any) -> None:
+        """Arm hierarchical tiering: ``mgr`` (a
+        :class:`~deepspeed_tpu.serving.kvcache.tiers.PageTierManager`)
+        takes over session spill/drop and cold prefix eviction."""
+        self.tiers = mgr
+
+    @_locked
     def recover(self) -> List[str]:
         """Post-crash: re-register manifest-verified session spills so
         rebinds keep working across the restart.  (Device pages and the
         learned prefix index died with the process — replay re-prefills
         and re-learns, so outputs stay bit-identical.)"""
-        return self.sessions.recover()
+        found = self.sessions.recover()
+        if self.tiers is not None:
+            found = found + self.tiers.recover()
+        return found
 
     # -- live migration (docs/serving.md §Elastic fleet) ------------------
     @_locked
@@ -592,6 +682,11 @@ class PagedKVPool:
         os.makedirs(dest_dir, exist_ok=True)
         exported: List[str] = []
         for sess in self.sessions.warm():
+            # a residency-window session keeps only head pages in T0;
+            # the export must carry the tier-held tail too
+            leaves = (self.tiers.merged_session_leaves(sess)
+                      if self.tiers is not None
+                      else self._gather_host(sess.pages))
             write_entry(
                 dest_dir, session_dir_name(sess.session_id),
                 {
@@ -600,7 +695,7 @@ class PagedKVPool:
                     "tokens": [int(t) for t in sess.tokens],
                     "parked_at": sess.parked_at,
                 },
-                self._gather_host(sess.pages),
+                leaves,
             )
             exported.append(sess.session_id)
         for sid in self.sessions.spilled_ids():
@@ -625,6 +720,9 @@ class PagedKVPool:
                 self._gather_host(entry.pages),
             )
             exported.append(f"pin:{len(entry.tokens)}")
+        if self.tiers is not None:
+            exported.extend(self.tiers.export_sessions(
+                dest_dir, skip=set(exported)))
         return exported
 
     @_locked
@@ -705,7 +803,7 @@ class PagedKVPool:
     @_locked
     def stats(self) -> Dict[str, Any]:
         sess = self.sessions.stats()
-        return {
+        out = {
             "page_len": self.page_len,
             "num_pages": self.num_pages,
             "pages_per_slot": self.pages_per_slot,
@@ -728,3 +826,6 @@ class PagedKVPool:
             "session_restores": sess["restores"],
             "session_drops": sess["drops"],
         }
+        if self.tiers is not None:
+            out["tiers"] = self.tiers.stats()
+        return out

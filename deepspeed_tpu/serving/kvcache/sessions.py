@@ -43,6 +43,13 @@ def pin_dir_name(tokens: "np.ndarray") -> str:
     return "sess_pin_" + digest[:16]
 
 
+def prefix_dir_name(tokens: "np.ndarray") -> str:
+    """Entry dir for a tier-demoted (learned) prefix entry — distinct
+    from pins so tier recovery can tell them apart by name alone."""
+    digest = hashlib.sha256(np.asarray(tokens, np.int32).tobytes()).hexdigest()
+    return "sess_pfx_" + digest[:16]
+
+
 # -- migration wire format (docs/serving.md §Elastic fleet) ---------------
 # One directory per entry, identical to the spill layout: kv.npz +
 # meta.json staged first, manifest.json written LAST.  An export killed
@@ -147,6 +154,15 @@ def _load_leaves(path: str, dtypes: Dict[str, str]) -> Dict[str, np.ndarray]:
                 arr = arr.view(ml_dtypes.bfloat16)
             out[key] = arr
     return out
+
+
+# the tier manager stages its own T2 entries (it needs a fault site
+# between the staged payload and the manifest), so the leaf codec and
+# file names are part of this module's public surface
+save_leaves = _save_leaves
+load_leaves = _load_leaves
+META_FILE = _META_FILE
+DATA_FILE = _DATA_FILE
 
 
 class SessionStore:

@@ -425,6 +425,23 @@ class ContinuousScheduler:
             + [r.request_id for r in self._active.values()]
         )
 
+    def upcoming_hints(self, limit: int = 4) -> List[Tuple[Any, Optional[str]]]:
+        """(prompt, session_id) of the next admits in priority-FIFO
+        order — the KV tier manager's prefetch contract (docs/serving.md
+        §KV tiering): pages these requests need promote back to T0
+        *before* their prefill chunk runs.  Read-only on the queue."""
+        if limit <= 0 or not self._queue:
+            return []
+        # priority-then-FIFO, matching _pop_next (0 = high; stable sort
+        # preserves FIFO within a tier)
+        ordered = sorted(
+            self._queue, key=lambda r: getattr(r, "priority", 1)
+        )
+        return [
+            (r.prompt, getattr(r, "session_id", None))
+            for r in ordered[:limit]
+        ]
+
     def request(self, request_id: int) -> Optional[Request]:
         if request_id in self._finished:
             return self._finished[request_id]

@@ -37,6 +37,7 @@ def test_compressed_allreduce_approximates_mean():
     assert np.abs(np.asarray(werr2)).mean() > 0
 
 
+@pytest.mark.slow  # ~52s EF-convergence loop; approximates_mean above keeps the fast-path coverage, the comm CI job runs this one
 def test_compressed_allreduce_error_feedback_converges():
     """Feeding the SAME per-rank values repeatedly with error feedback, the
     time-average of outputs converges toward the true mean (the EF

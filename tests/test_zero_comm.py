@@ -72,6 +72,7 @@ def test_zero3_gather_traffic_is_param_sized():
     assert 2 * n_bf16 <= ag <= 16 * n_bf16, (ag, n_bf16, by)
 
 
+@pytest.mark.slow  # ~44s HLO compile; the sharding CI job runs test_zero_comm.py in full
 def test_zero3_gas2_repeats_gathers_per_micro():
     """gas=2 runs the gather/reduce machinery per micro batch (the
     reference pays the same per-micro gathers, stage3.py:1394-1599).
@@ -121,6 +122,7 @@ def _step_memory(stage):
     return engine._compiled[key].memory_analysis()
 
 
+@pytest.mark.slow  # ~37s fsdp8 compile + live-range analysis; the sharding CI job runs test_zero_comm.py in full
 def test_zero3_compiled_memory_is_sharded_at_fsdp8():
     """The regression this pins: GSPMD silently re-materializing the
     full param/opt tree under stage 3 (a bad sharding annotation makes

@@ -678,6 +678,155 @@ def run_kvcache_bench(engine, args, slots, chunk, max_len, max_new, model):
         f"{rec['ttft_p50_ms_off']} ms off, bit_identical={bit_identical}")
 
 
+def run_kvtiers_bench(engine, args, slots, chunk, max_len, max_new, model):
+    """The ``kvtiers`` rung (docs/serving.md §KV tiering): a long-context
+    session fleet whose parked working set is ~4x the device page pool,
+    run three ways with the SAME prompt schedule —
+
+    * all-HBM reference (paged KV, pool sized to hold everything);
+    * tiering armed but T0-resident (same big pool + tiers: measures the
+      tier manager's overhead when nothing needs to move);
+    * tiering armed at ~4x oversubscription (tiny T0, host + disk tiers
+      absorb the rest; every turn revisits sessions demoted since).
+
+    Gates: greedy outputs bit-identical to the all-HBM run, zero
+    ServingQueueFull at 4x, T0-resident tokens/s within 10% of all-HBM
+    (recorded as ``tok_ratio_resident``); ``swap_hidden_ratio`` records
+    what fraction of device<->host/disk migration time hid beneath
+    serving steps (soft gate >= 0.8)."""
+    import shutil
+    import tempfile
+
+    from deepspeed_tpu.serving import ServingEngine
+
+    rng = np.random.default_rng(args.seed)
+    vocab = engine.model_config.vocab_size
+    page_len = chunk
+    n_sess, n_turns = 8, 3
+    tail_len = max(4, page_len // 2)
+    budget = max(2, min(max_new, page_len // 4))
+    pages_for = lambda toks: -(-max(toks, 1) // page_len)
+    # the working set is what the sessions park by the end; size T0 to a
+    # quarter of it (but never below one max request's upfront claim)
+    parked_toks = n_turns * (tail_len + budget) - 1
+    ws_pages = n_sess * pages_for(parked_toks)
+    per_req = pages_for(n_turns * (tail_len + budget)) + 1  # +1 COW page
+    t0_usable = max(-(-ws_pages // 4), per_req + 1)
+    # the pool refuses a T0 smaller than one slot's ceiling, so cap this
+    # rung's max_len to what the longest turn actually needs
+    rung_max_len = min(max_len, page_len * (per_req + 1))
+    tails = [[rng.integers(1, vocab, tail_len, dtype=np.int32)
+              for _ in range(n_turns)] for _ in range(n_sess)]
+
+    def run(num_pages, tiers_kw):
+        kv = {"enabled": True, "page_len": page_len}
+        if num_pages:
+            kv["num_pages"] = num_pages
+        if tiers_kw:
+            kv["tiers"] = {"enabled": True, **tiers_kw}
+        srv = ServingEngine(engine, num_slots=slots, prefill_chunk=chunk,
+                            max_len=rung_max_len, max_queue=args.max_queue,
+                            max_new_tokens=budget, kvcache=kv)
+        warm(srv, [{"prompt": tails[0][0][: page_len // 2], "max_new": 2}])
+        outputs = []
+        hist = [np.array([], np.int32) for _ in range(n_sess)]
+        t0 = time.monotonic()
+        for turn in range(n_turns):
+            prompts = [np.concatenate([hist[s], tails[s][turn]]).astype(np.int32)
+                       for s in range(n_sess)]
+            rids = [srv.submit(prompts[s], max_new_tokens=budget,
+                               temperature=0.0, session_id=f"tier-sess-{s}")
+                    for s in range(n_sess)]
+            res = srv.drain(max_steps=100_000)
+            for s, rid in enumerate(rids):
+                gen = np.asarray(res[rid].generated, np.int32)
+                outputs.append(gen)
+                hist[s] = np.concatenate([prompts[s], gen]).astype(np.int32)
+        makespan = time.monotonic() - t0
+        toks = sum(len(o) for o in outputs)
+        st = srv.stats()
+        rejected = int(st.get("rejected", 0))
+        tiers = st.get("kvcache", {}).get("tiers")
+        if getattr(srv, "_tiers", None) is not None:
+            srv._tiers.close()  # stop the migration worker between runs
+        return outputs, toks / max(makespan, 1e-9), rejected, tiers
+
+    t2_dir = tempfile.mkdtemp(prefix="ds_kvtiers_")
+    # the all-HBM pool holds the parked working set AND every active
+    # slot's upfront claim comfortably below the default demote
+    # watermark — no reclaim or demotion pressure, the true T0 baseline
+    hbm_pages = int((ws_pages + slots * per_req) / 0.7) + 2
+    try:
+        out_ref, tps_ref, rej_ref, _ = run(hbm_pages, None)
+        out_res, tps_res, rej_res, tiers_res = run(hbm_pages, {
+            "host_pages": t0_usable, "disk_dir": os.path.join(t2_dir, "res"),
+        })
+        out_4x, tps_4x, rej_4x, tiers_4x = run(t0_usable + 1, {
+            "host_pages": t0_usable,
+            "disk_dir": os.path.join(t2_dir, "cold"),
+            "residency_window": page_len,
+            "demote_watermark": 0.5,
+            "demote_batch": 8,
+            "prefetch_ahead": slots,
+        })
+    finally:
+        shutil.rmtree(t2_dir, ignore_errors=True)
+
+    bit_identical = (
+        len(out_4x) == len(out_ref) == len(out_res)
+        and all(np.array_equal(a, b) for a, b in zip(out_4x, out_ref))
+        and all(np.array_equal(a, b) for a, b in zip(out_res, out_ref))
+    )
+    ratio_res = round(tps_res / max(tps_ref, 1e-9), 3)
+    swaps = (tiers_4x["demote_t0_t1"] + tiers_4x["promote_t1_t0"]
+             + tiers_4x["promote_t2_t0"])
+    rec = {
+        "metric": f"serving_kvtiers_{model.replace('-', '_')}_4x",
+        # the headline is the KV capacity multiple served at zero
+        # rejects with bit-identical outputs — deterministic by
+        # construction, so the perf sentinel can gate it with a tight
+        # band (raw tok/s rides along below; too noisy on CPU runners)
+        "value": round(ws_pages / t0_usable, 2),
+        "unit": "x_hbm_kv_capacity",
+        "bit_identical": bit_identical,
+        "working_set_pages": ws_pages,
+        "t0_pages": t0_usable,
+        "oversubscription_x": round(ws_pages / t0_usable, 2),
+        "tokens_per_s_4x": round(tps_4x, 1),
+        "tokens_per_s_ref": round(tps_ref, 1),
+        "tokens_per_s_resident": round(tps_res, 1),
+        "tok_ratio_resident": ratio_res,
+        "queue_full_4x": rej_4x,
+        "swaps": swaps,
+        "swap_hidden_ratio": tiers_4x["swap_hidden_ratio"],
+        "demote_t0_t1": tiers_4x["demote_t0_t1"],
+        "demote_t1_t2": tiers_4x["demote_t1_t2"],
+        "promote_t1_t0": tiers_4x["promote_t1_t0"],
+        "promote_t2_t1": tiers_4x["promote_t2_t1"],
+        "promote_t2_t0": tiers_4x["promote_t2_t0"],
+        "hits_t1": tiers_4x["hits_t1"],
+        "hits_t2": tiers_4x["hits_t2"],
+        "sessions": n_sess,
+        "turns": n_turns,
+        "num_slots": slots,
+        "page_len": page_len,
+        "max_len": rung_max_len,
+    }
+    emit(rec, rung="kvtiers")
+    log(f"[kvtiers] {rec['oversubscription_x']}x working set: "
+        f"{rec['tokens_per_s_4x']} tok/s (ref {rec['tokens_per_s_ref']}, resident "
+        f"ratio {ratio_res}), {swaps} swaps, hidden "
+        f"{rec['swap_hidden_ratio']:.0%}, bit_identical={bit_identical}, "
+        f"queue_full={rej_4x}")
+    if not bit_identical:
+        raise SystemExit("[kvtiers] FAIL: tiered outputs diverge from all-HBM")
+    if rej_4x or rej_res or rej_ref:
+        raise SystemExit(f"[kvtiers] FAIL: ServingQueueFull raised "
+                         f"(ref={rej_ref} resident={rej_res} 4x={rej_4x})")
+    if swaps == 0:
+        raise SystemExit("[kvtiers] FAIL: 4x run never exercised the tiers")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--dryrun", action="store_true", help="tiny model on CPU")
@@ -708,6 +857,13 @@ def main():
                          "3-turn sessions, run with the cache on vs off — "
                          "records prefill-FLOPs reduction, hit rate, and "
                          "TTFT p50/p99 both ways at bit-identical outputs")
+    ap.add_argument("--kvtiers", action="store_true",
+                    help="KV-tiering mode (docs/serving.md §KV tiering): "
+                         "a session fleet whose parked KV working set is "
+                         "~4x the device page pool, vs an all-HBM "
+                         "reference — records tokens/s at 4x, the "
+                         "T0-resident overhead ratio, and the swap-hide "
+                         "ratio at bit-identical outputs")
     ap.add_argument("--overload", action="store_true",
                     help="overload-resilience mode: arm the estimated-TTFT "
                          "shedder (--slo-ttft-ms) and run 2x/4x offered load, "
@@ -779,6 +935,13 @@ def main():
 
     if args.kvcache:
         run_kvcache_bench(engine, args, slots, chunk, max_len, max_new, model)
+        if args.trace:
+            path = telemetry.export_trace(args.trace)
+            log(f"trace exported -> {path}")
+        return
+
+    if args.kvtiers:
+        run_kvtiers_bench(engine, args, slots, chunk, max_len, max_new, model)
         if args.trace:
             path = telemetry.export_trace(args.trace)
             log(f"trace exported -> {path}")
