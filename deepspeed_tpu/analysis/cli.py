@@ -86,6 +86,12 @@ def cli_main(argv: Optional[List[str]] = None) -> int:
         from deepspeed_tpu.analysis.race.cli import cli_main as race_main
 
         return race_main(argv[1:])
+    if argv and argv[0] == "shard":
+        # partition-spec dataflow + compiled-collective audit; imports
+        # the runtime (it compiles the engines), unlike lint/race
+        from deepspeed_tpu.analysis.shard.cli import cli_main as shard_main
+
+        return shard_main(argv[1:])
     if argv and argv[0] == "lint":
         argv = argv[1:]
     args = _build_parser().parse_args(argv)

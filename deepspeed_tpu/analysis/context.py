@@ -13,9 +13,10 @@ Suppression syntax (checked by tests/test_ds_lint.py):
   rule(s) for the whole file;
 * ``all`` is accepted in place of a rule list.
 
-The ``ds-race`` tool shares the table: ``# ds-race: disable=...`` is
-parsed identically (rule ids are disjoint across tools, so one table
-serves both without cross-talk).
+The ``ds-race`` and ``ds-shard`` tools share the table:
+``# ds-race: disable=...`` / ``# ds-shard: disable=...`` are parsed
+identically (rule ids are disjoint across tools, so one table serves
+all of them without cross-talk).
 """
 from __future__ import annotations
 
@@ -26,7 +27,7 @@ from dataclasses import dataclass, field
 from io import StringIO
 from typing import Dict, List, Optional, Set, Tuple
 
-_SUPPRESS_RE = re.compile(r"#\s*ds-(?:lint|race):\s*(disable(?:-file)?)\s*=\s*([A-Za-z0-9_,\- ]+)")
+_SUPPRESS_RE = re.compile(r"#\s*ds-(?:lint|race|shard):\s*(disable(?:-file)?)\s*=\s*([A-Za-z0-9_,\- ]+)")
 
 
 def _parse_rule_list(raw: str) -> Set[str]:

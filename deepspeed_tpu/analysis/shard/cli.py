@@ -1,0 +1,167 @@
+"""``ds_shard`` command-line interface.
+
+Unlike ds_lint/ds_race (AST-only, never import the linted code),
+ds_shard IMPORTS the runtime: Pass 1 eval-shapes the engine trees and
+Pass 2 compiles the engines at their dryrun configs.  The CLI therefore
+forces the 8-device CPU mesh before jax loads (the same environment
+tests/conftest.py sets) unless devices are already configured.
+
+Exit codes mirror ds_lint: 0 clean (or only findings below the failing
+tier), 1 new findings at/above the failing tier (default: tier A),
+2 usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+
+def _ensure_devices() -> None:
+    """Give jax an 8-device CPU mesh if nothing configured one yet.
+    Must run before the first jax import — a no-op when the caller
+    (pytest, a TPU launcher) already owns the platform env."""
+    if "jax" in sys.modules:
+        return
+    n = os.environ.get("DS_SHARD_DEVICES", "8")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ds_shard",
+        description="Partition-spec dataflow analysis + compiled-collective "
+        "audit: certifies every engine executable's comm against the byte "
+        "model (docs/ds_shard.md).",
+    )
+    p.add_argument("--baseline", metavar="PATH",
+                   help="baseline file (default: nearest .ds_shard_baseline.json)")
+    p.add_argument("--no-baseline", action="store_true", help="ignore any baseline file")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="record all current findings as the new baseline and exit 0")
+    p.add_argument("--select", metavar="RULES", help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--disable", metavar="RULES", help="comma-separated rule ids to skip")
+    p.add_argument("--engines", metavar="NAMES",
+                   help="comma-separated dryrun engines (default: train,offload,"
+                   "pipe,inference,serving)")
+    p.add_argument("--tables-only", action="store_true",
+                   help="audit only the built-in family rule tables (no jax, sub-second)")
+    p.add_argument("--inject", metavar="MODE", choices=["dcn-allgather"],
+                   help="add a synthetic guilty site (CI RED-gate self-test)")
+    p.add_argument("--fail-on", default="A", choices=["A", "B", "C"],
+                   help="lowest tier that fails the run (default: A)")
+    p.add_argument("--format", default="text", choices=["text", "json"], dest="fmt")
+    p.add_argument("--json", action="store_const", const="json", dest="fmt",
+                   help="shorthand for --format json")
+    p.add_argument("--list-rules", action="store_true", help="print the rule catalog and exit")
+    p.add_argument("-q", "--quiet", action="store_true", help="findings only, no summary")
+    return p
+
+
+def _split(raw: Optional[str]) -> Optional[List[str]]:
+    if not raw:
+        return None
+    return [part.strip() for part in raw.split(",") if part.strip()]
+
+
+def cli_main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    args = _build_parser().parse_args(argv)
+
+    from deepspeed_tpu.analysis.shard.rules import all_shard_rules
+
+    if args.list_rules:
+        rules = all_shard_rules()
+        width = max(len(r) for r in rules)
+        for rid in sorted(rules, key=lambda r: (-rules[r].tier, r)):
+            rule = rules[rid]
+            print(f"[{rule.tier.name}] {rid.ljust(width)}  {rule.description}")
+        return 0
+
+    if not args.tables_only:
+        _ensure_devices()
+
+    from deepspeed_tpu.analysis import baseline as baseline_mod
+    from deepspeed_tpu.analysis.core import Severity
+    from deepspeed_tpu.analysis.shard.runner import (
+        SHARD_BASELINE_NAME,
+        _REPO_ROOT,
+        shard_run,
+    )
+
+    fail_on = Severity.parse(args.fail_on)
+    baseline_path = args.baseline
+    if args.write_baseline and baseline_path is None:
+        # resolve BEFORE the run so fingerprints root at its directory
+        baseline_path = baseline_mod.discover([_REPO_ROOT], name=SHARD_BASELINE_NAME) \
+            or os.path.join(_REPO_ROOT, SHARD_BASELINE_NAME)
+
+    start = time.monotonic()
+    try:
+        result = shard_run(
+            select=_split(args.select),
+            disable=_split(args.disable),
+            baseline_path=baseline_path,
+            use_baseline=not args.no_baseline,
+            engines=_split(args.engines),
+            tables_only=args.tables_only,
+            inject=args.inject,
+        )
+    except (FileNotFoundError, KeyError, ValueError) as e:
+        print(f"ds_shard: error: {e}", file=sys.stderr)
+        return 2
+    elapsed = time.monotonic() - start
+
+    if args.write_baseline:
+        baseline_mod.save(baseline_path, result.all_current, tool="ds_shard")
+        print(f"ds_shard: wrote {len(result.all_current)} finding(s) to {baseline_path}")
+        return 0
+
+    if args.fmt == "json":
+        print(json.dumps(
+            {
+                "findings": [
+                    {
+                        "rule": f.rule, "path": f.path, "line": f.line, "col": f.col,
+                        "severity": f.severity.name, "message": f.message,
+                        "fingerprint": f.fingerprint,
+                    }
+                    for f in result.findings
+                ],
+                "baselined": len(result.baselined),
+                "suppressed": result.suppressed,
+                "files": result.files,
+            },
+            indent=1,
+        ))
+    else:
+        for f in result.findings:
+            print(f.format())
+        if not args.quiet:
+            tiers = ", ".join(
+                f"{result.count(t)} tier-{t.name}"
+                for t in (Severity.A, Severity.B, Severity.C))
+            bits = [f"{len(result.findings)} finding(s) ({tiers})"]
+            if result.baselined:
+                bits.append(f"{len(result.baselined)} baselined")
+            if result.suppressed:
+                bits.append(f"{result.suppressed} suppressed")
+            print(f"ds_shard: {', '.join(bits)} in {elapsed:.2f}s "
+                  f"(failing tier: {fail_on.name}+)")
+
+    return 1 if result.failing(fail_on) else 0
+
+
+def main() -> None:
+    sys.exit(cli_main())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,163 @@
+"""ds_shard rule catalog and the audit data model.
+
+Unlike ds_lint/ds_race, ds_shard rules are not AST visitors: Pass 1
+consumes :class:`SiteContext` objects (eval-shaped engine trees +
+their resolved shardings) and the family rule tables; Pass 2 consumes
+optimized HLO text.  The catalog below only carries id/tier/description
+so the CLI, baseline, and ds_report treat all four tools uniformly.
+
+Rule catalog (docs/ds_shard.md has the long-form version):
+
+* ``unresolved-partition-spec`` (A) — a param/state/KV leaf does not
+  resolve through PartitionRules into a spec the mesh can realize:
+  resolution raised, the spec names an axis the mesh doesn't have, the
+  spec has more dims than the leaf, or a sharded dim is not divisible
+  by its axis size.
+* ``conflicting-partition-spec`` (A) — the leaf's *live* sharding
+  contradicts the rule-resolved base spec: a dim the table shards over
+  a >1-sized axis is not sharded over that axis at runtime (the rule
+  engine and the executable disagree about the layout contract).
+* ``dead-rule-row`` (B) — a regex row in a family table matches no
+  leaf in the family's model corpus: the row documents a layout that
+  cannot occur and hides typos (the rule it was meant for never fires).
+* ``shadowed-rule-row`` (B) — a row matches leaves, but an earlier row
+  wins first-match on every one of them: the row's spec is
+  unreachable.
+* ``donation-layout-mismatch`` (A) — a donated input's sharding
+  differs from the output sharding at the same tree position: XLA
+  cannot alias the buffer, so donation silently degrades to a copy
+  (doubles peak HBM for the state tree).
+* ``replicated-blowup`` (B) — an intermediate above a configurable
+  fraction of per-device HBM is materialized with no sharding
+  constraint on it; reported with the op's source line (pre-compile
+  heuristic: GSPMD may still shard it, but above the threshold that
+  bet should be explicit).
+* ``unbudgeted-collective`` (A) — a compiled ICI collective whose
+  bytes no CommLayer decision record or byte-model row covers within
+  tolerance: GSPMD inserted a reshard nobody priced.
+* ``unbudgeted-dcn-collective`` (A) — same, for a collective whose
+  replica groups cross the DCN seam — including any *uncompressed*
+  dense collective at/above the DCN policy floor, budgeted or not
+  (PR 8's policy table requires the compressed strategy there).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from deepspeed_tpu.analysis.core import Finding, Rule, Severity
+
+_SHARD_REGISTRY: Dict[str, Rule] = {}
+
+
+def _register(rule_id: str, tier: str, description: str) -> None:
+    _SHARD_REGISTRY[rule_id] = Rule(
+        id=rule_id, tier=Severity.parse(tier), description=description,
+        check=lambda *a, **k: [], scope="project",
+    )
+
+
+_register("unresolved-partition-spec", "A",
+          "param/state/KV leaf does not resolve through PartitionRules "
+          "into a spec the mesh can realize")
+_register("conflicting-partition-spec", "A",
+          "live leaf sharding contradicts the rule-resolved base spec")
+_register("dead-rule-row", "B",
+          "family-table regex row matches no leaf in the family corpus")
+_register("shadowed-rule-row", "B",
+          "family-table row never wins first-match (an earlier row "
+          "shadows it everywhere)")
+_register("donation-layout-mismatch", "A",
+          "donated input sharding differs from the output sharding at "
+          "the same tree position (donation degrades to a copy)")
+_register("replicated-blowup", "B",
+          "unconstrained intermediate above the configured HBM "
+          "fraction (replicated materialization risk)")
+_register("unbudgeted-collective", "A",
+          "compiled ICI collective not covered by a CommLayer decision "
+          "or the byte model within tolerance")
+_register("unbudgeted-dcn-collective", "A",
+          "DCN-crossing collective unbudgeted or uncompressed at/above "
+          "the DCN policy floor")
+
+
+def all_shard_rules() -> Dict[str, Rule]:
+    return dict(_SHARD_REGISTRY)
+
+
+def make_shard_finding(rule_id: str, path: str, line: int,
+                       message: str, col: int = 0) -> Finding:
+    rule = _SHARD_REGISTRY[rule_id]
+    return Finding(rule=rule_id, path=path, line=line, col=col,
+                   message=message, severity=rule.tier)
+
+
+# ---------------------------------------------------------------------------
+# audit data model
+# ---------------------------------------------------------------------------
+
+@dataclass
+class LeafSpec:
+    """One param/state/KV leaf as Pass 1 sees it: tree path, abstract
+    shape/dtype, and (when the engine placed it) the live PartitionSpec
+    it actually carries."""
+
+    path: str
+    shape: Tuple[int, ...]
+    dtype: Any = None
+    actual: Optional[Any] = None  # live PartitionSpec (or None: unplaced)
+    kind: str = "param"           # param | state | kv
+
+
+@dataclass
+class DonationPair:
+    """A donated input leaf and the output leaf XLA should alias it to
+    (same tree position of donated argnum vs out_shardings)."""
+
+    path: str
+    donor: Optional[Any]   # PartitionSpec of the donated input leaf
+    target: Optional[Any]  # PartitionSpec declared for the output leaf
+
+
+@dataclass
+class SiteContext:
+    """Everything ds_shard knows about one engine compile site.
+
+    Engines build these through ``hooks`` at their existing AOT-compile
+    sites; test fixtures build them by hand.  ``origin`` anchors
+    findings that have no better source attribution (and is the line a
+    ``# ds-shard: disable=...`` pragma suppresses them on).
+    """
+
+    site: str
+    mesh: Any = None                    # jax Mesh (None: spec-only ctx)
+    topology: Any = None                # sharding.mesh.MeshTopology
+    rules: Any = None                   # sharding.rules.PartitionRules
+    origin: Tuple[str, int] = ("<unknown>", 1)
+    leaves: List[LeafSpec] = field(default_factory=list)
+    donations: List[DonationPair] = field(default_factory=list)
+    budget: Dict[str, int] = field(default_factory=dict)
+    decisions: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    jaxpr_thunk: Optional[Callable[[], Any]] = None
+    hlo_thunk: Optional[Callable[[], Optional[str]]] = None
+
+    def hlo_text(self) -> Optional[str]:
+        if self.hlo_thunk is None:
+            return None
+        return self.hlo_thunk()
+
+
+def mesh_axis_sizes(mesh) -> Dict[str, int]:
+    """{axis: size} for a jax Mesh (empty when mesh is None)."""
+    if mesh is None:
+        return {}
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def spec_dim_axes(entry) -> Tuple[str, ...]:
+    """Normalize one PartitionSpec entry to a tuple of axis names."""
+    if entry is None:
+        return ()
+    if isinstance(entry, str):
+        return (entry,)
+    return tuple(entry)

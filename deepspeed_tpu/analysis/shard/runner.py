@@ -1,0 +1,427 @@
+"""ds_shard runner: dryrun engine builds -> Pass 1 (spec dataflow) +
+Pass 2 (compiled-collective audit) -> suppression + baseline filtering.
+
+``shard_run`` mirrors ``lint_paths``/``race_paths`` — same LintResult
+shape, same fingerprint/baseline semantics — so the CLI, CI gate,
+ds_report, and tests treat all four analysis tools interchangeably.
+The baseline lives next to ds_lint's as ``.ds_shard_baseline.json``;
+the last self-run verdict is persisted to ``.ds_shard_status.json``
+(the ds_report row).
+
+The dryrun builds compile exactly what production compiles: each engine
+is constructed at its tiny dryrun config on the 8-device CPU mesh and
+driven through the ONE call that hits its AOT-compile site, with the
+hook collector armed.  A builder that cannot run on the current backend
+(pipe SPMD on some CPU jaxlibs) records a skip note, never a finding.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+from deepspeed_tpu.analysis import baseline as baseline_mod
+from deepspeed_tpu.analysis.context import parse_suppressions
+from deepspeed_tpu.analysis.core import Finding
+from deepspeed_tpu.analysis.runner import LintResult
+from deepspeed_tpu.analysis.shard import hooks
+from deepspeed_tpu.analysis.shard.hloaudit import audit_hlo
+from deepspeed_tpu.analysis.shard.rules import all_shard_rules
+from deepspeed_tpu.analysis.shard.speccheck import (
+    audit_builtin_tables,
+    audit_site_specs,
+)
+
+SHARD_BASELINE_NAME = ".ds_shard_baseline.json"
+SHARD_STATUS_NAME = ".ds_shard_status.json"
+
+#: engine dryruns in build order; ``--engines`` selects a subset
+ENGINE_DRYRUNS = ("train", "offload", "pipe", "inference", "serving")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+def _select_ids(select: Optional[Iterable[str]],
+                disable: Optional[Iterable[str]]) -> Set[str]:
+    rules = all_shard_rules()
+    keep = set(rules)
+    if select:
+        unknown = set(select) - set(rules)
+        if unknown:
+            raise KeyError(f"unknown rule(s): {sorted(unknown)}")
+        keep = set(select)
+    if disable:
+        unknown = set(disable) - set(rules)
+        if unknown:
+            raise KeyError(f"unknown rule(s): {sorted(unknown)}")
+        keep -= set(disable)
+    return keep
+
+
+# ---------------------------------------------------------------------------
+# dryrun engine builders (each drives exactly one AOT-compile site)
+# ---------------------------------------------------------------------------
+
+def _gpt2_tiny_cfg():
+    import dataclasses
+
+    from deepspeed_tpu.models import gpt2
+
+    return dataclasses.replace(
+        gpt2.GPT2_TINY, remat=False, scan_unroll=gpt2.GPT2_TINY.n_layer)
+
+
+def _train_config(**extra):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 10_000,
+    }
+    cfg.update(extra)
+    return cfg
+
+
+def _tiny_batch(cfg, global_bs=16, seq=16):
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    return {"input_ids": rng.integers(0, cfg.vocab_size, (global_bs, seq),
+                                      dtype=np.int32)}
+
+
+def _dryrun_train() -> None:
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt2
+
+    cfg = _gpt2_tiny_cfg()
+    model_fn, init_fn, tp_fn = gpt2.make_model(cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model_fn, model_parameters=init_fn(),
+        config=_train_config(), tp_spec_fn=tp_fn)
+    engine.train_batch(_tiny_batch(cfg))
+
+
+def _dryrun_offload() -> None:
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt2
+
+    cfg = _gpt2_tiny_cfg()
+    model_fn, init_fn, tp_fn = gpt2.make_model(cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model_fn, model_parameters=init_fn(),
+        config=_train_config(
+            zero_optimization={"stage": 2,
+                               "offload_optimizer": {"device": "cpu"}}),
+        tp_spec_fn=tp_fn)
+    engine.train_batch(_tiny_batch(cfg))
+
+
+class _PipeLinear:
+    """Minimal pipe layer (the tests/test_pipe.py fixture shape)."""
+
+    def __init__(self, dim, act=True):
+        self.dim, self.act = dim, act
+
+    def init(self, rng):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        w = jax.random.normal(rng, (self.dim, self.dim), jnp.float32)
+        return {"w": w / np.sqrt(self.dim), "b": jnp.zeros((self.dim,), jnp.float32)}
+
+    def apply(self, params, x, rng=None):
+        import jax
+
+        h = x @ params["w"].astype(x.dtype) + params["b"].astype(x.dtype)
+        return jax.nn.gelu(h) if self.act else h
+
+
+def _dryrun_pipe() -> None:
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.runtime.pipe import LayerSpec, PipelineModule
+
+    def mse(outputs, labels):
+        return jnp.mean((outputs.astype(jnp.float32) - labels.astype(jnp.float32)) ** 2)
+
+    dim, gas, micro_bs = 16, 4, 2
+    module = PipelineModule(
+        layers=[LayerSpec(_PipeLinear, dim) for _ in range(4)]
+        + [LayerSpec(_PipeLinear, dim, act=False)],
+        loss_fn=mse)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=module,
+        config=_train_config(
+            gradient_accumulation_steps=gas,
+            mesh={"pipe": 2, "data": -1}))
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((gas * micro_bs, dim)).astype(np.float32)
+    y = np.tanh(x * 0.3)
+    engine.train_batch(batch=(x, y))
+
+
+def _dryrun_inference() -> None:
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt2
+
+    inf = deepspeed_tpu.init_inference(
+        model_config=gpt2.GPT2_TINY, params=gpt2.init_params(gpt2.GPT2_TINY),
+        dtype=jnp.float32, max_out_tokens=gpt2.GPT2_TINY.n_positions)
+    inf.generate(np.ones((2, 8), np.int32), max_new_tokens=4)
+
+
+def _dryrun_serving() -> None:
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt2
+    from deepspeed_tpu.serving import ServingEngine
+
+    inf = deepspeed_tpu.init_inference(
+        model_config=gpt2.GPT2_TINY, params=gpt2.init_params(gpt2.GPT2_TINY),
+        dtype=jnp.float32, max_out_tokens=gpt2.GPT2_TINY.n_positions)
+    srv = ServingEngine(inf, num_slots=2, prefill_chunk=8, max_len=32)
+    # building the jits is enough — the notes fire at construction and
+    # Pass 2 AOT-lowers lazily; nothing needs to execute
+    srv._get_prefill()
+    srv._get_decode()
+
+
+_BUILDERS = {
+    "train": _dryrun_train,
+    "offload": _dryrun_offload,
+    "pipe": _dryrun_pipe,
+    "inference": _dryrun_inference,
+    "serving": _dryrun_serving,
+}
+
+
+def _inject_dcn_allgather(collector: hooks.ShardCollector) -> None:
+    """RED-gate fixture: a hand-injected ``with_sharding_constraint``
+    that forces GSPMD to materialize a >=1 MiB uncompressed all-gather
+    across the full device set — with ``DS_DCN_SLICES=2`` its replica
+    groups cross the DCN seam, which the audit must flag as tier-A
+    ``unbudgeted-dcn-collective`` no matter what any budget says."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from deepspeed_tpu.analysis.shard.rules import SiteContext
+    from deepspeed_tpu.sharding.mesh import derive_topology
+
+    devices = np.asarray(jax.devices())
+    mesh = Mesh(devices.reshape((devices.size,)), ("data",))
+
+    def fn(x):
+        y = jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P()))
+        return y * 2.0
+
+    jit_fn = jax.jit(
+        fn,
+        # deliberately guilty: the RED-gate spec bypasses the rule engine
+        in_shardings=NamedSharding(mesh, P("data")),  # ds-lint: disable=hand-built-partition-spec
+        out_shardings=NamedSharding(mesh, P()),
+    )
+    arg = jax.ShapeDtypeStruct((1 << 19,), jnp.float32)  # 2 MiB f32
+
+    def hlo_thunk():
+        try:
+            return jit_fn.lower(arg).compile().as_text()
+        except Exception as e:  # noqa: BLE001
+            collector.skip("inject.dcn-allgather",
+                           f"AOT compile unavailable: {type(e).__name__}: {e}")
+            return None
+
+    collector.add(SiteContext(
+        site="inject.dcn-allgather",
+        mesh=mesh,
+        topology=derive_topology(mesh),
+        origin=(os.path.abspath(__file__), 1),
+        hlo_thunk=hlo_thunk,
+    ))
+
+
+def collect_sites(engines: Optional[Sequence[str]] = None,
+                  inject: Optional[str] = None) -> hooks.ShardCollector:
+    """Arm the hook collector, run the selected dryrun builders, and
+    return the collected SiteContexts (collector stays usable after
+    disarm — only the global note switch is reset)."""
+    wanted = tuple(engines) if engines else ENGINE_DRYRUNS
+    unknown = set(wanted) - set(_BUILDERS)
+    if unknown:
+        raise KeyError(f"unknown engine(s): {sorted(unknown)}")
+    collector = hooks.arm()
+    try:
+        for name in wanted:
+            try:
+                _BUILDERS[name]()
+            except Exception as e:  # noqa: BLE001 — capability, not finding
+                collector.skip(name, f"dryrun failed: {type(e).__name__}: {e}")
+        if inject == "dcn-allgather":
+            _inject_dcn_allgather(collector)
+        elif inject:
+            raise KeyError(f"unknown inject mode: {inject}")
+    finally:
+        hooks.disarm()
+    return collector
+
+
+# ---------------------------------------------------------------------------
+# shard_run — the library entry point (CLI and tests go through it)
+# ---------------------------------------------------------------------------
+
+def _normalize_path(path: str, root: str) -> str:
+    """Repo-relative display paths for anything under the root (stable
+    fingerprints across checkouts); absolute paths stay as-is."""
+    ap = os.path.abspath(path) if os.path.isabs(path) else os.path.abspath(
+        os.path.join(root, path))
+    try:
+        rel = os.path.relpath(ap, root)
+    except ValueError:
+        return path
+    return path if rel.startswith("..") else rel
+
+
+def _read_sources(findings: List[Finding], root: str) -> Dict[str, str]:
+    sources: Dict[str, str] = {}
+    for f in findings:
+        if f.path in sources:
+            continue
+        ap = f.path if os.path.isabs(f.path) else os.path.join(root, f.path)
+        try:
+            with open(ap, "r", encoding="utf-8") as fh:
+                sources[f.path] = fh.read()
+        except (OSError, UnicodeDecodeError):
+            sources[f.path] = ""
+    return sources
+
+
+def shard_run(
+    select: Optional[Iterable[str]] = None,
+    disable: Optional[Iterable[str]] = None,
+    baseline_path: Optional[str] = None,
+    use_baseline: bool = True,
+    engines: Optional[Sequence[str]] = None,
+    tables_only: bool = False,
+    inject: Optional[str] = None,
+    root: Optional[str] = None,
+    write_status: bool = True,
+    sites: Optional[Sequence] = None,
+) -> LintResult:
+    """Run both passes and return a LintResult.
+
+    ``sites`` bypasses the dryrun builders with prebuilt SiteContexts
+    (test fixtures); ``tables_only`` audits just the built-in family
+    rule tables (no jax work at all); ``inject`` adds a synthetic
+    guilty site (the CI RED-gate).
+    """
+    root = os.path.abspath(root or _REPO_ROOT)
+    keep = _select_ids(select, disable)
+    result = LintResult()
+
+    raw: List[Finding] = []
+    notes: List[str] = []
+    site_names: List[str] = []
+
+    raw.extend(audit_builtin_tables())
+
+    if sites is not None:
+        for ctx in sites:
+            site_names.append(ctx.site)
+            raw.extend(audit_site_specs(ctx))
+            raw.extend(audit_hlo(ctx))
+    elif not tables_only:
+        collector = collect_sites(engines=engines, inject=inject)
+        for name in sorted(collector.sites):
+            ctx = collector.sites[name]
+            site_names.append(name)
+            raw.extend(audit_site_specs(ctx))
+            raw.extend(audit_hlo(ctx))
+        # after the audit loop: lazy HLO thunks record their skips during it
+        notes = list(collector.notes)
+
+    raw = [f for f in raw if f.rule in keep]
+    for f in raw:
+        f.path = _normalize_path(f.path, root)
+
+    sources = _read_sources(raw, root)
+    live: List[Finding] = []
+    suppressions = {p: parse_suppressions(src) for p, src in sources.items()}
+    for f in raw:
+        sup = suppressions.get(f.path)
+        if sup is not None and sup.is_suppressed(f.rule, f.line):
+            result.suppressed += 1
+        else:
+            live.append(f)
+
+    if baseline_path is None and use_baseline:
+        baseline_path = baseline_mod.discover([root], name=SHARD_BASELINE_NAME)
+    result.baseline_path = baseline_path
+    fp_root = os.path.dirname(os.path.abspath(baseline_path)) if baseline_path else root
+    baseline_mod.assign_fingerprints(live, fp_root, sources)
+
+    known: Set[str] = set()
+    if use_baseline and baseline_path and os.path.isfile(baseline_path):
+        known = baseline_mod.load(baseline_path)
+    for f in live:
+        (result.baselined if f.fingerprint in known else result.findings).append(f)
+
+    result.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    result.baselined.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    result.files = len(sources)
+
+    # an --inject run is deliberately guilty (the RED-gate): its verdict
+    # must not clobber the persisted status ds_report shows
+    if write_status and sites is None and not tables_only and inject is None:
+        write_run_status(result, root=root, sites=site_names, notes=notes)
+    return result
+
+
+def status_path(root: Optional[str] = None) -> str:
+    return os.path.join(os.path.abspath(root or _REPO_ROOT), SHARD_STATUS_NAME)
+
+
+def write_run_status(result: LintResult, root: Optional[str] = None,
+                     sites: Optional[Sequence[str]] = None,
+                     notes: Optional[Sequence[str]] = None) -> str:
+    """Persist the self-run verdict for ds_report (best-effort: a
+    read-only checkout must not make the audit itself fail)."""
+    from deepspeed_tpu.analysis.core import Severity
+
+    path = status_path(root)
+    payload = {
+        "version": 1,
+        "tool": "ds_shard",
+        "verdict": "RED" if result.failing(Severity.A) else "GREEN",
+        "new": len(result.findings),
+        "new_tier_a": len(result.failing(Severity.A)),
+        "baselined": len(result.baselined),
+        "suppressed": result.suppressed,
+        "sites": list(sites or []),
+        "skips": list(notes or []),
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    try:
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(payload, fh, indent=1)
+            fh.write("\n")
+    except OSError:
+        pass
+    return path
+
+
+def read_run_status(root: Optional[str] = None) -> Optional[Dict]:
+    try:
+        with open(status_path(root), "r", encoding="utf-8") as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None

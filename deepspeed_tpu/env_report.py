@@ -838,6 +838,10 @@ def analysis_report() -> None:
     )
     from deepspeed_tpu.analysis.race.stress import all_scenarios
     from deepspeed_tpu.analysis.sanitizer.cli import SAN_BASELINE_NAME
+    from deepspeed_tpu.analysis.shard.rules import all_shard_rules
+    from deepspeed_tpu.analysis.shard.runner import (
+        SHARD_BASELINE_NAME, read_run_status,
+    )
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -868,6 +872,9 @@ def analysis_report() -> None:
                     f"{len(all_scenarios())} stress scenario(s), "
                     f"{baseline_size(RACE_BASELINE_NAME)}"),
     ]
+    shard_rules = all_shard_rules()
+    rows.append(("ds_shard", f"{len(shard_rules)} rule(s) ({tiers(shard_rules)}), "
+                             f"{baseline_size(SHARD_BASELINE_NAME)}"))
     t0 = time.monotonic()
     try:
         res = race_paths([os.path.join(root, "deepspeed_tpu")])
@@ -879,6 +886,22 @@ def analysis_report() -> None:
                      f"{time.monotonic() - t0:.1f}s"))
     except Exception as e:  # noqa: BLE001 — a report must not crash the report
         rows.append(("ds_race self-run", f"{RED}failed{END}: {e!r}"))
+    # ds_shard compiles every engine, far too heavy for a report — show
+    # the persisted verdict of the last real run instead
+    status = read_run_status(root)
+    if status is None:
+        rows.append(("ds_shard self-run",
+                     "no run recorded (bin/ds_shard to refresh)"))
+    else:
+        verdict = status.get("verdict", "?")
+        color = GREEN if verdict == "GREEN" else RED
+        rows.append((
+            "ds_shard self-run",
+            f"{color}{verdict}{END} over {len(status.get('sites', []))} "
+            f"site(s), {status.get('new_tier_a', '?')} new tier-A, "
+            f"{len(status.get('skips', []))} skip(s) at "
+            f"{status.get('timestamp', '?')}",
+        ))
     for name, value in rows:
         print(f"{name} " + "." * (30 - len(name)) + f" {value}")
 

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from deepspeed_tpu.analysis.shard import hooks as shard_hooks
 from deepspeed_tpu.comm.mesh import MESH_AXES, MeshInfo
 from deepspeed_tpu.config.config import MeshConfig
 from deepspeed_tpu.utils.logging import log_dist, logger
@@ -234,7 +235,7 @@ class InferenceEngine:
                 # run as (x @ q) * s in the fused decode path
                 from deepspeed_tpu.runtime.weight_quantizer import pack_int8_tree
 
-                params = pack_int8_tree(params, donate=owns_params)
+                params = pack_int8_tree(params, donate=owns_params, mesh=self.mesh)
                 owns_params = True  # pack outputs are fresh arrays
                 self._packed_int8 = True
             else:
@@ -683,6 +684,13 @@ class InferenceEngine:
             self._compiled[key] = self._build_generate(
                 B, T, max_new_tokens, do_sample, temperature, top_k, eos_token_id, masked=masked
             )
+            # ds_shard Pass 1/2 feed (no-op unless the audit armed it)
+            if shard_hooks.armed():
+                shard_hooks.note_jit(
+                    self, "inference.generate", self._compiled[key],
+                    (self.params, input_ids, jax.random.PRNGKey(seed), attention_mask),
+                    leaves=shard_hooks.live_param_leaves(self.params),
+                )
         # telemetry (docs/telemetry.md): closed-generate calls count
         # tokens dispatched; no fence is added — the span measures the
         # host call window, the caller owns the sync

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from deepspeed_tpu.analysis.shard import hooks as shard_hooks
 from deepspeed_tpu.comm.mesh import MeshInfo
 from deepspeed_tpu.config.config import DeepSpeedConfig
 from deepspeed_tpu.sharding import (
@@ -974,7 +975,18 @@ class DeepSpeedEngine:
                 state["grad_acc"] = jax.tree.map(jnp.zeros_like, state["grad_acc"])
                 return state, grads
 
-            self._compiled["fetch_grads"] = jax.jit(fetch, donate_argnums=(0,))
+            # _scoped: the grad fetch runs under the engine mesh like every
+            # other executable (and ds_lint's bare-jit rule stays clean)
+            self._compiled["fetch_grads"] = jax.jit(self._scoped(fetch), donate_argnums=(0,))
+            # ds_shard Pass 1/2 feed (no-op unless the audit armed it)
+            if shard_hooks.armed():
+                budget, decisions = shard_hooks.train_budget(self)
+                shard_hooks.note_jit(
+                    self, "train.offload_drain", self._compiled["fetch_grads"],
+                    (self.state,),
+                    leaves=shard_hooks.live_param_leaves(self.state["params"]),
+                    budget=budget, decisions=decisions,
+                )
         self.state, grads = self._compiled["fetch_grads"](self.state)
         # copy=True: device_get may hand back read-only buffers and the
         # host path unscales/clips in place
@@ -1725,6 +1737,11 @@ class DeepSpeedEngine:
                 )
             self._compiled[tb_key] = executable
             self.compilation_count += 1
+            # ds_shard Pass 1/2 feed (no-op unless the audit armed it)
+            shard_hooks.note_train(self, "train.train_batch", executable,
+                                   fn=self._scoped(full_step),
+                                   args=(self.state, stacked),
+                                   out_state_shardings=out_sh[0])
             if san is not None:
                 # signature of exactly what was lowered: a recount here
                 # names the state/batch leaf whose shape/dtype/sharding

@@ -54,6 +54,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from deepspeed_tpu.analysis.shard import hooks as shard_hooks
 from deepspeed_tpu.comm import collectives
 from deepspeed_tpu.config.config import DeepSpeedConfig
 from deepspeed_tpu.runtime.engine import DeepSpeedEngine
@@ -489,6 +490,15 @@ class PipelineEngine(DeepSpeedEngine):
                 return state, loss, info
 
             self._compiled["pipe_train"] = jax.jit(self._scoped(full_step), donate_argnums=(0,))
+            # ds_shard Pass 1/2 feed (no-op unless the audit armed it)
+            if shard_hooks.armed():
+                budget, decisions = shard_hooks.train_budget(self)
+                shard_hooks.note_jit(
+                    self, "pipe.train_batch", self._compiled["pipe_train"],
+                    (self.state, full),
+                    leaves=shard_hooks.live_param_leaves(self.state["params"]),
+                    budget=budget, decisions=decisions,
+                )
 
         self.state, loss, info = self._compiled["pipe_train"](self.state, full)
         if self.loss_scaler.dynamic:

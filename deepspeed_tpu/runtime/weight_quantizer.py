@@ -100,13 +100,16 @@ def quantize_transformer_layer(params: Any, bits: int = 8, groups: int = 1) -> A
     return WeightQuantization(bits=bits, groups=groups).quantize_dequantize_tree(params)
 
 
-def pack_int8_tree(params: Any, donate: bool = False) -> Any:
+def pack_int8_tree(params: Any, donate: bool = False, mesh: Any = None) -> Any:
     """True-int8 packing for the serving path: every matmul weight
     (``*_w``, ndim>=2, non-embedding) becomes ``{"q": int8, "s": f32}``
     with per-output-channel scales (``ops/quantizer.quantize_per_channel``);
     the inference block computes ``(x @ q) * s`` so weights stay int8 in
-    HBM — halving decode weight bandwidth vs bf16."""
+    HBM — halving decode weight bandwidth vs bf16.  ``mesh`` scopes the
+    pack trace (falls back to the mesh the params are already placed
+    on, so GSPMD keeps their layout instead of guessing)."""
     from deepspeed_tpu.ops.quantizer.quantizer import quantize_per_channel
+    from deepspeed_tpu.parallel.sequence import scoped_to
 
     def visit(path, leaf):
         name = str(getattr(path[-1], "key", path[-1])) if path else ""
@@ -125,5 +128,10 @@ def pack_int8_tree(params: Any, donate: bool = False) -> Any:
         # (per-leaf eager ops would pay a dispatch round trip each);
         # donate=True frees the full-precision originals as it goes —
         # only safe when the caller owns the tree (engine-created init)
-        return jax.jit(pack, donate_argnums=0 if donate else ())(params)
+        if mesh is None:
+            for leaf in jax.tree.leaves(params):
+                mesh = getattr(getattr(leaf, "sharding", None), "mesh", None)
+                if mesh is not None:
+                    break
+        return jax.jit(scoped_to(mesh, pack), donate_argnums=0 if donate else ())(params)
     return jax.tree.map(np.asarray, pack(params))

@@ -627,21 +627,21 @@ class ZeroInfinityEngine:
 
         from deepspeed_tpu.parallel.sequence import scoped_to
 
-        sc = lambda fn: scoped_to(self.mesh, fn)  # ambient mesh for traces
+        mesh = self.mesh  # ambient mesh for traces
         self._compiled = {
-            "embed": jax.jit(sc(embed)),
-            "group_fwd": jax.jit(sc(group_fwd)),
-            "head": jax.jit(sc(head)),
+            "embed": jax.jit(scoped_to(mesh, embed)),
+            "group_fwd": jax.jit(scoped_to(mesh, group_fwd)),
+            "head": jax.jit(scoped_to(mesh, head)),
             # group grads leave in the groups' own 1/P fsdp layout —
             # GSPMD lowers the grad reduction to a reduce-scatter over
             # fsdp (+ psum over data) instead of a full allreduce
             "group_bwd": jax.jit(
-                sc(group_bwd), donate_argnums=(3,),
+                scoped_to(mesh, group_bwd), donate_argnums=(3,),
                 out_shardings=(self._group_shardings, self._batch_sh),
             ),
-            "embed_bwd": jax.jit(sc(embed_bwd), donate_argnums=(2,)),
-            "group_eval": jax.jit(sc(group_eval)),
-            "head_eval": jax.jit(sc(head_eval)),
+            "embed_bwd": jax.jit(scoped_to(mesh, embed_bwd), donate_argnums=(2,)),
+            "group_eval": jax.jit(scoped_to(mesh, group_eval)),
+            "head_eval": jax.jit(scoped_to(mesh, head_eval)),
         }
         return self._compiled
 

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu import telemetry as _telemetry
+from deepspeed_tpu.analysis.shard import hooks as shard_hooks
 from deepspeed_tpu.config.config import ServingConfig
 from deepspeed_tpu.resilience import faults
 from deepspeed_tpu.serving.journal import JournalError, RequestJournal
@@ -262,6 +263,7 @@ class ServingEngine:
 
         self._sanitizer = maybe_from_config(None)
         self._prefill_fn = None
+        self._prefill_jit = None  # unwrapped jit handle (ds_shard audit)
         self._decode_fn = None
         self._decode_jit = None  # unwrapped jit handle (attribute_decode)
         self.prefill_compiles = 0
@@ -388,11 +390,14 @@ class ServingEngine:
 
                 donate = (9, 10)
 
-            self._prefill_fn = self._wrap(
-                jax.jit(self.engine._scoped(fn), donate_argnums=donate),
-                "serving.prefill",
-            )
+            self._prefill_jit = jax.jit(self.engine._scoped(fn), donate_argnums=donate)
+            self._prefill_fn = self._wrap(self._prefill_jit, "serving.prefill")
             self.prefill_compiles += 1
+            # ds_shard Pass 2 feed (no-op unless the audit armed it)
+            shard_hooks.note_serving(
+                self, "serving.prefill", self._prefill_jit,
+                self._prefill_abstract_args(),
+            )
         return self._prefill_fn
 
     def _get_decode(self):
@@ -446,19 +451,17 @@ class ServingEngine:
             self._decode_jit = jax.jit(self.engine._scoped(fn), donate_argnums=donate)
             self._decode_fn = self._wrap(self._decode_jit, "serving.decode")
             self.decode_compiles += 1
+            # ds_shard Pass 2 feed (no-op unless the audit armed it)
+            shard_hooks.note_serving(
+                self, "serving.decode", self._decode_jit,
+                self._decode_abstract_args(),
+            )
         return self._decode_fn
 
-    def attribute_decode(self):
-        """Per-kernel cost attribution of the decode executable
-        (docs/telemetry.md §Attribution): AOT-lower the decode function
-        against the pool's own shapes — abstract args only, so nothing
-        executes, no slot state is touched, and the sanitizer's
-        one-executable recompile proof is unaffected.  Returns an
-        :class:`~deepspeed_tpu.telemetry.attribution.Attribution` or
-        None when the backend exposes no HLO text."""
-        from deepspeed_tpu.telemetry.attribution import attribute_executable
-
-        self._get_decode()  # ensure the jit handle exists
+    def _decode_abstract_args(self):
+        """The decode executable's argument signature as
+        ShapeDtypeStructs (pool-derived, nothing executes) — shared by
+        ``attribute_decode`` and the ds_shard collective audit."""
         S = self.pool.num_slots
         abstract = lambda tree: jax.tree.map(  # noqa: E731
             lambda a: jax.ShapeDtypeStruct(np.shape(a), a.dtype), tree
@@ -478,7 +481,46 @@ class ServingEngine:
                 jax.ShapeDtypeStruct((S,), jnp.bool_),  # write_mask
             ]
         args += [abstract(self.pool.k), abstract(self.pool.v)]
-        compiled = self._decode_jit.lower(*args).compile()
+        return tuple(args)
+
+    def _prefill_abstract_args(self):
+        """The prefill executable's argument signature (one chunk, one
+        slot) as ShapeDtypeStructs — the ds_shard audit's AOT feed."""
+        abstract = lambda tree: jax.tree.map(  # noqa: E731
+            lambda a: jax.ShapeDtypeStruct(np.shape(a), a.dtype), tree
+        )
+        chunk = self.config.prefill_chunk
+        i32 = lambda: jax.ShapeDtypeStruct((), jnp.int32)  # noqa: E731
+        args = [abstract(self.engine.params),
+                jax.ShapeDtypeStruct((1, chunk), jnp.int32)]
+        if self._paged:
+            args += [
+                jax.ShapeDtypeStruct((self.pool.pages_per_slot,), jnp.int32),
+                i32(), i32(), i32(), i32(),  # pos, take_idx, cow_src, cow_dst
+            ]
+        else:
+            args += [i32(), i32(), i32()]   # slot, pos, take_idx
+        args += [
+            jax.ShapeDtypeStruct((), jnp.bool_),    # do_sample
+            jax.ShapeDtypeStruct((), jnp.float32),  # temperature
+            jax.ShapeDtypeStruct((), jnp.int32),    # top_k
+            jax.ShapeDtypeStruct((), jnp.uint32),   # seed
+            abstract(self.pool.k), abstract(self.pool.v),
+        ]
+        return tuple(args)
+
+    def attribute_decode(self):
+        """Per-kernel cost attribution of the decode executable
+        (docs/telemetry.md §Attribution): AOT-lower the decode function
+        against the pool's own shapes — abstract args only, so nothing
+        executes, no slot state is touched, and the sanitizer's
+        one-executable recompile proof is unaffected.  Returns an
+        :class:`~deepspeed_tpu.telemetry.attribution.Attribution` or
+        None when the backend exposes no HLO text."""
+        from deepspeed_tpu.telemetry.attribution import attribute_executable
+
+        self._get_decode()  # ensure the jit handle exists
+        compiled = self._decode_jit.lower(*self._decode_abstract_args()).compile()
         return attribute_executable(compiled, label="serving_decode")
 
     # ------------------------------------------------------------------

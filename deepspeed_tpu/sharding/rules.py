@@ -268,16 +268,36 @@ def register_family(name: str, builder: Callable[[SpecLayout], Tuple[Rule, ...]]
     _FAMILIES[name] = builder
 
 
+def _neo_rules(layout: SpecLayout) -> Tuple[Rule, ...]:
+    """GPT-Neo shares the GPT-2 param schema (models/gpt2.py PRESETS
+    "gpt-neo-2.7b" is a GPT2Config with local-attention layers) but is
+    dense-only, so its table carries no MoE expert rows — every row
+    here matches a leaf a Neo checkpoint can actually contain
+    (ds_shard ``dead-rule-row``)."""
+    return _transformer_tp_rules(layout) + (
+        (r"(^|/)wte$", layout.vocab_embedding()),
+    )
+
+
+def _moe_family_rules(layout: SpecLayout) -> Tuple[Rule, ...]:
+    """MoE GPT-2 (models/gpt2.py with n_experts > 0): attention stays
+    Megatron-split, the FFN is the expert stack — the dense fc_w/fc_b/
+    fc_proj_w rows never match an MoE tree (the experts replace the
+    dense FFN entirely), so they are omitted rather than kept dead."""
+    tp = layout.tp_axis
+    return (
+        (r"(^|/)qkv_w$", PartitionSpec(None, None, tp)),
+        (r"(^|/)qkv_b$", PartitionSpec(None, tp)),
+        (r"(^|/)proj_w$", PartitionSpec(None, tp, None)),
+    ) + _moe_rules(layout) + (
+        (r"(^|/)wte$", layout.vocab_embedding()),
+    )
+
+
 register_family("gpt2", _gpt2_rules)
 register_family("bert", _bert_rules)
-# GPT-Neo shares the GPT-2 param schema (models/gpt2.py PRESETS
-# "gpt-neo-2.7b" is a GPT2Config with local-attention layers); the
-# alias keeps the family catalog explicit for checkpoints/docs.
-register_family("neo", _gpt2_rules)
-# the gpt2 table already carries the MoE expert rules (models/gpt2.py
-# hosts the MoE blocks); the alias keeps a distinct catalog entry
-# without duplicating rules that first-match-wins would shadow
-register_family("moe", _gpt2_rules)
+register_family("neo", _neo_rules)
+register_family("moe", _moe_family_rules)
 
 _RULES_CACHE: Dict[Tuple[str, SpecLayout], PartitionRules] = {}
 

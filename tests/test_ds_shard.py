@@ -1,0 +1,483 @@
+"""ds_shard: partition-spec dataflow analysis + compiled-collective
+audit (docs/ds_shard.md).  Guilty and clean fixtures per rule, the
+family-table hygiene regression, baseline round-trip, and pragma
+suppression on the attributed line."""
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.analysis import baseline as baseline_mod
+from deepspeed_tpu.analysis.core import Severity
+from deepspeed_tpu.analysis.shard.hloaudit import (
+    audit_hlo,
+    crosses_dcn,
+    group_axes,
+    parse_collectives,
+    _parse_groups,
+)
+from deepspeed_tpu.analysis.shard.rules import (
+    DonationPair,
+    LeafSpec,
+    SiteContext,
+    all_shard_rules,
+)
+from deepspeed_tpu.analysis.shard.runner import (
+    SHARD_BASELINE_NAME,
+    shard_run,
+)
+from deepspeed_tpu.analysis.shard.speccheck import (
+    audit_builtin_tables,
+    audit_donations,
+    audit_jaxpr,
+    audit_leaves,
+    audit_rule_table,
+)
+from deepspeed_tpu.sharding.rules import PartitionRules
+
+
+def data_mesh():
+    devs = np.asarray(jax.devices())
+    return Mesh(devs.reshape((devs.size,)), ("data",))
+
+
+def rules_of(*rows):
+    return PartitionRules(rows, name="fixture")
+
+
+def by_rule(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# rule catalog
+# ---------------------------------------------------------------------------
+def test_catalog_has_all_eight_rules():
+    rules = all_shard_rules()
+    assert set(rules) == {
+        "unresolved-partition-spec", "conflicting-partition-spec",
+        "dead-rule-row", "shadowed-rule-row", "donation-layout-mismatch",
+        "replicated-blowup", "unbudgeted-collective",
+        "unbudgeted-dcn-collective",
+    }
+    tier_a = {r for r, rule in rules.items() if rule.tier == Severity.A}
+    assert tier_a == {
+        "unresolved-partition-spec", "conflicting-partition-spec",
+        "donation-layout-mismatch", "unbudgeted-collective",
+        "unbudgeted-dcn-collective",
+    }
+
+
+# ---------------------------------------------------------------------------
+# Pass 1: leaf resolution (unresolved / conflicting)
+# ---------------------------------------------------------------------------
+class TestLeafResolution:
+    def test_clean_leaf_resolves(self):
+        ctx = SiteContext(
+            site="t", mesh=data_mesh(),
+            rules=rules_of((r"(^|/)w$", P("data", None))),
+            leaves=[LeafSpec("blocks/w", (16, 4), actual=P("data", None))])
+        assert audit_leaves(ctx) == []
+
+    def test_unknown_axis_is_unresolved(self):
+        ctx = SiteContext(
+            site="t", mesh=data_mesh(),
+            rules=rules_of((r"(^|/)w$", P("model", None))),
+            leaves=[LeafSpec("blocks/w", (16, 4))])
+        fs = by_rule(audit_leaves(ctx), "unresolved-partition-spec")
+        assert len(fs) == 1 and "model" in fs[0].message
+        assert fs[0].severity == Severity.A
+
+    def test_non_divisible_dim_is_unresolved(self):
+        ctx = SiteContext(
+            site="t", mesh=data_mesh(),
+            rules=rules_of((r"(^|/)w$", P("data", None))),
+            leaves=[LeafSpec("blocks/w", (10, 4))])  # 10 % 8 != 0
+        fs = by_rule(audit_leaves(ctx), "unresolved-partition-spec")
+        assert len(fs) == 1 and "not divisible" in fs[0].message
+
+    def test_rank_overflow_is_unresolved(self):
+        ctx = SiteContext(
+            site="t", mesh=data_mesh(),
+            rules=rules_of((r"(^|/)w$", P(None, None, "data"))),
+            leaves=[LeafSpec("blocks/w", (16, 4))])
+        fs = by_rule(audit_leaves(ctx), "unresolved-partition-spec")
+        assert len(fs) == 1 and "rank" in fs[0].message
+
+    def test_raising_table_is_unresolved(self):
+        def boom(path, shape):
+            raise ValueError("no rule for " + path)
+
+        ctx = SiteContext(
+            site="t", mesh=data_mesh(),
+            rules=PartitionRules.from_fn(boom, name="boom"),
+            leaves=[LeafSpec("blocks/w", (16, 4))])
+        fs = by_rule(audit_leaves(ctx), "unresolved-partition-spec")
+        assert len(fs) == 1 and "resolution raised" in fs[0].message
+
+    def test_live_sharding_conflict(self):
+        # table shards dim 0 over data(8) but the live array is
+        # replicated: the rule engine and the executable disagree
+        ctx = SiteContext(
+            site="t", mesh=data_mesh(),
+            rules=rules_of((r"(^|/)w$", P("data", None))),
+            leaves=[LeafSpec("blocks/w", (16, 4), actual=P())])
+        fs = by_rule(audit_leaves(ctx), "conflicting-partition-spec")
+        assert len(fs) == 1 and "disagree" in fs[0].message
+        assert fs[0].severity == Severity.A
+
+    def test_composition_may_add_axes(self):
+        # ZeRO stacks fsdp on top of the base spec — extra live axes on
+        # the same dim are NOT a conflict as long as the base survives
+        devs = np.asarray(jax.devices()).reshape(4, 2)
+        mesh = Mesh(devs, ("data", "fsdp"))
+        ctx = SiteContext(
+            site="t", mesh=mesh,
+            rules=rules_of((r"(^|/)w$", P("data", None))),
+            leaves=[LeafSpec("blocks/w", (16, 4), actual=P(("data", "fsdp"), None))])
+        assert audit_leaves(ctx) == []
+
+
+# ---------------------------------------------------------------------------
+# Pass 1: dead / shadowed family-table rows
+# ---------------------------------------------------------------------------
+class TestRuleTableHygiene:
+    CORPUS = {"tiny": ["wte", "blocks/qkv_w", "blocks/fc_w"]}
+
+    def test_clean_table(self):
+        rules = rules_of((r"(^|/)qkv_w$", P(None, None, "model")),
+                         (r"(^|/)wte$", P("model", None)))
+        assert audit_rule_table("fam", rules, self.CORPUS) == []
+
+    def test_dead_row(self):
+        rules = rules_of((r"(^|/)qkv_w$", P(None, None, "model")),
+                         (r"(^|/)nonexistent_w$", P(None, "model")))
+        fs = audit_rule_table("fam", rules, self.CORPUS)
+        assert [f.rule for f in fs] == ["dead-rule-row"]
+        assert "nonexistent_w" in fs[0].message
+        assert fs[0].severity == Severity.B
+
+    def test_shadowed_row(self):
+        # row 0 matches every path row 1 could claim — first-match-wins
+        # makes row 1 unreachable
+        rules = rules_of((r"_w$", P(None, "model")),
+                         (r"(^|/)qkv_w$", P(None, None, "model")))
+        fs = audit_rule_table("fam", rules, self.CORPUS)
+        assert [f.rule for f in fs] == ["shadowed-rule-row"]
+        assert "row(s) [0]" in fs[0].message
+
+    def test_duplicate_pattern_is_shadowed_even_corpus_free(self):
+        rules = rules_of((r"(^|/)wte$", P("model", None)),
+                         (r"(^|/)wte$", P(None, "model")))
+        fs = audit_rule_table("fam", rules, {})
+        assert [f.rule for f in fs] == ["shadowed-rule-row"]
+        assert "duplicates row 0" in fs[0].message
+
+    def test_builtin_tables_have_no_dead_or_shadowed_rows(self):
+        # the satellite regression: every built-in family (gpt2, bert,
+        # neo, moe) audits clean against its own model corpus
+        assert audit_builtin_tables() == []
+
+
+# ---------------------------------------------------------------------------
+# Pass 1: donation layout
+# ---------------------------------------------------------------------------
+class TestDonationLayout:
+    def test_clean_donation(self):
+        ctx = SiteContext(site="t", donations=[
+            DonationPair("params/w", P("data", None), P("data", None))])
+        assert audit_donations(ctx) == []
+
+    def test_mismatched_donation(self):
+        ctx = SiteContext(site="t", donations=[
+            DonationPair("params/w", P("data", None), P())])
+        fs = audit_donations(ctx)
+        assert [f.rule for f in fs] == ["donation-layout-mismatch"]
+        assert "copies" in fs[0].message and fs[0].severity == Severity.A
+
+
+# ---------------------------------------------------------------------------
+# Pass 1: replicated blowup (jaxpr walk)
+# ---------------------------------------------------------------------------
+class TestReplicatedBlowup:
+    def _thunk(self, fn, *args):
+        return lambda: jax.make_jaxpr(fn)(*args)
+
+    def test_blowup_flagged_with_source_line(self):
+        def fn(x):
+            big = jnp.einsum("i,j->ij", x, x)  # 256x256 f32 = 256 KiB
+            return big.sum()
+
+        ctx = SiteContext(site="t", jaxpr_thunk=self._thunk(
+            fn, jax.ShapeDtypeStruct((256,), jnp.float32)))
+        fs = audit_jaxpr(ctx, hbm_bytes=1024 * 1024, hbm_fraction=0.05)
+        assert any(f.rule == "replicated-blowup" for f in fs)
+        hit = by_rule(fs, "replicated-blowup")[0]
+        assert hit.severity == Severity.B
+        # attributed to THIS file's einsum line, not the hook site
+        assert hit.path.endswith("test_ds_shard.py")
+
+    def test_constrained_intermediate_is_clean(self):
+        mesh = data_mesh()
+
+        def fn(x):
+            big = jnp.einsum("i,j->ij", x, x)
+            big = jax.lax.with_sharding_constraint(
+                big, NamedSharding(mesh, P("data", None)))  # ds-lint: disable=hand-built-partition-spec
+            return big.sum()
+
+        ctx = SiteContext(site="t", jaxpr_thunk=self._thunk(
+            fn, jax.ShapeDtypeStruct((256,), jnp.float32)))
+        fs = audit_jaxpr(ctx, hbm_bytes=1024 * 1024, hbm_fraction=0.05)
+        assert by_rule(fs, "replicated-blowup") == []
+
+    def test_below_threshold_is_clean(self):
+        def fn(x):
+            return jnp.outer(x, x).sum()
+
+        ctx = SiteContext(site="t", jaxpr_thunk=self._thunk(
+            fn, jax.ShapeDtypeStruct((8,), jnp.float32)))
+        assert audit_jaxpr(ctx) == []
+
+
+# ---------------------------------------------------------------------------
+# Pass 2: HLO parsing + replica-group mapping
+# ---------------------------------------------------------------------------
+AG_LINE = (
+    '  %ag.1 = f32[1048576] all-gather(f32[131072] %p0), '
+    'replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}, '
+    'metadata={op_name="jit(step)/all_gather" '
+    'source_file="deepspeed_tpu/models/fixture.py" source_line=42}'
+)
+AR_SMALL = (
+    '  %ar.1 = f32[1] all-reduce(f32[1] %p1), '
+    'replica_groups=[1,8]<=[8], to_apply=%add'
+)
+
+
+def synthetic_hlo(*lines):
+    return "HloModule fixture\n\nENTRY %main () -> f32[] {\n" + \
+        "\n".join(lines) + "\n}\n"
+
+
+class TestHloParsing:
+    def test_parse_explicit_and_iota_groups(self):
+        assert _parse_groups("{{0,1},{2,3}}") == [[0, 1], [2, 3]]
+        assert _parse_groups("[1,8]<=[8]") == [[0, 1, 2, 3, 4, 5, 6, 7]]
+        assert _parse_groups("[2,4]<=[8]") == [[0, 1, 2, 3], [4, 5, 6, 7]]
+        # transpose: [4,2]<=[2,4]T(1,0) interleaves
+        assert _parse_groups("[4,2]<=[2,4]T(1,0)") == [
+            [0, 4], [1, 5], [2, 6], [3, 7]]
+
+    def test_parse_collectives_payload_and_source(self):
+        instrs = parse_collectives(synthetic_hlo(AG_LINE, AR_SMALL))
+        assert [i.opcode for i in instrs] == ["all-gather", "all-reduce"]
+        ag, ar = instrs
+        assert ag.payload_bytes == 1048576 * 4
+        assert ag.groups == [[0, 1, 2, 3, 4, 5, 6, 7]]
+        assert ag.source_file == "deepspeed_tpu/models/fixture.py"
+        assert ag.source_line == 42
+        assert ar.payload_bytes == 4
+        assert ar.weighted_bytes == 8.0  # ring weight: all-reduce x2
+
+    def test_group_axes(self):
+        mesh = data_mesh()
+        assert group_axes(mesh, [[0, 1, 2, 3, 4, 5, 6, 7]]) == ("data",)
+        devs = np.asarray(jax.devices()).reshape(2, 4)
+        mesh2 = Mesh(devs, ("pipe", "data"))
+        assert group_axes(mesh2, [[0, 4]]) == ("pipe",)
+        assert group_axes(mesh2, [[0, 1, 2, 3]]) == ("data",)
+
+    def test_crosses_dcn_needs_granules(self, monkeypatch):
+        mesh = data_mesh()
+        groups = [[0, 1, 2, 3, 4, 5, 6, 7]]
+        monkeypatch.delenv("DS_DCN_SLICES", raising=False)
+        assert not crosses_dcn(mesh, groups)
+        monkeypatch.setenv("DS_DCN_SLICES", "2")
+        assert crosses_dcn(mesh, groups)
+        # a group inside one granule stays ICI even with slices armed
+        assert not crosses_dcn(mesh, [[0, 1, 2, 3]])
+
+
+# ---------------------------------------------------------------------------
+# Pass 2: budgeted vs unbudgeted classification
+# ---------------------------------------------------------------------------
+class TestCollectiveAudit:
+    def _ctx(self, hlo, budget=None, decisions=None):
+        return SiteContext(
+            site="t", mesh=data_mesh(),
+            origin=(os.path.abspath(__file__), 1),
+            budget=dict(budget or {}), decisions=dict(decisions or {}),
+            hlo_thunk=lambda: hlo)
+
+    def test_unbudgeted_ici_collective(self):
+        # 4 MiB all-gather, empty budget: tier A with specs named
+        fs = audit_hlo(self._ctx(synthetic_hlo(AG_LINE)))
+        assert [f.rule for f in fs] == ["unbudgeted-collective"]
+        f = fs[0]
+        assert f.severity == Severity.A
+        assert "producer=P(dim0:'data')" in f.message
+        assert "consumer=replicated" in f.message
+        # anchored to the HLO source metadata, not the hook site
+        assert f.path == "deepspeed_tpu/models/fixture.py" and f.line == 42
+
+    def test_budgeted_collective_is_clean(self):
+        fs = audit_hlo(self._ctx(
+            synthetic_hlo(AG_LINE), budget={"all-gather": 1048576 * 4}))
+        assert fs == []
+
+    def test_tolerance_math(self):
+        payload = 1048576 * 4
+        # actual <= budget*(1+rel)+abs: a budget 25% under payload still
+        # clears at rel=0.30; 50% under does not
+        ok = audit_hlo(self._ctx(
+            synthetic_hlo(AG_LINE), budget={"all-gather": int(payload / 1.25)}))
+        assert ok == []
+        bad = audit_hlo(self._ctx(
+            synthetic_hlo(AG_LINE), budget={"all-gather": payload // 2}))
+        assert [f.rule for f in bad] == ["unbudgeted-collective"]
+
+    def test_control_floor_always_budgeted(self):
+        fs = audit_hlo(self._ctx(synthetic_hlo(AR_SMALL)))
+        assert fs == []
+
+    def test_collective_permute_needs_decision_record(self):
+        cp = ('  %cp.1 = f32[65536] collective-permute(f32[65536] %p0), '
+              'source_target_pairs={{0,1},{1,2},{2,3},{3,0}}')
+        guilty = audit_hlo(self._ctx(synthetic_hlo(cp)))
+        assert [f.rule for f in guilty] == ["unbudgeted-collective"]
+        clean = audit_hlo(self._ctx(
+            synthetic_hlo(cp), decisions={"pipe-p2p": ("p2p", "pipe handoff")}))
+        assert clean == []
+
+    def test_unbudgeted_dcn_collective(self, monkeypatch):
+        monkeypatch.setenv("DS_DCN_SLICES", "2")
+        # even a FULLY budgeted 4 MiB f32 all-gather is tier A on a
+        # DCN-crossing group: the policy floor demands compression
+        fs = audit_hlo(self._ctx(
+            synthetic_hlo(AG_LINE), budget={"all-gather": 1048576 * 4}))
+        assert [f.rule for f in fs] == ["unbudgeted-dcn-collective"]
+        assert fs[0].severity == Severity.A
+        assert "DCN seam" in fs[0].message
+        assert "producer=P(dim0:'data')" in fs[0].message
+
+    def test_dcn_clean_without_slices(self, monkeypatch):
+        monkeypatch.delenv("DS_DCN_SLICES", raising=False)
+        fs = audit_hlo(self._ctx(
+            synthetic_hlo(AG_LINE), budget={"all-gather": 1048576 * 4}))
+        assert by_rule(fs, "unbudgeted-dcn-collective") == []
+
+    def test_compressed_dcn_payload_clears_the_floor(self, monkeypatch):
+        monkeypatch.setenv("DS_DCN_SLICES", "2")
+        # 1-byte elements (1-bit Adam's packed payload dtype) are the
+        # compressed strategy the policy table wants on DCN
+        s8 = ('  %ag.2 = s8[4194304] all-gather(s8[524288] %p0), '
+              'replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}')
+        fs = audit_hlo(self._ctx(
+            synthetic_hlo(s8), budget={"all-gather": 4194304}))
+        assert by_rule(fs, "unbudgeted-dcn-collective") == []
+
+
+# ---------------------------------------------------------------------------
+# shard_run plumbing: suppression + baseline round-trip
+# ---------------------------------------------------------------------------
+def _guilty_ctx(origin):
+    return SiteContext(
+        site="fixture", origin=origin,
+        donations=[DonationPair("params/w", P("data", None), P())])
+
+
+class TestRunnerPlumbing:
+    def test_run_reports_guilty_site(self, tmp_path):
+        anchor = tmp_path / "site.py"
+        anchor.write_text("x = 1\n")
+        res = shard_run(sites=[_guilty_ctx((str(anchor), 1))],
+                        use_baseline=False, write_status=False)
+        assert [f.rule for f in res.findings] == ["donation-layout-mismatch"]
+        assert res.failing(Severity.A)
+
+    def test_pragma_suppresses_on_attributed_line(self, tmp_path):
+        anchor = tmp_path / "site.py"
+        anchor.write_text(
+            "compile_site()  # ds-shard: disable=donation-layout-mismatch\n")
+        res = shard_run(sites=[_guilty_ctx((str(anchor), 1))],
+                        use_baseline=False, write_status=False)
+        assert res.findings == [] and res.suppressed == 1
+
+    def test_sibling_tool_pragma_shares_table(self, tmp_path):
+        # the ds-* tools share one suppression table by design (rule
+        # ids are disjoint across tools, so there is no cross-talk)
+        anchor = tmp_path / "site.py"
+        anchor.write_text(
+            "compile_site()  # ds-race: disable=donation-layout-mismatch\n")
+        res = shard_run(sites=[_guilty_ctx((str(anchor), 1))],
+                        use_baseline=False, write_status=False)
+        assert res.findings == [] and res.suppressed == 1
+
+    def test_unrelated_pragma_does_not_suppress(self, tmp_path):
+        anchor = tmp_path / "site.py"
+        anchor.write_text(
+            "compile_site()  # ds-shard: disable=replicated-blowup\n")
+        res = shard_run(sites=[_guilty_ctx((str(anchor), 1))],
+                        use_baseline=False, write_status=False)
+        assert [f.rule for f in res.findings] == ["donation-layout-mismatch"]
+
+    def test_baseline_round_trip(self, tmp_path):
+        anchor = tmp_path / "site.py"
+        anchor.write_text("compile_site()\n")
+        bl = tmp_path / SHARD_BASELINE_NAME
+        first = shard_run(sites=[_guilty_ctx((str(anchor), 1))],
+                          baseline_path=str(bl), write_status=False)
+        assert len(first.findings) == 1 and first.findings[0].fingerprint
+        baseline_mod.save(str(bl), first.all_current, tool="ds_shard")
+        again = shard_run(sites=[_guilty_ctx((str(anchor), 1))],
+                          baseline_path=str(bl), write_status=False)
+        assert again.findings == [] and len(again.baselined) == 1
+        assert not again.failing(Severity.A)
+        data = json.loads(bl.read_text())
+        assert data["tool"] == "ds_shard" and len(data["findings"]) == 1
+
+    def test_select_and_disable(self, tmp_path):
+        anchor = tmp_path / "site.py"
+        anchor.write_text("compile_site()\n")
+        ctx = _guilty_ctx((str(anchor), 1))
+        only = shard_run(sites=[ctx], select=["unbudgeted-collective"],
+                         use_baseline=False, write_status=False)
+        assert only.findings == []
+        off = shard_run(sites=[ctx], disable=["donation-layout-mismatch"],
+                        use_baseline=False, write_status=False)
+        assert off.findings == []
+        with pytest.raises(KeyError):
+            shard_run(sites=[ctx], select=["no-such-rule"],
+                      use_baseline=False, write_status=False)
+
+    def test_tables_only_is_clean_and_fast(self):
+        res = shard_run(tables_only=True, use_baseline=False,
+                        write_status=False)
+        assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# the full self-run (compiles every engine: slow, excluded from tier 1)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_self_run_is_green_at_checked_in_baseline(tmp_path):
+    res = shard_run(write_status=False)
+    assert res.failing(Severity.A) == [], [
+        f"{f.rule} {f.path}:{f.line} {f.message}" for f in res.failing(Severity.A)]
+
+
+@pytest.mark.slow
+def test_injected_dcn_allgather_is_caught(monkeypatch):
+    monkeypatch.setenv("DS_DCN_SLICES", "2")
+    res = shard_run(engines=[], inject="dcn-allgather",
+                    use_baseline=False, write_status=False)
+    hits = by_rule(res.findings, "unbudgeted-dcn-collective")
+    assert len(hits) == 1
+    assert hits[0].severity == Severity.A
+    assert "producer=P(dim0:'data')" in hits[0].message
+    assert res.failing(Severity.A)
