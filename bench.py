@@ -608,6 +608,26 @@ def run_rung(name: str):
                   "reason": f"bench_serving --kvtiers child rc={proc.returncode}"})
         for rec in recs:
             emit(rec)
+    elif name == "tenants":
+        # mixed-tenant isolation rung (docs/serving.md §Front-door): a
+        # quiet tenant's seeded stream run solo vs next to a noisy
+        # tenant offered 10x its token-bucket quota — the emitted
+        # record gates the quiet tenant's admitted p99 TTFT in the
+        # mixed run (isolation breaking = the number inflates past the
+        # noise band).  Grandchild like the serving rung.
+        import subprocess as sp
+
+        cmd = [sys.executable, os.path.join(HERE, "tools", "bench_serving.py"),
+               "--tenants"]
+        if not on_tpu:
+            cmd.append("--dryrun")
+        proc = sp.run(cmd, stdout=sp.PIPE, cwd=HERE)
+        recs = _parse_records(proc.stdout.decode(errors="replace"))
+        if proc.returncode != 0 and not recs:
+            emit({"metric": "tenants", "skipped": True,
+                  "reason": f"bench_serving --tenants child rc={proc.returncode}"})
+        for rec in recs:
+            emit(rec)
     elif name == "sharding":
         # weight-update-sharding sweep (docs/sharding.md): replicated vs
         # cross-replica ZeRO-1 (vs the composed data x fsdp grid) —
@@ -730,6 +750,11 @@ RUNGS = [
     # T0-resident overhead ratio, and swap_hidden_ratio at bit-identical
     # greedy outputs with zero queue-full rejections
     ("kvtiers", 240, 480),
+    # mixed-tenant isolation proof (docs/serving.md §Front-door): one
+    # noisy tenant offered 10x its token-bucket quota next to a quiet
+    # tenant's fixed seeded stream; the record gates the quiet tenant's
+    # admitted p99 TTFT under contention (plus the noisy throttle rate)
+    ("tenants", 240, 480),
 ]
 
 # Plausibility floors for each rung's PRIMARY record on REAL TPU —

@@ -613,6 +613,100 @@ def _demote_while_prefix_hit(seed: int, inj: FaultInjector) -> None:
 
 
 @scenario(
+    "tenant-refill-under-admit",
+    "two tenants' admit loops race the token-bucket refill path, a WFQ "
+    "tag/pick loop, and snapshot readers; asserts exact bucket "
+    "accounting (burst + refilled - consumed == tokens), an exact "
+    "throttle count, and monotone per-tenant WFQ clocks")
+def _tenant_refill_under_admit(seed: int, inj: FaultInjector) -> None:
+    from deepspeed_tpu.serving.frontdoor.tenants import (
+        TenantRegistry,
+        TenantThrottled,
+    )
+
+    inj.race_stall("race.tenant.lock.acquire", seconds=2e-4, probability=0.2)
+    inj.race_stall("race.tenant.refill", seconds=2e-4, probability=0.3)
+
+    reg = TenantRegistry()
+    reg._overrides = {
+        "a": {"refill_tokens_per_second": 400.0, "burst_tokens": 40.0},
+        "b": {"refill_tokens_per_second": 250.0, "burst_tokens": 25.0,
+              "weight": 2.0},
+    }
+    instrument(reg, "_lock", "race.tenant.lock")
+
+    N = 80
+    throttled = {"a": 0, "b": 0}  # each key written by ONE thread
+
+    def admits(tenant: str) -> None:
+        rng = random.Random(seed * 100 + (0 if tenant == "a" else 1))
+        now = 0.0
+        last_tag = -1.0
+        for _ in range(N):
+            now += rng.random() * 0.02  # per-bucket clocks stay monotone
+            cost = 1.0 + rng.randrange(10)
+            try:
+                reg.admit(tenant, cost, now)
+            except TenantThrottled as e:
+                assert e.retry_after is not None and e.retry_after > 0, (
+                    f"throttle without retry_after: {e!r}")
+                throttled[tenant] += 1
+            tag = reg.tag(tenant, cost)
+            assert tag > last_tag, (
+                f"tenant {tenant} WFQ clock went backwards: "
+                f"{tag} after {last_tag}")
+            last_tag = tag
+
+    class _Queued:
+        def __init__(self, tenant, tag, priority):
+            self.tenant = tenant
+            self.wfq_tag = tag
+            self.priority = priority
+
+    stop = threading.Event()
+
+    def pick_and_snapshot():
+        rng = random.Random(seed * 7 + 3)
+        while not stop.is_set():
+            q = [_Queued(t, reg.tag(t, 0.5), rng.randrange(3))
+                 for t in ("a", "b", "bg") for _ in range(2)]
+            i = reg.pick(q)
+            assert 0 <= i < len(q), f"pick index {i} out of range"
+            reg.snapshot()
+
+    picker_errors: List[BaseException] = []
+
+    def picker_guarded():
+        try:
+            pick_and_snapshot()
+        except BaseException as e:  # noqa: BLE001
+            picker_errors.append(e)
+
+    picker = threading.Thread(target=picker_guarded, daemon=True)
+    picker.start()
+    try:
+        _run_threads([partial(admits, "a"), partial(admits, "b")])
+    finally:
+        stop.set()
+        picker.join(10)
+    if picker_errors:
+        raise picker_errors[0]
+    for t in ("a", "b"):
+        st = reg.state(t)
+        b = st.bucket
+        assert abs(b.burst + b.refilled - b.consumed - b.tokens) < 1e-6, (
+            f"tenant {t} bucket accounting tore: burst={b.burst} "
+            f"refilled={b.refilled} consumed={b.consumed} tokens={b.tokens}")
+        assert -1e-9 <= b.tokens <= b.burst + 1e-9, (
+            f"tenant {t} bucket over/underflow: {b.tokens} of {b.burst}")
+        assert st.counters["submitted"] == N, (
+            f"tenant {t} lost submits: {st.counters['submitted']}/{N}")
+        assert st.counters["throttled"] == throttled[t], (
+            f"tenant {t} throttle count raced: counter="
+            f"{st.counters['throttled']} observed={throttled[t]}")
+
+
+@scenario(
     "fixture-torn-counter",
     "DELIBERATELY unguarded read-modify-write; the harness must observe "
     "a lost update under at least one seed (the dynamic RED gate)",

@@ -1176,6 +1176,175 @@ class KVCacheConfig:
 
 
 @dataclass
+class FrontdoorConfig:
+    """``serving.frontdoor`` block (docs/serving.md §Front-door): the
+    stdlib HTTP front-door — chunked streaming token responses, request
+    deadlines mapped onto scheduler deadlines, ``Retry-After``-bearing
+    429/503 overload answers, and SIGTERM graceful drain composed with
+    the serving watchdog."""
+
+    enabled: bool = C.SERVING_FRONTDOOR_ENABLED_DEFAULT
+    host: str = C.SERVING_FRONTDOOR_HOST_DEFAULT
+    port: int = C.SERVING_FRONTDOOR_PORT_DEFAULT  # 0 = ephemeral
+    stream_poll_seconds: float = C.SERVING_FRONTDOOR_STREAM_POLL_SECONDS_DEFAULT
+    max_body_bytes: int = C.SERVING_FRONTDOOR_MAX_BODY_BYTES_DEFAULT
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "FrontdoorConfig":
+        if d is None:
+            return cls()
+        if isinstance(d, FrontdoorConfig):
+            d = dataclasses.asdict(d)
+        d = dict(d)
+        block = f"{C.SERVING}.{C.SERVING_FRONTDOOR}"
+        out = cls(
+            enabled=bool(_pop(d, "enabled", C.SERVING_FRONTDOOR_ENABLED_DEFAULT)),
+            host=str(_pop(d, "host", C.SERVING_FRONTDOOR_HOST_DEFAULT)),
+            port=int(_pop(d, "port", C.SERVING_FRONTDOOR_PORT_DEFAULT)),
+            stream_poll_seconds=float(
+                _pop(d, "stream_poll_seconds",
+                     C.SERVING_FRONTDOOR_STREAM_POLL_SECONDS_DEFAULT)
+            ),
+            max_body_bytes=int(
+                _pop(d, "max_body_bytes",
+                     C.SERVING_FRONTDOOR_MAX_BODY_BYTES_DEFAULT)
+            ),
+        )
+        _check_empty(d, block, _known_keys(cls))
+        if not 0 <= out.port <= 65535:
+            raise DeepSpeedConfigError(
+                f"'{block}.port' must be in [0, 65535] (0 = ephemeral), "
+                f"got {out.port}"
+            )
+        if out.stream_poll_seconds <= 0:
+            raise DeepSpeedConfigError(
+                f"'{block}.stream_poll_seconds' must be > 0, "
+                f"got {out.stream_poll_seconds}"
+            )
+        if out.max_body_bytes < 1:
+            raise DeepSpeedConfigError(
+                f"'{block}.max_body_bytes' must be >= 1, "
+                f"got {out.max_body_bytes}"
+            )
+        return out
+
+
+# per-tenant override spec keys accepted under serving.tenants.overrides
+_TENANT_SPEC_KEYS = (
+    "refill_tokens_per_second",
+    "burst_tokens",
+    "weight",
+    "slo_class",
+    "kv_pages_max",
+    "pinned_prefixes_max",
+)
+
+
+@dataclass
+class TenantsConfig:
+    """``serving.tenants`` block (docs/serving.md §Front-door): the
+    multi-tenant dimension — per-tenant token-bucket admission rates,
+    weighted-fair queueing ahead of priority tiers, SLO classes mapped
+    onto the degradation ladder's priorities, and per-tenant paged-KV
+    page / pinned-prefix quotas.  Field values are the defaults for any
+    tenant; ``overrides`` refines them per tenant name."""
+
+    enabled: bool = C.SERVING_TENANTS_ENABLED_DEFAULT
+    refill_tokens_per_second: float = (
+        C.SERVING_TENANTS_REFILL_TOKENS_PER_SECOND_DEFAULT)
+    burst_tokens: float = C.SERVING_TENANTS_BURST_TOKENS_DEFAULT
+    weight: float = C.SERVING_TENANTS_WEIGHT_DEFAULT
+    slo_class: str = C.SERVING_TENANTS_SLO_CLASS_DEFAULT
+    kv_pages_max: int = C.SERVING_TENANTS_KV_PAGES_MAX_DEFAULT
+    pinned_prefixes_max: int = C.SERVING_TENANTS_PINNED_PREFIXES_MAX_DEFAULT
+    overrides: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "TenantsConfig":
+        if d is None:
+            return cls()
+        if isinstance(d, TenantsConfig):
+            d = dataclasses.asdict(d)
+        d = dict(d)
+        block = f"{C.SERVING}.{C.SERVING_TENANTS}"
+        raw_over = _pop(d, "overrides", None) or {}
+        if not isinstance(raw_over, dict):
+            raise DeepSpeedConfigError(
+                f"'{block}.overrides' must be a dict of per-tenant spec "
+                f"dicts, got {type(raw_over).__name__}"
+            )
+        overrides: Dict[str, Dict[str, Any]] = {}
+        for name, spec in raw_over.items():
+            if not isinstance(spec, dict):
+                raise DeepSpeedConfigError(
+                    f"'{block}.overrides[{name!r}]' must be a dict, "
+                    f"got {type(spec).__name__}"
+                )
+            unknown = sorted(set(spec) - set(_TENANT_SPEC_KEYS))
+            if unknown:
+                raise DeepSpeedConfigError(
+                    f"'{block}.overrides[{name!r}]' has unknown keys "
+                    f"{unknown}; known: {sorted(_TENANT_SPEC_KEYS)}"
+                )
+            slo = spec.get("slo_class")
+            if slo is not None and slo not in C.SERVING_TENANTS_SLO_CLASSES:
+                raise DeepSpeedConfigError(
+                    f"'{block}.overrides[{name!r}].slo_class' must be one "
+                    f"of {C.SERVING_TENANTS_SLO_CLASSES}, got '{slo}'"
+                )
+            overrides[str(name)] = dict(spec)
+        out = cls(
+            enabled=bool(_pop(d, "enabled", C.SERVING_TENANTS_ENABLED_DEFAULT)),
+            refill_tokens_per_second=float(
+                _pop(d, "refill_tokens_per_second",
+                     C.SERVING_TENANTS_REFILL_TOKENS_PER_SECOND_DEFAULT)
+            ),
+            burst_tokens=float(
+                _pop(d, "burst_tokens", C.SERVING_TENANTS_BURST_TOKENS_DEFAULT)
+            ),
+            weight=float(_pop(d, "weight", C.SERVING_TENANTS_WEIGHT_DEFAULT)),
+            slo_class=str(
+                _pop(d, "slo_class", C.SERVING_TENANTS_SLO_CLASS_DEFAULT)
+            ).lower(),
+            kv_pages_max=int(
+                _pop(d, "kv_pages_max", C.SERVING_TENANTS_KV_PAGES_MAX_DEFAULT)
+            ),
+            pinned_prefixes_max=int(
+                _pop(d, "pinned_prefixes_max",
+                     C.SERVING_TENANTS_PINNED_PREFIXES_MAX_DEFAULT)
+            ),
+            overrides=overrides,
+        )
+        _check_empty(d, block, _known_keys(cls))
+        if out.refill_tokens_per_second < 0:
+            raise DeepSpeedConfigError(
+                f"'{block}.refill_tokens_per_second' must be >= 0 "
+                f"(0 with burst_tokens 0 = unlimited), "
+                f"got {out.refill_tokens_per_second}"
+            )
+        if out.burst_tokens < 0:
+            raise DeepSpeedConfigError(
+                f"'{block}.burst_tokens' must be >= 0, got {out.burst_tokens}"
+            )
+        if out.weight <= 0:
+            raise DeepSpeedConfigError(
+                f"'{block}.weight' must be > 0, got {out.weight}"
+            )
+        if out.slo_class not in C.SERVING_TENANTS_SLO_CLASSES:
+            raise DeepSpeedConfigError(
+                f"'{block}.slo_class' must be one of "
+                f"{C.SERVING_TENANTS_SLO_CLASSES}, got '{out.slo_class}'"
+            )
+        if out.kv_pages_max < 0 or out.pinned_prefixes_max < 0:
+            raise DeepSpeedConfigError(
+                f"'{block}.kv_pages_max'/'pinned_prefixes_max' must be >= 0 "
+                f"(0 = uncapped), got "
+                f"{out.kv_pages_max}/{out.pinned_prefixes_max}"
+            )
+        return out
+
+
+@dataclass
 class ServingConfig:
     """``serving`` block (TPU-native extension; docs/serving.md): the
     continuous-batching slot-pool engine.  ``num_slots`` concurrent
@@ -1223,6 +1392,12 @@ class ServingConfig:
     # paged KV pool with prefix dedup + COW + session reuse
     # (docs/serving.md §Paged KV & prefix caching)
     kvcache: KVCacheConfig = field(default_factory=KVCacheConfig)
+    # stdlib HTTP front-door with chunked streaming + graceful drain
+    # (docs/serving.md §Front-door)
+    frontdoor: FrontdoorConfig = field(default_factory=FrontdoorConfig)
+    # multi-tenant fairness/SLO/quota dimension (docs/serving.md
+    # §Front-door)
+    tenants: TenantsConfig = field(default_factory=TenantsConfig)
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ServingConfig":
@@ -1231,9 +1406,14 @@ class ServingConfig:
         d = dict(d)
         fleet = FleetConfig.from_dict(_pop(d, C.SERVING_FLEET, None))
         kvcache = KVCacheConfig.from_dict(_pop(d, C.SERVING_KVCACHE, None))
+        frontdoor = FrontdoorConfig.from_dict(
+            _pop(d, C.SERVING_FRONTDOOR, None))
+        tenants = TenantsConfig.from_dict(_pop(d, C.SERVING_TENANTS, None))
         out = cls(
             fleet=fleet,
             kvcache=kvcache,
+            frontdoor=frontdoor,
+            tenants=tenants,
             num_slots=int(_pop(d, "num_slots", C.SERVING_NUM_SLOTS_DEFAULT)),
             max_len=int(_pop(d, "max_len", C.SERVING_MAX_LEN_DEFAULT)),
             kv_cache_dtype=str(

@@ -344,6 +344,30 @@ SERVING_FLEET_ELASTIC_WARM_POOL_SIZE_DEFAULT = 1
 # victim revives) — it never proceeds over live work
 SERVING_FLEET_ELASTIC_MIGRATION_DEADLINE_SECONDS_DEFAULT = 30.0
 SERVING_FLEET_ELASTIC_MIGRATION_RETRIES_DEFAULT = 3
+# -- multi-tenant front-door (serving.frontdoor.* / serving.tenants.*;
+# docs/serving.md §Front-door) ----------------------------------------
+SERVING_FRONTDOOR = "frontdoor"
+SERVING_FRONTDOOR_ENABLED_DEFAULT = False
+SERVING_FRONTDOOR_HOST_DEFAULT = "127.0.0.1"
+SERVING_FRONTDOOR_PORT_DEFAULT = 0  # 0 = ephemeral (OS-assigned) port
+# chunked-streaming poll cadence: how often the handler thread samples
+# a live request's partial tokens between engine steps
+SERVING_FRONTDOOR_STREAM_POLL_SECONDS_DEFAULT = 0.01
+# hard cap on a single request body (token-id JSON) — a front door
+# should bound untrusted input before it reaches the scheduler
+SERVING_FRONTDOOR_MAX_BODY_BYTES_DEFAULT = 1 << 20
+SERVING_TENANTS = "tenants"
+SERVING_TENANTS_ENABLED_DEFAULT = False
+# default (per-tenant) token-bucket admission rate: budget tokens
+# (prompt + reserved max_new) per second, and the burst ceiling;
+# rate 0 + burst 0 = unlimited tenant
+SERVING_TENANTS_REFILL_TOKENS_PER_SECOND_DEFAULT = 0.0
+SERVING_TENANTS_BURST_TOKENS_DEFAULT = 0.0
+SERVING_TENANTS_WEIGHT_DEFAULT = 1.0  # WFQ share weight
+SERVING_TENANTS_SLO_CLASS_DEFAULT = "silver"  # gold | silver | bronze
+SERVING_TENANTS_SLO_CLASSES = ["gold", "silver", "bronze"]
+SERVING_TENANTS_KV_PAGES_MAX_DEFAULT = 0  # 0 = no per-tenant page cap
+SERVING_TENANTS_PINNED_PREFIXES_MAX_DEFAULT = 0  # 0 = no pin cap
 
 #############################################
 # Telemetry (unified metrics registry / trace export; docs/telemetry.md)

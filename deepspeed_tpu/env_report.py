@@ -561,6 +561,50 @@ def serving_report(config=None) -> None:
                 "off (serving.fleet.elastic.enabled=false; fixed replica "
                 "count)",
             ))
+    # front-door rows (docs/serving.md §Front-door)
+    fd = getattr(s, "frontdoor", None)
+    if fd is not None:
+        rows.append((
+            "http front-door",
+            f"on: {fd.host}:{fd.port or 'ephemeral'}, chunked streaming "
+            f"(poll {fd.stream_poll_seconds:g}s), 429/503 + Retry-After, "
+            "SIGTERM drain -> stream-out -> exit 43"
+            if fd.enabled
+            else "off (serving.frontdoor.enabled=false; rpc/in-process "
+            "submit only)",
+        ))
+    tn = getattr(s, "tenants", None)
+    if tn is not None:
+        if not tn.enabled:
+            rows.append((
+                "tenants",
+                "off (serving.tenants.enabled=false; single-tenant "
+                "admission)",
+            ))
+        else:
+            bucket = (
+                f"{tn.refill_tokens_per_second:g} tok/s burst "
+                f"{tn.burst_tokens:g}"
+                if tn.refill_tokens_per_second or tn.burst_tokens
+                else "unlimited (accounting/WFQ only)"
+            )
+            rows += [
+                (
+                    "tenants",
+                    f"on: default bucket {bucket}, weight {tn.weight:g}, "
+                    f"slo {tn.slo_class}; {len(tn.overrides)} override(s) "
+                    f"({', '.join(sorted(tn.overrides)) or 'none'})",
+                ),
+                (
+                    "tenant kv quotas",
+                    (f"kv_pages_max={tn.kv_pages_max}"
+                     if tn.kv_pages_max else "pages uncapped")
+                    + ", "
+                    + (f"pinned_prefixes_max={tn.pinned_prefixes_max}"
+                       if tn.pinned_prefixes_max else "pins uncapped")
+                    + " (over-quota allocs defer, over-quota pins degrade)",
+                ),
+            ]
     for name, value in rows:
         print(f"{name} " + "." * (30 - len(name)) + f" {value}")
 

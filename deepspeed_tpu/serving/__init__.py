@@ -27,6 +27,15 @@ from deepspeed_tpu.serving.fleet import (
     ReplicaDeadError,
     ReplicaSupervisor,
 )
+from deepspeed_tpu.serving.frontdoor import (
+    FrontDoor,
+    TenantRegistry,
+    TenantThrottled,
+    TransportFrameError,
+    TransportReplica,
+    journal_tenant_totals,
+    wrap_replica,
+)
 from deepspeed_tpu.serving.journal import JournalError, RequestJournal
 from deepspeed_tpu.serving.kvcache import PagedKVPool
 from deepspeed_tpu.serving.pool import SlotKVPool, SlotPoolError
@@ -62,6 +71,13 @@ __all__ = [
     "ServingOverloaded",
     "ServingDraining",
     "ServingWatchdog",
+    "FrontDoor",
+    "TenantRegistry",
+    "TenantThrottled",
+    "TransportFrameError",
+    "TransportReplica",
+    "journal_tenant_totals",
+    "wrap_replica",
     "PRIORITY_HIGH",
     "PRIORITY_NORMAL",
     "PRIORITY_LOW",

@@ -291,6 +291,19 @@ class ServingEngine:
             )
             self._tiers.telemetry = self.telemetry
             self.pool.attach_tiers(self._tiers)
+        # multi-tenant dimension (docs/serving.md §Front-door): rate
+        # limits + weighted-fair queueing + SLO classes + KV quotas +
+        # billing-grade accounting, all keyed by submit(tenant=...)
+        self.tenants = None
+        tcfg = getattr(config, "tenants", None)
+        if tcfg is not None and tcfg.enabled:
+            from deepspeed_tpu.serving.frontdoor.tenants import TenantRegistry
+
+            self.tenants = TenantRegistry(tcfg)
+            self.scheduler.tenants = self.tenants
+            attach = getattr(self.pool, "attach_tenants", None)
+            if attach is not None:
+                attach(self.tenants)
         log_dist(
             f"serving engine: {config.num_slots} slots x {max_len} positions "
             f"(kv={'int8' if kv_dtype == 'int8' else jnp.dtype(kv_dtype).name}, "
@@ -593,9 +606,10 @@ class ServingEngine:
         temperature: float = 1.0,
         top_k: int = 0,
         seed: int = 0,
-        priority: int = PRIORITY_NORMAL,
+        priority: Optional[int] = None,
         client_key: Optional[str] = None,
         session_id: Optional[str] = None,
+        tenant: Optional[str] = None,
     ) -> int:
         """Enqueue one request; returns its id.  Raises
         :class:`ServingQueueFull` when the queue is at its bound,
@@ -625,7 +639,16 @@ class ServingEngine:
         prefix caching): a finished turn's KV pages park under this id,
         and the next turn whose prompt extends the parked history
         rebinds them — prefill restarts at the first uncached chunk.
-        Ignored (beyond journaling) on the slot-contiguous pool."""
+        Ignored (beyond journaling) on the slot-contiguous pool.
+
+        ``tenant`` (docs/serving.md §Front-door): the multi-tenant
+        dimension.  With ``serving.tenants`` armed, the submit is
+        charged against the tenant's token bucket (raises
+        :class:`TenantThrottled` with ``retry_after`` past the limit),
+        queued under weighted-fair queueing ahead of the priority
+        tiers, and — when ``priority`` is not given explicitly — tiered
+        by the tenant's SLO class.  The label journals (``tn``), so
+        per-tenant accounting reconciles exactly across a crash."""
         if client_key is not None:
             known = self._client_keys.get(client_key)
             if known is not None:
@@ -652,12 +675,30 @@ class ServingEngine:
                 retry_after=max(self._watchdog.remaining(), 0.0),
             )
         faults.check("serving.submit")
+        effective_max_new = (
+            max_new_tokens if max_new_tokens is not None else self.config.max_new_tokens
+        )
+        if self.tenants is not None:
+            # SLO class → priority tier (an explicit priority wins),
+            # then the token-bucket charge: reserved capacity
+            # (prompt + budget), realized usage billed at retire.
+            # Raises TenantThrottled (429 semantics) with retry_after.
+            priority = self.tenants.priority_for(tenant, priority)
+            cost = float(np.asarray(prompt).reshape(-1).shape[0]
+                         + int(effective_max_new))
+            try:
+                self.tenants.admit(tenant, cost, now=time.monotonic())
+            except ServingQueueFull:
+                if self.telemetry.collect:
+                    self.telemetry.counter("serving/rejected").inc()
+                    self._tenant_counter(tenant, "throttled").inc()
+                raise
+        elif priority is None:
+            priority = PRIORITY_NORMAL
         try:
             req = self.scheduler.submit(
                 prompt,
-                max_new_tokens=(
-                    max_new_tokens if max_new_tokens is not None else self.config.max_new_tokens
-                ),
+                max_new_tokens=effective_max_new,
                 eos_token_id=eos_token_id,
                 deadline_seconds=deadline_seconds,
                 do_sample=do_sample,
@@ -667,6 +708,7 @@ class ServingEngine:
                 priority=priority,
                 client_key=client_key,
                 session_id=session_id,
+                tenant=tenant,
                 now=time.monotonic(),
                 step=self._step_count,
             )
@@ -677,10 +719,14 @@ class ServingEngine:
                 self.telemetry.histogram("serving/retry_after_s").observe(
                     e.retry_after or 0.0
                 )
+            if self.tenants is not None:
+                self.tenants.note("rejected", tenant)
             raise
         except ServingQueueFull:
             if self.telemetry.collect:
                 self.telemetry.counter("serving/rejected").inc()
+            if self.tenants is not None:
+                self.tenants.note("rejected", tenant)
             raise
         # WAL contract: the submit record is durable BEFORE the id is
         # acknowledged (a commit failure quarantines; the request still
@@ -689,9 +735,19 @@ class ServingEngine:
         self._journal_commit()
         if client_key is not None:
             self._client_keys[client_key] = req.request_id
+        if self.tenants is not None:
+            self.tenants.note("admitted", tenant)
+            if self.telemetry.collect:
+                self._tenant_counter(tenant, "admitted").inc()
         if self.telemetry.collect:
             self.telemetry.counter("serving/submitted").inc()
         return req.request_id
+
+    def _tenant_counter(self, tenant: Optional[str], kind: str):
+        from deepspeed_tpu.serving.frontdoor.tenants import DEFAULT_TENANT
+
+        return self.telemetry.counter(
+            f"serving/tenant/{tenant or DEFAULT_TENANT}/{kind}")
 
     def client_request_id(self, client_key: str) -> Optional[int]:
         """The id this engine acknowledged for ``client_key`` (in memory
@@ -749,11 +805,17 @@ class ServingEngine:
                 bypass_admission=True,  # accepted before the crash
                 client_key=e.get("ck"),
                 session_id=e.get("sid"),
+                # the journaled tenant label rides the replay — the
+                # bucket is NOT re-charged (admission happened before
+                # the crash; a replay must never double-bill)
+                tenant=e.get("tn"),
                 now=time.monotonic(),
                 step=self._step_count,
             )
             if e.get("ck"):
                 self._client_keys[str(e["ck"])] = rid
+            if self.tenants is not None:
+                self.tenants.note("replayed", e.get("tn"))
             advance_request_ids(rid)
             # re-journal into the live segment: recovery is self-contained
             # even after the old segments compact away
@@ -983,12 +1045,25 @@ class ServingEngine:
             self._journal_record("record_first_token", r)
         elif kind in ("finished", "cancelled"):
             self._journal_record("record_retire", r)
+            if self.tenants is not None:
+                # realized-usage billing, mirrored by the retire
+                # record's ``n`` — the two ledgers reconcile exactly
+                # after a crash + recover() (at most one retire per id)
+                if kind == "finished":
+                    self.tenants.bill(r.tenant, len(r.generated))
+                    if tm.collect:
+                        self._tenant_counter(r.tenant, "billed_tokens").inc(
+                            len(r.generated))
+                else:
+                    self.tenants.note("cancelled", r.tenant)
         elif kind in ("expired", "shed"):
             # reject record, committed NOW rather than at the step
             # boundary: a crash in between must not resurrect a request
             # the client was already told to retry elsewhere
             self._journal_record("record_reject", r)
             self._journal_commit()
+            if self.tenants is not None:
+                self.tenants.note(kind, r.tenant)
         if kind == "admitted":
             self._tel_queue_wait.observe((now - r.submit_time) * 1e3)
             if tracer is not None:
@@ -1273,6 +1348,8 @@ class ServingEngine:
         if self._paged:
             out["kvcache"] = self.pool.stats()
             self._publish_kvcache()
+        if self.tenants is not None:
+            out["tenants"] = self.tenants.snapshot()
         out.update(self.timeline.summary())
         return out
 

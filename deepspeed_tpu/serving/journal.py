@@ -286,6 +286,7 @@ class RequestJournal:
             "seed": int(req.seed),
             **({"ck": str(req.client_key)} if getattr(req, "client_key", None) else {}),
             **({"sid": str(req.session_id)} if getattr(req, "session_id", None) else {}),
+            **({"tn": str(req.tenant)} if getattr(req, "tenant", None) else {}),
         })
         if getattr(req, "client_key", None):
             self.client_keys[str(req.client_key)] = int(req.request_id)
@@ -300,8 +301,12 @@ class RequestJournal:
                       "tok": int(req.generated[0]) if req.generated else None})
 
     def record_retire(self, req) -> None:
+        # ``n`` is the REALIZED token count — the billing ground truth
+        # per-tenant accounting reconciles against across a crash (at
+        # most one retire per id, so a tenant is never double-billed)
         self._append({"t": RETIRE, "id": int(req.request_id),
-                      "reason": req.finish_reason or "?"})
+                      "reason": req.finish_reason or "?",
+                      "n": len(getattr(req, "generated", []) or [])})
 
     def record_reject(self, req) -> None:
         """Involuntary retirement (shed / expired): terminal like a

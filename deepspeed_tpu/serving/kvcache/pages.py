@@ -164,6 +164,18 @@ class PagedKVPool:
         self.evictions = 0
         self.session_rebinds = 0
         self.alloc_waits = 0  # alloc_request returned None for lack of pages
+        # per-tenant quota enforcement (docs/serving.md §Front-door):
+        # armed via attach_tenants().  Charges follow the live slot —
+        # fresh pages claimed for a tenant's request count against its
+        # kv_pages_max until the slot retires; pinned-prefix inserts
+        # count against pinned_prefixes_max (over-quota pins degrade to
+        # unpinned entries, which pressure reclaim may evict).
+        self.tenants: Optional[Any] = None
+        self._tenant_pages: Dict[str, int] = {}
+        self._slot_tenant: Dict[int, Tuple[str, int]] = {}
+        self._tenant_pinned: Dict[str, int] = {}
+        self.tenant_quota_defers = 0
+        self.tenant_pin_rejects = 0
 
     # -- refcounting ------------------------------------------------------
     def _page_incref(self, pages: Sequence[int]) -> None:
@@ -358,6 +370,24 @@ class PagedKVPool:
         )
         total = min(plen + int(req.max_new_tokens), self.max_len)
         need = max(_pages_for(total, self.page_len), n_cover)
+        # per-tenant page quota: fresh (privately-charged) pages for
+        # this slot must fit under the tenant's cap — reused shared
+        # pages are free (they are not attributable to one tenant).
+        # Over quota the request WAITS (None), exactly like page
+        # starvation: the tenant's own retirements free its budget, and
+        # other tenants are unaffected — which is the point.
+        tenant_name, n_fresh_planned = None, 0
+        if self.tenants is not None:
+            tenant_name = getattr(req, "tenant", None)
+            cap = self.tenants.kv_pages_max(tenant_name)
+            n_fresh_planned = need - n_cover + (1 if need_cow else 0)
+            from deepspeed_tpu.serving.frontdoor.tenants import DEFAULT_TENANT
+
+            key = tenant_name or DEFAULT_TENANT
+            if cap > 0 and self._tenant_pages.get(key, 0) + n_fresh_planned > cap:
+                self.tenant_quota_defers += 1
+                self.tenants.note_quota_defer(tenant_name)
+                return None
         # the slot takes its reference on every reused page BEFORE
         # claiming fresh ones: _take_pages may reclaim under pressure,
         # and reclaim is allowed to spill/demote the very session (or
@@ -393,6 +423,13 @@ class PagedKVPool:
         slot = self._free_slots.popleft()
         self._owner[slot] = rid
         self._bind(slot, mapping, cow)
+        if self.tenants is not None:
+            from deepspeed_tpu.serving.frontdoor.tenants import DEFAULT_TENANT
+
+            key = tenant_name or DEFAULT_TENANT
+            n_charged = len(fresh)
+            self._tenant_pages[key] = self._tenant_pages.get(key, 0) + n_charged
+            self._slot_tenant[slot] = (key, n_charged)
         req.prefill_pos = hit
         req.prefix_hint = hit
         if hit > 0:
@@ -448,21 +485,43 @@ class PagedKVPool:
         for spec in self._pinned_specs:
             L = int(spec.shape[0])
             if L <= prompt.shape[0] and np.array_equal(prompt[:L], spec):
-                self._insert_entry(spec.copy(), pages, pinned=True, now=now)
+                self._insert_entry(spec.copy(), pages, pinned=True, now=now,
+                                   tenant=getattr(req, "tenant", None))
         if self.prefill_chunk <= split < prompt.shape[0]:
             self._insert_entry(prompt[:split].copy(), pages, pinned=False, now=now)
         self._insert_entry(prompt.copy(), pages, pinned=False, now=now)
 
     def _insert_entry(self, tokens: np.ndarray, pages: List[int],
-                      pinned: bool, now: float) -> None:
+                      pinned: bool, now: float,
+                      tenant: Optional[str] = None) -> None:
+        if pinned and self.tenants is not None:
+            # per-tenant pinned-prefix quota: an over-quota pin degrades
+            # to a plain (evictable) entry instead of pinning — the
+            # tenant keeps the cache benefit but cannot exempt unbounded
+            # pages from pressure reclaim
+            from deepspeed_tpu.serving.frontdoor.tenants import DEFAULT_TENANT
+
+            cap = self.tenants.pinned_prefixes_max(tenant)
+            key = tenant or DEFAULT_TENANT
+            if cap > 0 and self._tenant_pinned.get(key, 0) >= cap:
+                self.tenant_pin_rejects += 1
+                pinned = False
         cover = pages[: _pages_for(tokens.shape[0], self.page_len)]
         entry = PrefixEntry(tokens=tokens, pages=list(cover), pinned=pinned,
                             last_used=now)
         inserted = self.index.insert(entry)
+        newly_pinned = False
         if inserted is entry:
             self._page_incref(cover)
+            newly_pinned = pinned
         elif pinned and not inserted.pinned:
             inserted.pinned = True  # a learned entry graduates to pinned
+            newly_pinned = True
+        if newly_pinned and self.tenants is not None:
+            from deepspeed_tpu.serving.frontdoor.tenants import DEFAULT_TENANT
+
+            key = tenant or DEFAULT_TENANT
+            self._tenant_pinned[key] = self._tenant_pinned.get(key, 0) + 1
 
     @_locked
     def prefix_hint_tokens(self, prompt: np.ndarray,
@@ -540,6 +599,14 @@ class PagedKVPool:
         if slot not in self._owner:
             raise SlotPoolError(f"slot {slot} is not allocated")
         del self._owner[slot]
+        charged = self._slot_tenant.pop(slot, None)
+        if charged is not None:
+            key, n_charged = charged
+            left = self._tenant_pages.get(key, 0) - n_charged
+            if left > 0:
+                self._tenant_pages[key] = left
+            else:
+                self._tenant_pages.pop(key, None)
         pages = self._slot_pages.pop(slot, [])
         self._pending_cow.pop(slot, None)
         self._tables[slot] = GARBAGE_PAGE
@@ -655,6 +722,15 @@ class PagedKVPool:
         :class:`~deepspeed_tpu.serving.kvcache.tiers.PageTierManager`)
         takes over session spill/drop and cold prefix eviction."""
         self.tiers = mgr
+
+    @_locked
+    def attach_tenants(self, registry: Any) -> None:
+        """Arm per-tenant quota enforcement: ``registry`` (a
+        :class:`~deepspeed_tpu.serving.frontdoor.tenants.TenantRegistry`)
+        supplies page and pinned-prefix caps; over-cap allocations defer
+        (return ``None`` from :meth:`alloc_request`) and over-cap pins
+        degrade to unpinned entries."""
+        self.tenants = registry
 
     @_locked
     def recover(self) -> List[str]:
@@ -828,4 +904,9 @@ class PagedKVPool:
         }
         if self.tiers is not None:
             out["tiers"] = self.tiers.stats()
+        if self.tenants is not None:
+            out["tenant_pages"] = dict(self._tenant_pages)
+            out["tenant_pinned"] = dict(self._tenant_pinned)
+            out["tenant_quota_defers"] = self.tenant_quota_defers
+            out["tenant_pin_rejects"] = self.tenant_pin_rejects
         return out

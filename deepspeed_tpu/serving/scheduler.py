@@ -137,6 +137,12 @@ class Request:
     # session_id rebind the previous turn's parked pages instead of
     # re-prefilling; journaled so replay reuses the same session
     session_id: Optional[str] = None
+    # multi-tenant dimension (serving/frontdoor/tenants.py): journaled
+    # (``tn``) so per-tenant accounting reconciles across a crash;
+    # ``wfq_tag`` is the start-time-fair-queueing virtual start time —
+    # the pop order when a TenantRegistry is attached to the scheduler
+    tenant: Optional[str] = None
+    wfq_tag: float = 0.0
     # tokens already cached at admission (prefix/session hit) — prefill
     # starts here; 0 on the slot pool and on kvcache misses
     prefix_hint: int = 0
@@ -400,6 +406,11 @@ class ContinuousScheduler:
         # "first_token", "finished", "expired" transitions.  Pure host
         # callback — the scheduler itself stays jax- and telemetry-free.
         self.on_event: Optional[Any] = None
+        # TenantRegistry (serving/frontdoor/tenants.py) when the tenant
+        # dimension is armed: submits get WFQ tags and _pop_next picks
+        # the tenant with the lowest outstanding tag first.  The
+        # scheduler stays tenant-policy-free — the registry owns it.
+        self.tenants: Optional[Any] = None
 
     def _emit(self, kind: str, r: Request, now: float, step: int) -> None:
         if self.on_event is not None:
@@ -481,6 +492,7 @@ class ContinuousScheduler:
         bypass_admission: bool = False,
         client_key: Optional[str] = None,
         session_id: Optional[str] = None,
+        tenant: Optional[str] = None,
     ) -> Request:
         """``priority``: 0 high (never TTFT-shed) / 1 normal / 2 low
         (first shed when the ladder tops out).  ``request_id`` +
@@ -556,9 +568,16 @@ class ContinuousScheduler:
             priority=int(priority),
             client_key=client_key,
             session_id=session_id,
+            tenant=tenant,
             submit_time=now,
             submit_step=step,
         )
+        if self.tenants is not None:
+            # weighted-fair queueing ahead of the priority tiers: the
+            # tag fixes this request's place in the tenant-fair pop
+            # order (replays are tagged too — fairness applies to the
+            # recovered queue exactly as it did to the original)
+            req.wfq_tag = self.tenants.tag(tenant, cost=float(total))
         if request_id is not None:
             self._ids.advance_past(request_id)
         self._queue.append(req)
@@ -678,7 +697,15 @@ class ContinuousScheduler:
 
     def _pop_next(self) -> Request:
         """Highest-priority (lowest tier number) queued request, FIFO
-        within a tier — an O(queue) scan, fine at max_queue scale."""
+        within a tier — an O(queue) scan, fine at max_queue scale.
+        With a TenantRegistry attached, weighted-fair queueing picks the
+        tenant FIRST (lowest outstanding virtual tag) and the
+        priority-then-FIFO scan runs within that tenant only."""
+        if self.tenants is not None:
+            i = self.tenants.pick(self._queue)
+            r = self._queue[i]
+            del self._queue[i]
+            return r
         best_i, best = 0, None
         for i, r in enumerate(self._queue):
             if best is None or r.priority < best.priority:

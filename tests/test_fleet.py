@@ -87,8 +87,23 @@ def _factory(eng, base, name, **kw):
     return build
 
 
+def _wrap(rep):
+    """DS_FLEET_TRANSPORT=inproc|socket reruns the whole suite with
+    every replica behind the frontdoor RPC boundary — the router /
+    supervisor contract must hold unchanged over both transports
+    (docs/serving.md §Front-door; the CI ``frontdoor`` job sets
+    ``socket``)."""
+    mode = os.environ.get("DS_FLEET_TRANSPORT", "")
+    if not mode:
+        return rep
+    from deepspeed_tpu.serving.frontdoor.transport import wrap_replica
+
+    return wrap_replica(rep, mode)
+
+
 def _fleet(eng, tmp_path, n=3, config=None, supervisor=None, clock=None, **kw):
-    reps = [LocalReplica(f"r{i}", _factory(eng, tmp_path, f"r{i}", **kw)) for i in range(n)]
+    reps = [_wrap(LocalReplica(f"r{i}", _factory(eng, tmp_path, f"r{i}", **kw)))
+            for i in range(n)]
     router = FleetRouter(
         reps,
         config=config,

@@ -1,0 +1,386 @@
+"""Front-door RPC transport tests (ISSUE 20; docs/serving.md
+§Front-door).
+
+The codec contract: one dispatch table behind two transports, a framed
+byte protocol whose EVERY defect — truncation at any byte, flipped
+bits, garbage — surfaces as ``TransportFrameError`` client-side and
+``ReplicaDeadError`` through a transport, never a hang; and the
+exception taxonomy (``ServingQueueFull`` / ``Overloaded`` / ``Draining``
+/ ``TenantThrottled``) reconstructing as its EXACT class with
+``retry_after`` intact across the wire (the satellite-c bugfix: a
+process boundary used to collapse the subclasses and drop the backoff
+hint).
+"""
+import io
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.resilience.faults import InjectedFault
+from deepspeed_tpu.serving.fleet.replica import ReplicaDeadError
+from deepspeed_tpu.serving.frontdoor.tenants import TenantThrottled
+from deepspeed_tpu.serving.frontdoor.transport import (
+    MAGIC,
+    InProcTransport,
+    SocketTransport,
+    TransportFrameError,
+    TransportReplica,
+    dispatch,
+    encode_error,
+    raise_wire,
+    read_frame,
+    wrap_replica,
+    write_frame,
+)
+from deepspeed_tpu.serving.scheduler import (
+    ServingDraining,
+    ServingOverloaded,
+    ServingQueueFull,
+)
+
+pytestmark = pytest.mark.serving
+
+
+# ---------------------------------------------------------------------------
+# fakes: the minimal LocalReplica duck surface, no engine
+# ---------------------------------------------------------------------------
+
+class _Result:
+    def __init__(self, tokens, reason="eos"):
+        self._tokens = list(tokens)
+        self.finish_reason = reason
+        self.first_token_time = 1.0
+        self.submit_time = 0.5
+        self.retry_after = None
+
+    def tokens(self):
+        return self._tokens
+
+
+class _FakeReplica:
+    def __init__(self, name="fake", submit_raises=None):
+        self.name = name
+        self._next = 0
+        self._raises = submit_raises
+        self._done = {}
+        self._keys = {}
+        self.kills = 0
+
+    def alive(self):
+        return True
+
+    def submit(self, prompt, client_key=None, **kw):
+        if self._raises is not None:
+            raise self._raises
+        rid = self._next
+        self._next += 1
+        if client_key:
+            self._keys[client_key] = rid
+        self._done[rid] = _Result(int(t) for t in np.asarray(prompt))
+        return rid
+
+    def step(self):
+        return False
+
+    def has_work(self):
+        return False
+
+    def pop_results(self):
+        out, self._done = self._done, {}
+        return out
+
+    def cancel(self, rid):
+        return False
+
+    def result(self, rid):
+        return None
+
+    def client_request_id(self, key):
+        return self._keys.get(key)
+
+    def estimate_ttft(self, n):
+        return 0.002
+
+    def queue_depth(self):
+        return 3
+
+    def degrade_level(self):
+        return 1
+
+    def draining(self):
+        return False
+
+    def stats(self):
+        return {"queued": np.int64(3), "rates": np.asarray([1.5])}
+
+    def kill(self, reason="killed"):
+        self.kills += 1
+
+    def restart(self):
+        return []
+
+
+def _frame_bytes(obj):
+    buf = io.BytesIO()
+    write_frame(buf, obj)
+    return buf.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------------
+
+def test_frame_roundtrip():
+    for obj in ({"op": "step"}, {"ok": [1, 2, 3]}, {"nested": {"a": None}},
+                {"unicode": "héllo", "f": 1.25}):
+        assert read_frame(io.BytesIO(_frame_bytes(obj))) == obj
+
+
+def test_frame_stream_of_frames():
+    objs = [{"i": i} for i in range(5)]
+    stream = io.BytesIO(b"".join(_frame_bytes(o) for o in objs))
+    assert [read_frame(stream) for _ in objs] == objs
+    with pytest.raises(EOFError):
+        read_frame(stream)
+
+
+def test_torn_frame_every_truncation_point():
+    """Satellite (a): a frame cut at ANY byte boundary is a clean
+    error — EOFError exactly at zero bytes, TransportFrameError at
+    every other cut — never a hang, never a parse."""
+    buf = _frame_bytes({"op": "submit", "prompt": [1, 2, 3], "kw": {}})
+    for cut in range(len(buf)):
+        exc = EOFError if cut == 0 else TransportFrameError
+        with pytest.raises(exc):
+            read_frame(io.BytesIO(buf[:cut]))
+    assert read_frame(io.BytesIO(buf))["op"] == "submit"
+
+
+def test_garbage_frame_fuzz_seeded():
+    """Seeded byte-flip fuzz: every single-byte corruption of a valid
+    frame must raise TransportFrameError (magic, length, crc and
+    payload are ALL covered by the header checks + crc32)."""
+    buf = bytearray(_frame_bytes({"op": "pop", "blob": "x" * 64}))
+    rng = np.random.default_rng(1234)
+    for _ in range(200):
+        pos = int(rng.integers(0, len(buf)))
+        flip = bytes(buf[:pos]) + bytes([buf[pos] ^ (1 + int(rng.integers(0, 255)))]) \
+            + bytes(buf[pos + 1:])
+        with pytest.raises(TransportFrameError):
+            read_frame(io.BytesIO(flip))
+
+
+def test_pure_garbage_is_bad_magic():
+    with pytest.raises(TransportFrameError):
+        read_frame(io.BytesIO(b"not a frame at all, definitely"))
+    assert MAGIC == b"DSRP"
+
+
+def test_oversized_frame_rejected():
+    import struct
+    import zlib
+
+    hdr = struct.Struct(">4sII").pack(MAGIC, 1 << 30, zlib.crc32(b""))
+    with pytest.raises(TransportFrameError):
+        read_frame(io.BytesIO(hdr))
+
+
+# ---------------------------------------------------------------------------
+# exception codec (satellite c)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("cls", [ServingQueueFull, ServingOverloaded,
+                                 ServingDraining, TenantThrottled])
+def test_exception_roundtrip_exact_class_and_retry_after(cls):
+    resp = encode_error(cls("bucket empty", retry_after=2.5))
+    with pytest.raises(cls) as ei:
+        raise_wire(resp)
+    assert type(ei.value) is cls  # EXACT class, not a collapsed parent
+    assert ei.value.retry_after == 2.5
+
+
+def test_exception_roundtrip_dead_and_injected():
+    with pytest.raises(ReplicaDeadError):
+        raise_wire(encode_error(ReplicaDeadError("gone")))
+    with pytest.raises(InjectedFault):
+        raise_wire(encode_error(InjectedFault("seeded")))
+
+
+def test_unknown_exception_degrades_to_runtime_error():
+    class Weird(Exception):
+        pass
+
+    with pytest.raises(RuntimeError, match="Weird"):
+        raise_wire(encode_error(Weird("boom")))
+
+
+def test_throttle_roundtrips_over_real_socket():
+    """The regression for the satellite bugfix: a TenantThrottled (and
+    its retry_after) crossing a REAL framed socket stays a
+    TenantThrottled — the front-door's 429 depends on it."""
+    rep = _FakeReplica(
+        submit_raises=TenantThrottled("tenant over quota", retry_after=7.0))
+    wrapped = wrap_replica(rep, "socket")
+    try:
+        with pytest.raises(TenantThrottled) as ei:
+            wrapped.submit(np.asarray([1, 2, 3], np.int32))
+        assert type(ei.value) is TenantThrottled
+        assert ei.value.retry_after == 7.0
+        assert wrapped.alive()  # a WIRE exception is not a dead peer
+    finally:
+        wrapped.close()
+
+
+# ---------------------------------------------------------------------------
+# dispatch table
+# ---------------------------------------------------------------------------
+
+def test_dispatch_submit_pop_ck_health_stats():
+    rep = _FakeReplica()
+    rid = dispatch(rep, {"op": "submit", "prompt": [5, 6],
+                         "client_key": "k1", "kw": {}})["ok"]
+    assert rid == 0
+    popped = dispatch(rep, {"op": "pop"})["ok"]
+    assert popped[str(rid)]["tokens"] == [5, 6]
+    assert popped[str(rid)]["finish_reason"] == "eos"
+    assert dispatch(rep, {"op": "ck", "key": "k1"})["ok"] == rid
+    assert dispatch(rep, {"op": "ck", "key": "nope"})["ok"] is None
+    h = dispatch(rep, {"op": "health"})["ok"]
+    assert h == {"depth": 3, "level": 1, "draining": False,
+                 "est": pytest.approx(0.002)}
+    # stats must come back JSON-plain (numpy scrubbed)
+    st = dispatch(rep, {"op": "stats"})["ok"]
+    assert st == {"queued": 3, "rates": [1.5]}
+    assert isinstance(st["queued"], int)
+
+
+def test_dispatch_unknown_op_is_wire_valueerror():
+    resp = dispatch(_FakeReplica(), {"op": "mystery"})
+    assert resp["type"] == "ValueError" and "mystery" in resp["err"]
+    with pytest.raises(ValueError):
+        raise_wire(resp)
+
+
+# ---------------------------------------------------------------------------
+# both transports, one behavior
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mode", ["inproc", "socket"])
+def test_wrap_replica_parity(mode):
+    rep = _FakeReplica()
+    wrapped = wrap_replica(rep, mode)
+    try:
+        assert isinstance(wrapped, TransportReplica)
+        rid = wrapped.submit(np.asarray([9, 8, 7], np.int32),
+                             client_key="ck-1")
+        assert rid == 0
+        assert wrapped.client_request_id("ck-1") == rid
+        out = wrapped.pop_results()
+        assert list(out) == [rid] and out[rid].tokens() == [9, 8, 7]
+        assert out[rid].finish_reason == "eos"
+        assert wrapped.queue_depth() == 3
+        assert wrapped.degrade_level() == 1
+        assert wrapped.draining() is False
+        assert wrapped.estimate_ttft(8) == pytest.approx(0.002)
+        assert wrapped.has_work() is False
+        assert wrapped.stats()["queued"] == 3
+    finally:
+        wrapped.close()
+
+
+def test_wrap_replica_unknown_transport():
+    with pytest.raises(ValueError):
+        wrap_replica(_FakeReplica(), "carrier-pigeon")
+
+
+def test_inproc_engine_passthrough():
+    rep = _FakeReplica()
+    t = InProcTransport(rep)
+    assert t.local_replica is rep
+    assert t.call({"op": "has_work"}) is False
+    assert t.first_rc is None
+
+
+# ---------------------------------------------------------------------------
+# torn frames over a live socket -> ReplicaDeadError, never a hang
+# ---------------------------------------------------------------------------
+
+def _evil_peer(sock, payload):
+    """Reads one request frame, answers with raw garbage, closes."""
+    rfile = sock.makefile("rb")
+    try:
+        read_frame(rfile)
+        sock.sendall(payload)
+    finally:
+        sock.close()
+
+
+@pytest.mark.parametrize("payload", [
+    b"",                                   # clean EOF mid-conversation
+    b"DSRP",                               # torn header
+    b"XXXX\x00\x00\x00\x04\x00\x00\x00\x00junk",  # bad magic
+    _frame_bytes({"ok": True})[:-3],       # torn payload
+    b"\x00" * 64,                          # zero garbage
+], ids=["eof", "torn-header", "bad-magic", "torn-payload", "zeros"])
+def test_torn_socket_frame_is_dead_replica_not_hang(payload):
+    a, b = socket.socketpair()
+    peer = threading.Thread(target=_evil_peer, args=(b, payload), daemon=True)
+    peer.start()
+    t = SocketTransport(a, name="evil")
+    t0 = time.monotonic()
+    with pytest.raises(ReplicaDeadError):
+        t.call({"op": "step"})
+    assert time.monotonic() - t0 < 5.0, "torn frame must not hang"
+    assert not t.alive() and t.kills == 1
+    # every subsequent call fails fast on the dead mark — no IO
+    with pytest.raises(ReplicaDeadError):
+        t.call({"op": "step"})
+    assert t.kills == 1
+    peer.join(5)
+
+
+def test_fuzzed_socket_responses_seeded():
+    """Byte-level fuzz loop over seeded truncation points of a VALID
+    response frame: whatever prefix the peer manages to send, the
+    client gets ReplicaDeadError promptly."""
+    full = _frame_bytes({"ok": {"depth": 0, "level": 0,
+                                "draining": False, "est": None}})
+    rng = np.random.default_rng(99)
+    cuts = sorted({int(rng.integers(0, len(full))) for _ in range(24)})
+    for cut in cuts:
+        a, b = socket.socketpair()
+        peer = threading.Thread(target=_evil_peer, args=(b, full[:cut]),
+                                daemon=True)
+        peer.start()
+        t = SocketTransport(a, name=f"fuzz-{cut}")
+        with pytest.raises(ReplicaDeadError):
+            t.call({"op": "health"})
+        assert not t.alive()
+        peer.join(5)
+
+
+def test_dead_transport_replica_neutral_values():
+    """A TransportReplica over a dead transport answers the same
+    neutral values LocalReplica gives for a dead engine — the router
+    health-gates it out instead of crashing."""
+    rep = _FakeReplica()
+    wrapped = wrap_replica(rep, "socket")
+    wrapped.kill("test")
+    assert not wrapped.alive()
+    assert wrapped.has_work() is False
+    assert wrapped.pop_results() == {}
+    assert wrapped.result(0) is None
+    assert wrapped.partial_result(0) is None
+    assert wrapped.cancel(0) is False
+    assert wrapped.client_request_id("k") is None
+    assert wrapped.queue_depth() == 0
+    assert wrapped.degrade_level() == 0
+    assert wrapped.draining() is False
+    assert wrapped.estimate_ttft(4) is None
+    assert wrapped.kv_affinity(np.asarray([1], np.int32)) == 0.0
+    assert wrapped.stats() == {"dead": True}
+    with pytest.raises(ReplicaDeadError):
+        wrapped.submit(np.asarray([1], np.int32))

@@ -571,6 +571,227 @@ def run_elastic_bench(engine, args, slots, chunk, max_len, max_new,
         f"{rec['sessions_migrated']} session(s) migrated")
 
 
+def run_tenant_load(make_serving, schedule):
+    """Open-loop run over a pre-merged ``[(arrival_s, item), ...]``
+    schedule where every item carries a ``tenant``; returns per-tenant
+    admitted TTFT percentiles plus throttle counts (a throttled submit
+    raises ``TenantThrottled`` — a ``ServingQueueFull`` subclass — and
+    counts as that tenant's rejection, exactly the front-door's 429)."""
+    from deepspeed_tpu.serving import ServingQueueFull
+
+    srv = warm(make_serving(), [w for _, w in schedule])
+    t0 = time.monotonic()
+    pending = list(schedule)
+    ids = {}  # rid -> (tenant, arrival offset)
+    finished = {}
+    rejected = {}  # tenant -> throttled/queue-full submit count
+    while pending or srv.scheduler.has_work():
+        now = time.monotonic() - t0
+        while pending and pending[0][0] <= now:
+            arr, w = pending.pop(0)
+            try:
+                rid = srv.submit(w["prompt"], max_new_tokens=w["max_new"],
+                                 tenant=w["tenant"])
+                ids[rid] = (w["tenant"], arr)
+            except ServingQueueFull:
+                rejected[w["tenant"]] = rejected.get(w["tenant"], 0) + 1
+        if srv.scheduler.has_work():
+            srv.step()
+        elif pending:
+            time.sleep(min(0.005, max(0.0, pending[0][0] - now)))
+        finished.update(srv.pop_results())
+    makespan = time.monotonic() - t0
+    per, per_steps, toks = {}, {}, 0
+    for rid, (tn, arr) in ids.items():
+        r = finished.get(rid)
+        if r is None or r.first_token_time is None:
+            continue
+        toks += len(r.generated)
+        per.setdefault(tn, []).append(
+            (r.first_token_time - r.submit_time) * 1e3)
+        # submit-to-first-token in SCHEDULER STEPS: the virtual-time
+        # view of the same wait (queue + chunked prefill), immune to
+        # the host descheduling that makes wall-clock ms ungateable
+        # on shared runners — a stalled host stops the step clock too
+        per_steps.setdefault(tn, []).append(
+            r.first_token_step - r.submit_step)
+    pct = lambda a, q: round(float(np.percentile(a, q)), 2) if a else None
+    return {
+        "tokens_per_s": round(toks / max(makespan, 1e-9), 1),
+        "tenants": {
+            tn: {
+                "completed": len(per.get(tn, [])),
+                "rejected": rejected.get(tn, 0),
+                "ttft_submit_p50_ms": pct(per.get(tn, []), 50),
+                "ttft_submit_p99_ms": pct(per.get(tn, []), 99),
+                "ttft_steps_p50": pct(per_steps.get(tn, []), 50),
+                "ttft_steps_p99": pct(per_steps.get(tn, []), 99),
+            }
+            for tn in sorted(set(per) | set(rejected))
+        },
+    }
+
+
+def run_tenant_bench(engine, args, slots, chunk, max_len, max_new, model):
+    """The ``tenants`` bench rung (docs/serving.md §Front-door): the
+    multi-tenant isolation proof.  A QUIET tenant runs the same seeded
+    Poisson stream twice — once alone, once next to a NOISY tenant
+    offered 10x its token-bucket quota.  The bucket + weighted-fair
+    queue must absorb the noisy tenant (throttled at admission, fair-
+    queued behind quiet's requests when admitted), so the quiet
+    tenant's admitted median TTFT in the mixed run — measured in
+    scheduler steps, the engine's virtual clock — IS the gated metric:
+    if isolation breaks, the quiet tenant queues for more steps, the
+    number inflates past the noise band and the perf sentinel goes
+    red."""
+    from deepspeed_tpu.serving import ServingEngine
+
+    log("=== mixed-tenant isolation bench ===")
+    rng = np.random.default_rng(args.seed)
+    n_req = args.requests or 16
+    lo, hi = 4, min(48, max_len // 2)
+    base = build_workload(n_req, lo, hi, max_new, args.seed,
+                          engine.model_config.vocab_size)
+    # the bucket charges prompt + max_new at admission — quota math is
+    # in TOKENS/s, so size it off the mean request cost
+    cost = float(np.mean([len(w["prompt"]) + w["max_new"] for w in base]))
+
+    # raw capacity (no tenants armed) sizes both offered rates
+    def make_plain():
+        return ServingEngine(engine, num_slots=slots, prefill_chunk=chunk,
+                             max_len=max_len, max_queue=args.max_queue)
+
+    toks_s, req_s, _ = run_closed_loop(make_plain, base)
+    quiet_rps = max(req_s * 0.4, 1e-3)
+    noisy_quota_rps = max(req_s * 0.3, 1e-3)  # the bucket's sustained rate
+    noisy_offered_rps = noisy_quota_rps * 10.0  # 10x its quota
+    log(f"[tenants] capacity {req_s:.2f} req/s; quiet offered "
+        f"{quiet_rps:.2f} req/s, noisy offered {noisy_offered_rps:.2f} "
+        f"req/s against a {noisy_quota_rps:.2f} req/s quota")
+
+    tenants_cfg = {
+        "enabled": True,
+        "overrides": {
+            # quiet: unlimited bucket, gold SLO (maps to priority 0)
+            "quiet": {"slo_class": "gold"},
+            # noisy: bucket sized to ~30% of capacity in token terms
+            "noisy": {
+                "refill_tokens_per_second": noisy_quota_rps * cost,
+                "burst_tokens": max(2.0 * cost, 1.0),
+                "slo_class": "bronze",
+            },
+        },
+    }
+
+    def make_tenanted():
+        return ServingEngine(engine, num_slots=slots, prefill_chunk=chunk,
+                             max_len=max_len, max_queue=args.max_queue,
+                             tenants=tenants_cfg)
+
+    # the quiet stream: IDENTICAL arrivals in both phases (same seed)
+    quiet_items = [dict(w, tenant="quiet") for w in base]
+    quiet_arr = np.cumsum(
+        np.random.default_rng(args.seed + 1).exponential(
+            1.0 / quiet_rps, size=len(quiet_items)))
+    sched_quiet = sorted(zip(quiet_arr.tolist(), quiet_items))
+
+    # latency noise on a shared host is one-sided (descheduling only
+    # ADDS time), so each phase runs ``repeats`` times and the gated
+    # number is the BEST step-count p50 — a real isolation regression
+    # is workload behaviour and inflates every repeat, a jitter
+    # outlier only one
+    repeats = 5
+
+    def best(runs_):
+        return min(runs_, key=lambda r: (
+            r["tenants"]["quiet"]["ttft_steps_p50"]
+            if r["tenants"]["quiet"]["ttft_steps_p50"] is not None
+            else float("inf")))
+
+    solo_runs = [run_tenant_load(make_tenanted, sched_quiet)
+                 for _ in range(repeats)]
+    solo = best(solo_runs)
+    q_solo = solo["tenants"]["quiet"]
+    log(f"[tenants] quiet solo: admitted p50 "
+        f"{q_solo['ttft_steps_p50']} steps / "
+        f"{q_solo['ttft_submit_p50_ms']} ms best-of-{repeats} (p99 "
+        f"{q_solo['ttft_submit_p99_ms']} ms, "
+        f"{q_solo['completed']}/{len(quiet_items)} completed)")
+
+    # the noisy stream spans the quiet window at 10x quota
+    window_s = float(quiet_arr[-1])
+    n_noisy = max(int(noisy_offered_rps * window_s) + 1, 4)
+    noisy_base = (base * (n_noisy // len(base) + 1))[:n_noisy]
+    noisy_items = [dict(w, tenant="noisy") for w in noisy_base]
+    noisy_arr = np.cumsum(rng.exponential(
+        1.0 / noisy_offered_rps, size=len(noisy_items)))
+    merged = sorted(
+        list(zip(quiet_arr.tolist(), quiet_items))
+        + list(zip(noisy_arr.tolist(), noisy_items)),
+        key=lambda p: p[0])
+
+    mixed_runs = [run_tenant_load(make_tenanted, merged)
+                  for _ in range(repeats)]
+    mixed = best(mixed_runs)
+    q_mix = mixed["tenants"]["quiet"]
+    n_mix = mixed["tenants"].get(
+        "noisy", {"completed": 0, "rejected": 0,
+                  "ttft_submit_p99_ms": None})
+    ratio = None
+    if q_solo["ttft_steps_p50"] and q_mix["ttft_steps_p50"]:
+        ratio = round(
+            q_mix["ttft_steps_p50"] / q_solo["ttft_steps_p50"], 3)
+    throttle_rate = round(
+        n_mix["rejected"] / max(len(noisy_items), 1), 3)
+    rec = {
+        # "ttft"/"p50" tokens -> lower-is-better for the perf
+        # sentinel; a DS_BENCH_INJECT 'tenants:3.0' triples it -> RED
+        # (CI check).  Gated on the quiet tenant's MEDIAN submit-to-
+        # first-token measured in SCHEDULER STEPS (virtual time), best
+        # of ``repeats`` identical mixed phases: a starved tenant
+        # queues for more steps in every repeat, while wall-clock ms
+        # at single-digit magnitudes is dominated by shared-runner
+        # descheduling (the ms percentiles ride along as context)
+        "metric": f"serving_tenants_{model.replace('-', '_')}"
+                  "_quiet_ttft_p50_steps_under_10x_noisy",
+        "value": q_mix["ttft_steps_p50"],
+        "unit": "steps",
+        "repeats": repeats,
+        "quiet_steps_p50_runs": [
+            r["tenants"]["quiet"]["ttft_steps_p50"]
+            for r in mixed_runs],
+        "quiet_steps_p99": q_mix["ttft_steps_p99"],
+        "quiet_solo_steps_p50": q_solo["ttft_steps_p50"],
+        "quiet_p50_ms": q_mix["ttft_submit_p50_ms"],
+        "quiet_p99_ms": q_mix["ttft_submit_p99_ms"],
+        "quiet_solo_p50_ms": q_solo["ttft_submit_p50_ms"],
+        "quiet_solo_p99_ms": q_solo["ttft_submit_p99_ms"],
+        "quiet_mixed_over_solo_p50_steps": ratio,
+        "quiet_completed": q_mix["completed"],
+        "quiet_offered": len(quiet_items),
+        "quiet_rejected": q_mix["rejected"],
+        "noisy_offered": len(noisy_items),
+        "noisy_completed": n_mix["completed"],
+        "noisy_throttled": n_mix["rejected"],
+        "noisy_throttle_rate": throttle_rate,
+        "noisy_p99_ms": n_mix["ttft_submit_p99_ms"],
+        "noisy_offered_x_quota": 10.0,
+        "capacity_req_s": round(req_s, 2),
+        "tokens_per_s": mixed["tokens_per_s"],
+        "num_slots": slots,
+        "prefill_chunk": chunk,
+        "max_len": max_len,
+    }
+    emit(rec, rung="tenants")
+    log(f"[tenants] mixed: quiet admitted p50 {rec['value']} steps "
+        f"best-of-{repeats} {rec['quiet_steps_p50_runs']} "
+        f"= {ratio}x solo ({rec['quiet_p50_ms']} ms, p99 "
+        f"{rec['quiet_p99_ms']} ms); "
+        f"noisy throttled {throttle_rate:.1%} "
+        f"({n_mix['rejected']}/{len(noisy_items)}), quiet rejected "
+        f"{q_mix['rejected']}")
+
+
 def run_kvcache_bench(engine, args, slots, chunk, max_len, max_new, model):
     """The ``kvcache`` bench rung (docs/serving.md §Paged KV & prefix
     caching): an 80%-shared system-prompt batch plus 3-turn chat
@@ -864,6 +1085,13 @@ def main():
                          "reference — records tokens/s at 4x, the "
                          "T0-resident overhead ratio, and the swap-hide "
                          "ratio at bit-identical outputs")
+    ap.add_argument("--tenants", action="store_true",
+                    help="mixed-tenant isolation mode (docs/serving.md "
+                         "§Front-door): a quiet tenant's seeded stream "
+                         "run solo vs next to a noisy tenant offered "
+                         "10x its token-bucket quota — records the "
+                         "quiet tenant's admitted p99 TTFT both ways "
+                         "plus the noisy throttle rate")
     ap.add_argument("--overload", action="store_true",
                     help="overload-resilience mode: arm the estimated-TTFT "
                          "shedder (--slo-ttft-ms) and run 2x/4x offered load, "
@@ -928,6 +1156,13 @@ def main():
     if args.elastic:
         run_elastic_bench(engine, args, slots, chunk, max_len, max_new,
                           workload, model)
+        if args.trace:
+            path = telemetry.export_trace(args.trace)
+            log(f"trace exported -> {path}")
+        return
+
+    if args.tenants:
+        run_tenant_bench(engine, args, slots, chunk, max_len, max_new, model)
         if args.trace:
             path = telemetry.export_trace(args.trace)
             log(f"trace exported -> {path}")

@@ -48,7 +48,6 @@ import argparse
 import json
 import os
 import signal
-import subprocess
 import sys
 import tempfile
 import time
@@ -115,293 +114,70 @@ def make_engine(journal_dir, paged=False, spill_dir=None):
 
 
 # ---------------------------------------------------------------------------
-# worker child: a replica process serving the JSONL command pipe
+# worker child: a replica process serving the framed RPC stream
 # ---------------------------------------------------------------------------
 
 def run_worker(journal_dir, paged=False, spill_dir=None):
-    """One replica process: engine over ``journal_dir``, commands in on
-    stdin, one JSON response line out per command.  A planned SIGKILL
-    (DS_FAULT_PLAN, site ``serving.decode`` or ``migrate.export``)
-    simply never answers — the parent's read hits EOF, which IS the
-    death signal."""
-    # claim fd 1 as the private JSON channel BEFORE the framework loads:
-    # the deepspeed_tpu logger writes to stdout, which would corrupt the
-    # line framing — re-point fd 1 (and sys.stdout) at stderr instead
-    out = os.fdopen(os.dup(1), "w")
+    """One replica process: engine over ``journal_dir`` wrapped in a
+    :class:`LocalReplica`, served over the crc-framed RPC codec
+    (serving/frontdoor/transport.py) on the stdio pipes.  A planned
+    SIGKILL (DS_FAULT_PLAN, site ``serving.decode`` or
+    ``migrate.export``) simply never answers — the parent's read hits
+    EOF, which IS the death signal."""
+    # claim fd 0/1 as the private framed channel BEFORE the framework
+    # loads: the deepspeed_tpu logger writes to stdout, which would
+    # corrupt the framing — re-point fd 1 (and sys.stdout) at stderr
+    rfile = os.fdopen(os.dup(0), "rb")
+    wfile = os.fdopen(os.dup(1), "wb")
     os.dup2(2, 1)
     sys.stdout = sys.stderr
-
-    import numpy as np
 
     from deepspeed_tpu.resilience import faults
 
     faults.install_from_env(rank=0)
-    _, _, srv = make_engine(journal_dir, paged=paged, spill_dir=spill_dir)
-    for line in sys.stdin:
-        line = line.strip()
-        if not line:
-            continue
-        cmd = json.loads(line)
-        op = cmd["op"]
-        try:
-            if op == "submit":
-                rid = srv.submit(
-                    np.asarray(cmd["prompt"], np.int32),
-                    client_key=cmd.get("client_key"),
-                    **cmd.get("kw", {}),
-                )
-                resp = {"ok": rid}
-            elif op == "step":
-                resp = {"ok": bool(srv.step())}
-            elif op == "has_work":
-                resp = {"ok": bool(srv.scheduler.has_work())}
-            elif op == "pop":
-                resp = {"ok": {
-                    str(rid): {
-                        "tokens": [int(t) for t in r.tokens()],
-                        "finish_reason": r.finish_reason,
-                        "first_token_time": r.first_token_time,
-                        "submit_time": r.submit_time,
-                        "retry_after": r.retry_after,
-                    }
-                    for rid, r in srv.pop_results().items()
-                }}
-            elif op == "cancel":
-                resp = {"ok": bool(srv.cancel(int(cmd["id"])))}
-            elif op == "result":
-                r = srv.result(int(cmd["id"]))
-                resp = {"ok": None if r is None
-                        else {"first_token": r.first_token_time is not None,
-                              "finished": r.finish_time is not None}}
-            elif op == "ck":
-                resp = {"ok": srv.client_request_id(str(cmd["key"]))}
-            elif op == "recover":
-                resp = {"ok": [int(r) for r in srv.recover()]}
-            elif op == "affinity":
-                hint = getattr(srv.pool, "prefix_hint_tokens", None)
-                resp = {"ok": 0 if hint is None else int(hint(
-                    np.asarray(cmd["prompt"], np.int32),
-                    session_id=cmd.get("session_id"),
-                ))}
-            elif op == "export":
-                # the fault fires IN THE CHILD: a sigkill plan at
-                # migrate.export kills this process mid-export and the
-                # parent's readline EOF is the ReplicaDeadError
-                faults.check("migrate.export")
-                faults.check_latency("migrate.export")
-                exp = getattr(srv.pool, "export_sessions", None)
-                resp = {"ok": [] if exp is None
-                        else exp(cmd["dir"], now=time.monotonic())}
-            elif op == "import":
-                faults.check("migrate.import")
-                faults.check_latency("migrate.import")
-                imp = getattr(srv.pool, "import_sessions", None)
-                resp = {"ok": {} if imp is None
-                        else imp(cmd["dir"], now=time.monotonic())}
-            elif op == "sweep":
-                swp = getattr(srv.pool, "sweep", None)
-                resp = {"ok": 0 if swp is None
-                        else int(swp(time.monotonic()))}
-            elif op == "kvstats":
-                resp = {"ok": srv.pool.stats()
-                        if hasattr(srv.pool, "sessions") else {}}
-            elif op == "health":
-                resp = {"ok": {
-                    "depth": srv.scheduler.queue_depth,
-                    "level": srv.scheduler.ladder.level,
-                    "est": srv.scheduler.admission.estimate_ttft_seconds(
-                        int(cmd.get("len", 8))
-                    ),
-                }}
-            elif op == "exit":
-                break
-            else:
-                resp = {"err": f"unknown op {op!r}", "type": "ValueError"}
-        except Exception as e:
-            resp = {"err": str(e), "type": type(e).__name__,
-                    "retry_after": getattr(e, "retry_after", None)}
-        out.write(json.dumps(resp) + "\n")
-        out.flush()
+
+    from deepspeed_tpu.serving.fleet import LocalReplica
+    from deepspeed_tpu.serving.frontdoor.transport import serve_stream
+
+    rep = LocalReplica(
+        "worker",
+        lambda: make_engine(journal_dir, paged=paged, spill_dir=spill_dir)[2],
+    )
+    serve_stream(rep, rfile, wfile)
 
 
 # ---------------------------------------------------------------------------
-# parent-side process replica: the router's duck-typed surface
+# parent-side process replica: the router's duck-typed surface, now the
+# shared TransportReplica over a ProcessTransport (one codec both ways)
 # ---------------------------------------------------------------------------
 
-class _WireResult:
-    """Parent-side view of a worker's retired request."""
+def ProcessReplica(name, journal_dir, fault_plan=None, paged=False,
+                   spill_dir=None):
+    """The fleet replica surface over a child worker process: a
+    :class:`TransportReplica` driving a :class:`ProcessTransport`.
+    Pipe EOF or a torn frame raises :class:`ReplicaDeadError` — the
+    parent-side shape of a SIGKILL'd replica.  ``restart()`` respawns
+    the child over the same journal directory (sans fault plan) and
+    replays.  (A factory, not a class: the transport import must stay
+    out of module scope so a worker child can claim fd 1 before the
+    framework's first stdout write.)"""
+    from deepspeed_tpu.serving.frontdoor.transport import (
+        ProcessTransport,
+        TransportReplica,
+    )
 
-    def __init__(self, d):
-        self._tokens = d["tokens"]
-        self.finish_reason = d["finish_reason"]
-        self.first_token_time = d["first_token_time"]
-        self.submit_time = d["submit_time"]
-        self.retry_after = d.get("retry_after")
-
-    def tokens(self):
-        return self._tokens
-
-
-class ProcessReplica:
-    """The fleet replica surface over a child process + JSONL pipe.
-    EOF on the pipe raises :class:`ReplicaDeadError` — the parent-side
-    shape of a SIGKILL'd replica.  ``restart()`` respawns the child
-    over the same journal directory (sans fault plan) and replays."""
-
-    def __init__(self, name, journal_dir, fault_plan=None, paged=False,
-                 spill_dir=None):
-        self.name = name
-        self.journal_dir = journal_dir
-        self.paged = paged
-        self.spill_dir = spill_dir
-        self.kills = 0
-        self.first_rc = None
-        self.proc = None
-        self._spawn(fault_plan)
-
-    def _spawn(self, fault_plan=None):
-        env = dict(os.environ)
-        env.pop("DS_FAULT_PLAN", None)
-        if fault_plan is not None:
-            env["DS_FAULT_PLAN"] = fault_plan
-        argv = [sys.executable, os.path.abspath(__file__), "--role", "worker",
-                "--journal", self.journal_dir, "--dryrun"]
-        if self.paged:
-            argv.append("--paged")
-        if self.spill_dir:
-            argv += ["--spill", self.spill_dir]
-        self.proc = subprocess.Popen(
-            argv, env=env, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
-            stderr=subprocess.DEVNULL, text=True,
-        )
-
-    def _rpc(self, **cmd):
-        from deepspeed_tpu.serving.fleet import ReplicaDeadError
-
-        if self.proc is None or self.proc.poll() is not None:
-            raise ReplicaDeadError(f"replica {self.name} process is gone")
-        try:
-            self.proc.stdin.write(json.dumps(cmd) + "\n")
-            self.proc.stdin.flush()
-            line = self.proc.stdout.readline()
-        except (BrokenPipeError, OSError):
-            line = ""
-        if not line:  # EOF: the process died mid-command
-            if self.first_rc is None:
-                self.first_rc = self.proc.wait()
-            self.kills += 1
-            raise ReplicaDeadError(
-                f"replica {self.name} pipe EOF (rc={self.proc.poll()})"
-            )
-        resp = json.loads(line)
-        if "err" in resp:
-            self._raise_wire(resp)
-        return resp["ok"]
-
-    @staticmethod
-    def _raise_wire(resp):
-        from deepspeed_tpu.serving import ServingQueueFull
-
-        if resp["type"] in ("ServingQueueFull", "ServingOverloaded",
-                            "ServingDraining"):
-            raise ServingQueueFull(resp["err"],
-                                   retry_after=resp.get("retry_after"))
-        raise RuntimeError(f"{resp['type']}: {resp['err']}")
-
-    # -- the replica surface ------------------------------------------------
-    def alive(self):
-        return self.proc is not None and self.proc.poll() is None
-
-    def restart(self):
-        if self.proc is not None and self.first_rc is None:
-            self.first_rc = self.proc.poll()
-        self._spawn()  # same journal dir, no fault plan
-        return self._rpc(op="recover")
-
-    def submit(self, prompt, client_key=None, **kw):
-        return self._rpc(op="submit", prompt=[int(t) for t in prompt],
-                         client_key=client_key, kw=kw)
-
-    def cancel(self, request_id):
-        try:
-            return self._rpc(op="cancel", id=int(request_id))
-        except Exception:
-            return False
-
-    def step(self):
-        return self._rpc(op="step")
-
-    def has_work(self):
-        if not self.alive():
-            return False
-        return self._rpc(op="has_work")
-
-    def pop_results(self):
-        if not self.alive():
-            return {}
-        return {int(rid): _WireResult(d)
-                for rid, d in self._rpc(op="pop").items()}
-
-    def result(self, request_id):
-        if not self.alive():
-            return None
-        return self._rpc(op="result", id=int(request_id))
-
-    def first_token_seen(self, request_id):
-        r = self.result(request_id)
-        return bool(r and r["first_token"])
-
-    def client_request_id(self, client_key):
-        if not self.alive():
-            return None
-        return self._rpc(op="ck", key=client_key)
-
-    def estimate_ttft(self, prompt_len):
-        if not self.alive():
-            return None
-        return self._rpc(op="health", len=prompt_len)["est"]
-
-    def kv_affinity(self, prompt, session_id=None):
-        if not self.paged or not self.alive():
-            return 0
-        return int(self._rpc(op="affinity", prompt=[int(t) for t in prompt],
-                             session_id=session_id))
-
-    # -- live migration surface (docs/serving.md §Elastic fleet) ------------
-    def export_sessions(self, dest_dir):
-        return self._rpc(op="export", dir=dest_dir)
-
-    def import_sessions(self, src_dir):
-        return self._rpc(op="import", dir=src_dir)
-
-    def sweep_sessions(self, now):
-        if not self.alive():
-            return 0
-        return self._rpc(op="sweep")
-
-    def kv_stats(self):
-        if not self.alive():
-            return {}
-        return self._rpc(op="kvstats")
-
-    def queue_depth(self):
-        if not self.alive():
-            return 0
-        return self._rpc(op="health")["depth"]
-
-    def degrade_level(self):
-        return 0  # health op is polled for placement; ladder rows n/a here
-
-    def draining(self):
-        return False
-
-    def close(self):
-        if self.alive():
-            try:
-                self._rpc(op="exit")
-            except Exception:
-                pass
-            self.proc.wait(timeout=30)
+    argv = [sys.executable, os.path.abspath(__file__), "--role", "worker",
+            "--journal", journal_dir, "--dryrun"]
+    if paged:
+        argv.append("--paged")
+    if spill_dir:
+        argv += ["--spill", spill_dir]
+    rep = TransportReplica(name, ProcessTransport(name, argv,
+                                                  fault_plan=fault_plan))
+    rep.journal_dir = journal_dir
+    rep.paged = paged
+    rep.spill_dir = spill_dir
+    return rep
 
 
 # ---------------------------------------------------------------------------

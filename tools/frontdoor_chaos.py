@@ -1,0 +1,383 @@
+"""Front-door chaos smoke: a REAL HTTP server process streaming token
+chunks, SIGKILL'd mid-stream, restarted over the same journal — the
+client's re-POST with the same ``client_key`` must resume the SAME
+request id (at-most-once admission over the HTTP boundary) and the
+full replayed stream must extend the pre-crash prefix bit-identically
+to an uninterrupted solo ``generate()``.  Then the SIGTERM leg: a
+drain signal mid-stream must finish streaming the in-flight request,
+answer new submits 503 + ``Retry-After``, and exit 43 only after the
+journal commit (the ``frontdoor`` CI job; docs/serving.md
+§Front-door).
+
+    python tools/frontdoor_chaos.py --dryrun
+
+Phases:
+
+1. warmup + throttle probe — a blocking request compiles the engine;
+   a starved tenant's POST must answer 429 with a ``Retry-After``
+   header and ``"type": "TenantThrottled"`` in the body.
+2. kill -9 mid-stream — the server carries a seeded ``DS_FAULT_PLAN``
+   (``frontdoor.stream`` sigkill): the chunked response dies without
+   its terminating chunk (rc == -9), the parent keeps the observed
+   token prefix.
+3. recover + resume — a fresh server over the SAME journal replays;
+   re-POSTing the same ``client_key`` returns the ORIGINAL request id
+   and streams the full output; asserted prefix-consistent and
+   bit-identical to solo ``generate()``.
+4. SIGTERM drain — a new stream is cut by SIGTERM after its first
+   chunk: the stream must still complete (terminating chunk arrives),
+   a probe POST during the drain answers 503 + ``Retry-After``, and
+   the server exits 43.
+5. accounting — ``journal_tenant_totals`` over the shared journal must
+   show exactly one admission per client key and per-tenant billed
+   tokens equal to the client-observed stream lengths (no double-bill
+   across the crash, no loss).
+"""
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+if "--dryrun" in sys.argv or os.environ.get("JAX_PLATFORMS") is None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MAX_NEW = 24
+DRAIN_MAX_NEW = 48
+KILL_AFTER_CHUNKS = 2
+
+
+def log(msg):
+    print(f"[frontdoor_chaos] {msg}", file=sys.stderr, flush=True)
+
+
+def make_engine(journal_dir):
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt2
+    from deepspeed_tpu.serving import ServingEngine
+
+    cfg = dataclasses.replace(gpt2.GPT2_TINY, remat=False)
+    params = gpt2.init_params(cfg, seed=7)
+    params["wpe"] = params["wpe"] * 40.0
+    eng = deepspeed_tpu.init_inference(
+        model_config=cfg, params=params, dtype=jnp.float32,
+        max_out_tokens=cfg.n_positions,
+    )
+    srv = ServingEngine(
+        eng, num_slots=2, prefill_chunk=8, max_len=64,
+        journal_dir=journal_dir,
+        tenants={
+            "enabled": True,
+            # unlimited default bucket (rate 0 + burst 0); one tenant
+            # starved to a 1-token burst for the 429 probe
+            "overrides": {
+                "starved": {"refill_tokens_per_second": 0.001,
+                            "burst_tokens": 1.0},
+            },
+        },
+    )
+    return cfg, eng, srv
+
+
+# ---------------------------------------------------------------------------
+# server child
+# ---------------------------------------------------------------------------
+
+def run_server(journal_dir, port_file):
+    from deepspeed_tpu.resilience import faults
+
+    faults.install_from_env(rank=0)
+
+    from deepspeed_tpu.serving.frontdoor.http import FrontDoor
+
+    _, _, srv = make_engine(journal_dir)
+    replayed = srv.recover()
+    if replayed:
+        log(f"server: replayed {len(replayed)} request(s): {replayed}")
+    srv.install_watchdog()
+    fd = FrontDoor(srv, host="127.0.0.1", port=0)
+    fd._bind()
+    tmp = port_file + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(str(fd.port))
+    os.rename(tmp, port_file)  # atomic: the parent never reads a torn port
+    fd._pump()  # main thread: the watchdog's SystemExit(43) unwinds here
+
+
+# ---------------------------------------------------------------------------
+# parent-side HTTP client
+# ---------------------------------------------------------------------------
+
+def wait_port(port_file, proc, timeout=300):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(port_file):
+            with open(port_file) as f:
+                return int(f.read())
+        if proc.poll() is not None:
+            raise RuntimeError(f"server died during boot rc={proc.poll()}")
+        time.sleep(0.1)
+    raise RuntimeError("server never published its port")
+
+
+def post(port, body, timeout=300):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("POST", "/v1/generate", json.dumps(body),
+                 {"Content-Type": "application/json"})
+    return conn, conn.getresponse()
+
+
+def read_stream(resp):
+    """Read JSON lines off a chunked response until the terminating
+    chunk, EOF, or a torn connection.  Returns (tokens, request_id,
+    done) — ``done`` False means the stream was cut mid-flight."""
+    tokens, rid, done = [], None, False
+    try:
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            rec = json.loads(line)
+            if "request_id" in rec:
+                rid = rec["request_id"]
+            if "tokens" in rec:
+                tokens.extend(rec["tokens"])
+            if rec.get("done"):
+                done = True
+                break
+    except (http.client.IncompleteRead, http.client.HTTPException,
+            ConnectionResetError, OSError, json.JSONDecodeError):
+        pass
+    return tokens, rid, done
+
+
+def spawn_server(journal_dir, port_file, fault_plan=None):
+    env = dict(os.environ)
+    env.pop("DS_FAULT_PLAN", None)
+    if fault_plan is not None:
+        env["DS_FAULT_PLAN"] = fault_plan
+    if os.path.exists(port_file):
+        os.unlink(port_file)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--role", "server",
+         "--journal", journal_dir, "--port-file", port_file, "--dryrun"],
+        env=env,
+    )
+    return proc, wait_port(port_file, proc)
+
+
+# ---------------------------------------------------------------------------
+# the proof
+# ---------------------------------------------------------------------------
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dryrun", action="store_true", help="tiny model on CPU")
+    ap.add_argument("--role", default=None, choices=(None, "server"))
+    ap.add_argument("--journal", default=None)
+    ap.add_argument("--port-file", default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    if args.role == "server":
+        run_server(args.journal, args.port_file)
+        return
+
+    import numpy as np
+
+    from deepspeed_tpu.resilience.faults import plan_json
+    from deepspeed_tpu.serving.frontdoor.tenants import journal_tenant_totals
+
+    t0 = time.monotonic()
+    rng = np.random.default_rng(args.seed)
+    with tempfile.TemporaryDirectory(prefix="frontdoor_chaos_") as root:
+        journal = os.path.join(root, "journal")
+        port_file = os.path.join(root, "port")
+
+        # the deterministic-serving bar: solo generate() of each prompt
+        cfg, eng, _ = make_engine(os.path.join(root, "ref-journal"))
+        warm_p = rng.integers(1, cfg.vocab_size, 6, dtype=np.int32)
+        kill_p = rng.integers(1, cfg.vocab_size, 8, dtype=np.int32)
+        drain_p = rng.integers(1, cfg.vocab_size, 8, dtype=np.int32)
+        expect_kill = [int(t) for t in np.asarray(
+            eng.generate(kill_p[None, :], max_new_tokens=MAX_NEW)
+        )[0]][len(kill_p):]
+        expect_drain = [int(t) for t in np.asarray(
+            eng.generate(drain_p[None, :], max_new_tokens=DRAIN_MAX_NEW)
+        )[0]][len(drain_p):]
+
+        # ---- phase 1+2: armed server; warmup, throttle probe, kill -9
+        plan = plan_json([
+            {"site": "frontdoor.stream", "action": "sigkill",
+             "after": KILL_AFTER_CHUNKS},
+        ])
+        proc, port = spawn_server(journal, port_file, fault_plan=plan)
+        conn, resp = post(port, {
+            "prompt": [int(t) for t in warm_p], "max_new_tokens": 4,
+            "tenant": "warm", "client_key": "fd-warm",
+        })
+        warm_out = json.loads(resp.read())
+        conn.close()
+        if warm_out.get("finish_reason") not in ("eos", "length"):
+            log(f"warmup failed: {warm_out}")
+            sys.exit(1)
+        warm_tokens = len(warm_out["tokens"])
+
+        conn, resp = post(port, {
+            "prompt": [int(t) for t in warm_p], "max_new_tokens": 4,
+            "tenant": "starved",
+        })
+        throttle_body = json.loads(resp.read())
+        throttle_status = resp.status
+        throttle_ra = resp.getheader("Retry-After")
+        conn.close()
+        if (throttle_status != 429 or throttle_ra is None
+                or throttle_body.get("type") != "TenantThrottled"):
+            log(f"starved tenant probe: want 429 + Retry-After + "
+                f"TenantThrottled, got {throttle_status} ra={throttle_ra} "
+                f"{throttle_body}")
+            sys.exit(1)
+        log(f"starved tenant throttled: 429, Retry-After={throttle_ra}s")
+
+        conn, resp = post(port, {
+            "prompt": [int(t) for t in kill_p], "max_new_tokens": MAX_NEW,
+            "tenant": "acme", "client_key": "fd-kill", "stream": True,
+        })
+        prefix, rid1, done = read_stream(resp)
+        conn.close()
+        rc1 = proc.wait(timeout=60)
+        if done or rc1 != -signal.SIGKILL:
+            log(f"kill -9 leg: stream done={done} rc={rc1}, expected a cut "
+                f"stream and rc={-signal.SIGKILL}")
+            sys.exit(1)
+        log(f"server SIGKILL'd mid-stream (rc={rc1}) after "
+            f"{len(prefix)} observed token(s), request id {rid1}")
+
+        # ---- phase 3: recover; same client_key -> same id, full stream
+        proc, port = spawn_server(journal, port_file)
+        conn, resp = post(port, {
+            "prompt": [int(t) for t in kill_p], "max_new_tokens": MAX_NEW,
+            "tenant": "acme", "client_key": "fd-kill", "stream": True,
+        })
+        full, rid2, done = read_stream(resp)
+        conn.close()
+        if not done:
+            log("post-recovery stream never finished")
+            sys.exit(1)
+        if rid2 != rid1:
+            log(f"at-most-once VIOLATED: request id {rid1} -> {rid2} across "
+                "the crash (client_key re-admitted)")
+            sys.exit(1)
+        if full[:len(prefix)] != prefix:
+            log(f"stream NOT prefix-consistent across recovery: "
+                f"observed {prefix}, replayed {full[:len(prefix)]}")
+            sys.exit(1)
+        if full != expect_kill:
+            log(f"replayed stream DIVERGED from solo generate(): "
+                f"{full} != {expect_kill}")
+            sys.exit(1)
+        log(f"recovery: same id {rid2}, {len(full)} token(s) streamed, "
+            "prefix-consistent + bit-identical to solo")
+
+        # ---- phase 4: SIGTERM mid-stream -> drain, 503, exit 43
+        conn, resp = post(port, {
+            "prompt": [int(t) for t in drain_p],
+            "max_new_tokens": DRAIN_MAX_NEW,
+            "tenant": "acme2", "client_key": "fd-drain", "stream": True,
+        })
+        # SIGTERM must land while the request is genuinely IN-FLIGHT
+        # (slot-resident): a merely-queued request does not drain — it
+        # replays from the journal.  Read past the request_id chunk
+        # until the first token delta proves admission.
+        pre = []
+        while not pre:
+            rec = json.loads(resp.readline())
+            if "tokens" in rec:
+                pre.extend(rec["tokens"])
+        os.kill(proc.pid, signal.SIGTERM)
+        probe_status, probe_ra, probe_type = None, None, None
+        try:
+            c2, r2 = post(port, {
+                "prompt": [int(t) for t in warm_p], "max_new_tokens": 4,
+                "tenant": "warm",
+            }, timeout=30)
+            probe_status = r2.status
+            probe_ra = r2.getheader("Retry-After")
+            probe_type = json.loads(r2.read()).get("type")
+            c2.close()
+        except OSError as e:
+            log(f"drain probe connection failed ({e!r}) — drain won the race")
+        tail, _, done = read_stream(resp)
+        drained = pre + tail
+        conn.close()
+        rc2 = proc.wait(timeout=120)
+        if not done:
+            log("SIGTERM cut the in-flight stream — drain must stream it out")
+            sys.exit(1)
+        if drained != expect_drain:
+            log(f"drained stream DIVERGED: {drained} != {expect_drain}")
+            sys.exit(1)
+        if rc2 != 43:
+            log(f"server exit rc={rc2}, expected 43 (journal-committed drain)")
+            sys.exit(1)
+        if probe_status is not None and (
+                probe_status != 503 or probe_ra is None
+                or probe_type != "ServingDraining"):
+            log(f"drain probe: want 503 + Retry-After + ServingDraining, got "
+                f"{probe_status} ra={probe_ra} type={probe_type}")
+            sys.exit(1)
+        log(f"SIGTERM: in-flight stream completed ({len(drained)} tokens), "
+            f"probe={'503' if probe_status else 'n/a'}, exit rc=43")
+
+        # ---- phase 5: per-tenant accounting reconciles with the journal
+        totals = journal_tenant_totals(journal)
+        observed = {
+            "warm": warm_tokens,
+            "acme": len(full),
+            "acme2": len(drained),
+        }
+        for tn, n in observed.items():
+            row = totals.get(tn)
+            if row is None or row["admitted"] != 1:
+                log(f"tenant {tn}: want exactly 1 admission, got {row}")
+                sys.exit(1)
+            if row["billed_tokens"] != n:
+                log(f"tenant {tn}: journal billed {row['billed_tokens']} "
+                    f"token(s), client observed {n} — accounting broke")
+                sys.exit(1)
+        log(f"accounting reconciled: {observed} billed exactly once each")
+
+    record = {
+        "metric": "frontdoor_chaos_kill9_stream_resume",
+        "value": len(full),
+        "unit": "tokens_streamed_bit_identical",
+        "observed_prefix": len(prefix),
+        "victim_rc": rc1,
+        "drain_rc": rc2,
+        "throttle_status": throttle_status,
+        "drain_probe_status": probe_status,
+        "tenants_reconciled": len(observed),
+        "wall_s": round(time.monotonic() - t0, 1),
+    }
+    print(json.dumps(record), flush=True)
+    log(
+        f"OK: kill -9 mid-stream -> same-id resume, bit-identical "
+        f"continuation; SIGTERM -> drained stream + 503 + exit 43; "
+        f"{len(observed)} tenants reconciled ({record['wall_s']}s)"
+    )
+
+
+if __name__ == "__main__":
+    main()
